@@ -1,0 +1,20 @@
+"""kubernetes_tpu — a TPU-native control plane + batched TPU scheduler.
+
+A from-scratch framework with the capabilities of Kubernetes (reference:
+bart0sh/kubernetes @ ~v1.36-dev), re-designed TPU-first:
+
+- ``api``       typed object core (Pod/Node/PodGroup/...), quantities, selectors
+                (reference: staging/src/k8s.io/api + apimachinery)
+- ``store``     versioned ordered KV + watch bus (reference: etcd + apiserver storage)
+- ``client``    reflector/informer/workqueue equivalents (reference: client-go)
+- ``scheduler`` cache/snapshot, 3-tier queue, framework runtime, plugins
+                (reference: pkg/scheduler)
+- ``ops``       dense pods x nodes feasibility/score kernels (JAX/Pallas) — the
+                TPU-native replacement for framework/parallelize goroutine fan-out
+- ``parallel``  device mesh + shard_map collectives (nodes axis over ICI)
+- ``models``    the TPU scheduling backend: tensorized snapshots + batched
+                multi-pod assignment ("the flagship model")
+- ``utils``     metrics, clock, logging, feature gates
+"""
+
+__version__ = "0.1.0"

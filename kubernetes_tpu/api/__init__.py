@@ -1,0 +1,57 @@
+"""Typed object core: the subset of the Kubernetes API surface the framework uses.
+
+Reference: staging/src/k8s.io/api/core/v1/types.go and
+staging/src/k8s.io/apimachinery. Quantities are canonicalized to integer "plane
+units" (CPU millicores, memory/storage MiB) at parse time so that host-path and
+TPU-path arithmetic is bit-identical by construction.
+"""
+
+from .quantity import parse_quantity, parse_cpu, parse_mem_mib  # noqa: F401
+from .resource import (  # noqa: F401
+    ResourceNames,
+    ResourceVec,
+    CPU,
+    MEM,
+    EPHEMERAL,
+    PODS,
+    NUM_BASE_RESOURCES,
+)
+from .meta import ObjectMeta  # noqa: F401
+from .labels import (  # noqa: F401
+    Requirement,
+    LabelSelector,
+    matches_selector,
+    format_labels,
+)
+from .types import (  # noqa: F401
+    Container,
+    ContainerPort,
+    Pod,
+    PodSpec,
+    PodStatus,
+    PodCondition,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ContainerImage,
+    Taint,
+    Toleration,
+    Affinity,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorTerm,
+    NodeSelectorRequirement,
+    PreferredSchedulingTerm,
+    PodAffinity,
+    PodAntiAffinity,
+    PodAffinityTerm,
+    WeightedPodAffinityTerm,
+    TopologySpreadConstraint,
+    SchedulingGroup,
+    PodGroup,
+    PodGroupSpec,
+    PodGroupStatus,
+    GangPolicy,
+    TopologyConstraint,
+    Binding,
+)

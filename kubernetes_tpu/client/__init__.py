@@ -1,0 +1,10 @@
+"""Client runtime: informers, workqueue, leader election.
+
+Reference: staging/src/k8s.io/client-go — tools/cache (Reflector, DeltaFIFO,
+SharedIndexInformer), util/workqueue, tools/leaderelection. In-process against
+the Store, so the reflector is a thin list+watch pump; semantics preserved:
+handlers observe a gap-free Add/Update/Delete stream and a local indexed cache.
+"""
+
+from .informer import SharedInformer, InformerFactory  # noqa: F401
+from .workqueue import WorkQueue  # noqa: F401

@@ -1,0 +1,140 @@
+"""Shared informers over the Store.
+
+Reference: client-go tools/cache — Reflector (reflector.go) feeds DeltaFIFO
+(delta_fifo.go) feeds SharedIndexInformer (shared_informer.go) which fans out
+to event handlers and maintains a thread-safe store. Here the Store's watch
+log already provides a gap-free ordered stream, so the informer reduces to:
+list (sync local cache, emit Adds) + watch (pump events to handlers).
+
+Determinism: `pump()` drains available events synchronously — tests and the
+single-threaded scheduler loop call it at well-defined points instead of
+racing a background goroutine. `run_background()` gives the threaded mode.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+from ..store.store import Store, ADDED, MODIFIED, DELETED
+
+Handler = Callable[[str, Any, Any], None]  # (event_type, old_obj, new_obj)
+
+
+class SharedInformer:
+    def __init__(self, store: Store, kind: str):
+        self._store = store
+        self.kind = kind
+        self._cache: dict[str, Any] = {}
+        self._handlers: list[Handler] = []
+        self._watch = None
+        self._synced = False
+        self._mu = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def add_handler(self, handler: Handler) -> None:
+        """Register a handler. If already synced, replays Adds for the current
+        cache contents (client-go AddEventHandler semantics)."""
+        self._handlers.append(handler)
+        if self._synced:
+            for obj in list(self._cache.values()):
+                handler(ADDED, None, obj)
+
+    def start(self) -> None:
+        """List + open watch. Emits ADDED for the initial list."""
+        objs, rev = self._store.list(self.kind)
+        self._watch = self._store.watch(self.kind, from_revision=rev)
+        for obj in objs:
+            self._cache[obj.meta.key] = obj
+            for h in self._handlers:
+                h(ADDED, None, obj)
+        self._synced = True
+
+    def has_synced(self) -> bool:
+        return self._synced
+
+    def pump(self) -> int:
+        """Drain all currently queued watch events; returns count processed."""
+        if self._watch is None:
+            return 0
+        n = 0
+        for ev in self._watch.drain():
+            self._dispatch(ev)
+            n += 1
+        return n
+
+    def _dispatch(self, ev) -> None:
+        key = ev.obj.meta.key
+        if ev.type == DELETED:
+            old = self._cache.pop(key, None)
+            for h in self._handlers:
+                h(DELETED, old if old is not None else ev.obj, ev.obj)
+        elif key in self._cache:
+            old = self._cache[key]
+            self._cache[key] = ev.obj
+            for h in self._handlers:
+                h(MODIFIED, old, ev.obj)
+        else:
+            self._cache[key] = ev.obj
+            for h in self._handlers:
+                h(ADDED, None, ev.obj)
+
+    def run_background(self, poll_interval: float = 0.002) -> None:
+        """Threaded pump, for components that want push-style delivery."""
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.is_set():
+                ev = self._watch.next(timeout=poll_interval)
+                if ev is not None:
+                    with self._mu:
+                        self._dispatch(ev)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=1)
+            self._thread = None
+
+    # local read interface (client-go Lister)
+    def get(self, key: str) -> Any | None:
+        return self._cache.get(key)
+
+    def list(self) -> list[Any]:
+        return list(self._cache.values())
+
+    def keys(self) -> list[str]:
+        return list(self._cache.keys())
+
+
+class InformerFactory:
+    """SharedInformerFactory: one informer per kind, shared across components."""
+
+    def __init__(self, store: Store):
+        self._store = store
+        self._informers: dict[str, SharedInformer] = {}
+
+    def informer(self, kind: str) -> SharedInformer:
+        inf = self._informers.get(kind)
+        if inf is None:
+            inf = SharedInformer(self._store, kind)
+            self._informers[kind] = inf
+        return inf
+
+    def start_all(self) -> None:
+        for inf in self._informers.values():
+            if not inf.has_synced():
+                inf.start()
+
+    def pump_all(self) -> int:
+        return sum(inf.pump() for inf in self._informers.values())
+
+    def wait_for_cache_sync(self) -> bool:
+        return all(inf.has_synced() for inf in self._informers.values())

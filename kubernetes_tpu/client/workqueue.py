@@ -1,0 +1,105 @@
+"""Rate-limited work queue for controllers.
+
+Reference: client-go util/workqueue — dedup while queued, per-item exponential
+backoff on retry (rate_limiting_queue.go). Used by the controller layer;
+the scheduler has its own richer 3-tier queue.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Hashable
+
+
+class WorkQueue:
+    def __init__(
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 1000.0,
+        clock=time.monotonic,
+    ):
+        self._mu = threading.Condition()
+        self._queue: list[Hashable] = []
+        self._dirty: set[Hashable] = set()
+        self._processing: set[Hashable] = set()
+        self._failures: dict[Hashable, int] = {}
+        self._delayed: list[tuple[float, int, Hashable]] = []
+        self._seq = 0
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._clock = clock
+        self._shutdown = False
+
+    def add(self, item: Hashable) -> None:
+        with self._mu:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._mu.notify()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        with self._mu:
+            self._seq += 1
+            heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
+            self._mu.notify()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        with self._mu:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        self.add_after(item, min(self._base_delay * (2**n), self._max_delay))
+
+    def forget(self, item: Hashable) -> None:
+        with self._mu:
+            self._failures.pop(item, None)
+
+    def _flush_delayed_locked(self) -> None:
+        now = self._clock()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item not in self._dirty:
+                self._dirty.add(item)
+                if item not in self._processing:
+                    self._queue.append(item)
+
+    def get(self, timeout: float | None = None) -> Hashable | None:
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._mu:
+            while True:
+                self._flush_delayed_locked()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._dirty.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown:
+                    return None
+                wait = None
+                if self._delayed:
+                    wait = max(0.0, self._delayed[0][0] - self._clock())
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._mu.wait(wait if wait is not None else 0.05)
+
+    def done(self, item: Hashable) -> None:
+        with self._mu:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._mu.notify()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._queue)
+
+    def shutdown(self) -> None:
+        with self._mu:
+            self._shutdown = True
+            self._mu.notify_all()

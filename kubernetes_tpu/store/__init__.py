@@ -1,0 +1,9 @@
+"""Versioned state store + watch bus — the etcd/apiserver-storage equivalent.
+
+Reference: staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go (CRUD with
+revisions), watcher (event.go), and the watch cache. Single-process and
+in-memory: all cluster state lives here; every other component is a stateless
+watcher that converges on it (crash-only design, SURVEY §5.3/§5.4).
+"""
+
+from .store import Store, Event, ADDED, MODIFIED, DELETED, ConflictError, NotFoundError, AlreadyExistsError  # noqa: F401

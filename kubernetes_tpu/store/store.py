@@ -1,0 +1,234 @@
+"""In-process versioned store with watch streams.
+
+Reference behavior modeled:
+- etcd3 store (staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go):
+  monotonically increasing cluster-wide revision; every write bumps it and
+  stamps the object's resource_version.
+- optimistic concurrency: update with a stale resource_version fails with
+  ConflictError (apiserver 409).
+- watch (etcd3 watcher + apiserver watch cache): per-(kind) event log with
+  list+watch-from-revision semantics so reflectors never miss events.
+
+TPU-first notes: the store is the *control-plane* contract and stays host-side
+(SURVEY §2.9 — "the API surface stays host-side"); kernels see only the cache's
+tensorized snapshots. Thread-safe via one mutex; watch delivery is synchronous
+fan-out into per-watcher deques drained by consumer threads or polls.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator
+
+from ..api.meta import new_uid
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class ConflictError(Exception):
+    pass
+
+
+class NotFoundError(Exception):
+    pass
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+@dataclass
+class Event:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: Any
+    revision: int
+
+
+class Watch:
+    """A single watch stream: a deque of events + condition variable.
+
+    Equivalent to a client-go watch.Interface; `stop()` is idempotent.
+    """
+
+    def __init__(self, store: "Store", kind: str):
+        self._store = store
+        self._kind = kind
+        self._events: list[Event] = []
+        self._cond = threading.Condition()
+        self._stopped = False
+
+    def _push(self, ev: Event) -> None:
+        with self._cond:
+            if self._stopped:
+                return
+            self._events.append(ev)
+            self._cond.notify_all()
+
+    def next(self, timeout: float | None = None) -> Event | None:
+        with self._cond:
+            if not self._events:
+                self._cond.wait(timeout)
+            if self._events:
+                return self._events.pop(0)
+            return None
+
+    def drain(self) -> list[Event]:
+        """Non-blocking: take all queued events."""
+        with self._cond:
+            evs, self._events = self._events, []
+            return evs
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._events.clear()
+            self._cond.notify_all()
+        self._store._remove_watch(self._kind, self)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+
+class Store:
+    """Ordered, versioned object store for all kinds."""
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._mu = threading.RLock()
+        self._revision = 0
+        self._objects: dict[str, dict[str, Any]] = {}  # kind -> key -> obj
+        self._log: dict[str, list[Event]] = {}  # kind -> event log (watch cache)
+        self._watches: dict[str, list[Watch]] = {}
+        self._clock = clock
+        self._log_cap = 100_000  # bounded watch cache; older events compacted
+
+    # -- helpers -----------------------------------------------------------
+
+    def _bump(self) -> int:
+        self._revision += 1
+        return self._revision
+
+    def _kind_of(self, obj: Any) -> str:
+        return obj.kind
+
+    def _emit(self, kind: str, ev: Event) -> None:
+        log = self._log.setdefault(kind, [])
+        log.append(ev)
+        if len(log) > self._log_cap:
+            del log[: self._log_cap // 2]
+        for w in self._watches.get(kind, []):
+            w._push(ev)
+
+    def _remove_watch(self, kind: str, w: Watch) -> None:
+        with self._mu:
+            ws = self._watches.get(kind)
+            if ws and w in ws:
+                ws.remove(w)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        with self._mu:
+            kind = self._kind_of(obj)
+            objs = self._objects.setdefault(kind, {})
+            key = obj.meta.key
+            if key in objs:
+                raise AlreadyExistsError(f"{kind} {key}")
+            obj = copy.deepcopy(obj)
+            if not obj.meta.uid:
+                obj.meta.uid = new_uid()
+            if not obj.meta.creation_timestamp:
+                obj.meta.creation_timestamp = self._clock()
+            rev = self._bump()
+            obj.meta.resource_version = rev
+            objs[key] = obj
+            self._emit(kind, Event(ADDED, copy.deepcopy(obj), rev))
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, key: str) -> Any:
+        with self._mu:
+            obj = self._objects.get(kind, {}).get(key)
+            if obj is None:
+                raise NotFoundError(f"{kind} {key}")
+            return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, key: str) -> Any | None:
+        with self._mu:
+            obj = self._objects.get(kind, {}).get(key)
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def update(self, obj: Any, *, check_version: bool = True) -> Any:
+        """Optimistic-concurrency update; stamps a fresh resource_version."""
+        with self._mu:
+            kind = self._kind_of(obj)
+            objs = self._objects.setdefault(kind, {})
+            key = obj.meta.key
+            cur = objs.get(key)
+            if cur is None:
+                raise NotFoundError(f"{kind} {key}")
+            if check_version and obj.meta.resource_version != cur.meta.resource_version:
+                raise ConflictError(
+                    f"{kind} {key}: rv {obj.meta.resource_version} != {cur.meta.resource_version}"
+                )
+            obj = copy.deepcopy(obj)
+            obj.meta.uid = cur.meta.uid
+            obj.meta.creation_timestamp = cur.meta.creation_timestamp
+            rev = self._bump()
+            obj.meta.resource_version = rev
+            objs[key] = obj
+            self._emit(kind, Event(MODIFIED, copy.deepcopy(obj), rev))
+            return copy.deepcopy(obj)
+
+    def delete(self, kind: str, key: str) -> Any:
+        with self._mu:
+            objs = self._objects.get(kind, {})
+            cur = objs.pop(key, None)
+            if cur is None:
+                raise NotFoundError(f"{kind} {key}")
+            rev = self._bump()
+            cur.meta.resource_version = rev
+            self._emit(kind, Event(DELETED, copy.deepcopy(cur), rev))
+            return cur
+
+    def list(self, kind: str) -> tuple[list[Any], int]:
+        """Returns (objects, revision) — the revision to start a watch from."""
+        with self._mu:
+            objs = [copy.deepcopy(o) for o in self._objects.get(kind, {}).values()]
+            return objs, self._revision
+
+    @property
+    def revision(self) -> int:
+        with self._mu:
+            return self._revision
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kind: str, from_revision: int = 0) -> Watch:
+        """Open a watch; replays logged events with revision > from_revision.
+
+        list() + watch(rev) gives the reflector's gap-free ListAndWatch.
+        """
+        with self._mu:
+            w = Watch(self, kind)
+            for ev in self._log.get(kind, []):
+                if ev.revision > from_revision:
+                    w._push(ev)
+            self._watches.setdefault(kind, []).append(w)
+            return w
+
+    # -- convenience typed helpers ----------------------------------------
+
+    def pods(self) -> list[Any]:
+        return self.list("Pod")[0]
+
+    def nodes(self) -> list[Any]:
+        return self.list("Node")[0]
+
+    def iter_kind(self, kind: str) -> Iterator[Any]:
+        objs, _ = self.list(kind)
+        return iter(objs)

@@ -1,0 +1,176 @@
+"""Tests for the typed object core: quantities, labels, selectors, taints."""
+
+from fractions import Fraction
+
+import pytest
+
+from kubernetes_tpu.api import (
+    CPU,
+    MEM,
+    PODS,
+    LabelSelector,
+    Requirement,
+    ResourceNames,
+    ResourceVec,
+    Taint,
+    Toleration,
+    parse_cpu,
+    parse_mem_mib,
+    parse_quantity,
+)
+from kubernetes_tpu.api.resource import nonzero_request_vec, pod_request_vec
+from kubernetes_tpu.api.types import (
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+)
+from tests.wrappers import make_pod
+
+
+class TestQuantity:
+    def test_plain(self):
+        assert parse_quantity("2") == 2
+        assert parse_quantity(5) == 5
+
+    def test_milli_cpu(self):
+        assert parse_cpu("100m") == 100
+        assert parse_cpu("2") == 2000
+        assert parse_cpu("1.5") == 1500
+        assert parse_cpu("0.1") == 100
+
+    def test_mem(self):
+        assert parse_mem_mib("1Gi") == 1024
+        assert parse_mem_mib("500Mi") == 500
+        assert parse_mem_mib("100M") == 96  # ceil(95.37)
+        assert parse_mem_mib("100M", floor=True) == 95
+        assert parse_mem_mib("1Ti") == 1024 * 1024
+
+    def test_suffixes(self):
+        assert parse_quantity("1Ki") == 1024
+        assert parse_quantity("1k") == 1000
+        assert parse_quantity("1.5Gi") == Fraction(3, 2) * 2**30
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+        with pytest.raises(ValueError):
+            parse_quantity("1Qx")
+
+
+class TestResourceVec:
+    def test_from_map(self):
+        names = ResourceNames()
+        r = ResourceVec.from_map({"cpu": "500m", "memory": "1Gi"}, names)
+        assert r[CPU] == 500
+        assert r[MEM] == 1024
+
+    def test_extended_resource(self):
+        names = ResourceNames()
+        r = ResourceVec.from_map({"cpu": "1", "example.com/gpu": "2"}, names)
+        gpu = names.index_of("example.com/gpu")
+        assert r[gpu] == 2
+        assert names.width == 5
+
+    def test_add_sub(self):
+        names = ResourceNames()
+        a = ResourceVec.from_map({"cpu": "1", "memory": "1Gi"}, names)
+        b = ResourceVec.from_map({"cpu": "500m", "memory": "512Mi"}, names)
+        a.add(b)
+        assert a[CPU] == 1500 and a[MEM] == 1536
+        a.sub(b)
+        assert a[CPU] == 1000 and a[MEM] == 1024
+
+    def test_pod_request(self):
+        names = ResourceNames()
+        pod = make_pod("p", cpu="100m", mem="200Mi")
+        req = pod_request_vec(pod, names)
+        assert req[CPU] == 100 and req[MEM] == 200 and req[PODS] == 1
+
+    def test_init_container_max(self):
+        names = ResourceNames()
+        pod = make_pod("p", cpu="100m", mem="200Mi")
+        from kubernetes_tpu.api.types import Container
+
+        pod.spec.init_containers = [Container(requests={"cpu": "1", "memory": "50Mi"})]
+        req = pod_request_vec(pod, names)
+        assert req[CPU] == 1000  # init dominates cpu
+        assert req[MEM] == 200  # main dominates mem
+
+    def test_nonzero_defaults(self):
+        names = ResourceNames()
+        pod = make_pod("p")  # no requests
+        req = pod_request_vec(pod, names)
+        nz = nonzero_request_vec(req)
+        assert req[CPU] == 0 and nz[CPU] == 100
+        assert req[MEM] == 0 and nz[MEM] == 191
+
+
+class TestSelectors:
+    def test_match_labels(self):
+        sel = LabelSelector.of({"app": "web"})
+        assert sel.matches({"app": "web", "x": "y"})
+        assert not sel.matches({"app": "db"})
+
+    def test_expressions(self):
+        sel = LabelSelector.of(
+            match_expressions=[
+                Requirement("tier", "In", ("frontend", "backend")),
+                Requirement("canary", "DoesNotExist"),
+            ]
+        )
+        assert sel.matches({"tier": "frontend"})
+        assert not sel.matches({"tier": "cache"})
+        assert not sel.matches({"tier": "frontend", "canary": "true"})
+
+    def test_not_in_requires_key(self):
+        # meta/v1 semantics: NotIn requires key presence
+        sel = LabelSelector.of(match_expressions=[Requirement("a", "NotIn", ("x",))])
+        assert not sel.matches({})
+        assert sel.matches({"a": "y"})
+
+    def test_empty_matches_all(self):
+        assert LabelSelector.of().matches({"anything": "yes"})
+
+    def test_canonical_stable(self):
+        s1 = LabelSelector.of({"b": "2", "a": "1"})
+        s2 = LabelSelector.of({"a": "1", "b": "2"})
+        assert s1.canonical() == s2.canonical()
+
+    def test_node_selector_or_of_ands(self):
+        ns = NodeSelector(
+            terms=(
+                NodeSelectorTerm(
+                    match_expressions=(NodeSelectorRequirement("zone", "In", ("a",)),)
+                ),
+                NodeSelectorTerm(
+                    match_expressions=(NodeSelectorRequirement("zone", "In", ("b",)),)
+                ),
+            )
+        )
+        assert ns.matches({"zone": "a"}, {})
+        assert ns.matches({"zone": "b"}, {})
+        assert not ns.matches({"zone": "c"}, {})
+        assert not NodeSelector().matches({"zone": "a"}, {})  # empty matches nothing
+
+    def test_gt_lt(self):
+        r = NodeSelectorRequirement("cores", "Gt", ("4",))
+        assert r.matches({"cores": "8"})
+        assert not r.matches({"cores": "2"})
+        assert not r.matches({})
+
+
+class TestTaints:
+    def test_equal(self):
+        t = Taint("k", "v", "NoSchedule")
+        assert Toleration(key="k", operator="Equal", value="v").tolerates(t)
+        assert not Toleration(key="k", operator="Equal", value="w").tolerates(t)
+
+    def test_exists(self):
+        t = Taint("k", "v", "NoSchedule")
+        assert Toleration(key="k", operator="Exists").tolerates(t)
+        assert Toleration(key="", operator="Exists").tolerates(t)  # wildcard
+
+    def test_effect_filter(self):
+        t = Taint("k", "v", "NoExecute")
+        assert not Toleration(key="k", operator="Exists", effect="NoSchedule").tolerates(t)
+        assert Toleration(key="k", operator="Exists", effect="NoExecute").tolerates(t)

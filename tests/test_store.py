@@ -1,0 +1,128 @@
+"""Tests for the versioned store + watch bus and informer layer."""
+
+import pytest
+
+from kubernetes_tpu.client import InformerFactory
+from kubernetes_tpu.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+)
+from tests.wrappers import make_node, make_pod
+
+
+class TestStore:
+    def test_create_get(self):
+        s = Store()
+        p = s.create(make_pod("a"))
+        assert p.meta.uid and p.meta.resource_version == 1
+        got = s.get("Pod", "default/a")
+        assert got.meta.name == "a"
+
+    def test_create_duplicate(self):
+        s = Store()
+        s.create(make_pod("a"))
+        with pytest.raises(AlreadyExistsError):
+            s.create(make_pod("a"))
+
+    def test_update_conflict(self):
+        s = Store()
+        p = s.create(make_pod("a"))
+        p2 = s.get("Pod", "default/a")
+        p2.spec.node_name = "n1"
+        s.update(p2)
+        p.spec.node_name = "n2"
+        with pytest.raises(ConflictError):
+            s.update(p)  # stale rv
+
+    def test_delete(self):
+        s = Store()
+        s.create(make_pod("a"))
+        s.delete("Pod", "default/a")
+        with pytest.raises(NotFoundError):
+            s.get("Pod", "default/a")
+
+    def test_revision_monotonic(self):
+        s = Store()
+        revs = [s.create(make_pod(f"p{i}")).meta.resource_version for i in range(5)]
+        assert revs == sorted(revs) and len(set(revs)) == 5
+
+    def test_deep_copy_isolation(self):
+        s = Store()
+        p = s.create(make_pod("a"))
+        p.spec.node_name = "mutated"
+        assert s.get("Pod", "default/a").spec.node_name == ""
+
+    def test_watch_from_revision(self):
+        s = Store()
+        s.create(make_pod("a"))
+        _, rev = s.list("Pod")
+        s.create(make_pod("b"))
+        w = s.watch("Pod", from_revision=rev)
+        evs = w.drain()
+        assert len(evs) == 1 and evs[0].obj.meta.name == "b"
+
+    def test_watch_event_types(self):
+        s = Store()
+        w = s.watch("Pod")
+        p = s.create(make_pod("a"))
+        p.spec.node_name = "n1"
+        s.update(p)
+        s.delete("Pod", "default/a")
+        types = [e.type for e in w.drain()]
+        assert types == [ADDED, MODIFIED, DELETED]
+
+    def test_kinds_isolated(self):
+        s = Store()
+        s.create(make_pod("a"))
+        s.create(make_node("n1"))
+        assert len(s.pods()) == 1
+        assert len(s.nodes()) == 1
+
+
+class TestInformer:
+    def test_initial_sync_and_pump(self):
+        s = Store()
+        s.create(make_pod("a"))
+        f = InformerFactory(s)
+        inf = f.informer("Pod")
+        events = []
+        inf.add_handler(lambda t, old, new: events.append((t, new.meta.name)))
+        inf.start()
+        assert events == [(ADDED, "a")]
+        s.create(make_pod("b"))
+        p = s.get("Pod", "default/a")
+        p.spec.node_name = "n1"
+        s.update(p)
+        inf.pump()
+        assert (ADDED, "b") in events and (MODIFIED, "a") in events
+        assert inf.get("default/a").spec.node_name == "n1"
+        assert len(inf.list()) == 2
+
+    def test_handler_added_after_sync_replays(self):
+        s = Store()
+        s.create(make_pod("a"))
+        f = InformerFactory(s)
+        inf = f.informer("Pod")
+        inf.start()
+        events = []
+        inf.add_handler(lambda t, old, new: events.append((t, new.meta.name)))
+        assert events == [(ADDED, "a")]
+
+    def test_delete_pumps_old_object(self):
+        s = Store()
+        f = InformerFactory(s)
+        inf = f.informer("Pod")
+        inf.start()
+        s.create(make_pod("a"))
+        inf.pump()
+        seen = []
+        inf.add_handler(lambda t, old, new: seen.append(t) if t == DELETED else None)
+        s.delete("Pod", "default/a")
+        inf.pump()
+        assert seen == [DELETED]
+        assert inf.get("default/a") is None
