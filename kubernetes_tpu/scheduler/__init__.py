@@ -1,0 +1,6 @@
+"""The scheduler: cache, queue, framework runtime, plugins, cycles.
+
+Reference: pkg/scheduler/.
+"""
+
+from .scheduler import Scheduler, Profile, Handle  # noqa: F401

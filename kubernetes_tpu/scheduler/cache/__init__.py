@@ -1,0 +1,9 @@
+"""Scheduler cluster cache: live state, snapshots, node ordering, gang state.
+
+Reference: pkg/scheduler/backend/cache/.
+"""
+
+from .cache import Cache  # noqa: F401
+from .snapshot import Snapshot, Placement  # noqa: F401
+from .node_tree import NodeTree  # noqa: F401
+from .podgroup_state import PodGroupStates, PodGroupState  # noqa: F401

@@ -1,0 +1,19 @@
+"""In-tree plugins — the "ops library" (reference: pkg/scheduler/framework/plugins/)."""
+
+from .basics import (  # noqa: F401
+    DefaultBinder,
+    ImageLocality,
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    PrioritySort,
+    SchedulingGates,
+    TaintToleration,
+)
+from .interpod_affinity import InterPodAffinity  # noqa: F401
+from .node_affinity import NodeAffinity  # noqa: F401
+from .node_resources import BalancedAllocation, NodeResourcesFit  # noqa: F401
+from .pod_topology_spread import PodTopologySpread  # noqa: F401
+from .gang_scheduling import GangScheduling  # noqa: F401
+from .default_preemption import DefaultPreemption  # noqa: F401
+from .registry import DEFAULT_WEIGHTS, default_plugins  # noqa: F401

@@ -1,0 +1,138 @@
+"""DefaultPreemption: dry-run victim search + eviction.
+
+Reference: pkg/scheduler/framework/plugins/defaultpreemption/ (SelectVictimsOnNode
+:207 — remove lower-priority pods, re-run Filter, reprieve victims that fit
+back) driving the engine at pkg/scheduler/framework/preemption/preemption.go
+(DryRunPreemption:408, candidate ranking in SelectCandidate).
+"""
+
+from __future__ import annotations
+
+from ...api.resource import ResourceNames
+from ...api.types import Pod
+from ..framework import events as ev
+from ..framework.events import ClusterEvent, ClusterEventWithHint
+from ..framework.interface import (
+    UNSCHEDULABLE,
+    Plugin,
+    PostFilterResult,
+    Status,
+)
+from ..nodeinfo import NodeInfo, PodInfo
+
+
+class _Candidate:
+    __slots__ = ("node_name", "victims")
+
+    def __init__(self, node_name: str, victims: list[PodInfo]):
+        self.node_name = node_name
+        self.victims = victims
+
+
+class DefaultPreemption(Plugin):
+    name = "DefaultPreemption"
+
+    def __init__(self, names: ResourceNames, handle=None):
+        self.names = names
+        self.handle = handle
+
+    def set_handle(self, handle) -> None:
+        self.handle = handle
+
+    def events_to_register(self):
+        return [ClusterEventWithHint(ClusterEvent(ev.POD, ev.DELETE))]
+
+    # -- eligibility (preemption.go PodEligibleToPreemptOthers) --------------
+
+    def _eligible(self, pod: Pod) -> bool:
+        if pod.spec.preemption_policy == "Never":
+            return False
+        nominated = pod.status.nominated_node_name
+        if nominated and self.handle is not None:
+            # if a previous nomination exists and victims are still terminating,
+            # wait (preemption.go:169) — approximate via node existence check
+            ni = self.handle.snapshot.get(nominated) if self.handle.snapshot else None
+            if ni is not None and any(
+                p.pod.is_terminating and p.pod.spec.priority < pod.spec.priority
+                for p in ni.iter_pods()
+            ):
+                return False
+        return True
+
+    # -- victim search -------------------------------------------------------
+
+    def _select_victims_on_node(self, state, pod: Pod, node_info: NodeInfo):
+        """SelectVictimsOnNode (default_preemption.go:207): remove all lower-
+        priority pods, check fit, then reprieve as many as possible
+        (highest-priority victims first)."""
+        fw = self.handle.framework
+        ni = node_info.clone()
+        state = state.clone()
+        lower = sorted(
+            (pi for pi in ni.iter_pods() if pi.pod.spec.priority < pod.spec.priority),
+            key=lambda pi: (-pi.pod.spec.priority, pi.pod.meta.creation_timestamp),
+        )
+        if not lower:
+            return None
+        removed: list[PodInfo] = []
+        for pi in lower:
+            ni.remove_pod(pi.key)
+            fw.run_pre_filter_extension_remove_pod(state, pod, pi, ni)
+            removed.append(pi)
+        if not fw.run_filter_plugins(state, pod, ni).is_success:
+            return None  # even with all victims gone the pod doesn't fit
+        # reprieve: re-add highest-priority victims that still fit
+        victims: list[PodInfo] = []
+        for pi in removed:  # removed is sorted high->low priority
+            ni.add_pod(pi)
+            fw.run_pre_filter_extension_add_pod(state, pod, pi, ni)
+            if not fw.run_filter_plugins(state, pod, ni).is_success:
+                ni.remove_pod(pi.key)
+                fw.run_pre_filter_extension_remove_pod(state, pod, pi, ni)
+                victims.append(pi)
+        return victims if victims else None
+
+    # -- candidate ranking (preemption.go SelectCandidate) --------------------
+
+    @staticmethod
+    def _candidate_rank(c: _Candidate):
+        priorities = [v.pod.spec.priority for v in c.victims]
+        return (
+            max(priorities, default=-(1 << 31)),  # lowest max victim priority
+            sum(priorities),
+            len(c.victims),
+        )
+
+    # -- post filter -----------------------------------------------------------
+
+    def post_filter(self, state, pod: Pod, node_to_status):
+        if not self._eligible(pod):
+            return None, Status.unresolvable(
+                "preemption not allowed for this pod", plugin=self.name
+            )
+        snapshot = self.handle.snapshot
+        candidates: list[_Candidate] = []
+        for ni in snapshot.list_nodes():
+            if node_to_status.get(ni.name).code != UNSCHEDULABLE:
+                continue  # UnschedulableAndUnresolvable can't be fixed by eviction
+            victims = self._select_victims_on_node(state, pod, ni)
+            if victims:
+                candidates.append(_Candidate(ni.name, victims))
+        if not candidates:
+            return None, Status.unschedulable(
+                "preemption: 0/%d nodes are available" % snapshot.num_nodes(),
+                plugin=self.name,
+            )
+        best = min(candidates, key=self._candidate_rank)
+        # evict victims via API (async dispatcher in reference; direct here)
+        store = self.handle.store
+        for v in best.victims:
+            try:
+                store.delete("Pod", v.key)
+            except Exception:
+                pass
+        # clear lower-priority nominations on this node (preemption.go:236)
+        return (
+            PostFilterResult(nominated_node_name=best.node_name),
+            Status(),
+        )

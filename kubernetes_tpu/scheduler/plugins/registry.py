@@ -1,0 +1,71 @@
+"""In-tree plugin registry and default enablement/weights.
+
+Reference: pkg/scheduler/framework/plugins/registry.go:49-77 and default
+plugin set + weights at pkg/scheduler/apis/config/v1/default_plugins.go:29-73
+(TaintToleration w3, NodeAffinity w2, PodTopologySpread w2, InterPodAffinity
+w2, NodeResourcesFit w1, NodeResourcesBalancedAllocation w1, ImageLocality w1).
+"""
+
+from __future__ import annotations
+
+from ...api.resource import ResourceNames
+from .basics import (
+    DefaultBinder,
+    ImageLocality,
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    PrioritySort,
+    SchedulingGates,
+    TaintToleration,
+)
+from .interpod_affinity import InterPodAffinity
+from .node_affinity import NodeAffinity
+from .node_resources import BalancedAllocation, NodeResourcesFit
+from .pod_topology_spread import PodTopologySpread
+
+DEFAULT_WEIGHTS = {
+    "TaintToleration": 3,
+    "NodeAffinity": 2,
+    "PodTopologySpread": 2,
+    "InterPodAffinity": 2,
+    "NodeResourcesFit": 1,
+    "NodeResourcesBalancedAllocation": 1,
+    "ImageLocality": 1,
+}
+
+
+def default_plugins(store, names: ResourceNames, feature_gates=None, args: dict | None = None):
+    """The default-profile plugin list, in extension-point order."""
+    args = args or {}
+    fit_args = args.get("NodeResourcesFit", {})
+    plugins = [
+        SchedulingGates(),
+        PrioritySort(),
+        NodeUnschedulable(),
+        NodeName(),
+        TaintToleration(),
+        NodeAffinity(),
+        NodePorts(),
+        NodeResourcesFit(
+            names,
+            scoring_strategy=fit_args.get("strategy", "LeastAllocated"),
+            resource_weights=fit_args.get("resources"),
+            shape=fit_args.get("shape"),
+        ),
+        PodTopologySpread(),
+        InterPodAffinity(),
+        BalancedAllocation(names),
+        ImageLocality(),
+        DefaultBinder(store),
+    ]
+    gates = feature_gates or {}
+    if gates.get("GangScheduling", True):
+        from .gang_scheduling import GangScheduling
+
+        plugins.insert(1, GangScheduling())
+    if gates.get("DefaultPreemption", True):
+        from .default_preemption import DefaultPreemption
+
+        plugins.append(DefaultPreemption(names))
+    return plugins

@@ -1,0 +1,12 @@
+"""Scheduling queue: activeQ / backoffQ / unschedulablePods + QueueingHints.
+
+Reference: pkg/scheduler/backend/queue/.
+"""
+
+from .heap import KeyedHeap  # noqa: F401
+from .scheduling_queue import (  # noqa: F401
+    QueuedPodInfo,
+    SchedulingQueue,
+    DEFAULT_POD_INITIAL_BACKOFF,
+    DEFAULT_POD_MAX_BACKOFF,
+)

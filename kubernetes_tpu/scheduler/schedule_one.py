@@ -1,0 +1,476 @@
+"""Per-pod scheduling + binding cycles.
+
+Reference: pkg/scheduler/schedule_one.go — ScheduleOne:66, schedulingCycle:174,
+schedulePod:568, findNodesThatFitPod:626, findNodesThatPassFilters:775,
+numFeasibleNodesToFind:862, prioritizeNodes:941, selectHost:1080,
+bindingCycle:396, handleSchedulingFailure:1188.
+
+TPU divergence: findNodesThatPassFilters + prioritizeNodes delegate to the
+TPU backend (one dense pods x nodes kernel) when the profile carries one and
+every non-kernelizable plugin is skippable for the pod; otherwise the host
+path below runs. Host path is sequential (no goroutine fan-out) — it exists
+for correctness, golden-testing, and the sparse long-tail plugins.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+from ..api.types import PENDING, Pod
+from .framework.cycle_state import CycleState
+from .framework.interface import (
+    Diagnosis,
+    FitError,
+    NodeToStatus,
+    PostFilterResult,
+    ScheduleResult,
+    Status,
+    UNSCHEDULABLE,
+    UNSCHEDULABLE_AND_UNRESOLVABLE,
+)
+from .framework.runtime import Framework
+from .nodeinfo import NodeInfo, PodInfo
+from .queue.scheduling_queue import QueuedPodInfo
+
+MIN_FEASIBLE_NODES_TO_FIND = 100  # schedule_one.go:56
+MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # schedule_one.go:62
+
+
+def num_feasible_nodes_to_find(percentage: int, num_all_nodes: int) -> int:
+    """Adaptive sampling formula (schedule_one.go:862-888)."""
+    if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or percentage >= 100:
+        return num_all_nodes
+    adaptive = percentage
+    if adaptive == 0:
+        adaptive = 50 - num_all_nodes // 125
+        if adaptive < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+            adaptive = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+    num = num_all_nodes * adaptive // 100
+    if num < MIN_FEASIBLE_NODES_TO_FIND:
+        return MIN_FEASIBLE_NODES_TO_FIND
+    return num
+
+
+class SchedulingAlgorithm:
+    """schedulePod + helpers, bound to one framework profile."""
+
+    def __init__(
+        self,
+        framework: Framework,
+        percentage_of_nodes_to_score: int = 0,
+        rng: random.Random | None = None,
+        nominator=None,
+    ):
+        self.fw = framework
+        self.percentage = percentage_of_nodes_to_score
+        self.next_start_node_index = 0
+        self.rng = rng or random.Random(0)  # seeded: deterministic tie-breaks
+        self.nominator = nominator  # queue, for nominated-pod protection
+        self.snapshot = None  # set per cycle by schedule_pod
+
+    # -- filtering -----------------------------------------------------------
+
+    def find_nodes_that_fit_pod(
+        self, state: CycleState, pod: Pod, snapshot, nominated_node: str = ""
+    ) -> tuple[list[NodeInfo], Diagnosis]:
+        all_nodes = snapshot.list_nodes()
+        diagnosis = Diagnosis()
+        result, status = self.fw.run_pre_filter_plugins(state, pod, all_nodes)
+        if not status.is_success:
+            if status.is_rejected:
+                diagnosis.pre_filter_msg = status.message()
+                diagnosis.unschedulable_plugins.add(status.plugin)
+                diagnosis.node_to_status.absent_nodes_status = status
+                return [], diagnosis
+            raise RuntimeError(f"prefilter failed: {status.reasons}")
+
+        # nominated-node fast path (schedule_one.go:718 evaluateNominatedNode)
+        if nominated_node:
+            ni = snapshot.get(nominated_node)
+            if ni is not None:
+                feasible = self._filter_one(state, pod, ni, diagnosis)
+                if feasible:
+                    return [ni], diagnosis
+
+        nodes = all_nodes
+        if result is not None and not result.all_nodes:
+            nodes = [n for n in all_nodes if n.name in result.node_names]
+            diagnosis.node_to_status.absent_nodes_status = Status.unresolvable(
+                "node(s) didn't satisfy plugin(s) "
+                f"[{', '.join(sorted(diagnosis.unschedulable_plugins)) or 'prefilter'}]"
+            )
+        feasible = self._find_nodes_that_pass_filters(state, pod, nodes, diagnosis)
+        return feasible, diagnosis
+
+    def _filter_one(self, state, pod, ni: NodeInfo, diagnosis: Diagnosis) -> bool:
+        nominated = self._nominated_pod_infos(pod, ni)
+        st = self.fw.run_filter_plugins_with_nominated_pods(state, pod, ni, nominated)
+        if st.is_success:
+            return True
+        diagnosis.node_to_status.set(ni.name, st)
+        if st.plugin:
+            diagnosis.unschedulable_plugins.add(st.plugin)
+        return False
+
+    def _nominated_pod_infos(self, pod: Pod, ni: NodeInfo) -> list[PodInfo]:
+        """Equal-or-higher-priority pods nominated onto this node must be
+        assumed during filtering so a preemptor's freed resources aren't
+        stolen (schedule_one.go:1190 addNominatedPods)."""
+        if self.nominator is None:
+            return []
+        out = []
+        for key in self.nominator.nominated_pods_for_node(ni.name):
+            if key == pod.meta.key:
+                continue
+            npi = self.nominator.nominated_pod_info(key)
+            if npi is not None and npi.pod.spec.priority >= pod.spec.priority:
+                out.append(npi)
+        return out
+
+    def _find_nodes_that_pass_filters(
+        self, state, pod, nodes: list[NodeInfo], diagnosis: Diagnosis
+    ) -> list[NodeInfo]:
+        """findNodesThatPassFilters:775 — rotate start index for fairness,
+        stop at numFeasibleNodesToFind (early exit)."""
+        num_all = len(nodes)
+        num_to_find = num_feasible_nodes_to_find(self.percentage, num_all)
+        feasible: list[NodeInfo] = []
+        start = self.next_start_node_index % num_all if num_all else 0
+        evaluated = 0
+        for i in range(num_all):
+            ni = nodes[(start + i) % num_all]
+            evaluated += 1
+            if self._filter_one(state, pod, ni, diagnosis):
+                feasible.append(ni)
+                if len(feasible) >= num_to_find:
+                    break
+        self.next_start_node_index = (start + evaluated) % num_all if num_all else 0
+        return feasible
+
+    # -- scoring ---------------------------------------------------------------
+
+    def prioritize_nodes(
+        self, state: CycleState, pod: Pod, nodes: list[NodeInfo]
+    ) -> list:
+        """prioritizeNodes:941 — PreScore + 3-pass Score; returns
+        NodePluginScores list."""
+        if not self.fw.score_plugins and not self.fw.pre_score_plugins:
+            from .framework.interface import NodePluginScores
+
+            return [NodePluginScores(name=n.name, total_score=1) for n in nodes]
+        st = self.fw.run_pre_score_plugins(state, pod, nodes)
+        if not st.is_success:
+            raise RuntimeError(f"prescore failed: {st.reasons}")
+        scores, st = self.fw.run_score_plugins(state, pod, nodes)
+        if not st.is_success:
+            raise RuntimeError(f"score failed: {st.reasons}")
+        return scores
+
+    def select_host(self, node_scores: list, count: int = 1) -> tuple[str, list]:
+        """selectHost:1080 — heap-select top `count`, random tie-break among
+        max-score nodes (seeded rng makes it reproducible)."""
+        if not node_scores:
+            raise ValueError("empty priority list")
+        best = max(s.total_score for s in node_scores)
+        winners = [s for s in node_scores if s.total_score == best]
+        chosen = winners[self.rng.randrange(len(winners))] if len(winners) > 1 else winners[0]
+        ordered = sorted(node_scores, key=lambda s: -s.total_score)
+        return chosen.name, ordered
+
+    # -- schedulePod ------------------------------------------------------------
+
+    def schedule_pod(self, state: CycleState, pod: Pod, snapshot) -> ScheduleResult:
+        """schedulePod:568 — the complete algorithm for one pod."""
+        if snapshot.num_nodes() == 0:
+            raise FitError(pod, 0, Diagnosis())
+        # nominated-node fast path: a preemptor retries its nomination first
+        # (schedule_one.go:718 evaluateNominatedNode)
+        nominated = pod.status.nominated_node_name
+        feasible, diagnosis = self.find_nodes_that_fit_pod(
+            state, pod, snapshot, nominated_node=nominated
+        )
+        if not feasible:
+            raise FitError(pod, snapshot.num_nodes(), diagnosis)
+        if len(feasible) == 1:
+            return ScheduleResult(
+                suggested_host=feasible[0].name,
+                evaluated_nodes=1 + len(diagnosis.node_to_status.node_to_status),
+                feasible_nodes=1,
+            )
+        scores = self.prioritize_nodes(state, pod, feasible)
+        host, _ = self.select_host(scores)
+        return ScheduleResult(
+            suggested_host=host,
+            evaluated_nodes=len(feasible) + len(diagnosis.node_to_status.node_to_status),
+            feasible_nodes=len(feasible),
+        )
+
+
+class ScheduleOneLoop:
+    """The per-pod cycle driver: pop → schedule → assume/reserve/permit → bind.
+
+    Reference: ScheduleOne:66 + schedulingCycle:174 + bindingCycle:396. The
+    binding cycle can run inline (deterministic tests) or on a thread pool
+    (pipeline parallelism pod N+1 scheduling overlaps pod N binding — §2.9.2).
+    """
+
+    def __init__(
+        self,
+        cache,
+        queue,
+        profiles: dict[str, Framework],
+        algorithms: dict[str, SchedulingAlgorithm],
+        store,
+        snapshot,
+        metrics=None,
+        async_binding: bool = False,
+        event_recorder=None,
+        names=None,
+    ):
+        from ..api.resource import ResourceNames
+
+        self.names = names or ResourceNames()
+        self.cache = cache
+        self.queue = queue
+        self.profiles = profiles
+        self.algorithms = algorithms
+        self.store = store
+        self.snapshot = snapshot
+        self.metrics = metrics
+        self.async_binding = async_binding
+        self.event_recorder = event_recorder
+        self._binding_threads: list = []
+
+    def framework_for_pod(self, pod: Pod) -> Framework | None:
+        return self.profiles.get(pod.spec.scheduler_name)
+
+    def _skip_pod_schedule(self, fw: Framework, pod: Pod) -> bool:
+        """skipPodSchedule:546 — deleted or already-assumed pods."""
+        if pod.is_terminating:
+            return True
+        cur = self.store.try_get("Pod", pod.meta.key)
+        if cur is None:
+            return True
+        if self.cache.is_assumed_pod(pod):
+            return True
+        return False
+
+    # -- one iteration -----------------------------------------------------------
+
+    def schedule_one(self, timeout: float | None = 0.05) -> bool:
+        """Pop and schedule one pod; returns False when queue empty."""
+        qpi = self.queue.pop(timeout=timeout)
+        if qpi is None:
+            return False
+        self.schedule_pod_info(qpi)
+        return True
+
+    def schedule_pod_info(self, qpi: QueuedPodInfo) -> None:
+        pod = qpi.pod
+        fw = self.framework_for_pod(pod)
+        if fw is None:
+            self.queue.done(qpi.key)
+            return
+        if self._skip_pod_schedule(fw, pod):
+            self.queue.done(qpi.key)
+            return
+
+        state = CycleState()
+        scheduling_cycle = self.queue.moved_count
+        result, status = self._scheduling_cycle(state, fw, qpi)
+        if not status.is_success:
+            self._handle_scheduling_failure(fw, qpi, status, scheduling_cycle)
+            return
+        # A pod parked at Permit (gang quorum wait) MUST bind on a thread even
+        # in sync mode: the scheduling loop has to keep scheduling its
+        # siblings or quorum never arrives (reference: bindingCycle is always
+        # a goroutine, schedule_one.go:146).
+        must_thread = fw.waiting_pod(pod.meta.key) is not None
+        if self.async_binding or must_thread:
+            import threading
+
+            t = threading.Thread(
+                target=self._binding_cycle, args=(state, fw, qpi, result), daemon=True
+            )
+            self._binding_threads.append(t)
+            t.start()
+        else:
+            self._binding_cycle(state, fw, qpi, result)
+
+    # -- scheduling cycle ---------------------------------------------------------
+
+    def _scheduling_cycle(
+        self, state: CycleState, fw: Framework, qpi: QueuedPodInfo
+    ) -> tuple[ScheduleResult | None, Status]:
+        pod = qpi.pod
+        self.cache.update_snapshot(self.snapshot)
+        algo = self.algorithms[fw.profile_name]
+        try:
+            result = algo.schedule_pod(state, pod, self.snapshot)
+        except FitError as fit_err:
+            # PostFilter (preemption) — schedule_one.go:293
+            for p in fit_err.diagnosis.unschedulable_plugins:
+                qpi.unschedulable_plugins.add(p)
+            for p in fit_err.diagnosis.pending_plugins:
+                qpi.pending_plugins.add(p)
+            if fw.post_filter_plugins:
+                pf_result, pf_status = fw.run_post_filter_plugins(
+                    state, pod, fit_err.diagnosis.node_to_status
+                )
+                if pf_status.is_success and pf_result and pf_result.nominated_node_name:
+                    # nominate; pod returns to queue and retries (victims terminating)
+                    self.queue.add_nominated_pod(
+                        pod, pf_result.nominated_node_name, PodInfo(pod, self.names)
+                    )
+                    self._patch_nominated_node(pod, pf_result.nominated_node_name)
+            return None, Status.unschedulable(str(fit_err), plugin="")
+        except Exception as e:  # noqa: BLE001
+            return None, Status.as_error(e)
+
+        # assume (schedule_one.go:320,1106): cache sees the pod on the node now
+        assumed = pod
+        try:
+            self.cache.assume_pod(assumed, result.suggested_host)
+        except Exception as e:  # noqa: BLE001
+            return None, Status.as_error(e)
+        gk = self._group_key(pod)
+        if gk is not None:
+            self.cache.pod_group_states.pod_assumed(gk, pod.meta.key)
+
+        # reserve
+        st = fw.run_reserve_plugins_reserve(state, assumed, result.suggested_host)
+        if not st.is_success:
+            fw.run_reserve_plugins_unreserve(state, assumed, result.suggested_host)
+            self._forget(assumed)
+            return None, st
+
+        # permit
+        st = fw.run_permit_plugins(state, assumed, result.suggested_host)
+        if not (st.is_success or st.is_wait):
+            fw.run_reserve_plugins_unreserve(state, assumed, result.suggested_host)
+            self._forget(assumed)
+            return None, st
+        return result, Status()
+
+    def _group_key(self, pod: Pod) -> str | None:
+        sg = pod.spec.scheduling_group
+        return f"{pod.meta.namespace}/{sg.pod_group_name}" if sg else None
+
+    def _forget(self, pod: Pod) -> None:
+        self.cache.forget_pod(pod)
+        gk = self._group_key(pod)
+        if gk is not None:
+            self.cache.pod_group_states.pod_unassumed(gk, pod.meta.key)
+
+    # -- binding cycle --------------------------------------------------------------
+
+    def _binding_cycle(
+        self, state: CycleState, fw: Framework, qpi: QueuedPodInfo, result: ScheduleResult
+    ) -> None:
+        pod = qpi.pod
+        host = result.suggested_host
+
+        st = fw.wait_on_permit(pod)
+        if not st.is_success:
+            self._handle_binding_failure(state, fw, qpi, host, st)
+            return
+
+        st = fw.run_pre_bind_plugins(state, pod, host)
+        if not st.is_success:
+            self._handle_binding_failure(state, fw, qpi, host, st)
+            return
+
+        st = fw.run_bind_plugins(state, pod, host)
+        if not st.is_success and not st.is_skip:
+            self._handle_binding_failure(state, fw, qpi, host, st)
+            return
+
+        fw.run_post_bind_plugins(state, pod, host)
+        # pod leaves the cycle for good: stop in-flight event tracking only now
+        # (a done() before bind would drop events needed on bind failure)
+        self.queue.done(qpi.key)
+        self.queue.delete_nominated_pod_if_exists(pod)
+        if self.metrics is not None:
+            self.metrics.pod_scheduled(qpi)
+        if self.event_recorder is not None:
+            self.event_recorder.event(pod, "Normal", "Scheduled", f"bound to {host}")
+        gk = self._group_key(pod)
+        if gk is not None:
+            self.cache.pod_group_states.pod_scheduled(gk, pod.meta.key)
+
+    def _handle_binding_failure(self, state, fw, qpi, host, status: Status) -> None:
+        """handleBindingCycleError (schedule_one.go:504) — unreserve, forget,
+        requeue via AssignedPodDelete movement."""
+        pod = qpi.pod
+        fw.run_reserve_plugins_unreserve(state, pod, host)
+        self._forget(pod)
+        from .framework import events as ev
+        from .framework.events import ClusterEvent
+
+        self.queue.move_all_to_active_or_backoff(
+            ClusterEvent(ev.ASSIGNED_POD, ev.DELETE, "BindFailure"), None, None
+        )
+        self._handle_scheduling_failure(fw, qpi, status, self.queue.moved_count)
+
+    def _handle_scheduling_failure(
+        self, fw: Framework, qpi: QueuedPodInfo, status: Status, cycle: int
+    ) -> None:
+        """handleSchedulingFailure:1188 — requeue + PodScheduled condition."""
+        pod = qpi.pod
+        if status.code == UNSCHEDULABLE:
+            qpi.unschedulable_count += 1
+        elif status.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
+            pass  # no backoff increment
+        else:
+            qpi.consecutive_errors_count += 1
+        if status.plugin:
+            qpi.unschedulable_plugins.add(status.plugin)
+        self.queue.add_unschedulable_if_not_present(qpi, cycle)
+        self._patch_condition(pod, status)
+        if self.event_recorder is not None:
+            self.event_recorder.event(
+                pod, "Warning", "FailedScheduling", status.message()
+            )
+        if self.metrics is not None:
+            self.metrics.pod_unschedulable(qpi)
+
+    # -- API writeback ----------------------------------------------------------------
+
+    def _patch_condition(self, pod: Pod, status: Status) -> None:
+        from ..api.types import PodCondition
+
+        cur = self.store.try_get("Pod", pod.meta.key)
+        if cur is None:
+            return
+        reason = "Unschedulable" if status.is_rejected else "SchedulerError"
+        msg = status.message()
+        for c in cur.status.conditions:
+            if c.type == "PodScheduled":
+                if c.reason == reason and c.message == msg:
+                    return
+                c.status, c.reason, c.message = "False", reason, msg
+                break
+        else:
+            cur.status.conditions.append(
+                PodCondition("PodScheduled", "False", reason, msg)
+            )
+        try:
+            self.store.update(cur, check_version=False)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _patch_nominated_node(self, pod: Pod, node_name: str) -> None:
+        cur = self.store.try_get("Pod", pod.meta.key)
+        if cur is None:
+            return
+        cur.status.nominated_node_name = node_name
+        try:
+            self.store.update(cur, check_version=False)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def wait_for_bindings(self) -> None:
+        for t in self._binding_threads:
+            t.join(timeout=5)
+        self._binding_threads.clear()

@@ -1,0 +1,280 @@
+"""Scheduler object: wiring of cache, queue, profiles, informers.
+
+Reference: pkg/scheduler/scheduler.go (Scheduler struct :67, New :273,
+Run :536) + eventhandlers.go (addAllEventHandlers :481).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..api.resource import ResourceNames
+from ..api.types import DEFAULT_SCHEDULER_NAME, Node, Pod
+from ..client.informer import InformerFactory
+from ..store.store import ADDED, DELETED, MODIFIED, Store
+from .cache import Cache, Snapshot
+from .framework import events as ev
+from .framework.events import ClusterEvent
+from .framework.runtime import Framework
+from .plugins.registry import DEFAULT_WEIGHTS, default_plugins
+from .queue.scheduling_queue import SchedulingQueue
+from .schedule_one import ScheduleOneLoop, SchedulingAlgorithm
+from .nodeinfo import PodInfo
+
+
+@dataclass
+class Handle:
+    """What stateful plugins get to touch (framework.Handle, interface.go:804)."""
+
+    store: Store
+    cache: Cache
+    queue: SchedulingQueue
+    snapshot: Snapshot
+    framework: Framework | None = None
+
+
+@dataclass
+class Profile:
+    name: str = DEFAULT_SCHEDULER_NAME
+    percentage_of_nodes_to_score: int = 0
+    plugin_args: dict = field(default_factory=dict)
+    weights: dict = field(default_factory=lambda: dict(DEFAULT_WEIGHTS))
+    backend: str = "host"  # "host" | "tpu"
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store: Store,
+        profiles: list[Profile] | None = None,
+        names: ResourceNames | None = None,
+        feature_gates: dict | None = None,
+        clock=None,
+        metrics=None,
+        seed: int = 0,
+        async_binding: bool = False,
+        event_recorder=None,
+    ):
+        from ..utils.clock import Clock
+
+        self.store = store
+        self.names = names or ResourceNames()
+        self.clock = clock or Clock()
+        self.metrics = metrics
+        self.cache = Cache(self.names)
+        self.snapshot = Snapshot()
+        self.feature_gates = dict(feature_gates or {})
+
+        profiles = profiles or [Profile()]
+        self.frameworks: dict[str, Framework] = {}
+        self.algorithms: dict[str, SchedulingAlgorithm] = {}
+        pre_enqueue = []
+        hint_map: dict = {}
+        less_fn = None
+        for prof in profiles:
+            plugins = default_plugins(
+                store, self.names, self.feature_gates, prof.plugin_args
+            )
+            fw = Framework(
+                plugins, prof.weights, profile_name=prof.name, metrics=metrics, clock=self.clock
+            )
+            self.frameworks[prof.name] = fw
+            self.algorithms[prof.name] = SchedulingAlgorithm(
+                fw, prof.percentage_of_nodes_to_score, rng=random.Random(seed)
+            )  # nominator wired below once the queue exists
+            pre_enqueue = fw.pre_enqueue_plugins  # last profile wins (single-profile typical)
+            hint_map.update(fw.queueing_hint_map())
+            if less_fn is None:
+                less_fn = fw.queue_sort_less
+
+        self.queue = SchedulingQueue(
+            less_fn or (lambda a, b: a.timestamp < b.timestamp),
+            clock=self.clock,
+            pre_enqueue_plugins=pre_enqueue,
+            queueing_hint_map=hint_map,
+        )
+        for algo in self.algorithms.values():
+            algo.nominator = self.queue
+
+        # wire handles into stateful plugins
+        self.handle = Handle(store, self.cache, self.queue, self.snapshot)
+        for fw in self.frameworks.values():
+            self.handle.framework = fw
+            for p in fw.plugins:
+                if hasattr(p, "set_handle"):
+                    p.set_handle(self.handle)
+
+        self.loop = ScheduleOneLoop(
+            self.cache,
+            self.queue,
+            self.frameworks,
+            self.algorithms,
+            store,
+            self.snapshot,
+            metrics=metrics,
+            async_binding=async_binding,
+            event_recorder=event_recorder,
+            names=self.names,
+        )
+
+        self._last_leftover_flush = self.clock.now()
+
+        # informers (addAllEventHandlers, eventhandlers.go:481)
+        self.informers = InformerFactory(store)
+        self.informers.informer("Pod").add_handler(self._on_pod_event)
+        self.informers.informer("Node").add_handler(self._on_node_event)
+        self.informers.informer("PodGroup").add_handler(self._on_podgroup_event)
+
+    # -- event handlers (eventhandlers.go) ----------------------------------
+
+    def _group_key(self, pod: Pod) -> str | None:
+        sg = pod.spec.scheduling_group
+        return f"{pod.meta.namespace}/{sg.pod_group_name}" if sg else None
+
+    def _on_pod_event(self, etype: str, old: Pod | None, new: Pod) -> None:
+        gk = self._group_key(new)
+        if etype == ADDED:
+            if new.is_scheduled:
+                self.cache.add_pod(new)
+                if gk:
+                    self.cache.pod_group_states.pod_scheduled(gk, new.meta.key)
+                self.queue.move_all_to_active_or_backoff(
+                    ClusterEvent(ev.ASSIGNED_POD, ev.ADD), None, new
+                )
+            else:
+                if gk:
+                    self.cache.pod_group_states.pod_added(gk, new.meta.key)
+                self.queue.add(new, PodInfo(new, self.names))
+                self.queue.move_all_to_active_or_backoff(
+                    ClusterEvent(ev.UNSCHEDULED_POD, ev.ADD), None, new
+                )
+        elif etype == MODIFIED:
+            if new.is_scheduled:
+                if old is not None and not old.is_scheduled:
+                    # bind landed: cache confirms the assume
+                    self.cache.add_pod(new)
+                    if gk:
+                        self.cache.pod_group_states.pod_scheduled(gk, new.meta.key)
+                    self.queue.move_all_to_active_or_backoff(
+                        ClusterEvent(ev.ASSIGNED_POD, ev.ADD), old, new
+                    )
+                else:
+                    self.cache.update_pod(old, new)
+                    action = self._pod_update_actions(old, new)
+                    if action:
+                        self.queue.move_all_to_active_or_backoff(
+                            ClusterEvent(ev.ASSIGNED_POD, action), old, new
+                        )
+            else:
+                self.queue.update(old, new)
+                action = self._pod_update_actions(old, new)
+                if action:
+                    self.queue.move_all_to_active_or_backoff(
+                        ClusterEvent(ev.UNSCHEDULED_POD, action), old, new
+                    )
+        elif etype == DELETED:
+            if gk:
+                self.cache.pod_group_states.pod_removed(gk, new.meta.key)
+            if new.is_scheduled:
+                self.cache.remove_pod(new)
+                self.queue.move_all_to_active_or_backoff(
+                    ClusterEvent(ev.ASSIGNED_POD, ev.DELETE), new, None
+                )
+            else:
+                self.queue.delete(new)
+
+    def _pod_update_actions(self, old: Pod | None, new: Pod) -> int:
+        """OR of action bits describing what changed (eventhandlers.go
+        podSchedulingPropertiesChange) — never a guess of a single bit."""
+        if old is None:
+            return ev.UPDATE
+        action = 0
+        if old.meta.labels != new.meta.labels:
+            action |= ev.UPDATE_POD_LABEL
+        if old.spec.tolerations != new.spec.tolerations:
+            action |= ev.UPDATE_POD_TOLERATIONS
+        if old.spec.scheduling_gates != new.spec.scheduling_gates and not new.spec.scheduling_gates:
+            action |= ev.UPDATE_POD_SCHEDULING_GATES_ELIMINATED
+        old_req = PodInfo(old, self.names).request
+        new_req = PodInfo(new, self.names).request
+        if any(n < o for o, n in zip(old_req.v, new_req.v)):
+            action |= ev.UPDATE_POD_SCALE_DOWN
+        return action
+
+    def _on_node_event(self, etype: str, old: Node | None, new: Node) -> None:
+        if etype == ADDED:
+            self.cache.add_node(new)
+            self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(ev.NODE, ev.ADD), None, new
+            )
+        elif etype == MODIFIED:
+            self.cache.update_node(old, new)
+            action = 0
+            if old is not None:
+                if old.status.allocatable != new.status.allocatable:
+                    action |= ev.UPDATE_NODE_ALLOCATABLE
+                if old.meta.labels != new.meta.labels:
+                    action |= ev.UPDATE_NODE_LABEL
+                if old.spec.taints != new.spec.taints:
+                    action |= ev.UPDATE_NODE_TAINT
+                if old.spec.unschedulable != new.spec.unschedulable:
+                    action |= ev.UPDATE_NODE_TAINT
+            if action:
+                self.queue.move_all_to_active_or_backoff(
+                    ClusterEvent(ev.NODE, action), old, new
+                )
+        elif etype == DELETED:
+            self.cache.remove_node(new)
+
+    def _on_podgroup_event(self, etype: str, old, new) -> None:
+        if etype in (ADDED, MODIFIED):
+            self.cache.pod_group_states.set_group(new)
+            self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(ev.POD_GROUP, ev.ADD), old, new
+            )
+        elif etype == DELETED:
+            self.cache.pod_group_states.remove_group(new.meta.key)
+
+    # -- run -----------------------------------------------------------------
+
+    def start(self) -> None:
+        """Sync informers (initial list)."""
+        self.informers.start_all()
+
+    def pump(self) -> int:
+        """Drain informer events (deterministic single-thread mode)."""
+        n = self.informers.pump_all()
+        # periodic safety net (reference: 30s ticker -> 5 min leftover flush)
+        now = self.clock.now()
+        if now - self._last_leftover_flush > 30.0:
+            self._last_leftover_flush = now
+            self.queue.flush_unschedulable_leftover()
+        return n
+
+    def schedule_pending(self, max_cycles: int = 100_000) -> int:
+        """Run scheduling cycles until the queue stays empty; returns count.
+
+        Each cycle pumps informers first so bind results confirm assumes.
+        """
+        scheduled = 0
+        idle_rounds = 0
+        for _ in range(max_cycles):
+            self.pump()
+            if not self.loop.schedule_one(timeout=0.0):
+                idle_rounds += 1
+                if idle_rounds > 2:
+                    break
+                continue
+            idle_rounds = 0
+            scheduled += 1
+        self.loop.wait_for_bindings()
+        self.pump()
+        return scheduled
+
+    def run_forever(self, stop_event) -> None:
+        """Threaded mode: pump + schedule until stop_event set."""
+        while not stop_event.is_set():
+            self.pump()
+            self.loop.schedule_one(timeout=0.05)

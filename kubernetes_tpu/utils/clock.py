@@ -1,0 +1,34 @@
+"""Injectable clocks — deterministic time in tests.
+
+Reference: k8s.io/utils/clock (clock.WithTicker injected at scheduler.go:242).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Clock:
+    def now(self) -> float:
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class FakeClock(Clock):
+    def __init__(self, start: float = 1000.0):
+        self._now = start
+        self._mu = threading.Lock()
+
+    def now(self) -> float:
+        with self._mu:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.step(seconds)
+
+    def step(self, seconds: float) -> None:
+        with self._mu:
+            self._now += seconds

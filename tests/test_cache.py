@@ -1,0 +1,170 @@
+"""Tests for the scheduler cache: assume/confirm state machine, incremental
+snapshots by generation, zone-interleaved node ordering."""
+
+from kubernetes_tpu.api.resource import CPU, MEM, PODS, ResourceNames
+from kubernetes_tpu.scheduler.cache import Cache, NodeTree, Snapshot
+from kubernetes_tpu.scheduler.nodeinfo import PodInfo
+from tests.wrappers import make_node, make_pod
+
+
+def new_cache():
+    return Cache(ResourceNames())
+
+
+class TestNodeTree:
+    def test_zone_interleave(self):
+        t = NodeTree()
+        for i in range(4):
+            t.add_node(make_node(f"a{i}", zone="za"))
+        for i in range(2):
+            t.add_node(make_node(f"b{i}", zone="zb"))
+        order = t.list()
+        assert order == ["a0", "b0", "a1", "b1", "a2", "a3"]
+
+    def test_remove(self):
+        t = NodeTree()
+        n = make_node("x", zone="z")
+        t.add_node(n)
+        t.remove_node(n)
+        assert t.list() == [] and t.num_nodes == 0
+
+
+class TestCachePods:
+    def test_assume_confirm(self):
+        c = new_cache()
+        c.add_node(make_node("n1", cpu="4"))
+        pod = make_pod("p1", cpu="1")
+        c.assume_pod(pod, "n1")
+        assert c.is_assumed_pod(pod)
+        ni = c.get_node_info("n1")
+        assert ni.requested[CPU] == 1000 and ni.requested[PODS] == 1
+        # informer confirms
+        pod2 = make_pod("p1", cpu="1", node_name="n1")
+        c.add_pod(pod2)
+        assert not c.is_assumed_pod(pod)
+        assert c.get_node_info("n1").requested[CPU] == 1000  # not double counted
+
+    def test_assume_forget(self):
+        c = new_cache()
+        c.add_node(make_node("n1"))
+        pod = make_pod("p1", cpu="1")
+        c.assume_pod(pod, "n1")
+        c.forget_pod(pod)
+        assert c.get_node_info("n1").requested[CPU] == 0
+        assert c.pod_count() == 0
+
+    def test_confirm_on_different_node(self):
+        c = new_cache()
+        c.add_node(make_node("n1"))
+        c.add_node(make_node("n2"))
+        pod = make_pod("p1", cpu="1")
+        c.assume_pod(pod, "n1")
+        c.add_pod(make_pod("p1", cpu="1", node_name="n2"))
+        assert c.get_node_info("n1").requested[CPU] == 0
+        assert c.get_node_info("n2").requested[CPU] == 1000
+
+    def test_remove_pod(self):
+        c = new_cache()
+        c.add_node(make_node("n1"))
+        p = make_pod("p1", cpu="1", node_name="n1")
+        c.add_pod(p)
+        c.remove_pod(p)
+        assert c.get_node_info("n1").requested[CPU] == 0
+
+    def test_pod_on_unknown_node_kept_until_drained(self):
+        c = new_cache()
+        p = make_pod("p1", cpu="1", node_name="ghost")
+        c.add_pod(p)  # node not added yet — imaginary NodeInfo
+        assert c.get_node_info("ghost").requested[CPU] == 1000
+        c.remove_pod(p)
+        assert c.get_node_info("ghost") is None
+
+
+class TestSnapshot:
+    def test_full_then_incremental(self):
+        c = new_cache()
+        for i in range(3):
+            c.add_node(make_node(f"n{i}", cpu="8"))
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        assert snap.num_nodes() == 3
+        gen0 = snap.generation
+
+        c.add_pod(make_pod("p1", cpu="2", node_name="n1"))
+        c.update_snapshot(snap)
+        assert snap.generation > gen0
+        assert snap.get("n1").requested[CPU] == 2000
+        # untouched nodes were not re-cloned
+        assert snap.get("n0").requested[CPU] == 0
+
+    def test_snapshot_isolated_from_cache(self):
+        c = new_cache()
+        c.add_node(make_node("n1"))
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        c.add_pod(make_pod("p1", cpu="1", node_name="n1"))
+        # snapshot unchanged until refresh
+        assert snap.get("n1").requested[CPU] == 0
+        c.update_snapshot(snap)
+        assert snap.get("n1").requested[CPU] == 1000
+
+    def test_node_removal(self):
+        c = new_cache()
+        n1, n2 = make_node("n1"), make_node("n2")
+        c.add_node(n1)
+        c.add_node(n2)
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        c.remove_node(n1)
+        c.update_snapshot(snap)
+        assert snap.num_nodes() == 1 and snap.get("n1") is None
+
+    def test_affinity_list(self):
+        from tests.wrappers import with_pod_affinity
+
+        c = new_cache()
+        c.add_node(make_node("n1"))
+        pod = with_pod_affinity(
+            make_pod("p1", node_name="n1", labels={"app": "x"}),
+            "app", "x", "zone",
+        )
+        c.add_pod(pod)
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        assert len(snap.have_pods_with_affinity_list) == 1
+
+    def test_in_snapshot_assume_forget(self):
+        names = ResourceNames()
+        c = Cache(names)
+        c.add_node(make_node("n1", cpu="4"))
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        pi = PodInfo(make_pod("g1", cpu="1"), names)
+        snap.assume_pod(pi, "n1")
+        assert snap.get("n1").requested[CPU] == 1000
+        assert c.get_node_info("n1").requested[CPU] == 0  # cache untouched
+        snap.forget_pod("default/g1", "n1")
+        assert snap.get("n1").requested[CPU] == 0
+
+    def test_placement_narrowing(self):
+        from kubernetes_tpu.scheduler.cache import Placement
+
+        c = new_cache()
+        for i in range(4):
+            c.add_node(make_node(f"n{i}"))
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        snap.assume_placement(Placement("d1", ["n1", "n3"]))
+        assert {n.name for n in snap.list_nodes()} == {"n1", "n3"}
+        snap.forget_placement()
+        assert snap.num_nodes() == 4
+
+    def test_zone_interleaved_order(self):
+        c = new_cache()
+        for i in range(2):
+            c.add_node(make_node(f"a{i}", zone="za"))
+            c.add_node(make_node(f"b{i}", zone="zb"))
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        order = [n.name for n in snap.list_nodes()]
+        assert order == ["a0", "b0", "a1", "b1"]

@@ -1,0 +1,72 @@
+"""Regression tests for review findings on the queue/scheduler wiring."""
+
+import time
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import GangPolicy, PodGroup, PodGroupSpec
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_node, make_pod, with_gang
+
+
+def test_gated_pods_unblocked_by_podgroup_event():
+    """Pods gated by GangScheduling.pre_enqueue (group missing) must be
+    re-admitted when the PodGroup is created — event-driven, no pod update."""
+    store = Store()
+    for i in range(3):
+        store.create(make_node(f"n{i}", cpu="4"))
+    s = Scheduler(store)
+    s.start()
+    for i in range(3):
+        store.create(with_gang(make_pod(f"g-{i}", cpu="1"), "g"))
+    s.schedule_pending()
+    assert s.queue.pending_pods() == (0, 0, 3)  # all gated
+    store.create(
+        PodGroup(meta=ObjectMeta(name="g"), spec=PodGroupSpec(policy=GangPolicy(min_count=3)))
+    )
+    s.schedule_pending()
+    for i in range(3):
+        assert store.get("Pod", f"default/g-{i}").spec.node_name
+
+
+def test_error_status_pods_retried_via_backoff():
+    """Pods failing with Error (no rejecting plugin) go to backoff, not
+    unschedulablePods — they retry without any cluster event."""
+    from kubernetes_tpu.api.resource import ResourceNames
+    from kubernetes_tpu.scheduler.nodeinfo import PodInfo
+    from kubernetes_tpu.scheduler.queue import SchedulingQueue
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    q = SchedulingQueue(lambda a, b: a.timestamp < b.timestamp, clock=clock)
+    pod = make_pod("p")
+    q.add(pod, PodInfo(pod, ResourceNames()))
+    qpi = q.pop()
+    qpi.consecutive_errors_count += 1  # error path: no unschedulable_plugins
+    q.add_unschedulable_if_not_present(qpi, q.moved_count)
+    active, backoff, unsched = q.pending_pods()
+    assert (backoff, unsched) == (1, 0)
+    clock.step(1.1)
+    assert q.pop(timeout=0.01) is not None
+
+
+def test_nominated_pod_resources_protected():
+    """A lower-priority pod must not steal resources freed for a preemptor
+    that holds a nomination on the node."""
+    store = Store()
+    store.create(make_node("n1", cpu="2", pods=10))
+    store.create(make_pod("victim", cpu="2", priority=0))
+    s = Scheduler(store)
+    s.start()
+    s.schedule_pending()
+    # preemptor arrives, evicts victim, gets nomination, backs off
+    store.create(make_pod("preemptor", cpu="2", priority=100))
+    s.schedule_pending()
+    assert store.get("Pod", "default/preemptor").status.nominated_node_name == "n1"
+    # opportunist with lower priority tries to squeeze in
+    store.create(make_pod("opportunist", cpu="2", priority=1))
+    s.schedule_pending()
+    assert store.get("Pod", "default/opportunist").spec.node_name == ""
+    time.sleep(1.1)
+    s.schedule_pending()
+    assert store.get("Pod", "default/preemptor").spec.node_name == "n1"

@@ -1,0 +1,262 @@
+"""End-to-end scheduler tests: store → informers → queue → cycles → bindings.
+
+Modeled on test/integration/scheduler/ — pods get scheduled (spec.node_name
+set in the store) but never "run" (no kubelet needed for scheduler behavior).
+"""
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import PodGroup, PodGroupSpec, GangPolicy, Taint
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.store import Store
+from tests.wrappers import (
+    make_node,
+    make_pod,
+    with_gang,
+    with_node_affinity_in,
+    with_pod_affinity,
+    with_spread,
+    with_tolerations,
+)
+from kubernetes_tpu.api.types import Toleration
+
+
+def new_scheduler(store, **kw):
+    s = Scheduler(store, **kw)
+    s.start()
+    return s
+
+
+def scheduled_nodes(store):
+    return {p.meta.name: p.spec.node_name for p in store.pods()}
+
+
+class TestBasicScheduling:
+    def test_single_pod(self):
+        store = Store()
+        store.create(make_node("n1", cpu="4", mem="8Gi"))
+        store.create(make_pod("p1", cpu="1", mem="1Gi"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        assert scheduled_nodes(store)["p1"] == "n1"
+
+    def test_resource_fit_rejects(self):
+        store = Store()
+        store.create(make_node("n1", cpu="1", mem="1Gi"))
+        store.create(make_pod("big", cpu="8"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert scheduled_nodes(store)["big"] == ""
+        pod = store.get("Pod", "default/big")
+        conds = {c.type: c for c in pod.status.conditions}
+        assert conds["PodScheduled"].status == "False"
+        assert "Insufficient cpu" in conds["PodScheduled"].message
+
+    def test_spreads_by_least_allocated(self):
+        store = Store()
+        for i in range(4):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        for i in range(8):
+            store.create(make_pod(f"p{i}", cpu="1", mem="1Gi"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 8
+        placement = scheduled_nodes(store)
+        counts = {}
+        for node in placement.values():
+            counts[node] = counts.get(node, 0) + 1
+        # LeastAllocated spreads evenly: 2 pods per node
+        assert sorted(counts.values()) == [2, 2, 2, 2]
+
+    def test_many_pods_all_land(self):
+        store = Store()
+        for i in range(10):
+            store.create(make_node(f"n{i}", cpu="32", mem="64Gi", pods=20))
+        for i in range(100):
+            store.create(make_pod(f"p{i}", cpu="100m", mem="128Mi"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 100
+        assert all(n for n in scheduled_nodes(store).values())
+
+    def test_capacity_exhaustion_queues_rest(self):
+        store = Store()
+        store.create(make_node("n1", cpu="2", pods=10))
+        for i in range(4):
+            store.create(make_pod(f"p{i}", cpu="1"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        placed = [n for n in scheduled_nodes(store).values() if n]
+        assert len(placed) == 2
+        active, backoff, unsched = s.queue.pending_pods()
+        assert active + backoff + unsched == 2
+
+    def test_new_node_unblocks_unschedulable(self):
+        store = Store()
+        store.create(make_pod("p1", cpu="1"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert scheduled_nodes(store)["p1"] == ""
+        store.create(make_node("n1", cpu="4"))
+        s.clock  # event-driven requeue via NodeAdd hint
+        import time
+
+        time.sleep(1.1)  # real clock backoff for the retried pod
+        s.schedule_pending()
+        assert scheduled_nodes(store)["p1"] == "n1"
+
+
+class TestFilters:
+    def test_node_name(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        p = make_pod("p1")
+        p.spec.node_name = ""
+        p2 = make_pod("pinned")
+        p2.spec.node_name = ""
+        # pin via nodeName on spec requires the pod not be "scheduled" — use affinity instead
+        store.create(with_node_affinity_in(make_pod("aff"), "kubernetes.io/hostname", ("n2",)))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert scheduled_nodes(store)["aff"] == "n2"
+
+    def test_taints(self):
+        store = Store()
+        store.create(make_node("tainted", taints=(Taint("dedicated", "gpu", "NoSchedule"),)))
+        store.create(make_node("clean"))
+        store.create(make_pod("normal"))
+        store.create(
+            with_tolerations(
+                make_pod("tolerant"),
+                Toleration(key="dedicated", operator="Equal", value="gpu", effect="NoSchedule"),
+            )
+        )
+        s = new_scheduler(store)
+        s.schedule_pending()
+        nodes = scheduled_nodes(store)
+        assert nodes["normal"] == "clean"
+        assert nodes["tolerant"] in ("clean", "tainted")
+
+    def test_unschedulable_node(self):
+        store = Store()
+        store.create(make_node("off", unschedulable=True))
+        store.create(make_node("on"))
+        store.create(make_pod("p"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert scheduled_nodes(store)["p"] == "on"
+
+    def test_host_ports(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_pod("a", host_ports=(8080,)))
+        store.create(make_pod("b", host_ports=(8080,)))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        nodes = scheduled_nodes(store)
+        assert sorted([nodes["a"], nodes["b"]]) == ["", "n1"]
+
+    def test_topology_spread_hard(self):
+        store = Store()
+        for zone, names in (("za", ["a0", "a1"]), ("zb", ["b0", "b1"])):
+            for n in names:
+                store.create(make_node(n, zone=zone))
+        for i in range(4):
+            store.create(
+                with_spread(make_pod(f"p{i}", labels={"app": "x"}), max_skew=1)
+            )
+        s = new_scheduler(store)
+        s.schedule_pending()
+        by_zone = {"za": 0, "zb": 0}
+        for pod, node in scheduled_nodes(store).items():
+            assert node
+            by_zone["za" if node.startswith("a") else "zb"] += 1
+        assert by_zone == {"za": 2, "zb": 2}
+
+    def test_pod_anti_affinity(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(
+            with_pod_affinity(
+                make_pod("a", labels={"app": "x"}),
+                "app", "x", "kubernetes.io/hostname", anti=True,
+            )
+        )
+        store.create(
+            with_pod_affinity(
+                make_pod("b", labels={"app": "x"}),
+                "app", "x", "kubernetes.io/hostname", anti=True,
+            )
+        )
+        s = new_scheduler(store)
+        s.schedule_pending()
+        nodes = scheduled_nodes(store)
+        assert nodes["a"] and nodes["b"] and nodes["a"] != nodes["b"]
+
+    def test_pod_affinity_colocates(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_pod("seed", labels={"app": "db"}, node_name="n2"))
+        store.create(
+            with_pod_affinity(make_pod("follower"), "app", "db", "kubernetes.io/hostname")
+        )
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert scheduled_nodes(store)["follower"] == "n2"
+
+
+class TestPreemption:
+    def test_high_priority_preempts(self):
+        store = Store()
+        store.create(make_node("n1", cpu="2", pods=10))
+        store.create(make_pod("low1", cpu="1", priority=1))
+        store.create(make_pod("low2", cpu="1", priority=1))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert all(n == "n1" for n in scheduled_nodes(store).values())
+        store.create(make_pod("high", cpu="2", priority=100))
+        s.schedule_pending()
+        pods = {p.meta.name for p in store.pods()}
+        # both low-priority victims evicted
+        assert "high" in pods and len(pods) == 1
+        high = store.get("Pod", "default/high")
+        assert high.status.nominated_node_name == "n1"
+        # after victims gone, high gets scheduled on retry
+        import time
+
+        time.sleep(1.1)
+        s.schedule_pending()
+        assert store.get("Pod", "default/high").spec.node_name == "n1"
+
+
+class TestGangScheduling:
+    def test_gang_waits_for_quorum_then_binds(self):
+        store = Store()
+        for i in range(3):
+            store.create(make_node(f"n{i}", cpu="4"))
+        store.create(
+            PodGroup(
+                meta=ObjectMeta(name="g1"),
+                spec=PodGroupSpec(policy=GangPolicy(min_count=3)),
+            )
+        )
+        for i in range(3):
+            store.create(with_gang(make_pod(f"g1-{i}", cpu="1"), "g1"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        nodes = scheduled_nodes(store)
+        assert all(nodes[f"g1-{i}"] for i in range(3)), nodes
+
+    def test_gang_below_min_count_gated(self):
+        store = Store()
+        store.create(make_node("n1", cpu="8"))
+        store.create(
+            PodGroup(
+                meta=ObjectMeta(name="g2"),
+                spec=PodGroupSpec(policy=GangPolicy(min_count=3)),
+            )
+        )
+        store.create(with_gang(make_pod("g2-0", cpu="1"), "g2"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert scheduled_nodes(store)["g2-0"] == ""
