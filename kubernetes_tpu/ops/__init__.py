@@ -1,0 +1,27 @@
+"""Dense TPU kernel layer: vocabularies, tensor planes, fit/score kernels.
+
+The kernels enable jax x64 lazily at first invocation (int64 image-byte math
+must match the host path exactly); all other kernel dtypes are explicit
+(int32/float32), and importing this package has no global side effects.
+"""
+
+from .vocab import ClusterVocabs, Vocab, next_pow2
+from .planes import (
+    FallbackNeeded,
+    Planes,
+    PlaneBuilder,
+    PodFeatureExtractor,
+    stack_features,
+)
+from .kernels import (
+    FILTER_NAMES,
+    KernelConfig,
+    batched_assign,
+    fit_and_score,
+)
+
+__all__ = [
+    "ClusterVocabs", "Vocab", "next_pow2", "FallbackNeeded", "Planes",
+    "PlaneBuilder", "PodFeatureExtractor", "stack_features", "FILTER_NAMES",
+    "KernelConfig", "batched_assign", "fit_and_score",
+]

@@ -1,0 +1,393 @@
+"""Dense scheduling kernels: filter masks + scores over [nodes] planes.
+
+The TPU-native re-expression of the scheduler's two hot loops
+(pkg/scheduler/schedule_one.go:844 findNodesThatPassFilters and
+framework/runtime/framework.go:1320 RunScorePlugins): instead of fanning
+filter/score plugin calls across 16 goroutines per node, every plugin becomes
+vectorized integer/float32 arithmetic over the whole node axis at once, and
+multi-pod batches become a lax.scan where pod i+1 sees pod i's assumed deltas
+(subsuming both the gang default algorithm, schedule_one_podgroup.go:275, and
+OpportunisticBatching, framework/runtime/batch.go).
+
+Bit-compatibility: all score math is int32 with floor division on non-negative
+operands, except BalancedAllocation which is float32 with a fixed op order and
+PodTopologySpread's log-weight which is float32 — the host plugins use the
+same numpy float32 op order, so host and device agree exactly.
+
+Filter mask order mirrors the registry filter order (plugins/registry.py):
+NodeUnschedulable, NodeName, TaintToleration, NodeAffinity, NodePorts,
+NodeResourcesFit, PodTopologySpread.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from ..api.resource import CPU, MEM, PODS
+
+MAX_NODE_SCORE = 100
+
+LEAST_ALLOCATED = "LeastAllocated"
+MOST_ALLOCATED = "MostAllocated"
+REQUESTED_TO_CAPACITY_RATIO = "RequestedToCapacityRatio"
+
+# Filter mask rows (first-failure priority == host plugin order); PTS emits
+# per-constraint rows appended after these.
+FILTER_NAMES = (
+    "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
+    "NodePorts", "NodeResourcesFit",
+)
+
+_IMG_MIN = 23 * 1024 * 1024             # image_locality.go:34
+_IMG_MAX_PER_CONTAINER = 1024 ** 3      # image_locality.go:35
+
+
+@dataclass(frozen=True)
+class KernelConfig:
+    """Static (compile-time) kernel parameters."""
+
+    strategy: str = LEAST_ALLOCATED
+    # (resource column, weight) for the Fit score (NodeResourcesFitArgs)
+    fit_resources: tuple[tuple[int, int], ...] = ((CPU, 1), (MEM, 1))
+    # RequestedToCapacityRatio (utilization%, score) breakpoints
+    rtc_shape: tuple[tuple[int, int], ...] = ((0, 0), (100, MAX_NODE_SCORE))
+    # BalancedAllocation resource columns (exactly 2 supported in-kernel)
+    balanced_resources: tuple[int, int] = (CPU, MEM)
+    # plugin weights (apis/config/v1/default_plugins.go:29-73)
+    weights: tuple[tuple[str, int], ...] = (
+        ("TaintToleration", 3), ("NodeAffinity", 2), ("PodTopologySpread", 2),
+        ("NodeResourcesFit", 1), ("NodeResourcesBalancedAllocation", 1),
+        ("ImageLocality", 1),
+    )
+    # segment count for topology-domain segment sums (≥ max domain vocab len)
+    dseg: int = 1024
+    max_constraints: int = 4
+
+    def weight(self, name: str) -> int:
+        return dict(self.weights).get(name, 1)
+
+
+# --------------------------------------------------------------------------
+# filtering
+# --------------------------------------------------------------------------
+
+
+def _pts_domain_stats(cfg, planes, mask, key_i, sel_i):
+    """Per-domain pod counts + presence for one spread constraint.
+
+    mask selects which nodes participate (all valid nodes for Filter,
+    feasible nodes for Score — matching where the host plugin builds counts:
+    PreFilter over all nodes, PreScore over the filtered list).
+    """
+    dom = jnp.take(planes["domain"], key_i, axis=1)          # [Nb]
+    cnt = jnp.take(planes["sel_counts"], sel_i, axis=1)      # [Nb]
+    has_key = dom >= 0
+    part = mask & has_key
+    dom_c = jnp.clip(dom, 0, cfg.dseg - 1)
+    seg = jax.ops.segment_sum(
+        jnp.where(part, cnt, 0), dom_c, num_segments=cfg.dseg
+    )
+    present = jax.ops.segment_sum(
+        jnp.where(part, 1, 0), dom_c, num_segments=cfg.dseg
+    ) > 0
+    count_at_node = jnp.take(seg, dom_c)
+    return has_key, count_at_node, seg, present
+
+
+def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
+    """All filter plugins at once → (fails [F, Nb] bool, feasible [Nb] bool,
+    fit_insufficient [R, Nb], too_many_pods [Nb]).
+
+    fails rows follow FILTER_NAMES, then per-constraint PTS missing-key and
+    skew rows (2 * max_constraints rows).
+    """
+    valid = planes["valid"]
+    nb = valid.shape[0]
+    iota = jnp.arange(nb, dtype=jnp.int32)
+
+    # NodeUnschedulable (node_unschedulable.go:142)
+    f_unsched = planes["unsched"] & ~f["tol_unsched"]
+
+    # NodeName (node_name.go:79)
+    f_name = (f["name_idx"] != -1) & (iota != f["name_idx"])
+
+    # TaintToleration filter (taint_toleration.go:119)
+    tid = planes["taints"]
+    tol = jnp.take(f["tol"], jnp.clip(tid, 0), axis=0)
+    f_taint = ((tid >= 0) & ~tol).any(axis=1)
+
+    # NodeAffinity required + nodeSelector (node_affinity.go:218)
+    gm = jnp.take(f["group_match"], planes["group_id"], axis=0)
+    f_aff = ~(gm & f["node_allow"])
+
+    # NodePorts (node_ports.go:75)
+    conflict = (planes["port_words"] & f["ports"][None, :]) != 0
+    f_ports = f["has_ports"] & conflict.any(axis=1)
+
+    # NodeResourcesFit (fit.go:673-760)
+    free = planes["alloc"] - planes["used"]
+    insufficient = (f["req"][None, :] > 0) & (f["req"][None, :] > free)
+    insufficient = insufficient.at[:, PODS].set(False)
+    too_many = planes["used"][:, PODS] + 1 > planes["alloc"][:, PODS]
+    f_fit = insufficient.any(axis=1) | too_many
+
+    # PodTopologySpread hard constraints (filtering.go:314)
+    pts_missing, pts_skew = [], []
+    for c in range(cfg.max_constraints):
+        active = f["hard_active"][c]
+        has_key, count, seg, present = _pts_domain_stats(
+            cfg, planes, valid, f["hard_key"][c], f["hard_sel"][c]
+        )
+        min_count = jnp.where(
+            present.any(),
+            jnp.min(jnp.where(present, seg, jnp.iinfo(jnp.int32).max)),
+            0,
+        )
+        skew = count + f["hard_self"][c] - min_count
+        pts_missing.append(active & ~has_key)
+        pts_skew.append(active & has_key & (skew > f["hard_skew"][c]))
+
+    fails = jnp.stack(
+        [f_unsched, f_name, f_taint, f_aff, f_ports, f_fit] + pts_missing + pts_skew
+    )
+    feasible = valid & ~fails.any(axis=0)
+    return fails, feasible, insufficient.T, too_many
+
+
+# --------------------------------------------------------------------------
+# scoring
+# --------------------------------------------------------------------------
+
+
+def _strategy_score(cfg: KernelConfig, requested, capacity):
+    """Integer strategy formulas (least_allocated.go:30-52 etc.); caller
+    guarantees capacity > 0 via where()."""
+    cap = jnp.maximum(capacity, 1)
+    if cfg.strategy == LEAST_ALLOCATED:
+        return (cap - requested) * MAX_NODE_SCORE // cap
+    if cfg.strategy == MOST_ALLOCATED:
+        return requested * MAX_NODE_SCORE // cap
+    # RequestedToCapacityRatio piecewise-linear (requested_to_capacity_ratio.go)
+    util = requested * 100 // cap
+    shape = cfg.rtc_shape
+    out = jnp.full_like(requested, shape[-1][1])
+    for (x0, y0), (x1, y1) in reversed(list(zip(shape, shape[1:]))):
+        seg = y1 if x1 == x0 else y0 + (y1 - y0) * (util - x0) // (x1 - x0)
+        out = jnp.where(util <= x1, seg, out)
+    return jnp.where(util <= shape[0][0], shape[0][1], out)
+
+
+def _requested_for(planes, f, col):
+    """Requested-including-pod per node; cpu/mem use NonZero accounting
+    (resource_allocation.go:138)."""
+    if col == CPU:
+        return planes["nonzero_used"][:, 0] + f["nz_req"][0]
+    if col == MEM:
+        return planes["nonzero_used"][:, 1] + f["nz_req"][1]
+    return planes["used"][:, col] + f["req"][col]
+
+
+def _fit_score(cfg: KernelConfig, planes, f):
+    """resource_allocation.go:52 — weighted mean of per-resource strategy
+    scores, nodes with zero capacity for a resource exclude its weight."""
+    nb = planes["valid"].shape[0]
+    total = jnp.zeros(nb, jnp.int32)
+    tw = jnp.zeros(nb, jnp.int32)
+    for col, w in cfg.fit_resources:
+        alloc = planes["alloc"][:, col]
+        ok = alloc > 0
+        requested = jnp.minimum(_requested_for(planes, f, col), alloc)
+        s = _strategy_score(cfg, requested, alloc)
+        total = total + jnp.where(ok, s * w, 0)
+        tw = tw + jnp.where(ok, w, 0)
+    return jnp.where(tw > 0, total // jnp.maximum(tw, 1), 0)
+
+
+def _balanced_score(cfg: KernelConfig, planes, f):
+    """balanced_allocation.go:204-230 — float32, fixed op order matching the
+    host plugin's numpy float32 sequence exactly."""
+    ca, cb = cfg.balanced_resources
+    alloc_a = planes["alloc"][:, ca]
+    alloc_b = planes["alloc"][:, cb]
+    fa = jnp.minimum(
+        _requested_for(planes, f, ca).astype(jnp.float32)
+        / jnp.maximum(alloc_a, 1).astype(jnp.float32),
+        jnp.float32(1.0),
+    )
+    fb = jnp.minimum(
+        _requested_for(planes, f, cb).astype(jnp.float32)
+        / jnp.maximum(alloc_b, 1).astype(jnp.float32),
+        jnp.float32(1.0),
+    )
+    s = fa + fb
+    mean = s / jnp.float32(2.0)
+    var = ((fa - mean) ** 2 + (fb - mean) ** 2) / jnp.float32(2.0)
+    std = jnp.sqrt(var)
+    score = ((jnp.float32(1.0) - std) * jnp.float32(MAX_NODE_SCORE)).astype(jnp.int32)
+    both = (alloc_a > 0) & (alloc_b > 0)
+    return jnp.where(both, score, 0)
+
+
+def _taint_score(planes, f, feasible):
+    """taint_toleration.go:180-215 — count intolerable PreferNoSchedule
+    taints, inverted over the feasible set in normalize."""
+    ptid = planes["prefer_taints"]
+    tolp = jnp.take(f["tol_prefer"], jnp.clip(ptid, 0), axis=0)
+    count = ((ptid >= 0) & ~tolp).sum(axis=1).astype(jnp.int32)
+    max_count = jnp.max(jnp.where(feasible, count, 0))
+    return jnp.where(
+        max_count > 0,
+        MAX_NODE_SCORE - count * MAX_NODE_SCORE // jnp.maximum(max_count, 1),
+        MAX_NODE_SCORE,
+    )
+
+
+def _node_affinity_score(planes, f, feasible):
+    """node_affinity.go:272 + normalize to max=100 over the feasible set."""
+    raw = jnp.take(f["group_pref"], planes["group_id"], axis=0)
+    mx = jnp.max(jnp.where(feasible, raw, 0))
+    normed = jnp.where(mx > 0, raw * MAX_NODE_SCORE // jnp.maximum(mx, 1), raw)
+    return jnp.where(f["has_pref"], normed, 0)
+
+
+def _pts_score(cfg: KernelConfig, planes, f, feasible):
+    """podtopologyspread scoring.go:118-305 — per-domain counts weighted by
+    log(domains+2) float32, inverted min/max over the feasible set."""
+    nb = planes["valid"].shape[0]
+    cost = jnp.zeros(nb, jnp.float32)
+    any_active = f["soft_active"].any()
+    for c in range(cfg.max_constraints):
+        active = f["soft_active"][c]
+        has_key, count, seg, present = _pts_domain_stats(
+            cfg, planes, feasible, f["soft_key"][c], f["soft_sel"][c]
+        )
+        nd = present.sum().astype(jnp.int32)
+        w = jnp.log((nd + 2).astype(jnp.float32))
+        cost = cost + jnp.where(
+            active & has_key, count.astype(jnp.float32) * w, jnp.float32(0)
+        )
+    raw = cost.astype(jnp.int32)
+    big = jnp.iinfo(jnp.int32).max
+    mx = jnp.max(jnp.where(feasible, raw, -big))
+    mn = jnp.min(jnp.where(feasible, raw, big))
+    spread = mx - mn
+    normed = jnp.where(
+        spread == 0,
+        MAX_NODE_SCORE,
+        (mx - raw) * MAX_NODE_SCORE // jnp.maximum(spread, 1),
+    )
+    return jnp.where(any_active, normed, 0)
+
+
+def _image_score(planes, f):
+    """image_locality.go:93-105 — int64 byte totals against
+    [23MB, 1GB × containers]."""
+    idx = jnp.clip(f["img_idx"], 0)
+    present = f["img_idx"] >= 0
+    sizes = jnp.take(planes["image_bytes"], idx, axis=1)     # [Nb, 8]
+    total = jnp.where(present[None, :], sizes, 0).sum(axis=1)
+    max_thr = jnp.int64(_IMG_MAX_PER_CONTAINER) * f["num_containers"].astype(jnp.int64)
+    span = jnp.maximum(max_thr - _IMG_MIN, 1)
+    mid = MAX_NODE_SCORE * (total - _IMG_MIN) // span
+    score = jnp.where(total < _IMG_MIN, 0, jnp.where(total > max_thr, MAX_NODE_SCORE, mid))
+    return score.astype(jnp.int32)
+
+
+def scores(cfg: KernelConfig, planes: dict, f: dict, feasible):
+    """Weighted total per node (framework.go:1320 3-pass structure collapsed:
+    raw score → normalize-over-feasible → weight+sum, all in one trace)."""
+    per = {
+        "NodeResourcesFit": _fit_score(cfg, planes, f),
+        "NodeResourcesBalancedAllocation": _balanced_score(cfg, planes, f),
+        "TaintToleration": _taint_score(planes, f, feasible),
+        "NodeAffinity": _node_affinity_score(planes, f, feasible),
+        "PodTopologySpread": _pts_score(cfg, planes, f, feasible),
+        "ImageLocality": _image_score(planes, f),
+    }
+    total = jnp.zeros_like(per["NodeResourcesFit"])
+    for name, s in per.items():
+        total = total + s * cfg.weight(name)
+    return total, per
+
+
+# --------------------------------------------------------------------------
+# single-pod and batched entry points
+# --------------------------------------------------------------------------
+
+
+def _ensure_x64() -> None:
+    """int64 image-byte math must not be silently downcast inside jit; flip
+    the flag lazily at first kernel use instead of at import so merely
+    importing this package never mutates process-global JAX config."""
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _fit_and_score_jit(cfg: KernelConfig, planes: dict, f: dict):
+    fails, feasible, insufficient, too_many = filter_masks(cfg, planes, f)
+    total, per = scores(cfg, planes, f, feasible)
+    return {
+        "fails": fails,
+        "feasible": feasible,
+        "insufficient": insufficient,
+        "too_many_pods": too_many,
+        "total": jnp.where(feasible, total, -1),
+        "per_plugin": per,
+    }
+
+
+def fit_and_score(cfg: KernelConfig, planes: dict, f: dict):
+    """One pod against all nodes: the fused findNodesThatFitPod +
+    prioritizeNodes kernel (schedule_one.go:626,941)."""
+    _ensure_x64()
+    return _fit_and_score_jit(cfg, planes, f)
+
+
+def _assign_step(cfg: KernelConfig, planes: dict, carry, f):
+    """One greedy step: filter+score under the carry's assumed state, pick the
+    best node (first-index tie-break), apply the pod's deltas."""
+    used, nonzero_used, sel_counts = carry
+    p = dict(planes)
+    p["used"], p["nonzero_used"], p["sel_counts"] = used, nonzero_used, sel_counts
+    _, feasible, _, _ = filter_masks(cfg, p, f)
+    total, _ = scores(cfg, p, f, feasible)
+    key = jnp.where(feasible, total, -1)
+    win = jnp.argmax(key).astype(jnp.int32)
+    found = key[win] >= 0
+    onehot = (jnp.arange(used.shape[0]) == win) & found
+    oh_i = onehot.astype(jnp.int32)
+    used = used + oh_i[:, None] * f["req"][None, :]
+    nonzero_used = nonzero_used + oh_i[:, None] * f["nz_req"][None, :]
+    sel_counts = sel_counts + oh_i[:, None] * f["sig_match"][None, :]
+    winner = jnp.where(found, win, -1)
+    return (used, nonzero_used, sel_counts), winner
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _batched_assign_jit(cfg: KernelConfig, planes: dict, batched_f: dict):
+    init = (planes["used"], planes["nonzero_used"], planes["sel_counts"])
+    step = functools.partial(_assign_step, cfg, planes)
+    (used, nonzero_used, sel_counts), winners = jax.lax.scan(step, init, batched_f)
+    return winners, {"used": used, "nonzero_used": nonzero_used, "sel_counts": sel_counts}
+
+
+def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict):
+    """Greedy multi-pod assignment: lax.scan over the pod axis; pod i+1 sees
+    pod i's assumed deltas (the in-kernel analogue of the cache assume in
+    schedule_one.go:320-333 and of the gang default algorithm, and the
+    dense subsumption of OpportunisticBatching's score-list reuse).
+
+    Tie-break is first-max-index (deterministic), NOT the host path's
+    seeded-rng draw — batched mode is the throughput path; use the per-pod
+    kernel via TPUSchedulingAlgorithm when bit-identical host parity is
+    required.
+
+    Returns (winners [P] int32 node index or -1, updated used/nonzero/sel
+    planes)."""
+    _ensure_x64()
+    return _batched_assign_jit(cfg, planes, batched_f)

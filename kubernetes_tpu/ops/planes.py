@@ -1,0 +1,493 @@
+"""Tensor planes: the Snapshot materialized as dense [nodes, ...] arrays.
+
+This is the TPU-native replacement for walking `[]NodeInfo` with 16 goroutines
+(pkg/scheduler/framework/parallelize/parallelism.go): every per-node quantity a
+filter or score plugin reads is laid out as a column of a dense plane, padded
+to power-of-two buckets (static shapes for XLA), and updated incrementally by
+NodeInfo generation (mirroring the O(changed) snapshot update of
+pkg/scheduler/backend/cache/cache.go:190-360).
+
+Planes (all numpy host-side; the backend uploads them to device HBM):
+- alloc/used        [Nb, R]  int32   allocatable / requested, plane units
+- nonzero_used      [Nb, 2]  int32   NonZeroRequested cpu/mem (scoring)
+- valid             [Nb]     bool    padding mask
+- unsched           [Nb]     bool    node.spec.unschedulable
+- group_id          [Nb]     int32   node-label-group vocab id
+- taints            [Nb, T]  int32   NoSchedule/NoExecute taint vocab ids, -1 pad
+- prefer_taints     [Nb, Tp] int32   PreferNoSchedule taint vocab ids, -1 pad
+- domain            [Nb, K]  int32   per-topology-key domain id, -1 = key absent
+- sel_counts        [Nb, S]  int32   pods on node matching selector signature s
+- port_words        [Nb, W]  uint32  used host-port bitset over the port vocab
+- image_bytes       [Nb, I]  int64   per-image bytes present on node
+
+Pod features (PodFeatureExtractor) are the per-pod side of the same split:
+everything string-shaped is resolved host-side against the vocabularies, so
+the kernel only gathers and compares integers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api.resource import CPU, MEM, ResourceNames
+from ..api.types import NO_SCHEDULE, PREFER_NO_SCHEDULE, Pod, Taint
+from .vocab import ClusterVocabs, next_pow2
+
+ZONE_LABEL = "topology.kubernetes.io/zone"
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+UNSCHEDULABLE_TAINT_KEY = "node.kubernetes.io/unschedulable"
+_FIELD_HOSTNAME = "metadata.name"
+
+
+class Planes:
+    """Container of the dense node planes + index metadata."""
+
+    __slots__ = (
+        "node_names", "node_index", "n", "nb", "r",
+        "alloc", "used", "nonzero_used", "valid", "unsched", "group_id",
+        "taints", "prefer_taints", "domain", "sel_counts", "port_words",
+        "image_bytes", "version", "bucket_sizes",
+    )
+
+    def as_dict(self) -> dict[str, np.ndarray]:
+        """The kernel-input arrays (everything the jitted code consumes)."""
+        return {
+            "alloc": self.alloc,
+            "used": self.used,
+            "nonzero_used": self.nonzero_used,
+            "valid": self.valid,
+            "unsched": self.unsched,
+            "group_id": self.group_id,
+            "taints": self.taints,
+            "prefer_taints": self.prefer_taints,
+            "domain": self.domain,
+            "sel_counts": self.sel_counts,
+            "port_words": self.port_words,
+            "image_bytes": self.image_bytes,
+        }
+
+
+def _canonical_fingerprint(vocabs: ClusterVocabs, names: ResourceNames) -> tuple:
+    return (
+        len(vocabs.taints), len(vocabs.prefer_taints), len(vocabs.groups),
+        len(vocabs.topo_keys),
+        tuple(len(vocabs.domain_vocab(i)) for i in range(len(vocabs.topo_keys))),
+        len(vocabs.selectors), len(vocabs.ports), len(vocabs.images),
+        names.width,
+    )
+
+
+class PlaneBuilder:
+    """Builds and incrementally refreshes Planes from a Snapshot."""
+
+    def __init__(self, names: ResourceNames, vocabs: ClusterVocabs | None = None):
+        self.names = names
+        self.vocabs = vocabs or ClusterVocabs()
+        # default topology keys so the common spread constraints don't force
+        # an early rebuild (podtopologyspread system defaults, plugin.go:46-60)
+        self.vocabs.topo_keys.id(ZONE_LABEL)
+        self.vocabs.topo_keys.id(HOSTNAME_LABEL)
+        self._planes: Planes | None = None
+        self._row_cache: dict[str, tuple[int, tuple]] = {}  # name -> (gen, fp)
+        self._version = 0
+        self.dirty_rows: list[int] | None = None  # rows changed by last sync
+
+    # -- public ------------------------------------------------------------
+
+    def sync(self, snapshot) -> Planes:
+        """Refresh planes from the snapshot; O(changed nodes) when the node
+        set, bucket sizes, and vocabularies are stable."""
+        nodes = snapshot.list_nodes()
+        names = [ni.name for ni in nodes]
+        # intern node-derived vocab entries BEFORE sizing buckets, so the
+        # fingerprint and bucket sizes already reflect this sync's content
+        for ni in nodes:
+            cached = self._row_cache.get(ni.name)
+            if cached is None or cached[0] != ni.generation:
+                self._register_node(ni)
+        fp = _canonical_fingerprint(self.vocabs, self.names)
+        buckets = self._bucket_sizes(len(nodes), fp)
+        p = self._planes
+        if p is None or p.node_names != names or p.bucket_sizes != buckets:
+            p = self._full_build(nodes, names, buckets, fp)
+            self.dirty_rows: list[int] | None = None  # None = everything changed
+        else:
+            dirty: list[int] = []
+            for i, ni in enumerate(nodes):
+                cached = self._row_cache.get(ni.name)
+                if cached is not None and cached == (ni.generation, fp):
+                    continue
+                self._write_row(p, i, ni, fp)
+                dirty.append(i)
+            self.dirty_rows = dirty
+            if dirty:
+                self._version += 1
+                p.version = self._version
+        # _write_row may have interned new *values* (e.g. topology domains)
+        # mid-pass; restamp the row cache with the post-write fingerprint so
+        # the next sync doesn't see a spurious mismatch and rewrite every row.
+        # Row content is invariant to value-vocab growth (ids are append-only;
+        # shape-affecting growth changes bucket sizes and forces a rebuild).
+        fp2 = _canonical_fingerprint(self.vocabs, self.names)
+        if fp2 != fp:
+            self._row_cache = {nm: (gen, fp2) for nm, (gen, _) in self._row_cache.items()}
+        self._planes = p
+        return p
+
+    # -- internals ----------------------------------------------------------
+
+    def _register_node(self, ni) -> None:
+        v = self.vocabs
+        node = ni.node
+        if node is not None:
+            v.group_of_labels(dict(node.meta.labels))
+            for tt in node.spec.taints:
+                if tt.effect in (NO_SCHEDULE, "NoExecute"):
+                    v.taints.id((tt.key, tt.value, tt.effect))
+                elif tt.effect == PREFER_NO_SCHEDULE:
+                    v.prefer_taints.id((tt.key, tt.value))
+            for ki in range(len(v.topo_keys)):
+                val = node.meta.labels.get(v.topo_keys.key(ki))
+                if val is not None:
+                    v.domain_vocab(ki).id(val)
+        for (_ip, proto, port) in ni.used_ports:
+            v.ports.id((proto, port))
+        for img_name in ni.image_sizes:
+            v.images.id(img_name)
+
+    def _bucket_sizes(self, n: int, fp: tuple) -> tuple:
+        v = self.vocabs
+        max_taints = max((len(v.taints), 1))
+        return (
+            next_pow2(n, 8),                       # Nb
+            next_pow2(self.names.width, 4),        # R
+            next_pow2(max_taints, 1),              # T (vocab-sized: node rows index it)
+            next_pow2(max(len(v.prefer_taints), 1), 1),   # Tp
+            next_pow2(max(len(v.topo_keys), 2), 2),       # K
+            next_pow2(max(len(v.selectors), 1), 1),       # S
+            next_pow2((len(v.ports) + 31) // 32, 1),      # W port words
+            next_pow2(max(len(v.images), 1), 1),          # I
+        )
+
+    def _full_build(self, nodes, names, buckets, fp) -> Planes:
+        nb, r, t, tp, k, s, w, im = buckets
+        p = Planes()
+        p.node_names = names
+        p.node_index = {nm: i for i, nm in enumerate(names)}
+        p.n = len(nodes)
+        p.nb, p.r = nb, r
+        p.bucket_sizes = buckets
+        p.alloc = np.zeros((nb, r), np.int32)
+        p.used = np.zeros((nb, r), np.int32)
+        p.nonzero_used = np.zeros((nb, 2), np.int32)
+        p.valid = np.zeros(nb, bool)
+        p.valid[: p.n] = True
+        p.unsched = np.zeros(nb, bool)
+        p.group_id = np.zeros(nb, np.int32)
+        p.taints = np.full((nb, t), -1, np.int32)
+        p.prefer_taints = np.full((nb, tp), -1, np.int32)
+        p.domain = np.full((nb, k), -1, np.int32)
+        p.sel_counts = np.zeros((nb, s), np.int32)
+        p.port_words = np.zeros((nb, w), np.uint32)
+        p.image_bytes = np.zeros((nb, im), np.int64)
+        self._row_cache.clear()
+        for i, ni in enumerate(nodes):
+            self._write_row(p, i, ni, fp)
+        self._version += 1
+        p.version = self._version
+        return p
+
+    def _write_row(self, p: Planes, i: int, ni, fp: tuple) -> None:
+        v = self.vocabs
+        node = ni.node
+        p.alloc[i, : p.r] = 0
+        p.alloc[i, : min(len(ni.allocatable.v), p.r)] = [
+            min(x, 2**31 - 1) for x in ni.allocatable.v[: p.r]
+        ]
+        p.used[i, : p.r] = 0
+        p.used[i, : min(len(ni.requested.v), p.r)] = ni.requested.v[: p.r]
+        p.nonzero_used[i, 0] = ni.nonzero_requested[CPU]
+        p.nonzero_used[i, 1] = ni.nonzero_requested[MEM]
+        labels = node.meta.labels if node is not None else {}
+        p.unsched[i] = bool(node is not None and node.spec.unschedulable)
+        p.group_id[i] = v.group_of_labels(dict(labels))
+        # taints
+        p.taints[i, :] = -1
+        p.prefer_taints[i, :] = -1
+        if node is not None:
+            hard = [tt for tt in node.spec.taints if tt.effect in (NO_SCHEDULE, "NoExecute")]
+            soft = [tt for tt in node.spec.taints if tt.effect == PREFER_NO_SCHEDULE]
+            for j, tt in enumerate(hard[: p.taints.shape[1]]):
+                p.taints[i, j] = v.taints.id((tt.key, tt.value, tt.effect))
+            for j, tt in enumerate(soft[: p.prefer_taints.shape[1]]):
+                p.prefer_taints[i, j] = v.prefer_taints.id((tt.key, tt.value))
+        # topology domains
+        p.domain[i, :] = -1
+        for ki in range(len(v.topo_keys)):
+            key = v.topo_keys.key(ki)
+            val = labels.get(key)
+            if val is not None and ki < p.domain.shape[1]:
+                p.domain[i, ki] = v.domain_vocab(ki).id(val)
+        # selector-signature pod counts (podtopologyspread/filtering.go:97)
+        p.sel_counts[i, :] = 0
+        for si, (ns, sel) in enumerate(v.selector_matchers):
+            if si >= p.sel_counts.shape[1]:
+                break
+            c = 0
+            for pi in ni.iter_pods():
+                pod = pi.pod
+                if pod.meta.namespace != ns or pod.is_terminating:
+                    continue
+                if sel.matches(pod.meta.labels):
+                    c += 1
+            p.sel_counts[i, si] = c
+        # used host ports
+        p.port_words[i, :] = 0
+        for (_ip, proto, port) in ni.used_ports:
+            b = v.ports.id((proto, port))
+            if b // 32 < p.port_words.shape[1]:
+                p.port_words[i, b // 32] |= np.uint32(1 << (b % 32))
+        # images
+        p.image_bytes[i, :] = 0
+        for img_name, size in ni.image_sizes.items():
+            ii = v.images.id(img_name)
+            if ii < p.image_bytes.shape[1]:
+                p.image_bytes[i, ii] = size
+        self._row_cache[ni.name] = (ni.generation, fp)
+
+
+class FallbackNeeded(Exception):
+    """Raised when a pod uses features the dense kernel does not model yet;
+    the caller must run the host scheduling path for this pod."""
+
+
+class PodFeatureExtractor:
+    """Resolves one Pod against the vocabularies into fixed-shape arrays.
+
+    Raises FallbackNeeded for the long-tail features kept host-side in this
+    round (inter-pod affinity, match_fields beyond the In(metadata.name) fast
+    path, host ports with specific hostIPs).
+    """
+
+    MAX_CONSTRAINTS = 4  # padded constraint slots per pod
+
+    def __init__(self, names: ResourceNames, vocabs: ClusterVocabs,
+                 system_default_spread: bool = True):
+        self.names = names
+        self.vocabs = vocabs
+        self.system_default_spread = system_default_spread
+
+    # -- vocab registration (must run before PlaneBuilder.sync) -------------
+
+    def register(self, pod: Pod) -> None:
+        """Intern every vocab entry this pod needs so the subsequent
+        planes sync covers them."""
+        from ..scheduler.plugins.pod_topology_spread import PodTopologySpread
+
+        pts = PodTopologySpread(system_defaulting=self.system_default_spread)
+        for action in ("DoNotSchedule", "ScheduleAnyway"):
+            for c in pts._constraints_for(pod, action):
+                ki = self.vocabs.topo_keys.id(c.topology_key)
+                self.vocabs.domain_vocab(ki)
+                sel = c.label_selector
+                if sel is not None:
+                    self.vocabs.selector_id(pod.meta.namespace, sel)
+        for c in pod.spec.containers:
+            for prt in c.ports:
+                if prt.host_port > 0:
+                    self.vocabs.ports.id((prt.protocol, prt.host_port))
+            if c.image:
+                self.vocabs.images.id(c.image)
+
+    # -- extraction ----------------------------------------------------------
+
+    def features(self, pod: Pod, planes: Planes) -> dict[str, np.ndarray]:
+        """Fixed-shape per-pod kernel inputs, aligned to `planes` buckets."""
+        from ..api.resource import nonzero_request_vec, pod_request_vec
+        from ..scheduler.plugins.pod_topology_spread import PodTopologySpread
+
+        v = self.vocabs
+        nb = planes.nb
+        _, r, t, tp, k, s, w, im = planes.bucket_sizes
+        f: dict[str, np.ndarray] = {}
+
+        aff = pod.spec.affinity
+        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
+            raise FallbackNeeded("inter-pod (anti)affinity is host-side in r1")
+
+        # resources (noderesources/fit.go:317 computePodResourceRequest)
+        req = pod_request_vec(pod, self.names)
+        nz = nonzero_request_vec(req)
+        f["req"] = np.array(req.row(r), np.int32)
+        f["nz_req"] = np.array([nz[CPU], nz[MEM]], np.int32)
+
+        # NodeName (node_name.go:79)
+        if pod.spec.node_name:
+            f["name_idx"] = np.int32(planes.node_index.get(pod.spec.node_name, -2))
+        else:
+            f["name_idx"] = np.int32(-1)
+
+        # NodeUnschedulable toleration escape (node_unschedulable.go:142)
+        f["tol_unsched"] = np.bool_(any(
+            tl.key in (UNSCHEDULABLE_TAINT_KEY, "") and tl.operator == "Exists"
+            for tl in pod.spec.tolerations
+        ))
+
+        # taint tolerance tables (tainttoleration.go Filter + Score)
+        tol = np.zeros(t, bool)
+        for j in range(len(v.taints)):
+            key, val, eff = v.taints.key(j)
+            taint = Taint(key, val, eff)
+            tol[j] = any(tl.tolerates(taint) for tl in pod.spec.tolerations)
+        f["tol"] = tol
+        score_tols = [tl for tl in pod.spec.tolerations
+                      if tl.effect in ("", PREFER_NO_SCHEDULE)]
+        tolp = np.zeros(tp, bool)
+        for j in range(len(v.prefer_taints)):
+            key, val = v.prefer_taints.key(j)
+            taint = Taint(key, val, PREFER_NO_SCHEDULE)
+            tolp[j] = any(tl.tolerates(taint) for tl in score_tols)
+        f["tol_prefer"] = tolp
+
+        # node affinity / nodeSelector per label-group (node_affinity.go:218)
+        f.update(self._affinity_features(pod, planes))
+
+        # host ports (node_ports.go:75) — wildcard-ip pods only; the
+        # (proto, port) bitset is exact for those
+        ports = np.zeros(w, np.uint32)
+        has_ports = False
+        for c in pod.spec.containers:
+            for prt in c.ports:
+                if prt.host_port <= 0:
+                    continue
+                if prt.host_ip not in ("", "0.0.0.0"):
+                    raise FallbackNeeded("host port with specific hostIP")
+                b = v.ports.get((prt.protocol, prt.host_port))
+                if b is None or b // 32 >= w:
+                    raise FallbackNeeded("port vocab stale; re-register pod")
+                ports[b // 32] |= np.uint32(1 << (b % 32))
+                has_ports = True
+        f["ports"] = ports
+        f["has_ports"] = np.bool_(has_ports)
+
+        # topology spread constraints → (key idx, selector idx, skew) slots
+        pts = PodTopologySpread(system_defaulting=self.system_default_spread)
+        for kind, action in (("hard", "DoNotSchedule"), ("soft", "ScheduleAnyway")):
+            cs = pts._constraints_for(pod, action)
+            if len(cs) > self.MAX_CONSTRAINTS:
+                raise FallbackNeeded("more spread constraints than kernel slots")
+            active = np.zeros(self.MAX_CONSTRAINTS, bool)
+            ckey = np.zeros(self.MAX_CONSTRAINTS, np.int32)
+            csel = np.zeros(self.MAX_CONSTRAINTS, np.int32)
+            cskew = np.zeros(self.MAX_CONSTRAINTS, np.int32)
+            cself = np.zeros(self.MAX_CONSTRAINTS, np.int32)
+            for j, c in enumerate(cs):
+                ki = v.topo_keys.get(c.topology_key)
+                sel = c.label_selector
+                si = (v.selectors.get((pod.meta.namespace, sel.canonical()))
+                      if sel is not None else None)
+                if ki is None or ki >= k or si is None or si >= s:
+                    raise FallbackNeeded("spread vocab stale; re-register pod")
+                active[j] = True
+                ckey[j], csel[j], cskew[j] = ki, si, c.max_skew
+                cself[j] = 1 if sel.matches(pod.meta.labels) else 0
+            f[f"{kind}_active"] = active
+            f[f"{kind}_key"] = ckey
+            f[f"{kind}_sel"] = csel
+            f[f"{kind}_skew"] = cskew
+            f[f"{kind}_self"] = cself
+
+        # image locality (image_locality.go:93-105)
+        img_idx = np.full(8, -1, np.int32)
+        n_containers = len(pod.spec.containers)
+        if n_containers > 8:
+            raise FallbackNeeded("more containers than image slots")
+        for j, c in enumerate(pod.spec.containers):
+            if c.image:
+                ii = v.images.get(c.image)
+                if ii is not None and ii < im:
+                    img_idx[j] = ii
+        f["img_idx"] = img_idx
+        f["num_containers"] = np.int32(max(n_containers, 1))
+
+        # which selector signatures this pod itself matches (batched-assign
+        # carry update: the placed pod joins its own spread domains)
+        sig = np.zeros(s, np.int32)
+        for si, (ns, sel) in enumerate(v.selector_matchers):
+            if si < s and ns == pod.meta.namespace and sel.matches(pod.meta.labels):
+                sig[si] = 1
+        f["sig_match"] = sig
+        return f
+
+    def _affinity_features(self, pod: Pod, planes: Planes) -> dict[str, np.ndarray]:
+        """Per-label-group required/preferred node-affinity evaluation.
+
+        match_fields support is limited to the reference's own fast path —
+        a single term whose fields are `In(metadata.name, [...])`
+        (node_affinity.go:159) — expressed as a node allowlist mask.
+        """
+        v = self.vocabs
+        g = next_pow2(len(v.groups), 1)
+        nb = planes.nb
+        aff = pod.spec.affinity
+        node_aff = aff.node_affinity if aff else None
+        required = node_aff.required if node_aff else None
+        preferred = list(node_aff.preferred) if node_aff else []
+
+        node_allow = np.ones(nb, bool)
+        terms_for_groups = None
+        if required is not None:
+            terms = required.terms
+            any_fields = any(t.match_fields for t in terms)
+            if any_fields:
+                if len(terms) != 1 or not all(
+                    fr.key == _FIELD_HOSTNAME and fr.operator == "In"
+                    for fr in terms[0].match_fields
+                ):
+                    raise FallbackNeeded("match_fields beyond In(metadata.name)")
+                allowed: set[str] = set()
+                first = True
+                for fr in terms[0].match_fields:
+                    vals = set(fr.values)
+                    allowed = vals if first else (allowed & vals)
+                    first = False
+                node_allow = np.zeros(nb, bool)
+                for nm in allowed:
+                    i = planes.node_index.get(nm)
+                    if i is not None:
+                        node_allow[i] = True
+                # strip fields; expressions still gate per group
+                from ..api.types import NodeSelector, NodeSelectorTerm
+                terms_for_groups = NodeSelector(
+                    (NodeSelectorTerm(terms[0].match_expressions, ()),)
+                )
+            else:
+                terms_for_groups = required
+        for term in preferred:
+            if term.preference.match_fields:
+                raise FallbackNeeded("preferred term with match_fields")
+
+        group_match = np.ones(g, bool)
+        group_pref = np.zeros(g, np.int32)
+        for gi in range(len(v.groups)):
+            labels = dict(v.groups.key(gi))
+            ok = all(labels.get(kk) == vv for kk, vv in pod.spec.node_selector.items())
+            if ok and terms_for_groups is not None:
+                ok = terms_for_groups.matches(labels, {})
+            group_match[gi] = ok
+            group_pref[gi] = sum(
+                t.weight for t in preferred if t.preference.matches(labels, {})
+            )
+        return {
+            "group_match": group_match,
+            "group_pref": group_pref,
+            "has_pref": np.bool_(bool(preferred)),
+            "node_allow": node_allow,
+        }
+
+
+def stack_features(feats: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
+    """Stack per-pod feature dicts into [P, ...] batched arrays."""
+    if not feats:
+        raise ValueError("no features to stack")
+    return {k: np.stack([f[k] for f in feats]) for k in feats[0]}

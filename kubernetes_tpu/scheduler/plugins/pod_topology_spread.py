@@ -12,7 +12,7 @@ TPU-equiv (ops/kernels.py): domain ids per node + segment-sums.
 
 from __future__ import annotations
 
-import math
+import numpy as np
 
 from ...api.labels import LabelSelector
 from ...api.types import (
@@ -228,7 +228,10 @@ class PodTopologySpread(Plugin):
         node = node_info.node
         if node is None:
             return 0, Status()
-        cost = 0.0
+        # float32 fixed op order — the canonical spec mirrored by the device
+        # kernel (ops/kernels.py _pts_score); math.log would be float64 and
+        # could truncate differently at int() boundaries.
+        cost = np.float32(0.0)
         for c, counts, _self_match in per_constraint:
             val = node.meta.labels.get(c.topology_key)
             if val is None:
@@ -236,8 +239,8 @@ class PodTopologySpread(Plugin):
             count = counts.get(val, 0)
             ndomains = len(counts)
             # topologyNormalizingWeight (scoring.go:305)
-            weight = math.log(ndomains + 2)
-            cost += count * weight
+            weight = np.log(np.float32(ndomains + 2))
+            cost = cost + np.float32(count) * weight
         return int(cost), Status()
 
     def normalize_score(self, state, pod: Pod, scores) -> Status:

@@ -391,6 +391,10 @@ class SchedulingQueue:
             entry = self._nominated.get(pod.meta.key)
             return entry[0] if entry else ""
 
+    def has_nominated_pods(self) -> bool:
+        with self._mu:
+            return bool(self._nominated)
+
     # -- introspection -------------------------------------------------------
 
     def pending_pods(self) -> tuple[int, int, int]:

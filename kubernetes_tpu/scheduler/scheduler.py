@@ -81,9 +81,18 @@ class Scheduler:
                 plugins, prof.weights, profile_name=prof.name, metrics=metrics, clock=self.clock
             )
             self.frameworks[prof.name] = fw
-            self.algorithms[prof.name] = SchedulingAlgorithm(
-                fw, prof.percentage_of_nodes_to_score, rng=random.Random(seed)
-            )  # nominator wired below once the queue exists
+            if prof.backend == "tpu":
+                from .tpu.backend import TPUBackend, TPUSchedulingAlgorithm
+
+                backend = TPUBackend(self.names, plugin_args=prof.plugin_args)
+                fw.tpu_backend = backend
+                self.algorithms[prof.name] = TPUSchedulingAlgorithm(
+                    fw, backend, rng=random.Random(seed)
+                )
+            else:
+                self.algorithms[prof.name] = SchedulingAlgorithm(
+                    fw, prof.percentage_of_nodes_to_score, rng=random.Random(seed)
+                )  # nominator wired below once the queue exists
             pre_enqueue = fw.pre_enqueue_plugins  # last profile wins (single-profile typical)
             hint_map.update(fw.queueing_hint_map())
             if less_fn is None:

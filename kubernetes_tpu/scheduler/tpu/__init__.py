@@ -1,0 +1,3 @@
+from .backend import TPUBackend, TPUSchedulingAlgorithm
+
+__all__ = ["TPUBackend", "TPUSchedulingAlgorithm"]

@@ -1,0 +1,315 @@
+"""The TPU scheduling backend: dense-kernel findNodesThatFitPod + prioritizeNodes.
+
+This is the north-star component (BASELINE.json): a `backend=tpu` profile
+whose scheduling algorithm runs the fused pods×nodes feasibility-and-score
+kernel (ops/kernels.py) instead of the per-node host plugin fan-out
+(the reference's Parallelizer.Until at schedule_one.go:844 and
+runtime/framework.go:1320). The framework's extension-point state machine —
+Reserve/Permit/Bind, queueing, preemption — is untouched; only the two hot
+loops move onto the device.
+
+Bit-compatibility contract (SURVEY.md §7): with percentageOfNodesToScore=100
+the host path evaluates every node, the rotating start index is a no-op, and
+selection reduces to (max total score, seeded-rng tie-break over winners in
+snapshot node order) — which is exactly what this backend computes, so TPU
+and host decisions are identical. Golden tests enforce it.
+
+Fallback: pods using features the kernel doesn't model yet (inter-pod
+affinity, exotic match_fields, hostIP-specific ports), clusters whose
+existing pods carry (anti)affinity, and preemption aftermath (nominated
+pods) run the host path via super() — mirroring how the reference composes
+host + extender paths in one cycle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...api.resource import ResourceNames
+from ...api.types import Pod
+from ...ops import (
+    FallbackNeeded,
+    KernelConfig,
+    PlaneBuilder,
+    PodFeatureExtractor,
+    batched_assign,
+    fit_and_score,
+    next_pow2,
+    stack_features,
+)
+from ...ops.kernels import FILTER_NAMES
+from ..framework.interface import (
+    Diagnosis,
+    FitError,
+    ScheduleResult,
+    Status,
+)
+from ..schedule_one import SchedulingAlgorithm
+
+# Reconstructed host-path messages + codes per filter mask row.
+_ROW_STATUS = {
+    "NodeUnschedulable": ("unresolvable", "node(s) were unschedulable"),
+    "NodeName": ("unresolvable", "node didn't match the requested node name"),
+    "NodeAffinity": ("unresolvable", "node(s) didn't match Pod's node affinity/selector"),
+    "NodePorts": ("unschedulable", "node(s) didn't have free ports for the requested pod ports"),
+}
+
+
+class TPUBackend:
+    """Planes + features + device-state bookkeeping for one cluster."""
+
+    def __init__(self, names: ResourceNames, plugin_args: dict | None = None,
+                 system_default_spread: bool = True):
+        import jax
+
+        args = (plugin_args or {}).get("NodeResourcesFit", {})
+        self.names = names
+        self.builder = PlaneBuilder(names)
+        self.extractor = PodFeatureExtractor(
+            names, self.builder.vocabs, system_default_spread=system_default_spread
+        )
+        self.strategy = args.get("strategy", "LeastAllocated")
+        resources = args.get("resources") or {"cpu": 1, "memory": 1}
+        self.fit_resources = tuple(
+            (names.index_of(r), w) for r, w in sorted(resources.items(),
+                                                      key=lambda kv: names.index_of(kv[0]))
+        )
+        shape = args.get("shape")
+        # deep-tuple: KernelConfig is a static jit arg and must be hashable
+        # even when the config came in as JSON/YAML lists
+        self.rtc_shape = (
+            tuple(sorted(tuple(p) for p in shape)) if shape else ((0, 0), (100, 100))
+        )
+        self._device_planes: dict | None = None
+        self._device_version = -1
+        self._jax = jax
+
+    # -- config / planes -----------------------------------------------------
+
+    def kernel_config(self, planes) -> KernelConfig:
+        v = self.builder.vocabs
+        max_dom = max(
+            [len(v.domain_vocab(i)) for i in range(len(v.topo_keys))] or [1]
+        )
+        return KernelConfig(
+            strategy=self.strategy,
+            fit_resources=self.fit_resources,
+            rtc_shape=self.rtc_shape,
+            dseg=next_pow2(max_dom, planes.nb),
+            max_constraints=self.extractor.MAX_CONSTRAINTS,
+        )
+
+    def sync(self, snapshot):
+        """Refresh host planes from the snapshot and mirror them to device.
+
+        Unchanged rows cost nothing host-side (generation check); device
+        mirrors are re-uploaded per changed plane. Row-granular device
+        scatter is a round-2 optimization; the arrays are ~1 MB at 5k nodes
+        so full re-put is not the bottleneck yet.
+        """
+        planes = self.builder.sync(snapshot)
+        if self._device_planes is None or self._device_version != planes.version:
+            self._device_planes = {
+                k: self._jax.device_put(a) for k, a in planes.as_dict().items()
+            }
+            self._device_version = planes.version
+        return planes, self._device_planes
+
+    # -- eligibility ----------------------------------------------------------
+
+    def cluster_fallback_reason(self, snapshot) -> str | None:
+        """Existing-pod (anti)affinity makes *every* pod's filter/score depend
+        on pod×pod term matching (interpodaffinity filtering.go:91) — host
+        path until the IPA kernel lands."""
+        if snapshot.have_pods_with_required_anti_affinity_list:
+            return "existing pods with required anti-affinity"
+        if snapshot.have_pods_with_affinity_list:
+            return "existing pods with (anti)affinity terms"
+        return None
+
+    # -- single-pod kernel cycle ---------------------------------------------
+
+    def run(self, pod: Pod, snapshot):
+        """One pod against the whole cluster; returns kernel outputs (numpy)
+        plus the planes used. Raises FallbackNeeded when not kernelizable."""
+        reason = self.cluster_fallback_reason(snapshot)
+        if reason:
+            raise FallbackNeeded(reason)
+        self.extractor.register(pod)
+        planes, dev = self.sync(snapshot)
+        f = self.extractor.features(pod, planes)
+        cfg = self.kernel_config(planes)
+        out = fit_and_score(cfg, dev, f)
+        return planes, {
+            "fails": np.asarray(out["fails"]),
+            "feasible": np.asarray(out["feasible"]),
+            "insufficient": np.asarray(out["insufficient"]),
+            "too_many_pods": np.asarray(out["too_many_pods"]),
+            "total": np.asarray(out["total"]),
+        }
+
+    def run_batched(self, pods: list[Pod], snapshot):
+        """Greedy batched assignment of a pod wave in one device program.
+
+        Returns (node names per pod or None, planes). The caller applies the
+        same assumes host-side so cache and device state stay coherent."""
+        reason = self.cluster_fallback_reason(snapshot)
+        if reason:
+            raise FallbackNeeded(reason)
+        for pod in pods:
+            self.extractor.register(pod)
+        planes, dev = self.sync(snapshot)
+        feats = stack_features([self.extractor.features(p, planes) for p in pods])
+        cfg = self.kernel_config(planes)
+        winners, _ = batched_assign(cfg, dev, feats)
+        winners = np.asarray(winners)
+        return [planes.node_names[w] if w >= 0 else None for w in winners], planes
+
+    # -- diagnosis reconstruction ---------------------------------------------
+
+    def build_diagnosis(self, pod: Pod, planes, out) -> Diagnosis:
+        """Reconstruct per-node first-failure statuses exactly as the host
+        filter chain would have produced them (first rejecting plugin wins,
+        runtime RunFilterPlugins)."""
+        diagnosis = Diagnosis()
+        v = self.builder.vocabs
+        fails = out["fails"]
+        c_max = self.extractor.MAX_CONSTRAINTS
+        # interleave PTS rows the way the host plugin checks per constraint:
+        # missing-key then skew, constraint by constraint
+        order: list[tuple[str, int]] = [(nm, i) for i, nm in enumerate(FILTER_NAMES)]
+        for c in range(c_max):
+            order.append((f"pts_missing:{c}", len(FILTER_NAMES) + c))
+            order.append((f"pts_skew:{c}", len(FILTER_NAMES) + c_max + c))
+        hard_keys = self._hard_constraint_keys(pod)
+        # tolerance per taint-vocab entry, for host-identical taint messages
+        from ...api.types import Taint
+
+        tol = [
+            any(tl.tolerates(Taint(*v.taints.key(j))) for tl in pod.spec.tolerations)
+            for j in range(len(v.taints))
+        ]
+        for i in range(planes.n):
+            if out["feasible"][i]:
+                continue
+            st = None
+            for name, row in order:
+                if not fails[row, i]:
+                    continue
+                st = self._row_to_status(name, i, planes, out, hard_keys, tol)
+                break
+            if st is not None:
+                diagnosis.node_to_status.set(planes.node_names[i], st)
+                diagnosis.unschedulable_plugins.add(st.plugin)
+        return diagnosis
+
+    def _hard_constraint_keys(self, pod: Pod) -> list[str]:
+        from ..plugins.pod_topology_spread import PodTopologySpread
+
+        pts = PodTopologySpread(system_defaulting=self.extractor.system_default_spread)
+        return [c.topology_key for c in pts._constraints_for(pod, "DoNotSchedule")]
+
+    def _row_to_status(self, name: str, i: int, planes, out, hard_keys, tol) -> Status:
+        v = self.builder.vocabs
+        if name == "TaintToleration":
+            # the first *intolerable* taint, matching the host filter's
+            # first-rejection message (basics.py TaintToleration.filter)
+            msg = "node(s) had untolerated taint"
+            for tid in planes.taints[i]:
+                if tid >= 0 and not tol[int(tid)]:
+                    key, val, _eff = v.taints.key(int(tid))
+                    msg = f"node(s) had untolerated taint {{{key}: {val}}}"
+                    break
+            return Status.unresolvable(msg, plugin="TaintToleration")
+        if name == "NodeResourcesFit":
+            reasons = []
+            if out["too_many_pods"][i]:
+                reasons.append("Too many pods")
+            for r in range(out["insufficient"].shape[0]):
+                if out["insufficient"][r, i]:
+                    rname = (self.names.names[r] if r < self.names.width else f"res{r}")
+                    reasons.append(f"Insufficient {rname}")
+            return Status.unschedulable(*reasons, plugin="NodeResourcesFit")
+        if name.startswith("pts_missing:"):
+            c = int(name.split(":")[1])
+            key = hard_keys[c] if c < len(hard_keys) else "?"
+            return Status.unresolvable(
+                f"node(s) didn't have required label {key}", plugin="PodTopologySpread"
+            )
+        if name.startswith("pts_skew:"):
+            return Status.unschedulable(
+                "node(s) didn't match pod topology spread constraints",
+                plugin="PodTopologySpread",
+            )
+        kind, msg = _ROW_STATUS[name]
+        ctor = Status.unresolvable if kind == "unresolvable" else Status.unschedulable
+        return ctor(msg, plugin=name)
+
+
+class TPUSchedulingAlgorithm(SchedulingAlgorithm):
+    """schedulePod with the dense kernel on the hot path.
+
+    Inherits select_host (seeded-rng tie-break) and the host path for
+    fallback, so decisions match the host algorithm bit-for-bit at
+    percentageOfNodesToScore=100."""
+
+    def __init__(self, framework, backend: TPUBackend, rng=None, nominator=None):
+        super().__init__(framework, percentage_of_nodes_to_score=100,
+                         rng=rng, nominator=nominator)
+        self.backend = backend
+        self.fallback_count = 0
+        self.kernel_count = 0
+
+    def schedule_pod(self, state, pod: Pod, snapshot) -> ScheduleResult:
+        if snapshot.num_nodes() == 0:
+            raise FitError(pod, 0, Diagnosis())
+        if self._must_fall_back(pod):
+            self.fallback_count += 1
+            return super().schedule_pod(state, pod, snapshot)
+        try:
+            planes, out = self.backend.run(pod, snapshot)
+        except FallbackNeeded:
+            self.fallback_count += 1
+            return super().schedule_pod(state, pod, snapshot)
+        self.kernel_count += 1
+
+        feasible_idx = np.flatnonzero(out["feasible"][: planes.n])
+        if feasible_idx.size == 0:
+            # Populate CycleState via the host PreFilter chain before raising:
+            # DefaultPreemption's victim dry-run re-runs Filter plugins against
+            # this state (preemption.go SelectVictimsOnNode), and e.g.
+            # PodTopologySpread.filter is a no-op without its prefilter state —
+            # skipping this would let preemption nominate skew-violating nodes.
+            self.fw.run_pre_filter_plugins(state, pod, snapshot.list_nodes())
+            diagnosis = self.backend.build_diagnosis(pod, planes, out)
+            raise FitError(pod, snapshot.num_nodes(), diagnosis)
+        if feasible_idx.size == 1:
+            evaluated = planes.n  # every node was evaluated by the kernel
+            return ScheduleResult(
+                suggested_host=planes.node_names[int(feasible_idx[0])],
+                evaluated_nodes=evaluated,
+                feasible_nodes=1,
+            )
+        totals = out["total"][feasible_idx]
+        best = totals.max()
+        winners = feasible_idx[totals == best]
+        if winners.size > 1:
+            win = int(winners[self.rng.randrange(winners.size)])
+        else:
+            win = int(winners[0])
+        return ScheduleResult(
+            suggested_host=planes.node_names[win],
+            evaluated_nodes=planes.n,
+            feasible_nodes=int(feasible_idx.size),
+        )
+
+    def _must_fall_back(self, pod: Pod) -> bool:
+        # preemption aftermath: nominated pods must be simulated onto nodes
+        # during filtering (schedule_one.go:1190) — host path handles it
+        if pod.status.nominated_node_name:
+            return True
+        if self.nominator is not None and getattr(
+            self.nominator, "has_nominated_pods", lambda: False
+        )():
+            return True
+        return False

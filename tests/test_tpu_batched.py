@@ -1,0 +1,104 @@
+"""Batched-assign kernel tests: scan-carry semantics + failure-path state.
+
+batched_assign's contract: scheduling a pod wave in one device program gives
+the same placements as running the per-pod kernel sequentially with host-side
+assumes between pods (first-max-index tie-break in both) — i.e. the carry
+correctly plays the role of cache.AssumePod (schedule_one.go:320-333).
+"""
+
+import numpy as np
+
+from kubernetes_tpu.api.resource import ResourceNames
+from kubernetes_tpu.ops import KernelConfig, batched_assign, stack_features
+from kubernetes_tpu.scheduler.cache.cache import Cache
+from kubernetes_tpu.scheduler.cache.snapshot import Snapshot
+from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
+from kubernetes_tpu.scheduler.framework.interface import FitError
+from kubernetes_tpu.scheduler.nodeinfo import PodInfo
+from kubernetes_tpu.scheduler.tpu.backend import TPUBackend
+from tests.wrappers import make_node, make_pod
+
+
+def make_cluster(n_nodes=12):
+    names = ResourceNames()
+    cache = Cache(names)
+    for i in range(n_nodes):
+        cache.add_node(make_node(f"n{i}", cpu="4", mem="8Gi", zone=f"z{i % 3}"))
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    return names, cache, snap
+
+
+class TestBatchedAssign:
+    def test_matches_sequential_kernel_with_assumes(self):
+        names, cache, snap = make_cluster()
+        pods = [make_pod(f"p{i:02d}", cpu="1", mem="1Gi", labels={"app": "w"})
+                for i in range(20)]
+
+        # batched: one device program for the whole wave
+        backend_b = TPUBackend(names)
+        batched_names, _ = backend_b.run_batched(pods, snap)
+
+        # reference: per-pod kernel + host assume between pods
+        backend_s = TPUBackend(names)
+        seq_names = []
+        for pod in pods:
+            planes, out = backend_s.run(pod, snap)
+            total = out["total"][: planes.n]
+            if (total >= 0).any():
+                win = int(np.argmax(total))  # first-max, as the scan does
+                node = planes.node_names[win]
+                cache.assume_pod(pod, node)
+                cache.update_snapshot(snap)
+            else:
+                node = None
+            seq_names.append(node)
+
+        assert batched_names == seq_names
+        # the wave must actually spread (carry visible to later pods):
+        # 20 pods × 1cpu over 12 × 4cpu nodes → no node gets more than 2
+        counts = {}
+        for n in batched_names:
+            counts[n] = counts.get(n, 0) + 1
+        assert max(counts.values()) <= 2
+
+    def test_capacity_exhaustion_returns_minus_one(self):
+        names, cache, snap = make_cluster(n_nodes=2)
+        pods = [make_pod(f"p{i}", cpu="3") for i in range(4)]  # 2×4cpu total
+        backend = TPUBackend(names)
+        got, _ = backend.run_batched(pods, snap)
+        assert got[0] is not None and got[1] is not None
+        assert got[2] is None and got[3] is None
+
+
+class TestKernelFailurePathState:
+    def test_prefilter_state_populated_on_fit_error(self):
+        """Preemption dry-runs re-run Filter plugins against the CycleState;
+        the kernel failure path must populate it via the host PreFilter chain
+        (regression: PTS filter is a no-op without its prefilter state)."""
+        import random
+
+        from kubernetes_tpu.scheduler.framework.runtime import Framework
+        from kubernetes_tpu.scheduler.plugins.pod_topology_spread import PodTopologySpread
+        from kubernetes_tpu.scheduler.plugins.registry import DEFAULT_WEIGHTS, default_plugins
+        from kubernetes_tpu.scheduler.tpu.backend import TPUSchedulingAlgorithm
+        from kubernetes_tpu.store import Store
+
+        from kubernetes_tpu.api.labels import LabelSelector
+        from tests.wrappers import with_spread
+
+        names, cache, snap = make_cluster(n_nodes=2)
+        fw = Framework(default_plugins(Store(), names, {}, {}), dict(DEFAULT_WEIGHTS))
+        algo = TPUSchedulingAlgorithm(fw, TPUBackend(names), rng=random.Random(0))
+        state = CycleState()
+        pod = with_spread(
+            make_pod("big", cpu="64", labels={"app": "w"}),
+            max_skew=1, key="topology.kubernetes.io/zone",
+            when="DoNotSchedule", selector=LabelSelector.of({"app": "w"}),
+        )
+        try:
+            algo.schedule_pod(state, pod, snap)
+            raise AssertionError("expected FitError")
+        except FitError:
+            pass
+        assert state.read(PodTopologySpread.PRE_FILTER_KEY) is not None

@@ -1,0 +1,279 @@
+"""Golden tests: TPU kernel path ≡ host plugin path, bit for bit.
+
+The contract (SURVEY.md §7, BASELINE.json): at percentageOfNodesToScore=100
+the host algorithm evaluates every node and its decisions reduce to
+(feasible set, integer total scores, seeded tie-break) — all of which the
+dense kernel must reproduce exactly. Modeled on the reference's golden-diff
+strategy between scheduler configs (test/integration/scheduler_perf).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.resource import ResourceNames
+from kubernetes_tpu.api.types import Taint, Toleration
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.cache.cache import Cache
+from kubernetes_tpu.scheduler.cache.snapshot import Snapshot
+from kubernetes_tpu.scheduler.framework.cycle_state import CycleState
+from kubernetes_tpu.scheduler.framework.interface import FitError
+from kubernetes_tpu.scheduler.framework.runtime import Framework
+from kubernetes_tpu.scheduler.plugins.registry import DEFAULT_WEIGHTS, default_plugins
+from kubernetes_tpu.scheduler.schedule_one import SchedulingAlgorithm
+from kubernetes_tpu.scheduler.tpu.backend import TPUBackend, TPUSchedulingAlgorithm
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_node, make_pod, with_spread, with_tolerations
+
+
+def build_pair(nodes, existing_pods=(), plugin_args=None):
+    """(host algo, tpu algo, cache, snapshot) over the same cluster."""
+    names = ResourceNames()
+    cache = Cache(names)
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing_pods:
+        cache.add_pod(p)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    store = Store()
+    plugins = default_plugins(store, names, {}, plugin_args or {})
+    fw = Framework(plugins, dict(DEFAULT_WEIGHTS))
+    host = SchedulingAlgorithm(fw, percentage_of_nodes_to_score=100,
+                               rng=random.Random(0))
+    backend = TPUBackend(names, plugin_args=plugin_args)
+    tpu = TPUSchedulingAlgorithm(fw, backend, rng=random.Random(0))
+    return host, tpu, cache, snap
+
+
+def host_feasible_and_scores(host, pod, snap):
+    state = CycleState()
+    feasible, _diag = host.find_nodes_that_fit_pod(state, pod, snap)
+    names = [ni.name for ni in feasible]
+    scores = host.prioritize_nodes(state, pod, feasible)
+    return names, {s.name: s.total_score for s in scores}
+
+
+def kernel_feasible_and_scores(tpu, pod, snap):
+    planes, out = tpu.backend.run(pod, snap)
+    idx = np.flatnonzero(out["feasible"][: planes.n])
+    names = [planes.node_names[i] for i in idx]
+    return names, {planes.node_names[i]: int(out["total"][i]) for i in idx}
+
+
+def assert_parity(host, tpu, pod, snap):
+    h_names, h_scores = host_feasible_and_scores(host, pod, snap)
+    k_names, k_scores = kernel_feasible_and_scores(tpu, pod, snap)
+    assert sorted(h_names) == sorted(k_names), (
+        f"feasible mismatch for {pod.meta.name}: host-only "
+        f"{set(h_names) - set(k_names)}, kernel-only {set(k_names) - set(h_names)}"
+    )
+    assert h_scores == k_scores, (
+        f"score mismatch for {pod.meta.name}: "
+        f"{ {n: (h_scores[n], k_scores[n]) for n in h_scores if h_scores[n] != k_scores.get(n)} }"
+    )
+
+
+def hetero_nodes(n=24, seed=7):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n):
+        cpu = rng.choice(["2", "4", "8", "16", "32"])
+        mem = rng.choice(["4Gi", "8Gi", "16Gi", "64Gi"])
+        nodes.append(make_node(f"n{i}", cpu=cpu, mem=mem, pods=rng.choice([5, 110]),
+                               zone=f"z{i % 3}"))
+    return nodes
+
+
+def hetero_existing(nodes, count=30, seed=11):
+    rng = random.Random(seed)
+    pods = []
+    for i in range(count):
+        node = rng.choice(nodes).meta.name
+        pods.append(make_pod(
+            f"ex{i}", cpu=rng.choice(["100m", "500m", "1"]),
+            mem=rng.choice(["128Mi", "1Gi"]), node_name=node,
+            labels={"app": rng.choice(["web", "db"])},
+        ))
+    return pods
+
+
+class TestFeasibilityAndScoreParity:
+    def test_basic_resources(self):
+        nodes = hetero_nodes()
+        host, tpu, _, snap = build_pair(nodes, hetero_existing(nodes))
+        for i, (cpu, mem) in enumerate([("1", "1Gi"), ("500m", "4Gi"), ("8", "100Mi"),
+                                        (None, None), ("16", "32Gi")]):
+            pod = make_pod(f"p{i}", cpu=cpu, mem=mem, labels={"app": "web"})
+            assert_parity(host, tpu, pod, snap)
+
+    def test_zero_request_pod_nonzero_accounting(self):
+        nodes = hetero_nodes(8)
+        host, tpu, _, snap = build_pair(nodes, hetero_existing(nodes, 10))
+        assert_parity(host, tpu, make_pod("empty"), snap)
+
+    def test_most_allocated_strategy(self):
+        args = {"NodeResourcesFit": {"strategy": "MostAllocated"}}
+        nodes = hetero_nodes(12)
+        host, tpu, _, snap = build_pair(nodes, hetero_existing(nodes, 20),
+                                        plugin_args=args)
+        assert_parity(host, tpu, make_pod("p", cpu="1", mem="2Gi"), snap)
+
+    def test_requested_to_capacity_ratio(self):
+        args = {"NodeResourcesFit": {
+            "strategy": "RequestedToCapacityRatio",
+            "shape": [(0, 100), (100, 0)],
+        }}
+        nodes = hetero_nodes(12)
+        host, tpu, _, snap = build_pair(nodes, hetero_existing(nodes, 20),
+                                        plugin_args=args)
+        assert_parity(host, tpu, make_pod("p", cpu="2", mem="1Gi"), snap)
+
+    def test_taints_filter_and_score(self):
+        nodes = hetero_nodes(12)
+        nodes[0].spec.taints = (Taint("dedicated", "gpu", "NoSchedule"),)
+        nodes[1].spec.taints = (Taint("maint", "", "NoExecute"),)
+        nodes[2].spec.taints = (Taint("pref", "x", "PreferNoSchedule"),)
+        nodes[3].spec.taints = (Taint("pref", "x", "PreferNoSchedule"),
+                                Taint("pref2", "y", "PreferNoSchedule"))
+        host, tpu, _, snap = build_pair(nodes)
+        plain = make_pod("plain", cpu="1")
+        assert_parity(host, tpu, plain, snap)
+        tolerant = with_tolerations(
+            make_pod("tolerant", cpu="1"),
+            Toleration(key="dedicated", operator="Equal", value="gpu", effect="NoSchedule"),
+            Toleration(key="maint", operator="Exists"),
+            Toleration(key="pref", operator="Exists", effect="PreferNoSchedule"),
+        )
+        assert_parity(host, tpu, tolerant, snap)
+
+    def test_unschedulable_nodes(self):
+        nodes = hetero_nodes(6)
+        nodes[0].spec.unschedulable = True
+        nodes[4].spec.unschedulable = True
+        host, tpu, _, snap = build_pair(nodes)
+        assert_parity(host, tpu, make_pod("p", cpu="1"), snap)
+        tol = with_tolerations(
+            make_pod("tol", cpu="1"),
+            Toleration(key="node.kubernetes.io/unschedulable", operator="Exists"),
+        )
+        assert_parity(host, tpu, tol, snap)
+
+    def test_node_name_pod(self):
+        nodes = hetero_nodes(6)
+        host, tpu, _, snap = build_pair(nodes)
+        assert_parity(host, tpu, make_pod("pinned", cpu="1", node_name="n3"), snap)
+
+    def test_node_selector_groups(self):
+        nodes = hetero_nodes(12)
+        for i, n in enumerate(nodes):
+            n.meta.labels["disk"] = "ssd" if i % 2 == 0 else "hdd"
+        host, tpu, _, snap = build_pair(nodes)
+        pod = make_pod("p", cpu="1")
+        pod.spec.node_selector = {"disk": "ssd"}
+        assert_parity(host, tpu, pod, snap)
+
+    def test_host_ports(self):
+        nodes = hetero_nodes(6)
+        existing = [make_pod("ex0", node_name="n0", host_ports=(8080,)),
+                    make_pod("ex1", node_name="n1", host_ports=(8080, 9090))]
+        host, tpu, _, snap = build_pair(nodes, existing)
+        assert_parity(host, tpu, make_pod("p", host_ports=(8080,)), snap)
+        assert_parity(host, tpu, make_pod("q", host_ports=(9090,)), snap)
+
+    def test_default_spread_scoring(self):
+        nodes = hetero_nodes(12)
+        existing = hetero_existing(nodes, 20)
+        host, tpu, _, snap = build_pair(nodes, existing)
+        assert_parity(host, tpu, make_pod("p", cpu="1", labels={"app": "web"}), snap)
+
+    def test_hard_spread_constraint(self):
+        nodes = [make_node(f"n{i}", cpu="8", mem="16Gi", zone=f"z{i % 3}")
+                 for i in range(9)]
+        existing = [make_pod(f"ex{i}", cpu="100m", node_name=f"n{i % 4}",
+                             labels={"group": "g"}) for i in range(6)]
+        host, tpu, _, snap = build_pair(nodes, existing)
+        from kubernetes_tpu.api.labels import LabelSelector
+
+        pod = with_spread(
+            make_pod("p", cpu="100m", labels={"group": "g"}),
+            max_skew=1, key="topology.kubernetes.io/zone",
+            when="DoNotSchedule", selector=LabelSelector.of({"group": "g"}),
+        )
+        assert_parity(host, tpu, pod, snap)
+
+    def test_image_locality(self):
+        nodes = hetero_nodes(6)
+        from kubernetes_tpu.api.types import ContainerImage
+
+        nodes[0].status.images = [ContainerImage(("img:v1",), 700 * 1024 * 1024)]
+        nodes[1].status.images = [ContainerImage(("img:v1",), 50 * 1024 * 1024)]
+        host, tpu, _, snap = build_pair(nodes)
+        assert_parity(host, tpu, make_pod("p", cpu="1", image="img:v1"), snap)
+
+    def test_infeasible_diagnosis_codes(self):
+        nodes = [make_node("small", cpu="1", mem="1Gi")]
+        host, tpu, _, snap = build_pair(nodes)
+        pod = make_pod("big", cpu="8", mem="64Gi")
+        with pytest.raises(FitError) as hosterr:
+            host.schedule_pod(CycleState(), pod, snap)
+        with pytest.raises(FitError) as tpuerr:
+            tpu.schedule_pod(CycleState(), pod, snap)
+        assert str(hosterr.value) == str(tpuerr.value)
+
+
+class TestEndToEndDecisionParity:
+    """Two full schedulers over identical stores must produce identical
+    bindings for every pod (the reference's golden-diff requirement)."""
+
+    def _run(self, backend, nodes, pods, plugin_args=None):
+        store = Store()
+        for n in nodes:
+            store.create(n)
+        for p in pods:
+            store.create(p)
+        prof = Profile(backend=backend, plugin_args=plugin_args or {},
+                       percentage_of_nodes_to_score=100)
+        s = Scheduler(store, profiles=[prof], seed=42)
+        s.start()
+        s.schedule_pending()
+        return {p.meta.name: p.spec.node_name for p in store.pods()}, s
+
+    def _nodes_and_pods(self, seed=3, n_nodes=20, n_pods=40):
+        rng = random.Random(seed)
+        nodes = []
+        for i in range(n_nodes):
+            nodes.append(make_node(
+                f"n{i}", cpu=rng.choice(["4", "8", "16"]),
+                mem=rng.choice(["8Gi", "32Gi"]), zone=f"z{i % 4}",
+            ))
+        pods = []
+        for i in range(n_pods):
+            pods.append(make_pod(
+                f"p{i:03d}", cpu=rng.choice(["100m", "500m", "2"]),
+                mem=rng.choice(["128Mi", "1Gi", "4Gi"]),
+                labels={"app": rng.choice(["a", "b"])},
+            ))
+        return nodes, pods
+
+    def test_sequence_parity(self):
+        nodes, pods = self._nodes_and_pods()
+        import copy
+
+        host_bind, _ = self._run("host", copy.deepcopy(nodes), copy.deepcopy(pods))
+        tpu_bind, s = self._run("tpu", nodes, pods)
+        assert host_bind == tpu_bind
+        algo = s.algorithms["default-scheduler"]
+        assert algo.kernel_count > 0, "kernel path never ran"
+        assert algo.fallback_count == 0
+
+    def test_sequence_parity_most_allocated(self):
+        nodes, pods = self._nodes_and_pods(seed=9)
+        args = {"NodeResourcesFit": {"strategy": "MostAllocated"}}
+        import copy
+
+        host_bind, _ = self._run("host", copy.deepcopy(nodes), copy.deepcopy(pods), args)
+        tpu_bind, s = self._run("tpu", nodes, pods, args)
+        assert host_bind == tpu_bind
+        assert s.algorithms["default-scheduler"].kernel_count > 0
