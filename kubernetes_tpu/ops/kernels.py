@@ -63,8 +63,14 @@ class KernelConfig:
         ("NodeResourcesFit", 1), ("NodeResourcesBalancedAllocation", 1),
         ("ImageLocality", 1),
     )
-    # segment count for topology-domain segment sums (≥ max domain vocab len)
-    dseg: int = 1024
+    # per-topology-key domain treatment: 0 = singleton fast path (every
+    # domain holds exactly one node, e.g. kubernetes.io/hostname — counts
+    # are pure elementwise math), else the padded domain-vocab size for the
+    # one-hot-matmul segment reduction (e.g. zone: 8 domains → 8)
+    topo_domains: tuple[int, ...] = (16, 0)
+    # above this domain count, fall back to scatter segment_sum rather than
+    # materializing a [dk, Nb] one-hot each step
+    matmul_domain_cap: int = 2048
     max_constraints: int = 4
 
     def weight(self, name: str) -> int:
@@ -77,25 +83,84 @@ class KernelConfig:
 
 
 def _pts_domain_stats(cfg, planes, mask, key_i, sel_i):
-    """Per-domain pod counts + presence for one spread constraint.
+    """Per-constraint domain stats: (has_key [Nb], count_at_node [Nb],
+    min_count scalar, ndom scalar — number of domains with a participant).
 
     mask selects which nodes participate (all valid nodes for Filter,
     feasible nodes for Score — matching where the host plugin builds counts:
     PreFilter over all nodes, PreScore over the filtered list).
+
+    Statically unrolls over the topology-key slots so each key uses its
+    shape-appropriate reduction: singleton keys (hostname — one node per
+    domain) are pure elementwise, small vocabs use a one-hot matmul (MXU,
+    no scatter), giant non-singleton vocabs fall back to segment_sum.
+    count_at_node is only meaningful where mask & has_key; callers gate on
+    that, so the singleton path may return the raw per-node count everywhere.
     """
-    dom = jnp.take(planes["domain"], key_i, axis=1)          # [Nb]
+    dom_all = planes["domain"]
+    if len(cfg.topo_domains) != dom_all.shape[1]:
+        raise ValueError(
+            f"KernelConfig.topo_domains has {len(cfg.topo_domains)} slots but "
+            f"planes carry {dom_all.shape[1]} topology-key columns; build the "
+            "config via TPUBackend.kernel_config/PlaneBuilder.topo_domains"
+        )
     cnt = jnp.take(planes["sel_counts"], sel_i, axis=1)      # [Nb]
-    has_key = dom >= 0
-    part = mask & has_key
-    dom_c = jnp.clip(dom, 0, cfg.dseg - 1)
-    seg = jax.ops.segment_sum(
-        jnp.where(part, cnt, 0), dom_c, num_segments=cfg.dseg
-    )
-    present = jax.ops.segment_sum(
-        jnp.where(part, 1, 0), dom_c, num_segments=cfg.dseg
-    ) > 0
-    count_at_node = jnp.take(seg, dom_c)
-    return has_key, count_at_node, seg, present
+    big = jnp.iinfo(jnp.int32).max
+    nb = dom_all.shape[0]
+    has_key_o = jnp.zeros(nb, bool)
+    count_o = jnp.zeros(nb, jnp.int32)
+    min_o = jnp.int32(0)
+    ndom_o = jnp.int32(0)
+    for k, dk in enumerate(cfg.topo_domains):
+        dom = dom_all[:, k]
+        has_key = dom >= 0
+        part = mask & has_key
+        if dk == 0:
+            # singleton: domain ↔ node, so the segment sum is the identity
+            count = cnt
+            min_c = jnp.where(
+                part.any(), jnp.min(jnp.where(part, cnt, big)), 0
+            )
+            ndom = part.sum().astype(jnp.int32)
+        elif dk <= cfg.matmul_domain_cap:
+            dom_c = jnp.clip(dom, 0, dk - 1)
+            # one-hot matmul at HIGHEST precision: the MXU's default bf16
+            # input cast would round counts > 256; highest-precision f32 is
+            # exact for integer values < 2^24
+            oh = (jnp.arange(dk, dtype=jnp.int32)[:, None] == dom_c[None, :]
+                  ).astype(jnp.float32)
+            seg = jnp.matmul(
+                oh, jnp.where(part, cnt, 0).astype(jnp.float32),
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(jnp.int32)
+            present = jnp.matmul(
+                oh, part.astype(jnp.float32),
+                precision=jax.lax.Precision.HIGHEST,
+            ) > 0.5
+            count = jnp.take(seg, dom_c)
+            min_c = jnp.where(
+                present.any(), jnp.min(jnp.where(present, seg, big)), 0
+            )
+            ndom = present.sum().astype(jnp.int32)
+        else:
+            dom_c = jnp.clip(dom, 0, dk - 1)
+            seg = jax.ops.segment_sum(
+                jnp.where(part, cnt, 0), dom_c, num_segments=dk
+            )
+            present = jax.ops.segment_sum(
+                jnp.where(part, 1, 0), dom_c, num_segments=dk
+            ) > 0
+            count = jnp.take(seg, dom_c)
+            min_c = jnp.where(
+                present.any(), jnp.min(jnp.where(present, seg, big)), 0
+            )
+            ndom = present.sum().astype(jnp.int32)
+        sel = key_i == k
+        has_key_o = jnp.where(sel, has_key, has_key_o)
+        count_o = jnp.where(sel, count, count_o)
+        min_o = jnp.where(sel, min_c, min_o)
+        ndom_o = jnp.where(sel, ndom, ndom_o)
+    return has_key_o, count_o, min_o, ndom_o
 
 
 def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
@@ -120,9 +185,13 @@ def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
     tol = jnp.take(f["tol"], jnp.clip(tid, 0), axis=0)
     f_taint = ((tid >= 0) & ~tol).any(axis=1)
 
-    # NodeAffinity required + nodeSelector (node_affinity.go:218)
-    gm = jnp.take(f["group_match"], planes["group_id"], axis=0)
-    f_aff = ~(gm & f["node_allow"])
+    # NodeAffinity required + nodeSelector (node_affinity.go:218) —
+    # per-signature table rows shared across identical pods (the dense
+    # analogue of SignPod, staging/.../framework/signers.go)
+    row = jnp.take(planes["aff_match"], f["aff_sig"], axis=0)    # [G]
+    allow = jnp.take(planes["aff_allow"], f["aff_sig"], axis=0)  # [Nb]
+    gm = jnp.take(row, planes["group_id"])
+    f_aff = ~(gm & allow)
 
     # NodePorts (node_ports.go:75)
     conflict = (planes["port_words"] & f["ports"][None, :]) != 0
@@ -139,13 +208,8 @@ def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
     pts_missing, pts_skew = [], []
     for c in range(cfg.max_constraints):
         active = f["hard_active"][c]
-        has_key, count, seg, present = _pts_domain_stats(
+        has_key, count, min_count, _ = _pts_domain_stats(
             cfg, planes, valid, f["hard_key"][c], f["hard_sel"][c]
-        )
-        min_count = jnp.where(
-            present.any(),
-            jnp.min(jnp.where(present, seg, jnp.iinfo(jnp.int32).max)),
-            0,
         )
         skew = count + f["hard_self"][c] - min_count
         pts_missing.append(active & ~has_key)
@@ -248,10 +312,12 @@ def _taint_score(planes, f, feasible):
 
 def _node_affinity_score(planes, f, feasible):
     """node_affinity.go:272 + normalize to max=100 over the feasible set."""
-    raw = jnp.take(f["group_pref"], planes["group_id"], axis=0)
+    row = jnp.take(planes["aff_pref"], f["aff_sig"], axis=0)    # [G]
+    raw = jnp.take(row, planes["group_id"])
     mx = jnp.max(jnp.where(feasible, raw, 0))
     normed = jnp.where(mx > 0, raw * MAX_NODE_SCORE // jnp.maximum(mx, 1), raw)
-    return jnp.where(f["has_pref"], normed, 0)
+    has_pref = jnp.take(planes["aff_has_pref"], f["aff_sig"])
+    return jnp.where(has_pref, normed, 0)
 
 
 def _pts_score(cfg: KernelConfig, planes, f, feasible):
@@ -262,10 +328,9 @@ def _pts_score(cfg: KernelConfig, planes, f, feasible):
     any_active = f["soft_active"].any()
     for c in range(cfg.max_constraints):
         active = f["soft_active"][c]
-        has_key, count, seg, present = _pts_domain_stats(
+        has_key, count, _, nd = _pts_domain_stats(
             cfg, planes, feasible, f["soft_key"][c], f["soft_sel"][c]
         )
-        nd = present.sum().astype(jnp.int32)
         w = jnp.log((nd + 2).astype(jnp.float32))
         cost = cost + jnp.where(
             active & has_key, count.astype(jnp.float32) * w, jnp.float32(0)

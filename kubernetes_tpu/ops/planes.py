@@ -134,6 +134,26 @@ class PlaneBuilder:
         self._planes = p
         return p
 
+    def topo_domains(self, planes: Planes) -> tuple[int, ...]:
+        """Per-topology-key kernel treatment (KernelConfig.topo_domains):
+        0 when every domain holds at most one node (hostname-style keys —
+        the kernel then skips segment reductions entirely), else the padded
+        domain-vocab size for the one-hot-matmul reduction."""
+        v = self.vocabs
+        out = []
+        k_bucket = planes.domain.shape[1]
+        for k in range(k_bucket):
+            if k >= len(v.topo_keys):
+                out.append(0)  # unused key slot
+                continue
+            col = planes.domain[: planes.n, k]
+            vals = col[col >= 0]
+            if vals.size == 0 or np.unique(vals).size == vals.size:
+                out.append(0)
+            else:
+                out.append(next_pow2(len(v.domain_vocab(k)), 1))
+        return tuple(out)
+
     # -- internals ----------------------------------------------------------
 
     def _register_node(self, ni) -> None:
@@ -276,6 +296,10 @@ class PodFeatureExtractor:
         self.names = names
         self.vocabs = vocabs
         self.system_default_spread = system_default_spread
+        self._aff_sigs: dict = {}
+        self._aff_specs: list = []
+        self._aff_tables: dict | None = None
+        self._aff_tables_key: tuple | None = None
 
     # -- vocab registration (must run before PlaneBuilder.sync) -------------
 
@@ -349,8 +373,10 @@ class PodFeatureExtractor:
             tolp[j] = any(tl.tolerates(taint) for tl in score_tols)
         f["tol_prefer"] = tolp
 
-        # node affinity / nodeSelector per label-group (node_affinity.go:218)
-        f.update(self._affinity_features(pod, planes))
+        # node affinity / nodeSelector resolved to a shared signature row
+        # (node_affinity.go:218; signature reuse mirrors SignPod,
+        # staging/.../framework/signers.go — identical pods share one row)
+        f["aff_sig"] = np.int32(self._affinity_sig(pod))
 
         # host ports (node_ports.go:75) — wildcard-ip pods only; the
         # (proto, port) bitset is exact for those
@@ -419,43 +445,39 @@ class PodFeatureExtractor:
         f["sig_match"] = sig
         return f
 
-    def _affinity_features(self, pod: Pod, planes: Planes) -> dict[str, np.ndarray]:
-        """Per-label-group required/preferred node-affinity evaluation.
+    def _affinity_sig(self, pod: Pod) -> int:
+        """Intern the pod's (nodeSelector, node affinity) spec into a
+        signature id; identical pods share one table row.
 
         match_fields support is limited to the reference's own fast path —
         a single term whose fields are `In(metadata.name, [...])`
-        (node_affinity.go:159) — expressed as a node allowlist mask.
+        (node_affinity.go:159) — expressed as a node allowlist.
         """
-        v = self.vocabs
-        g = next_pow2(len(v.groups), 1)
-        nb = planes.nb
         aff = pod.spec.affinity
         node_aff = aff.node_affinity if aff else None
         required = node_aff.required if node_aff else None
-        preferred = list(node_aff.preferred) if node_aff else []
+        preferred = tuple(node_aff.preferred) if node_aff else ()
+        selector = tuple(sorted(pod.spec.node_selector.items()))
+        key = (selector, repr(required), repr(preferred))
+        sig = self._aff_sigs.get(key)
+        if sig is not None:
+            return sig
 
-        node_allow = np.ones(nb, bool)
+        allowed_names: frozenset | None = None
         terms_for_groups = None
         if required is not None:
             terms = required.terms
-            any_fields = any(t.match_fields for t in terms)
-            if any_fields:
+            if any(t.match_fields for t in terms):
                 if len(terms) != 1 or not all(
                     fr.key == _FIELD_HOSTNAME and fr.operator == "In"
                     for fr in terms[0].match_fields
                 ):
                     raise FallbackNeeded("match_fields beyond In(metadata.name)")
-                allowed: set[str] = set()
-                first = True
+                allowed: set[str] | None = None
                 for fr in terms[0].match_fields:
                     vals = set(fr.values)
-                    allowed = vals if first else (allowed & vals)
-                    first = False
-                node_allow = np.zeros(nb, bool)
-                for nm in allowed:
-                    i = planes.node_index.get(nm)
-                    if i is not None:
-                        node_allow[i] = True
+                    allowed = vals if allowed is None else (allowed & vals)
+                allowed_names = frozenset(allowed or ())
                 # strip fields; expressions still gate per group
                 from ..api.types import NodeSelector, NodeSelectorTerm
                 terms_for_groups = NodeSelector(
@@ -467,23 +489,60 @@ class PodFeatureExtractor:
             if term.preference.match_fields:
                 raise FallbackNeeded("preferred term with match_fields")
 
-        group_match = np.ones(g, bool)
-        group_pref = np.zeros(g, np.int32)
-        for gi in range(len(v.groups)):
-            labels = dict(v.groups.key(gi))
-            ok = all(labels.get(kk) == vv for kk, vv in pod.spec.node_selector.items())
-            if ok and terms_for_groups is not None:
-                ok = terms_for_groups.matches(labels, {})
-            group_match[gi] = ok
-            group_pref[gi] = sum(
-                t.weight for t in preferred if t.preference.matches(labels, {})
-            )
-        return {
-            "group_match": group_match,
-            "group_pref": group_pref,
-            "has_pref": np.bool_(bool(preferred)),
-            "node_allow": node_allow,
-        }
+        sig = len(self._aff_specs)
+        self._aff_specs.append(
+            (dict(pod.spec.node_selector), terms_for_groups, preferred, allowed_names)
+        )
+        self._aff_sigs[key] = sig
+        return sig
+
+    def affinity_tables(self, planes: Planes) -> dict[str, np.ndarray]:
+        """Materialize the signature rows against the current group vocab and
+        node set; cached until either grows or the node list changes."""
+        v = self.vocabs
+        n_sigs = len(self._aff_specs)
+        a = next_pow2(n_sigs, 1)
+        g = next_pow2(len(v.groups), 1)
+        base_key = (a, g, planes.nb, hash(tuple(planes.node_names)))
+        prev = self._aff_tables
+        if prev is not None and self._aff_tables_key == (base_key, n_sigs):
+            return prev
+        # signatures are append-only; when only new ones arrived (same group
+        # vocab, node set, and buckets), fill just the new rows instead of
+        # re-evaluating every prior spec — O(new) on the scheduling hot path
+        if prev is not None and self._aff_tables_key[0] == base_key:
+            start = self._aff_tables_key[1]
+            # fresh dict object: TPUBackend.device_inputs re-uploads on
+            # identity change, and the rows below mutate in place
+            tables = dict(prev)
+        else:
+            start = 0
+            tables = {
+                "aff_match": np.ones((a, g), bool),
+                "aff_pref": np.zeros((a, g), np.int32),
+                "aff_allow": np.ones((a, planes.nb), bool),
+                "aff_has_pref": np.zeros(a, bool),
+            }
+        group_labels = [dict(v.groups.key(gi)) for gi in range(len(v.groups))]
+        for si in range(start, n_sigs):
+            node_selector, terms, preferred, allowed_names = self._aff_specs[si]
+            tables["aff_has_pref"][si] = bool(preferred)
+            if allowed_names is not None:
+                tables["aff_allow"][si, :] = False
+                for nm in allowed_names:
+                    i = planes.node_index.get(nm)
+                    if i is not None:
+                        tables["aff_allow"][si, i] = True
+            for gi, labels in enumerate(group_labels):
+                ok = all(labels.get(kk) == vv for kk, vv in node_selector.items())
+                if ok and terms is not None:
+                    ok = terms.matches(labels, {})
+                tables["aff_match"][si, gi] = ok
+                tables["aff_pref"][si, gi] = sum(
+                    t.weight for t in preferred if t.preference.matches(labels, {})
+                )
+        self._aff_tables, self._aff_tables_key = tables, (base_key, n_sigs)
+        return tables
 
 
 def stack_features(feats: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:

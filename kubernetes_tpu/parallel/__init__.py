@@ -1,0 +1,23 @@
+"""Parallelism layer: device-mesh sharding of the scheduling kernels.
+
+The TPU-native replacement for the reference's five parallelism mechanisms
+(SURVEY.md §2.9): intra-cycle node fan-out → node-axis sharding over ICI;
+batch reuse → the batched/wave kernels; the rest (binding pipeline, async
+API, multi-profile) stay host-side in kubernetes_tpu.scheduler.
+"""
+
+from .mesh import (
+    NODE_AXIS,
+    WAVE_AXIS,
+    replicate,
+    scheduler_mesh,
+    shard_planes,
+    sharded_batched_assign,
+    sharded_fit_and_score,
+    wave_fit_and_score,
+)
+
+__all__ = [
+    "NODE_AXIS", "WAVE_AXIS", "replicate", "scheduler_mesh", "shard_planes",
+    "sharded_batched_assign", "sharded_fit_and_score", "wave_fit_and_score",
+]

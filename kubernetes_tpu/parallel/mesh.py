@@ -1,0 +1,166 @@
+"""Device-mesh sharding for the dense scheduling kernels (ICI data plane).
+
+The reference scales the two hot loops with 16 goroutines on one host
+(pkg/scheduler/framework/parallelize/parallelism.go) and scales the cluster
+with sampling (percentageOfNodesToScore, schedule_one.go:862-888). The TPU
+rebuild instead shards the *nodes axis* of every plane across a
+`jax.sharding.Mesh` — v5e-8 style, collectives riding ICI — and lets GSPMD
+insert the cross-chip reductions:
+
+- per-domain segment-sums (PodTopologySpread) become scatter-add + psum,
+- normalize passes (max/min over the feasible set) become all-reduces,
+- the final winner selection is a per-shard argmax + allgather.
+
+A second optional mesh axis, "wave", data-parallelizes independent pod
+evaluations: `wave_fit_and_score` computes the full pods×nodes
+feasibility-and-score matrix (the BASELINE.json north-star kernel) with pods
+sharded over "wave" and nodes over "nodes". The sequential-greedy
+`batched_assign` scan (pod i+1 sees pod i's assumes) keeps pods on the scan
+axis — that dependency chain is inherently sequential — with all its per-step
+node math sharded.
+
+No NCCL/MPI translation anywhere: sharding annotations + jit are the whole
+communication backend (SURVEY.md §2.9, §5.8).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..ops.kernels import (
+    KernelConfig,
+    _batched_assign_jit,
+    _ensure_x64,
+    _fit_and_score_jit,
+    filter_masks,
+    scores,
+)
+
+NODE_AXIS = "nodes"
+WAVE_AXIS = "wave"
+
+# which dim of each kernel-input array is the nodes axis (None = replicated)
+_NODE_DIM = {
+    "alloc": 0, "used": 0, "nonzero_used": 0, "valid": 0, "unsched": 0,
+    "group_id": 0, "taints": 0, "prefer_taints": 0, "domain": 0,
+    "sel_counts": 0, "port_words": 0, "image_bytes": 0,
+    # affinity signature tables: [A, G] rows replicate, [A, Nb] shards dim 1
+    "aff_match": None, "aff_pref": None, "aff_has_pref": None,
+    "aff_allow": 1,
+}
+
+
+def scheduler_mesh(n_devices: int | None = None, wave: int = 1, devices=None) -> Mesh:
+    """A (wave, nodes) mesh over the first n_devices available devices.
+
+    wave=1 dedicates the whole slice to the nodes axis (max single-pod
+    latency); wave>1 trades node-shard width for pod-wave data parallelism.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if n == 0:
+        raise ValueError("no devices for mesh")
+    if n % wave:
+        raise ValueError(f"wave={wave} does not divide device count {n}")
+    return Mesh(np.asarray(devs).reshape(wave, n // wave), (WAVE_AXIS, NODE_AXIS))
+
+
+def shard_planes(mesh: Mesh, planes_dict: dict) -> dict:
+    """Put every plane on the mesh with its node axis (dim 0) sharded.
+
+    Plane buckets are powers of two ≥ 8 (ops/vocab.py next_pow2), so any
+    power-of-two node-shard count ≤ 8 divides evenly; reject the rest loudly
+    rather than letting GSPMD silently replicate.
+    """
+    shards = mesh.shape[NODE_AXIS]
+    out = {}
+    for k, a in planes_dict.items():
+        a = np.asarray(a)
+        if k not in _NODE_DIM:
+            raise ValueError(
+                f"unknown kernel input {k!r}: add it to _NODE_DIM so its "
+                "node axis (or replication) is explicit"
+            )
+        dim = _NODE_DIM[k]
+        if dim is None:
+            spec = P()
+        else:
+            if a.shape[dim] % shards:
+                raise ValueError(
+                    f"plane {k!r} node bucket {a.shape[dim]} not divisible "
+                    f"by {shards} node shards"
+                )
+            spec = P(*([None] * dim + [NODE_AXIS]))
+        out[k] = jax.device_put(a, NamedSharding(mesh, spec))
+    return out
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate pod features (tiny) across the mesh."""
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(lambda a: jax.device_put(np.asarray(a), sh), tree)
+
+
+# -- sharded kernel entry points --------------------------------------------
+#
+# The jitted kernels are shared with the single-chip path: computation
+# follows data, so calling them on sharded planes partitions the whole
+# program. Only the wave (pods×nodes matrix) kernel needs its own trace.
+
+
+def sharded_fit_and_score(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict, f: dict):
+    """One pod against the node-sharded cluster (fused filter+score)."""
+    _ensure_x64()
+    return _fit_and_score_jit(cfg, sharded_planes, replicate(mesh, f))
+
+
+def sharded_batched_assign(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
+                           batched_f: dict):
+    """Sequential-greedy wave over node-sharded planes (lax.scan on pods)."""
+    _ensure_x64()
+    return _batched_assign_jit(cfg, sharded_planes, replicate(mesh, batched_f))
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _wave_fit_and_score_jit(cfg: KernelConfig, planes: dict, batched_f: dict):
+    def one(f):
+        _, feasible, _, _ = filter_masks(cfg, planes, f)
+        total, _ = scores(cfg, planes, f, feasible)
+        return feasible, jnp.where(feasible, total, -1)
+
+    return jax.vmap(one)(batched_f)
+
+
+def wave_fit_and_score(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
+                       batched_f: dict):
+    """The pods×nodes matrix kernel: every pod scored against every node in
+    one program, pods sharded over WAVE_AXIS, nodes over NODE_AXIS.
+
+    Each pod's row is evaluated against the *same* snapshot (no assumes
+    between pods) — this is the placement-enumeration / gang-scoring shape
+    (schedule_one_podgroup.go:520), and the input to host-side winner
+    assignment when decisions must not interact.
+
+    Returns (feasible [P, Nb] bool, total [P, Nb] int32 with -1 infeasible).
+    """
+    _ensure_x64()
+    wave = mesh.shape[WAVE_AXIS]
+    sh = NamedSharding(mesh, P(WAVE_AXIS))
+    bf = {}
+    for k, a in batched_f.items():
+        a = np.asarray(a)
+        if a.shape[0] % wave:
+            raise ValueError(
+                f"pod batch {a.shape[0]} not divisible by wave={wave}; pad the batch"
+            )
+        bf[k] = jax.device_put(a, sh)
+    return _wave_fit_and_score_jit(cfg, sharded_planes, bf)

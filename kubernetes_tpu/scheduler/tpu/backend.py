@@ -34,7 +34,6 @@ from ...ops import (
     PodFeatureExtractor,
     batched_assign,
     fit_and_score,
-    next_pow2,
     stack_features,
 )
 from ...ops.kernels import FILTER_NAMES
@@ -82,38 +81,46 @@ class TPUBackend:
         )
         self._device_planes: dict | None = None
         self._device_version = -1
+        self._device_tables: dict | None = None
+        self._tables_src: dict | None = None
         self._jax = jax
 
     # -- config / planes -----------------------------------------------------
 
     def kernel_config(self, planes) -> KernelConfig:
-        v = self.builder.vocabs
-        max_dom = max(
-            [len(v.domain_vocab(i)) for i in range(len(v.topo_keys))] or [1]
-        )
         return KernelConfig(
             strategy=self.strategy,
             fit_resources=self.fit_resources,
             rtc_shape=self.rtc_shape,
-            dseg=next_pow2(max_dom, planes.nb),
+            topo_domains=self.builder.topo_domains(planes),
             max_constraints=self.extractor.MAX_CONSTRAINTS,
         )
 
     def sync(self, snapshot):
-        """Refresh host planes from the snapshot and mirror them to device.
+        """Refresh host planes from the snapshot (O(changed) by generation)."""
+        return self.builder.sync(snapshot)
 
-        Unchanged rows cost nothing host-side (generation check); device
-        mirrors are re-uploaded per changed plane. Row-granular device
-        scatter is a round-2 optimization; the arrays are ~1 MB at 5k nodes
-        so full re-put is not the bottleneck yet.
+    def device_inputs(self, planes) -> dict:
+        """Node planes + affinity signature tables, mirrored to device HBM.
+
+        Call AFTER feature extraction — features intern affinity signatures.
+        Unchanged planes cost nothing (version check); tables re-upload only
+        when a new signature, label group, or node set appears. Row-granular
+        device scatter is a round-2 optimization; the arrays are ~1 MB at
+        5k nodes so full re-put is not the bottleneck yet.
         """
-        planes = self.builder.sync(snapshot)
         if self._device_planes is None or self._device_version != planes.version:
             self._device_planes = {
                 k: self._jax.device_put(a) for k, a in planes.as_dict().items()
             }
             self._device_version = planes.version
-        return planes, self._device_planes
+        tables = self.extractor.affinity_tables(planes)
+        if self._tables_src is not tables:
+            self._device_tables = {
+                k: self._jax.device_put(a) for k, a in tables.items()
+            }
+            self._tables_src = tables
+        return {**self._device_planes, **self._device_tables}
 
     # -- eligibility ----------------------------------------------------------
 
@@ -136,8 +143,9 @@ class TPUBackend:
         if reason:
             raise FallbackNeeded(reason)
         self.extractor.register(pod)
-        planes, dev = self.sync(snapshot)
+        planes = self.sync(snapshot)
         f = self.extractor.features(pod, planes)
+        dev = self.device_inputs(planes)
         cfg = self.kernel_config(planes)
         out = fit_and_score(cfg, dev, f)
         return planes, {
@@ -158,8 +166,9 @@ class TPUBackend:
             raise FallbackNeeded(reason)
         for pod in pods:
             self.extractor.register(pod)
-        planes, dev = self.sync(snapshot)
+        planes = self.sync(snapshot)
         feats = stack_features([self.extractor.features(p, planes) for p in pods])
+        dev = self.device_inputs(planes)
         cfg = self.kernel_config(planes)
         winners, _ = batched_assign(cfg, dev, feats)
         winners = np.asarray(winners)

@@ -1,0 +1,22 @@
+"""In-tree test fixtures, modeled on pkg/scheduler/testing/ in the reference
+(wrappers.go builder style + framework_helpers.go synthetic clusters)."""
+
+from .wrappers import (
+    make_node,
+    make_pod,
+    with_gang,
+    with_node_affinity_in,
+    with_pod_affinity,
+    with_preferred_node_affinity,
+    with_preferred_pod_affinity,
+    with_spread,
+    with_tolerations,
+)
+from .cluster import synthetic_cluster
+
+__all__ = [
+    "make_node", "make_pod", "with_gang", "with_node_affinity_in",
+    "with_pod_affinity", "with_preferred_node_affinity",
+    "with_preferred_pod_affinity", "with_spread", "with_tolerations",
+    "synthetic_cluster",
+]

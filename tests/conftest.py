@@ -1,14 +1,20 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any jax import, hence env mutation at conftest import time.
+The environment pins JAX_PLATFORMS to the real accelerator tunnel, so env
+setdefault is not enough — tests must override the resolved config after
+import. XLA_FLAGS still must be set before the CPU backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "true")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,198 +1,5 @@
-"""Fixture builders, modeled on pkg/scheduler/testing/wrappers.go
-(MakePod().Req().NodeAffinityIn()... builder style)."""
+"""Compatibility shim: fixture builders now live in the framework itself
+(kubernetes_tpu.testing.wrappers), mirroring the reference's in-tree
+pkg/scheduler/testing/wrappers.go."""
 
-from __future__ import annotations
-
-from kubernetes_tpu.api.labels import LabelSelector, Requirement
-from kubernetes_tpu.api.meta import ObjectMeta
-from kubernetes_tpu.api.types import (
-    Affinity,
-    Container,
-    ContainerPort,
-    Node,
-    NodeAffinity,
-    NodeSelector,
-    NodeSelectorRequirement,
-    NodeSelectorTerm,
-    NodeSpec,
-    NodeStatus,
-    Pod,
-    PodAffinity,
-    PodAffinityTerm,
-    PodAntiAffinity,
-    PodSpec,
-    PreferredSchedulingTerm,
-    SchedulingGroup,
-    Taint,
-    Toleration,
-    TopologySpreadConstraint,
-    WeightedPodAffinityTerm,
-)
-
-
-def make_pod(
-    name: str,
-    namespace: str = "default",
-    cpu: str | None = None,
-    mem: str | None = None,
-    requests: dict | None = None,
-    labels: dict | None = None,
-    node_name: str = "",
-    priority: int = 0,
-    image: str = "",
-    host_ports: tuple[int, ...] = (),
-) -> Pod:
-    req: dict = {}
-    if cpu is not None:
-        req["cpu"] = cpu
-    if mem is not None:
-        req["memory"] = mem
-    if requests:
-        req.update(requests)
-    c = Container(
-        name="c",
-        image=image,
-        requests=req,
-        ports=tuple(ContainerPort(container_port=p, host_port=p) for p in host_ports),
-    )
-    return Pod(
-        meta=ObjectMeta(name=name, namespace=namespace, labels=dict(labels or {})),
-        spec=PodSpec(containers=[c], node_name=node_name, priority=priority),
-    )
-
-
-def make_node(
-    name: str,
-    cpu: str = "32",
-    mem: str = "64Gi",
-    pods: int = 110,
-    labels: dict | None = None,
-    taints: tuple[Taint, ...] = (),
-    unschedulable: bool = False,
-    zone: str | None = None,
-    extra: dict | None = None,
-) -> Node:
-    lab = dict(labels or {})
-    lab.setdefault("kubernetes.io/hostname", name)
-    if zone is not None:
-        lab["topology.kubernetes.io/zone"] = zone
-    alloc = {"cpu": cpu, "memory": mem, "pods": pods, "ephemeral-storage": "100Gi"}
-    if extra:
-        alloc.update(extra)
-    return Node(
-        meta=ObjectMeta(name=name, namespace="", labels=lab),
-        spec=NodeSpec(unschedulable=unschedulable, taints=taints),
-        status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
-    )
-
-
-def with_node_affinity_in(pod: Pod, key: str, values: tuple[str, ...]) -> Pod:
-    pod.spec.affinity = Affinity(
-        node_affinity=NodeAffinity(
-            required=NodeSelector(
-                terms=(
-                    NodeSelectorTerm(
-                        match_expressions=(NodeSelectorRequirement(key, "In", values),)
-                    ),
-                )
-            )
-        )
-    )
-    return pod
-
-
-def with_preferred_node_affinity(pod: Pod, weight: int, key: str, values: tuple[str, ...]) -> Pod:
-    na = pod.spec.affinity.node_affinity if pod.spec.affinity else None
-    required = na.required if na else None
-    preferred = tuple(na.preferred) if na else ()
-    pod.spec.affinity = Affinity(
-        node_affinity=NodeAffinity(
-            required=required,
-            preferred=preferred
-            + (
-                PreferredSchedulingTerm(
-                    weight=weight,
-                    preference=NodeSelectorTerm(
-                        match_expressions=(NodeSelectorRequirement(key, "In", values),)
-                    ),
-                ),
-            ),
-        )
-    )
-    return pod
-
-
-def with_tolerations(pod: Pod, *tols: Toleration) -> Pod:
-    pod.spec.tolerations = tuple(pod.spec.tolerations) + tols
-    return pod
-
-
-def with_spread(
-    pod: Pod,
-    max_skew: int = 1,
-    key: str = "topology.kubernetes.io/zone",
-    when: str = "DoNotSchedule",
-    selector: LabelSelector | None = None,
-) -> Pod:
-    if selector is None:
-        selector = LabelSelector.of(dict(pod.meta.labels))
-    pod.spec.topology_spread_constraints = tuple(pod.spec.topology_spread_constraints) + (
-        TopologySpreadConstraint(max_skew, key, when, selector),
-    )
-    return pod
-
-
-def with_pod_affinity(pod: Pod, key: str, value: str, topology_key: str, anti: bool = False) -> Pod:
-    term = PodAffinityTerm(
-        label_selector=LabelSelector.of({key: value}), topology_key=topology_key
-    )
-    aff = pod.spec.affinity or Affinity()
-    if anti:
-        pa = aff.pod_anti_affinity or PodAntiAffinity()
-        pod.spec.affinity = Affinity(
-            node_affinity=aff.node_affinity,
-            pod_affinity=aff.pod_affinity,
-            pod_anti_affinity=PodAntiAffinity(required=tuple(pa.required) + (term,), preferred=pa.preferred),
-        )
-    else:
-        pa = aff.pod_affinity or PodAffinity()
-        pod.spec.affinity = Affinity(
-            node_affinity=aff.node_affinity,
-            pod_affinity=PodAffinity(required=tuple(pa.required) + (term,), preferred=pa.preferred),
-            pod_anti_affinity=aff.pod_anti_affinity,
-        )
-    return pod
-
-
-def with_preferred_pod_affinity(
-    pod: Pod, weight: int, key: str, value: str, topology_key: str, anti: bool = False
-) -> Pod:
-    wterm = WeightedPodAffinityTerm(
-        weight=weight,
-        term=PodAffinityTerm(
-            label_selector=LabelSelector.of({key: value}), topology_key=topology_key
-        ),
-    )
-    aff = pod.spec.affinity or Affinity()
-    if anti:
-        pa = aff.pod_anti_affinity or PodAntiAffinity()
-        pod.spec.affinity = Affinity(
-            node_affinity=aff.node_affinity,
-            pod_affinity=aff.pod_affinity,
-            pod_anti_affinity=PodAntiAffinity(
-                required=pa.required, preferred=tuple(pa.preferred) + (wterm,)
-            ),
-        )
-    else:
-        pa = aff.pod_affinity or PodAffinity()
-        pod.spec.affinity = Affinity(
-            node_affinity=aff.node_affinity,
-            pod_affinity=PodAffinity(required=pa.required, preferred=tuple(pa.preferred) + (wterm,)),
-            pod_anti_affinity=aff.pod_anti_affinity,
-        )
-    return pod
-
-
-def with_gang(pod: Pod, group_name: str) -> Pod:
-    pod.spec.scheduling_group = SchedulingGroup(pod_group_name=group_name)
-    return pod
+from kubernetes_tpu.testing.wrappers import *  # noqa: F401,F403
