@@ -197,6 +197,7 @@ class SchedulingGroup:
 class PodSpec:
     node_name: str = ""
     scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    volumes: tuple = ()  # tuple[storage.Volume, ...]
     containers: list[Container] = field(default_factory=list)
     init_containers: list[Container] = field(default_factory=list)
     overhead: dict[str, object] = field(default_factory=dict)

@@ -503,7 +503,10 @@ class PodFeatureExtractor:
         n_sigs = len(self._aff_specs)
         a = next_pow2(n_sigs, 1)
         g = next_pow2(len(v.groups), 1)
-        base_key = (a, g, planes.nb, hash(tuple(planes.node_names)))
+        # actual group count must key the cache (not just its pow2 bucket):
+        # new groups within the same bucket need their columns evaluated for
+        # EVERY signature, which the incremental new-rows-only path can't do
+        base_key = (a, g, len(v.groups), planes.nb, hash(tuple(planes.node_names)))
         prev = self._aff_tables
         if prev is not None and self._aff_tables_key == (base_key, n_sigs):
             return prev

@@ -56,6 +56,7 @@ class PodInfo:
         "request",
         "nonzero_request",
         "ports",
+        "pvc_keys",
         "required_affinity_terms",
         "required_anti_affinity_terms",
         "preferred_affinity_terms",
@@ -71,6 +72,11 @@ class PodInfo:
             for p in c.ports:
                 if p.host_port > 0:
                     self.ports.append((p.host_ip or "0.0.0.0", p.protocol, p.host_port))
+        from ..api.storage import pod_claim_names
+
+        self.pvc_keys = [
+            f"{pod.meta.namespace}/{name}" for name in pod_claim_names(pod)
+        ]
         aff = pod.spec.affinity
         ns = pod.meta.namespace
         self.required_affinity_terms = (
@@ -166,6 +172,8 @@ class NodeInfo:
         self.nonzero_requested.add(pi.nonzero_request)
         for port in pi.ports:
             self.used_ports[port] = self.used_ports.get(port, 0) + 1
+        for k in pi.pvc_keys:
+            self.pvc_ref_counts[k] = self.pvc_ref_counts.get(k, 0) + 1
         if pi.has_affinity_constraints:
             self.pods_with_affinity.append(pi)
         if pi.has_required_anti_affinity:
@@ -184,6 +192,12 @@ class NodeInfo:
                 self.used_ports.pop(port, None)
             else:
                 self.used_ports[port] = n
+        for k in pi.pvc_keys:
+            n = self.pvc_ref_counts.get(k, 0) - 1
+            if n <= 0:
+                self.pvc_ref_counts.pop(k, None)
+            else:
+                self.pvc_ref_counts[k] = n
         self.pods_with_affinity = [p for p in self.pods_with_affinity if p.key != key]
         self.pods_with_required_anti_affinity = [
             p for p in self.pods_with_required_anti_affinity if p.key != key

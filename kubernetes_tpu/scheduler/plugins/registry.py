@@ -23,6 +23,12 @@ from .interpod_affinity import InterPodAffinity
 from .node_affinity import NodeAffinity
 from .node_resources import BalancedAllocation, NodeResourcesFit
 from .pod_topology_spread import PodTopologySpread
+from .volumes import (
+    NodeVolumeLimits,
+    VolumeBinding,
+    VolumeRestrictions,
+    VolumeZone,
+)
 
 DEFAULT_WEIGHTS = {
     "TaintToleration": 3,
@@ -32,6 +38,7 @@ DEFAULT_WEIGHTS = {
     "NodeResourcesFit": 1,
     "NodeResourcesBalancedAllocation": 1,
     "ImageLocality": 1,
+    "VolumeBinding": 1,
 }
 
 
@@ -53,6 +60,10 @@ def default_plugins(store, names: ResourceNames, feature_gates=None, args: dict 
             resource_weights=fit_args.get("resources"),
             shape=fit_args.get("shape"),
         ),
+        VolumeRestrictions(store),
+        NodeVolumeLimits(store),
+        VolumeBinding(store),
+        VolumeZone(store),
         PodTopologySpread(),
         InterPodAffinity(),
         BalancedAllocation(names),

@@ -135,6 +135,19 @@ class Scheduler:
         self.informers.informer("Pod").add_handler(self._on_pod_event)
         self.informers.informer("Node").add_handler(self._on_node_event)
         self.informers.informer("PodGroup").add_handler(self._on_podgroup_event)
+        # dynamic handlers for the EventResources plugins actually register
+        # (eventhandlers.go:481 — only kinds some hint listens to get informers)
+        registered = {
+            h.event.resource
+            for hints in hint_map.values()
+            for h in hints
+        }
+        for kind in (ev.PVC, ev.PV, ev.STORAGE_CLASS, ev.CSI_NODE,
+                     ev.RESOURCE_CLAIM, ev.RESOURCE_SLICE):
+            if kind in registered:
+                self.informers.informer(kind).add_handler(
+                    self._make_generic_handler(kind)
+                )
 
     # -- event handlers (eventhandlers.go) ----------------------------------
 
@@ -236,6 +249,17 @@ class Scheduler:
                 )
         elif etype == DELETED:
             self.cache.remove_node(new)
+
+    def _make_generic_handler(self, kind: str):
+        """Storage/DRA kinds only move queued pods; there is no cache state."""
+
+        def handler(etype: str, old, new) -> None:
+            action = {ADDED: ev.ADD, MODIFIED: ev.UPDATE, DELETED: ev.DELETE}[etype]
+            self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(kind, action), old, new
+            )
+
+        return handler
 
     def _on_podgroup_event(self, etype: str, old, new) -> None:
         if etype in (ADDED, MODIFIED):

@@ -313,6 +313,12 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         )
 
     def _must_fall_back(self, pod: Pod) -> bool:
+        # long-tail volume plugins (VolumeBinding/Zone/Restrictions/Limits)
+        # run host-side only — a claim-backed pod needs the full host chain
+        from ...api.storage import pod_claim_names
+
+        if pod_claim_names(pod):
+            return True
         # preemption aftermath: nominated pods must be simulated onto nodes
         # during filtering (schedule_one.go:1190) — host path handles it
         if pod.status.nominated_node_name:

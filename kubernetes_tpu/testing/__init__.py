@@ -2,21 +2,27 @@
 (wrappers.go builder style + framework_helpers.go synthetic clusters)."""
 
 from .wrappers import (
+    make_csi_node,
     make_node,
     make_pod,
+    make_pv,
+    make_pvc,
+    make_storage_class,
     with_gang,
     with_node_affinity_in,
     with_pod_affinity,
     with_preferred_node_affinity,
     with_preferred_pod_affinity,
+    with_pvc,
     with_spread,
     with_tolerations,
 )
 from .cluster import synthetic_cluster
 
 __all__ = [
-    "make_node", "make_pod", "with_gang", "with_node_affinity_in",
+    "make_csi_node", "make_node", "make_pod", "make_pv", "make_pvc",
+    "make_storage_class", "with_gang", "with_node_affinity_in",
     "with_pod_affinity", "with_preferred_node_affinity",
-    "with_preferred_pod_affinity", "with_spread", "with_tolerations",
-    "synthetic_cluster",
+    "with_preferred_pod_affinity", "with_pvc", "with_spread",
+    "with_tolerations", "synthetic_cluster",
 ]

@@ -196,3 +196,124 @@ def with_preferred_pod_affinity(
 def with_gang(pod: Pod, group_name: str) -> Pod:
     pod.spec.scheduling_group = SchedulingGroup(pod_group_name=group_name)
     return pod
+
+
+# --- storage fixtures -------------------------------------------------------
+
+
+def with_pvc(pod: Pod, claim_name: str, volume_name: str | None = None) -> Pod:
+    from kubernetes_tpu.api.storage import Volume
+
+    pod.spec.volumes = tuple(pod.spec.volumes) + (
+        Volume(name=volume_name or claim_name, persistent_volume_claim=claim_name),
+    )
+    return pod
+
+
+def make_pv(
+    name: str,
+    storage: str = "10Gi",
+    storage_class: str = "",
+    access_modes: tuple[str, ...] = ("ReadWriteOnce",),
+    node_names: tuple[str, ...] = (),
+    zone: str | None = None,
+    csi_driver: str = "",
+):
+    """A PersistentVolume; node_names pins it via NodeAffinity on hostname
+    (the local-volume pattern), zone adds the well-known zone label."""
+    from kubernetes_tpu.api.storage import (
+        PersistentVolume,
+        PersistentVolumeSpec,
+    )
+
+    labels = {}
+    if zone is not None:
+        labels["topology.kubernetes.io/zone"] = zone
+    affinity = None
+    if node_names:
+        affinity = NodeSelector(
+            terms=(
+                NodeSelectorTerm(
+                    match_expressions=(
+                        NodeSelectorRequirement(
+                            "kubernetes.io/hostname", "In", tuple(node_names)
+                        ),
+                    )
+                ),
+            )
+        )
+    return PersistentVolume(
+        meta=ObjectMeta(name=name, namespace="", labels=labels),
+        spec=PersistentVolumeSpec(
+            capacity={"storage": storage},
+            access_modes=access_modes,
+            storage_class_name=storage_class,
+            node_affinity=affinity,
+            csi_driver=csi_driver,
+        ),
+    )
+
+
+def make_pvc(
+    name: str,
+    namespace: str = "default",
+    storage: str = "5Gi",
+    storage_class: str = "",
+    access_modes: tuple[str, ...] = ("ReadWriteOnce",),
+    volume_name: str = "",
+    bound: bool = False,
+):
+    from kubernetes_tpu.api.storage import (
+        CLAIM_BOUND,
+        CLAIM_PENDING,
+        PersistentVolumeClaim,
+        PersistentVolumeClaimSpec,
+        PersistentVolumeClaimStatus,
+    )
+
+    assert not bound or volume_name, "bound=True requires volume_name"
+    return PersistentVolumeClaim(
+        meta=ObjectMeta(name=name, namespace=namespace),
+        spec=PersistentVolumeClaimSpec(
+            access_modes=access_modes,
+            storage_class_name=storage_class,
+            volume_name=volume_name,
+            request={"storage": storage},
+        ),
+        status=PersistentVolumeClaimStatus(
+            phase=CLAIM_BOUND if bound else CLAIM_PENDING
+        ),
+    )
+
+
+def make_storage_class(
+    name: str, provisioner: str = "kubernetes.io/no-provisioner",
+    wait_for_first_consumer: bool = True,
+):
+    from kubernetes_tpu.api.storage import (
+        BINDING_IMMEDIATE,
+        BINDING_WAIT_FOR_FIRST_CONSUMER,
+        StorageClass,
+    )
+
+    return StorageClass(
+        meta=ObjectMeta(name=name, namespace=""),
+        provisioner=provisioner,
+        volume_binding_mode=(
+            BINDING_WAIT_FOR_FIRST_CONSUMER
+            if wait_for_first_consumer
+            else BINDING_IMMEDIATE
+        ),
+    )
+
+
+def make_csi_node(node_name: str, **driver_limits: int):
+    from kubernetes_tpu.api.storage import CSINode, CSINodeDriver
+
+    return CSINode(
+        meta=ObjectMeta(name=node_name, namespace=""),
+        drivers=tuple(
+            CSINodeDriver(name=d.replace("__", "."), allocatable_count=n)
+            for d, n in driver_limits.items()
+        ),
+    )

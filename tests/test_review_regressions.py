@@ -70,3 +70,41 @@ def test_nominated_pod_resources_protected():
     time.sleep(1.1)
     s.schedule_pending()
     assert store.get("Pod", "default/preemptor").spec.node_name == "n1"
+
+
+def test_affinity_tables_rebuilt_on_group_growth_within_bucket():
+    """Group-vocab growth that stays inside the same pow2 bucket must
+    invalidate cached affinity tables: a node relabeled to a NEW label
+    combination must stop matching a selector it no longer satisfies."""
+    from kubernetes_tpu.api.resource import ResourceNames
+    from kubernetes_tpu.scheduler.cache.cache import Cache
+    from kubernetes_tpu.scheduler.cache.snapshot import Snapshot
+    from kubernetes_tpu.scheduler.tpu.backend import TPUBackend
+    import numpy as np
+
+    names = ResourceNames()
+    cache = Cache(names)
+    # 3 distinct label-groups (pads to bucket of 4)
+    cache.add_node(make_node("n0", labels={"disk": "ssd"}))
+    cache.add_node(make_node("n1", labels={"disk": "hdd"}))
+    cache.add_node(make_node("n2", labels={"disk": "nvme"}))
+    snapshot = cache.update_snapshot(Snapshot())
+
+    backend = TPUBackend(names)
+    pod = make_pod("p", cpu="1")
+    pod.spec.node_selector = {"disk": "ssd"}
+    backend.extractor.register(pod)
+    planes = backend.sync(snapshot)
+    tables1 = backend.extractor.affinity_tables(planes)
+    assert tables1 is not None
+
+    # relabel n0 to a NEW combination: grows group vocab 3 -> 4 (same bucket)
+    old = cache._nodes["n0"].info.node
+    cache.update_node(old, make_node("n0", labels={"disk": "floppy"}))
+    snapshot = cache.update_snapshot(snapshot)
+    planes2 = backend.sync(snapshot)
+    tables2 = backend.extractor.affinity_tables(planes2)
+    _, out = backend.run(pod, snapshot)
+    feasible = np.flatnonzero(out["feasible"][: planes2.n])
+    feasible_names = {planes2.node_names[int(i)] for i in feasible}
+    assert "n0" not in feasible_names  # no longer disk=ssd

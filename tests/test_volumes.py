@@ -1,0 +1,265 @@
+"""Volume plugin tests: binding, topology, restrictions, CSI limits.
+
+Modeled on test/integration/scheduler/ volume suites and
+pkg/scheduler/framework/plugins/volumebinding/volume_binding_test.go.
+"""
+
+from kubernetes_tpu.api.storage import CLAIM_BOUND
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import Store
+from tests.wrappers import (
+    make_csi_node,
+    make_node,
+    make_pod,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+    with_pvc,
+)
+
+
+def new_scheduler(store, **kw):
+    s = Scheduler(store, **kw)
+    s.start()
+    return s
+
+
+def node_of(store, pod_name):
+    return store.get("Pod", f"default/{pod_name}").spec.node_name
+
+
+class TestVolumeBinding:
+    def test_wait_for_first_consumer_local_pv(self):
+        """WFFC claim + node-pinned PV: pod must land on the PV's node and the
+        claim must come out Bound (volume_binding.go PreBind:577)."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_storage_class("local", wait_for_first_consumer=True))
+        store.create(make_pv("pv-n2", storage="10Gi", storage_class="local",
+                             node_names=("n2",)))
+        store.create(make_pvc("data", storage="5Gi", storage_class="local"))
+        store.create(with_pvc(make_pod("p1", cpu="1"), "data"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        assert node_of(store, "p1") == "n2"
+        pvc = store.get("PersistentVolumeClaim", "default/data")
+        assert pvc.status.phase == CLAIM_BOUND
+        assert pvc.spec.volume_name == "pv-n2"
+        pv = store.get("PersistentVolume", "pv-n2")
+        assert pv.spec.claim_ref == "default/data"
+
+    def test_unbound_immediate_claim_unschedulable(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_storage_class("fast", wait_for_first_consumer=False))
+        store.create(make_pvc("data", storage_class="fast"))
+        store.create(with_pvc(make_pod("p1", cpu="1"), "data"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert node_of(store, "p1") == ""
+
+    def test_missing_claim_unschedulable(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(with_pvc(make_pod("p1", cpu="1"), "nope"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert node_of(store, "p1") == ""
+
+    def test_bound_claim_node_affinity_conflict(self):
+        """Pre-bound PV pinned to n1: pod follows it (Filter rejects n2)."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_pv("pv1", storage_class="local", node_names=("n1",)))
+        store.create(make_pvc("data", storage_class="local",
+                              volume_name="pv1", bound=True))
+        store.create(with_pvc(make_pod("p1", cpu="1"), "data"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        assert node_of(store, "p1") == "n1"
+
+    def test_dynamic_provisioning(self):
+        """WFFC class with a real provisioner: no static PV needed; PreBind
+        provisions a PV and binds (binder.go provisioning path)."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_storage_class(
+            "csi-fast", provisioner="ebs.csi.example.com",
+            wait_for_first_consumer=True,
+        ))
+        store.create(make_pvc("data", storage="8Gi", storage_class="csi-fast"))
+        store.create(with_pvc(make_pod("p1", cpu="1"), "data"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        assert node_of(store, "p1") == "n1"
+        pvc = store.get("PersistentVolumeClaim", "default/data")
+        assert pvc.status.phase == CLAIM_BOUND
+        pv = store.get("PersistentVolume", pvc.spec.volume_name)
+        assert pv.spec.claim_ref == "default/data"
+        assert pv.spec.csi_driver == "ebs.csi.example.com"
+
+    def test_two_pods_compete_for_one_pv(self):
+        """The PV assume-cache must keep the loser off the bound PV."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_storage_class("local", wait_for_first_consumer=True))
+        store.create(make_pv("only-pv", storage_class="local"))
+        store.create(make_pvc("c1", storage_class="local"))
+        store.create(make_pvc("c2", storage_class="local"))
+        store.create(with_pvc(make_pod("p1", cpu="1"), "c1"))
+        store.create(with_pvc(make_pod("p2", cpu="1"), "c2"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        placed = [n for n in (node_of(store, "p1"), node_of(store, "p2")) if n]
+        assert len(placed) == 1  # exactly one pod won the single PV
+        bound = [
+            pvc for pvc in (store.get("PersistentVolumeClaim", "default/c1"),
+                            store.get("PersistentVolumeClaim", "default/c2"))
+            if pvc.is_bound
+        ]
+        assert len(bound) == 1
+        assert bound[0].spec.volume_name == "only-pv"
+
+
+class TestVolumeZone:
+    def test_zone_conflict_filters_node(self):
+        store = Store()
+        store.create(make_node("n-a", zone="zone-a"))
+        store.create(make_node("n-b", zone="zone-b"))
+        store.create(make_pv("pv-a", storage_class="", zone="zone-a"))
+        store.create(make_pvc("data", storage_class="",
+                              volume_name="pv-a", bound=True))
+        store.create(with_pvc(make_pod("p1", cpu="1"), "data"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        assert node_of(store, "p1") == "n-a"
+
+
+class TestVolumeRestrictions:
+    def test_rwop_conflict(self):
+        """A second pod claiming an in-use ReadWriteOncePod PVC is rejected
+        (volume_restrictions.go:318)."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_pv("pv1", access_modes=("ReadWriteOncePod",)))
+        store.create(make_pvc("data", access_modes=("ReadWriteOncePod",),
+                              volume_name="pv1", bound=True))
+        store.create(with_pvc(make_pod("p1", cpu="1", node_name="n1"), "data"))
+        store.create(with_pvc(make_pod("p2", cpu="1"), "data"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert node_of(store, "p2") == ""
+
+    def test_rwop_free_after_owner_deleted(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_pv("pv1", access_modes=("ReadWriteOncePod",)))
+        store.create(make_pvc("data", access_modes=("ReadWriteOncePod",),
+                              volume_name="pv1", bound=True))
+        owner = with_pvc(make_pod("p1", cpu="1", node_name="n1"), "data")
+        store.create(owner)
+        store.create(with_pvc(make_pod("p2", cpu="1"), "data"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert node_of(store, "p2") == ""
+        store.delete("Pod", "default/p1")
+        import time
+
+        time.sleep(1.1)  # real clock backoff for the retried pod
+        s.schedule_pending()
+        assert node_of(store, "p2") == "n1"
+
+
+class TestNodeVolumeLimits:
+    def test_csi_attach_limit(self):
+        """n1's CSI driver reports 1 attachable volume and already has one;
+        the new pod's claim must push the pod to n2 (csi.go:257)."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_csi_node("n1", **{"ebs__csi__example__com": 1}))
+        store.create(make_csi_node("n2", **{"ebs__csi__example__com": 8}))
+        for i, claim in enumerate(("v1", "v2")):
+            store.create(make_pv(f"pv-{claim}", csi_driver="ebs.csi.example.com"))
+            store.create(make_pvc(claim, volume_name=f"pv-{claim}", bound=True))
+        store.create(with_pvc(make_pod("existing", cpu="1", node_name="n1"), "v1"))
+        store.create(with_pvc(make_pod("newpod", cpu="1"), "v2"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert node_of(store, "newpod") == "n2"
+
+
+class TestReviewFixes:
+    def test_provisioned_pv_pinned_to_selected_node(self):
+        """A dynamically provisioned PV must carry node affinity for the node
+        the pod landed on (selected-node annotation semantics)."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_storage_class(
+            "csi", provisioner="ebs.csi.example.com", wait_for_first_consumer=True))
+        store.create(make_pvc("data", storage_class="csi"))
+        store.create(with_pvc(make_pod("p1", cpu="1"), "data"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        landed = node_of(store, "p1")
+        pvc = store.get("PersistentVolumeClaim", "default/data")
+        pv = store.get("PersistentVolume", pvc.spec.volume_name)
+        assert pv.spec.node_affinity is not None
+        # a follow-up pod on the same claim must follow the pinned node
+        store.create(with_pvc(make_pod("p2", cpu="1"), "data"))
+        s.schedule_pending()
+        assert node_of(store, "p2") == landed
+
+    def test_rwop_conflict_resolvable_by_preemption(self):
+        """A high-priority pod blocked by an RWOP holder must be able to evict
+        it via preemption (volume_restrictions.go preFilterState + AddPod/
+        RemovePod make the dry-run pass once the holder is removed)."""
+        import time
+
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_pv("pv1", access_modes=("ReadWriteOncePod",)))
+        store.create(make_pvc("data", access_modes=("ReadWriteOncePod",),
+                              volume_name="pv1", bound=True))
+        store.create(with_pvc(
+            make_pod("holder", cpu="1", node_name="n1", priority=0), "data"))
+        store.create(with_pvc(make_pod("urgent", cpu="1", priority=100), "data"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        # holder evicted (deletion via preemption), urgent nominated
+        assert store.try_get("Pod", "default/holder") is None or \
+            store.get("Pod", "default/holder").meta.deletion_timestamp is not None
+        time.sleep(1.1)
+        s.schedule_pending()
+        assert node_of(store, "urgent") == "n1"
+
+    def test_ephemeral_claim_requires_pod_ownership(self):
+        from kubernetes_tpu.api.meta import OwnerReference
+        from kubernetes_tpu.api.storage import Volume
+
+        store = Store()
+        store.create(make_node("n1"))
+        # foreign claim that collides with the generated ephemeral name
+        store.create(make_pvc("p1-scratch"))
+        pod = make_pod("p1", cpu="1")
+        pod.spec.volumes = (Volume(name="scratch", ephemeral=True),)
+        store.create(pod)
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert node_of(store, "p1") == ""  # rejected, not adopted
+        # now a properly owned claim for another pod schedules fine
+        owned = make_pvc("p2-scratch", volume_name="pv-x", bound=True)
+        owned.meta.owner_references.append(
+            OwnerReference(kind="Pod", name="p2", uid="u", controller=True))
+        store.create(make_pv("pv-x"))
+        store.create(owned)
+        pod2 = make_pod("p2", cpu="1")
+        pod2.spec.volumes = (Volume(name="scratch", ephemeral=True),)
+        store.create(pod2)
+        s.schedule_pending()
+        assert node_of(store, "p2") == "n1"
