@@ -1,0 +1,157 @@
+"""Dynamic Resource Allocation (DRA) API types: ResourceClaim, ResourceSlice,
+DeviceClass.
+
+Reference: staging/src/k8s.io/api/resource/v1/types.go (ResourceClaim,
+ResourceSlice, DeviceClass with structured parameters) — the device-claim
+model behind pkg/scheduler/framework/plugins/dynamicresources/.
+
+Divergence from the reference: device selectors are typed attribute
+requirements instead of CEL expressions. CEL's role there is exactly
+attribute/capacity predicates; a typed requirement list covers the same
+selection semantics with a compilable, kernel-friendly form.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from .meta import ObjectMeta
+
+
+@dataclass(frozen=True)
+class DeviceSelector:
+    """One attribute predicate on a device. Operators: In, NotIn, Exists,
+    Gt, Lt (numeric attributes compare as ints)."""
+
+    key: str
+    operator: str = "Exists"
+    values: tuple[str, ...] = ()
+
+    def matches(self, attributes: Mapping[str, object]) -> bool:
+        present = self.key in attributes
+        val = attributes.get(self.key)
+        if self.operator == "Exists":
+            return present
+        if self.operator == "DoesNotExist":
+            return not present
+        if self.operator == "In":
+            return present and str(val) in self.values
+        if self.operator == "NotIn":
+            return not present or str(val) not in self.values
+        if self.operator in ("Gt", "Lt"):
+            if not present or not self.values:
+                return False
+            try:
+                lhs, rhs = int(str(val)), int(self.values[0])
+            except ValueError:
+                return False
+            return lhs > rhs if self.operator == "Gt" else lhs < rhs
+        return False
+
+
+@dataclass(frozen=True)
+class Device:
+    """One allocatable device in a ResourceSlice (resource/v1 BasicDevice)."""
+
+    name: str
+    attributes: Mapping[str, object] = field(default_factory=dict)
+    capacity: Mapping[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceSlice:
+    """Per-(node, driver, pool) device inventory published by a DRA driver
+    (resource/v1 ResourceSlice). node_name == "" means network-attached
+    devices available to every node (all_nodes)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    node_name: str = ""
+    driver: str = ""
+    pool: str = "default"
+    devices: tuple[Device, ...] = ()
+    all_nodes: bool = False
+
+    kind = "ResourceSlice"
+
+
+@dataclass
+class DeviceClass:
+    """Admin-defined device category (resource/v1 DeviceClass): a driver
+    plus common selectors every claim of this class inherits."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    driver: str = ""
+    selectors: tuple[DeviceSelector, ...] = ()
+
+    kind = "DeviceClass"
+
+
+@dataclass(frozen=True)
+class DeviceRequest:
+    """One device request inside a claim (resource/v1 DeviceRequest)."""
+
+    name: str
+    device_class_name: str = ""
+    selectors: tuple[DeviceSelector, ...] = ()
+    count: int = 1
+
+
+@dataclass
+class ResourceClaimSpec:
+    requests: tuple[DeviceRequest, ...] = ()
+
+
+@dataclass(frozen=True)
+class DeviceAllocationResult:
+    """One allocated device (resource/v1 DeviceRequestAllocationResult)."""
+
+    request: str
+    driver: str
+    pool: str
+    device: str
+
+
+@dataclass
+class AllocationResult:
+    devices: tuple[DeviceAllocationResult, ...] = ()
+    node_name: str = ""  # node the allocation is bound to ("" = any node)
+
+
+@dataclass
+class ResourceClaimStatus:
+    allocation: AllocationResult | None = None
+    reserved_for: tuple[str, ...] = ()  # pod keys (resource/v1 max 256)
+
+
+@dataclass
+class ResourceClaim:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceClaimSpec = field(default_factory=ResourceClaimSpec)
+    status: ResourceClaimStatus = field(default_factory=ResourceClaimStatus)
+
+    kind = "ResourceClaim"
+
+    @property
+    def is_allocated(self) -> bool:
+        return self.status.allocation is not None
+
+
+@dataclass(frozen=True)
+class PodResourceClaim:
+    """pod.spec.resourceClaims entry: a pod-local name mapping to a
+    ResourceClaim object in the pod's namespace."""
+
+    name: str
+    resource_claim_name: str
+
+
+RESERVED_FOR_MAX = 256  # resource/v1 ResourceClaimReservedForMaxSize
+
+
+def pod_resource_claim_keys(pod) -> list[str]:
+    """Store keys of all ResourceClaims the pod references."""
+    return [
+        f"{pod.meta.namespace}/{rc.resource_claim_name}"
+        for rc in pod.spec.resource_claims
+    ]

@@ -210,6 +210,7 @@ class PodSpec:
     preemption_policy: str = "PreemptLowerPriority"  # or "Never"
     scheduling_gates: tuple[str, ...] = ()
     scheduling_group: SchedulingGroup | None = None
+    resource_claims: tuple = ()  # tuple[dra.PodResourceClaim, ...]
     host_network: bool = False
     termination_grace_period_seconds: int = 30
     restart_policy: str = "Always"

@@ -1,0 +1,227 @@
+"""Async API-call dispatcher + cache: binding/status writes never block the
+scheduling loop.
+
+Reference: pkg/scheduler/backend/api_dispatcher/ (APIDispatcher:32-112,
+call_queue.go relevance-merge) + backend/api_cache/api_cache.go:29-61 and the
+call types in pkg/scheduler/framework/api_calls/ (Relevances at
+api_calls.go:33). SchedulerAsyncAPICalls feature
+(pkg/features/kube_features.go:899).
+
+Semantics preserved:
+- one in-flight/queued call per object; a newer call against the same object
+  merges with or replaces the queued one by relevance comparison
+- a less-relevant incoming call is dropped (ErrCallSkipped)
+- `parallelism` worker threads drain the queue; callers can wait on a future
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+# call-type relevance (api_calls.go Relevances): higher wins on conflict
+POD_STATUS_PATCH = "pod_status_patch"
+POD_BINDING = "pod_binding"
+RELEVANCES = {POD_STATUS_PATCH: 1, POD_BINDING: 2}
+
+
+class CallSkippedError(Exception):
+    """A queued more-relevant call made this one redundant."""
+
+
+@dataclass
+class APICall:
+    call_type: str
+    object_key: str
+    execute: Callable[[], Any]
+    on_finish: Callable[[Exception | None], None] | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Exception | None = None
+
+    @property
+    def relevance(self) -> int:
+        return RELEVANCES.get(self.call_type, 0)
+
+    def sync_or_merge(self, older: "APICall") -> bool:
+        """Can this call subsume `older`? Same type merges (latest wins);
+        higher relevance replaces; lower relevance is skipped."""
+        return self.relevance >= older.relevance
+
+
+class APIDispatcher:
+    """Queue + workers (api_dispatcher.go APIDispatcher)."""
+
+    def __init__(self, parallelism: int = 16, metrics=None):
+        self.parallelism = parallelism
+        self.metrics = metrics
+        self._queued: dict[str, APICall] = {}  # object key -> pending call
+        self._inflight: set[str] = set()  # keys a worker is executing now
+        self._order: _queue.Queue = _queue.Queue()
+        self._lock = threading.Lock()
+        self._workers: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- enqueue -------------------------------------------------------------
+
+    def add(self, call: APICall) -> APICall:
+        """Queue a call; returns the call actually representing the work (the
+        merged-into call when dedup applies). Raises CallSkippedError when a
+        more relevant call is already pending for the object."""
+        with self._lock:
+            pending = self._queued.get(call.object_key)
+            if pending is not None:
+                if not call.sync_or_merge(pending):
+                    raise CallSkippedError(
+                        f"{call.call_type} for {call.object_key} skipped: "
+                        f"{pending.call_type} already queued"
+                    )
+                # replace the queued call's work in place (merge = latest wins)
+                pending.call_type = call.call_type
+                pending.execute = call.execute
+                pending.on_finish = call.on_finish
+                return pending
+            self._queued[call.object_key] = call
+            self._order.put(call.object_key)
+            if self.metrics is not None:
+                self.metrics.async_api_pending.set(len(self._queued))
+            return call
+
+    # -- workers -------------------------------------------------------------
+
+    def run(self) -> None:
+        for i in range(self.parallelism):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"api-dispatcher-{i}")
+            t.start()
+            self._workers.append(t)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                key = self._order.get(timeout=0.05)
+            except _queue.Empty:
+                continue
+            with self._lock:
+                if key in self._inflight:
+                    # strictly one executing call per object: requeue until
+                    # the in-flight one finishes (call_queue.go semantics)
+                    self._order.put(key)
+                    defer = True
+                    call = None
+                else:
+                    defer = False
+                    call = self._queued.pop(key, None)
+                    if call is not None:
+                        self._inflight.add(key)
+                    if self.metrics is not None:
+                        self.metrics.async_api_pending.set(len(self._queued))
+            if defer:
+                time.sleep(0.001)
+                continue
+            if call is None:
+                continue
+            try:
+                self._execute(call)
+            finally:
+                with self._lock:
+                    self._inflight.discard(key)
+
+    def _execute(self, call: APICall) -> None:
+        err: Exception | None = None
+        try:
+            call.execute()
+        except Exception as e:  # noqa: BLE001 - surfaced via on_finish
+            err = e
+        call.error = err
+        if self.metrics is not None:
+            self.metrics.async_api_calls.inc(
+                call.call_type, "error" if err else "success"
+            )
+        if call.on_finish is not None:
+            call.on_finish(err)
+        call.done.set()
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Synchronously execute everything still queued (tests/shutdown);
+        respects the one-executing-call-per-object invariant."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                key = next(
+                    (k for k in self._queued if k not in self._inflight), None
+                )
+                if key is None:
+                    if not self._queued and not self._inflight:
+                        return
+                    call = None  # everything left is busy in a worker
+                else:
+                    call = self._queued.pop(key)
+                    self._inflight.add(key)
+            if call is None:
+                time.sleep(0.001)
+                continue
+            try:
+                self._execute(call)
+            finally:
+                with self._lock:
+                    self._inflight.discard(key)
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._workers:
+            t.join(timeout=1)
+        self._workers.clear()
+
+
+class APICacher:
+    """api_cache.go APICacher — routes scheduler API writes through the
+    dispatcher while keeping queue/cache consistent. The store write happens
+    asynchronously; the cache already holds the assumed pod, so scheduling
+    correctness never depends on the write having landed."""
+
+    def __init__(self, store, dispatcher: APIDispatcher):
+        self.store = store
+        self.dispatcher = dispatcher
+
+    def bind_pod(self, pod, node_name: str) -> APICall:
+        from ..store.store import NotFoundError
+
+        def execute():
+            try:
+                cur = self.store.get("Pod", pod.meta.key)
+            except NotFoundError:
+                return  # pod deleted mid-flight: binding is moot
+            cur.spec.node_name = node_name
+            self.store.update(cur, check_version=False)
+
+        return self.dispatcher.add(
+            APICall(POD_BINDING, pod.meta.key, execute)
+        )
+
+    def patch_pod_status(self, pod, condition=None, nominated_node: str | None = None) -> APICall:
+        from ..store.store import NotFoundError
+
+        def execute():
+            try:
+                cur = self.store.get("Pod", pod.meta.key)
+            except NotFoundError:
+                return
+            if condition is not None:
+                for c in cur.status.conditions:
+                    if c.type == condition.type:
+                        c.status = condition.status
+                        c.reason = condition.reason
+                        c.message = condition.message
+                        break
+                else:
+                    cur.status.conditions.append(condition)
+            if nominated_node is not None:
+                cur.status.nominated_node_name = nominated_node
+            self.store.update(cur, check_version=False)
+
+        return self.dispatcher.add(
+            APICall(POD_STATUS_PATCH, pod.meta.key, execute)
+        )

@@ -1,0 +1,219 @@
+"""Scheduler metrics: the §2.6 metric set over the shared registry.
+
+Reference: pkg/scheduler/metrics/metrics.go (scheduleAttempts:225,
+SchedulingAlgorithmLatency:251, FrameworkExtensionPointDuration:340,
+PluginExecutionDuration:351, pendingPods:276, PodSchedulingSLIDuration:312,
+PodSchedulingAttempts:323, CacheSize:394, unschedulableReasons:402, batching
+BatchAttemptStats:297/GetNodeHintDuration:496, gang
+podGroupScheduleAttempts:519) and metric_recorder.go MetricsAsyncRecorder.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils.metrics import Registry
+
+SCHEDULED = "scheduled"
+UNSCHEDULABLE = "unschedulable"
+ERROR = "error"
+
+
+class SchedulerMetrics:
+    """The facade the scheduler/framework call sites use; every observation
+    lands in a Prometheus-style registry exposable at /metrics."""
+
+    def __init__(self, registry: Registry | None = None, profile: str = "default-scheduler"):
+        self.registry = registry or Registry()
+        self.profile = profile
+        r = self.registry
+        self.schedule_attempts = r.counter(
+            "scheduler_schedule_attempts_total",
+            "Number of attempts to schedule pods, by result",
+            labels=("result", "profile"), stability="STABLE",
+        )
+        self.scheduling_attempt_duration = r.histogram(
+            "scheduler_scheduling_attempt_duration_seconds",
+            "Scheduling attempt latency (algorithm + binding)",
+            labels=("result", "profile"), stability="STABLE",
+        )
+        self.scheduling_algorithm_duration = r.histogram(
+            "scheduler_scheduling_algorithm_duration_seconds",
+            "Scheduling algorithm latency", stability="ALPHA",
+        )
+        self.extension_point_duration = r.histogram(
+            "scheduler_framework_extension_point_duration_seconds",
+            "Latency per extension point",
+            labels=("extension_point", "status", "profile"), stability="STABLE",
+        )
+        self.plugin_execution_duration = r.histogram(
+            "scheduler_plugin_execution_duration_seconds",
+            "Plugin execution latency per extension point",
+            labels=("plugin", "extension_point"), stability="ALPHA",
+        )
+        self.pending_pods = r.gauge(
+            "scheduler_pending_pods",
+            "Pending pods by queue (active|backoff|unschedulable|gated)",
+            labels=("queue",), stability="STABLE",
+        )
+        self.pod_scheduling_sli_duration = r.histogram(
+            "scheduler_pod_scheduling_sli_duration_seconds",
+            "E2e pod scheduling latency from first attempt, by attempt count",
+            labels=("attempts",), stability="BETA",
+        )
+        self.pod_scheduling_attempts = r.histogram(
+            "scheduler_pod_scheduling_attempts",
+            "Attempts to successfully schedule a pod",
+            buckets=(1, 2, 4, 8, 16), stability="STABLE",
+        )
+        self.cache_size = r.gauge(
+            "scheduler_scheduler_cache_size",
+            "Nodes/pods/assumed-pods in the cache", labels=("type",),
+        )
+        self.unschedulable_reasons = r.gauge(
+            "scheduler_unschedulable_pods",
+            "Unschedulable pods by plugin", labels=("plugin", "profile"),
+        )
+        self.queue_incoming_pods = r.counter(
+            "scheduler_queue_incoming_pods_total",
+            "Pods added to queues by event", labels=("queue", "event"),
+        )
+        self.preemption_attempts = r.counter(
+            "scheduler_preemption_attempts_total", "Preemption attempts",
+        )
+        self.preemption_victims = r.histogram(
+            "scheduler_preemption_victims", "Victims per preemption",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        self.goroutines = r.gauge(
+            "scheduler_goroutines", "Worker threads by operation", labels=("operation",),
+        )
+        # batching (fork: metrics.go:297-310,496-517)
+        self.batch_attempts = r.counter(
+            "scheduler_batch_attempts_total",
+            "OpportunisticBatching outcomes", labels=("result",),
+        )
+        self.get_node_hint_duration = r.histogram(
+            "scheduler_get_node_hint_duration_seconds", "GetNodeHint latency",
+        )
+        self.store_schedule_results_duration = r.histogram(
+            "scheduler_store_schedule_results_duration_seconds",
+            "StoreScheduleResults latency",
+        )
+        # gang (fork: metrics.go:519-534)
+        self.pod_group_schedule_attempts = r.counter(
+            "scheduler_pod_group_schedule_attempts_total",
+            "Pod-group cycle outcomes", labels=("result",),
+        )
+        self.pod_group_algorithm_duration = r.histogram(
+            "scheduler_pod_group_scheduling_algorithm_duration_seconds",
+            "Pod-group algorithm latency",
+        )
+        # async API dispatcher (metrics.go:438-457)
+        self.async_api_calls = r.counter(
+            "scheduler_async_api_call_execution_total",
+            "Executed async API calls", labels=("call_type", "result"),
+        )
+        self.async_api_pending = r.gauge(
+            "scheduler_pending_async_api_calls", "Queued async API calls",
+        )
+        # TPU backend (new: kernel-vs-host path split)
+        self.kernel_dispatches = r.counter(
+            "scheduler_tpu_kernel_dispatches_total",
+            "Pods scheduled by the device kernel vs host fallback",
+            labels=("path",),
+        )
+        self._first_attempt: dict[str, float] = {}
+        self._attempt_counts: dict[str, int] = {}
+
+    # -- call sites used by the framework/loop -------------------------------
+
+    def observe_plugin(self, extension_point: str, plugin: str, seconds: float) -> None:
+        self.plugin_execution_duration.observe(seconds, plugin, extension_point)
+
+    def observe_extension_point(self, point: str, success: bool, seconds: float) -> None:
+        self.extension_point_duration.observe(
+            seconds, point, "Success" if success else "Error", self.profile
+        )
+
+    def attempt_started(self, qpi) -> None:
+        key = qpi.pod.meta.key
+        self._first_attempt.setdefault(key, time.time())
+        self._attempt_counts[key] = self._attempt_counts.get(key, 0) + 1
+
+    def pod_scheduled(self, qpi) -> None:
+        key = qpi.pod.meta.key
+        self.attempt_started(qpi)
+        attempts = self._attempt_counts.pop(key, 1)
+        start = self._first_attempt.pop(key, None)
+        self.schedule_attempts.inc(SCHEDULED, self.profile)
+        self.pod_scheduling_attempts.observe(attempts)
+        if start is not None:
+            self.pod_scheduling_sli_duration.observe(
+                time.time() - start, str(min(attempts, 16))
+            )
+
+    def pod_unschedulable(self, qpi) -> None:
+        self.attempt_started(qpi)
+        self.schedule_attempts.inc(UNSCHEDULABLE, self.profile)
+        for plugin in qpi.unschedulable_plugins:
+            self.unschedulable_reasons.inc(plugin, self.profile)
+
+    def pod_error(self, qpi) -> None:
+        self.attempt_started(qpi)
+        self.schedule_attempts.inc(ERROR, self.profile)
+
+    def update_queue_gauges(self, active: int, backoff: int, unschedulable: int,
+                            gated: int = 0) -> None:
+        self.pending_pods.set(active, "active")
+        self.pending_pods.set(backoff, "backoff")
+        self.pending_pods.set(unschedulable, "unschedulable")
+        self.pending_pods.set(gated, "gated")
+
+    def update_cache_gauges(self, nodes: int, pods: int, assumed: int) -> None:
+        self.cache_size.set(nodes, "nodes")
+        self.cache_size.set(pods, "pods")
+        self.cache_size.set(assumed, "assumed_pods")
+
+    def expose(self) -> str:
+        return self.registry.expose()
+
+
+class MetricsAsyncRecorder:
+    """metric_recorder.go MetricsAsyncRecorder — observations buffered on the
+    hot path, flushed by a background thread once per interval."""
+
+    def __init__(self, metrics: SchedulerMetrics, interval: float = 1.0):
+        self.metrics = metrics
+        self.interval = interval
+        self._buf: list[tuple] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def observe_plugin_duration_async(
+        self, extension_point: str, plugin: str, seconds: float
+    ) -> None:
+        with self._lock:
+            self._buf.append((extension_point, plugin, seconds))
+
+    def flush(self) -> None:
+        with self._lock:
+            buf, self._buf = self._buf, []
+        for point, plugin, seconds in buf:
+            self.metrics.observe_plugin(point, plugin, seconds)
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.flush()
+        self.flush()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -79,6 +79,14 @@ class NodePorts(Plugin):
     def events_to_register(self):
         return [ClusterEventWithHint(ClusterEvent(ev.POD, ev.DELETE))]
 
+    def sign(self, pod: Pod) -> str | None:
+        """signers.go PortsSigner — host-port demands key the signature."""
+        ports = sorted(
+            (p.host_ip, p.protocol, p.host_port)
+            for c in pod.spec.containers for p in c.ports if p.host_port > 0
+        )
+        return ";".join(f"{ip}:{proto}:{port}" for ip, proto, port in ports)
+
     def pre_filter(self, state, pod: Pod, nodes):
         ports = []
         for c in pod.spec.containers:
@@ -148,6 +156,14 @@ class TaintToleration(Plugin):
 
     def events_to_register(self):
         return [ClusterEventWithHint(ClusterEvent(ev.NODE, ev.ADD | ev.UPDATE_NODE_TAINT))]
+
+    def sign(self, pod: Pod) -> str | None:
+        """signers.go TolerationsSigner — pods differing in tolerations must
+        not share a batch signature."""
+        return ";".join(
+            f"{t.key}:{t.operator}:{t.value}:{t.effect}"
+            for t in sorted(pod.spec.tolerations, key=lambda t: (t.key, t.effect))
+        )
 
     def filter(self, state, pod: Pod, node_info: NodeInfo) -> Status:
         node = node_info.node

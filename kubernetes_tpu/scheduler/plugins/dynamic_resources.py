@@ -1,0 +1,287 @@
+"""DynamicResources plugin: device-claim allocation against ResourceSlices.
+
+Reference: pkg/scheduler/framework/plugins/dynamicresources/dynamicresources.go
+(PreEnqueue:252 claims-must-exist, PreFilter:408 allocator setup, Filter:637
+per-node allocation attempt, Reserve, PreBind, Unreserve) with the structured
+allocator from staging/src/k8s.io/dynamic-resource-allocation/ and in-memory
+allocation tracking mirroring dra_manager.go / allocateddevices.go.
+
+The allocator here is typed-selector based (api/dra.py) rather than CEL; the
+per-node allocation attempt is the same shape: gather the node's device
+inventory, subtract devices already allocated (claim statuses + in-flight
+assumes), then greedily satisfy each request.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ...api.dra import (
+    RESERVED_FOR_MAX,
+    AllocationResult,
+    DeviceAllocationResult,
+    DeviceRequest,
+    ResourceClaim,
+    pod_resource_claim_keys,
+)
+from ...api.types import Pod
+from ..framework import events as ev
+from ..framework.events import ClusterEvent, ClusterEventWithHint, QUEUE
+from ..framework.interface import Plugin, Status
+from ..nodeinfo import NodeInfo
+
+ERR_CLAIM_NOT_FOUND = "waiting for dynamic resource claim to be created"
+ERR_CANNOT_ALLOCATE = "cannot allocate all claims"
+ERR_RESERVED_ELSEWHERE = "resourceclaim in use and not available on this node"
+ERR_TOO_MANY_CONSUMERS = "resourceclaim has reached its maximum consumer count"
+
+
+@dataclass
+class _ClaimState:
+    """Per-cycle DRA state (dynamicresources.go stateData)."""
+
+    claims: list[ResourceClaim] = field(default_factory=list)
+    # node name -> {claim key -> AllocationResult} computed by Filter
+    allocations_per_node: dict[str, dict[str, AllocationResult]] = field(
+        default_factory=dict
+    )
+    # set by reserve; used by unreserve/pre_bind
+    reserved_node: str = ""
+
+    def clone(self) -> "_ClaimState":
+        c = _ClaimState(list(self.claims))
+        c.allocations_per_node = {
+            n: dict(m) for n, m in self.allocations_per_node.items()
+        }
+        c.reserved_node = self.reserved_node
+        return c
+
+
+class DRAManager:
+    """In-memory view of allocated devices (dra_manager.go +
+    allocateddevices.go): claim statuses from the store plus in-flight
+    assumed allocations not yet written back."""
+
+    def __init__(self, store):
+        self.store = store
+        # claim key -> AllocationResult assumed during Reserve
+        self.assumed: dict[str, AllocationResult] = {}
+
+    def allocated_device_ids(self) -> set[tuple[str, str, str]]:
+        """(driver, pool, device) triples currently taken cluster-wide."""
+        taken: set[tuple[str, str, str]] = set()
+        claims, _ = self.store.list("ResourceClaim")
+        for claim in claims:
+            alloc = claim.status.allocation
+            if alloc is not None:
+                for d in alloc.devices:
+                    taken.add((d.driver, d.pool, d.device))
+        for alloc in self.assumed.values():
+            for d in alloc.devices:
+                taken.add((d.driver, d.pool, d.device))
+        return taken
+
+    def effective_allocation(self, claim: ResourceClaim) -> AllocationResult | None:
+        return claim.status.allocation or self.assumed.get(claim.meta.key)
+
+    def assume(self, claim_key: str, alloc: AllocationResult) -> None:
+        self.assumed[claim_key] = alloc
+
+    def forget(self, claim_key: str) -> None:
+        self.assumed.pop(claim_key, None)
+
+
+class Allocator:
+    """Structured allocator: satisfy a claim's requests from one node's
+    inventory (staging/.../structured/allocator.go, typed-selector form)."""
+
+    def __init__(self, store, manager: DRAManager):
+        self.store = store
+        self.manager = manager
+
+    def _class_requirements(self, request: DeviceRequest):
+        driver = ""
+        selectors = list(request.selectors)
+        if request.device_class_name:
+            dc = self.store.try_get("DeviceClass", request.device_class_name)
+            if dc is not None:
+                driver = dc.driver
+                selectors.extend(dc.selectors)
+        return driver, selectors
+
+    def node_inventory(self, node_name: str):
+        """(driver, pool, device) inventory visible to one node."""
+        out = []
+        slices, _ = self.store.list("ResourceSlice")
+        for sl in slices:
+            if sl.all_nodes or sl.node_name == node_name:
+                for dev in sl.devices:
+                    out.append((sl.driver, sl.pool, dev))
+        return out
+
+    def allocate(
+        self, claim: ResourceClaim, node_name: str,
+        taken: set[tuple[str, str, str]],
+    ) -> AllocationResult | None:
+        """Greedy per-request allocation; mutates `taken` on success so one
+        Filter pass can allocate several claims without double-booking."""
+        inventory = self.node_inventory(node_name)
+        picked: list[DeviceAllocationResult] = []
+        newly: list[tuple[str, str, str]] = []
+        for request in claim.spec.requests:
+            driver, selectors = self._class_requirements(request)
+            need = request.count
+            for drv, pool, dev in inventory:
+                if need == 0:
+                    break
+                if driver and drv != driver:
+                    continue
+                key = (drv, pool, dev.name)
+                if key in taken or key in newly:
+                    continue
+                if all(sel.matches(dev.attributes) for sel in selectors):
+                    picked.append(
+                        DeviceAllocationResult(request.name, drv, pool, dev.name)
+                    )
+                    newly.append(key)
+                    need -= 1
+            if need > 0:
+                return None
+        taken.update(newly)
+        return AllocationResult(devices=tuple(picked), node_name=node_name)
+
+
+class DynamicResources(Plugin):
+    """dynamicresources/dynamicresources.go — DRA extension points."""
+
+    name = "DynamicResources"
+    STATE_KEY = "PreFilterDynamicResources"
+
+    def __init__(self, store, manager: DRAManager | None = None):
+        self.store = store
+        self.manager = manager or DRAManager(store)
+        self.allocator = Allocator(store, self.manager)
+
+    def events_to_register(self):
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(ev.RESOURCE_CLAIM, ev.ADD | ev.UPDATE | ev.DELETE),
+                lambda *_: QUEUE,
+            ),
+            ClusterEventWithHint(
+                ClusterEvent(ev.RESOURCE_SLICE, ev.ADD | ev.UPDATE), lambda *_: QUEUE
+            ),
+            ClusterEventWithHint(ClusterEvent(ev.NODE, ev.ADD), lambda *_: QUEUE),
+        ]
+
+    # -- queue gating --------------------------------------------------------
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        """PreEnqueue:252 — claims must exist before the pod may queue."""
+        for key in pod_resource_claim_keys(pod):
+            if self.store.try_get("ResourceClaim", key) is None:
+                return Status.unresolvable(ERR_CLAIM_NOT_FOUND, plugin=self.name)
+        return Status()
+
+    # -- scheduling cycle ----------------------------------------------------
+
+    def pre_filter(self, state, pod: Pod, nodes):
+        keys = pod_resource_claim_keys(pod)
+        if not keys:
+            return None, Status.skip()
+        s = _ClaimState()
+        for key in keys:
+            claim = self.store.try_get("ResourceClaim", key)
+            if claim is None:
+                return None, Status.unresolvable(ERR_CLAIM_NOT_FOUND, plugin=self.name)
+            s.claims.append(claim)
+        state.write(self.STATE_KEY, s)
+        return None, None
+
+    def filter(self, state, pod: Pod, node_info: NodeInfo) -> Status:
+        s: _ClaimState | None = state.read(self.STATE_KEY)
+        if s is None:
+            return Status()
+        node_name = node_info.name
+        taken = None  # lazy: only hit the store when an allocation is needed
+        node_allocs: dict[str, AllocationResult] = {}
+        for claim in s.claims:
+            alloc = self.manager.effective_allocation(claim)
+            if alloc is not None:
+                # already allocated: node must match the allocation
+                if alloc.node_name and alloc.node_name != node_name:
+                    return Status.unresolvable(
+                        ERR_RESERVED_ELSEWHERE, plugin=self.name
+                    )
+                if (
+                    len(claim.status.reserved_for) >= RESERVED_FOR_MAX
+                    and pod.meta.key not in claim.status.reserved_for
+                ):
+                    return Status.unresolvable(
+                        ERR_TOO_MANY_CONSUMERS, plugin=self.name
+                    )
+                continue
+            if taken is None:
+                taken = self.manager.allocated_device_ids()
+            alloc = self.allocator.allocate(claim, node_name, taken)
+            if alloc is None:
+                return Status.unschedulable(ERR_CANNOT_ALLOCATE, plugin=self.name)
+            node_allocs[claim.meta.key] = alloc
+        if node_allocs:
+            s.allocations_per_node[node_name] = node_allocs
+        return Status()
+
+    def reserve(self, state, pod: Pod, node_name: str) -> Status:
+        s: _ClaimState | None = state.read(self.STATE_KEY)
+        if s is None:
+            return Status()
+        s.reserved_node = node_name
+        for key, alloc in s.allocations_per_node.get(node_name, {}).items():
+            self.manager.assume(key, alloc)
+        return Status()
+
+    def unreserve(self, state, pod: Pod, node_name: str) -> None:
+        s: _ClaimState | None = state.read(self.STATE_KEY)
+        if s is None:
+            return
+        for key in s.allocations_per_node.get(node_name, {}):
+            self.manager.forget(key)
+
+    def pre_bind(self, state, pod: Pod, node_name: str) -> Status:
+        """Write allocation + reservedFor to the store (PreBind)."""
+        s: _ClaimState | None = state.read(self.STATE_KEY)
+        if s is None:
+            return Status()
+        my_allocs = s.allocations_per_node.get(node_name, {})
+        try:
+            for claim in s.claims:
+                cur = self.store.get("ResourceClaim", claim.meta.key)
+                alloc = my_allocs.get(claim.meta.key)
+                if alloc is not None and cur.status.allocation is None:
+                    cur.status.allocation = alloc
+                if pod.meta.key not in cur.status.reserved_for:
+                    cur.status.reserved_for = tuple(cur.status.reserved_for) + (
+                        pod.meta.key,
+                    )
+                self.store.update(cur, check_version=False)
+                # forget only assumes THIS pod created — a shared claim's
+                # assume may belong to another pod's in-flight binding
+                if claim.meta.key in my_allocs:
+                    self.manager.forget(claim.meta.key)
+        except Exception as e:  # noqa: BLE001 - surfaced as bind failure
+            return Status.as_error(e, self.name)
+        return Status()
+
+    def pre_bind_pre_flight(self, state, pod: Pod, node_name: str) -> Status:
+        s: _ClaimState | None = state.read(self.STATE_KEY)
+        if s is None:
+            return Status.skip()
+        return Status()
+
+    def sign(self, pod: Pod) -> str | None:
+        """Claim-referencing pods are unsignable: allocation state is
+        per-pod, so batching identical-pod score reuse would be wrong
+        (signers.go treats DRA pods the same way)."""
+        if pod.spec.resource_claims:
+            return None
+        return ""

@@ -71,6 +71,11 @@ def default_plugins(store, names: ResourceNames, feature_gates=None, args: dict 
         DefaultBinder(store),
     ]
     gates = feature_gates or {}
+    if gates.get("DynamicResourceAllocation", True):
+        from .dynamic_resources import DynamicResources
+
+        idx = next(i for i, p in enumerate(plugins) if p.name == "PodTopologySpread")
+        plugins.insert(idx, DynamicResources(store))
     if gates.get("GangScheduling", True):
         from .gang_scheduling import GangScheduling
 

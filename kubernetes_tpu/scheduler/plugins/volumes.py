@@ -318,7 +318,9 @@ class VolumeBinding(Plugin):
             pod, s.claims, node_info, s.pv_candidates
         )
         if reasons:
-            return Status.unschedulable(*reasons, plugin=self.name)
+            # UnschedulableAndUnresolvable (volume_binding.go Filter): no
+            # eviction changes PV node affinity, so preemption must not try
+            return Status.unresolvable(*reasons, plugin=self.name)
         s.per_node[node_info.name] = volumes
         return Status()
 
@@ -525,9 +527,10 @@ class VolumeZone(Plugin):
         labels = node_info.node.meta.labels
         for key, value in constraints:
             # missing label counts as a mismatch (volume_zone.go:198 — the
-            # node must carry the PV's topology label with the same value)
+            # node must carry the PV's topology label with the same value);
+            # unresolvable: eviction can't relabel nodes
             if labels.get(key) != value:
-                return Status.unschedulable(ERR_REASON_ZONE_CONFLICT, plugin=self.name)
+                return Status.unresolvable(ERR_REASON_ZONE_CONFLICT, plugin=self.name)
         return Status()
 
 
@@ -568,6 +571,7 @@ class NodeVolumeLimits(Plugin):
         return None
 
     STATE_KEY = "PreFilterNodeVolumeLimits"
+    MEMO_KEY = "PreFilterNodeVolumeLimitsMemo"
 
     def pre_filter(self, state, pod: Pod, nodes):
         # resolve the pod's claims to per-driver volume identities once — the
@@ -582,6 +586,9 @@ class NodeVolumeLimits(Plugin):
         if not new_by_driver:
             return None, Status.skip()
         state.write(self.STATE_KEY, new_by_driver)
+        # claim->driver resolutions are stable within a cycle: memoize them
+        # across the per-node Filter calls (csi.go resolves once per cycle)
+        state.write(self.MEMO_KEY, {})
         return None, None
 
     def filter(self, state, pod: Pod, node_info: NodeInfo) -> Status:
@@ -591,9 +598,13 @@ class NodeVolumeLimits(Plugin):
         csi_node = self.store.try_get("CSINode", node_info.name)
         if csi_node is None or not csi_node.drivers:
             return Status()
+        memo: dict = state.read(self.MEMO_KEY) or {}
         used_by_driver: dict[str, set[str]] = {}
         for key in node_info.pvc_ref_counts:
-            res = self._driver_of(key)
+            if key in memo:
+                res = memo[key]
+            else:
+                res = memo[key] = self._driver_of(key)
             if res is None:
                 continue
             driver, vol = res

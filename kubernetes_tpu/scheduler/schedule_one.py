@@ -61,12 +61,15 @@ class SchedulingAlgorithm:
         percentage_of_nodes_to_score: int = 0,
         rng: random.Random | None = None,
         nominator=None,
+        extenders: list | None = None,
     ):
         self.fw = framework
         self.percentage = percentage_of_nodes_to_score
         self.next_start_node_index = 0
         self.rng = rng or random.Random(0)  # seeded: deterministic tie-breaks
         self.nominator = nominator  # queue, for nominated-pod protection
+        self.extenders = list(extenders or [])
+        self.batch = None  # BatchCache when OpportunisticBatching is on
         self.snapshot = None  # set per cycle by schedule_pod
 
     # -- filtering -----------------------------------------------------------
@@ -101,6 +104,12 @@ class SchedulingAlgorithm:
                 f"[{', '.join(sorted(diagnosis.unschedulable_plugins)) or 'prefilter'}]"
             )
         feasible = self._find_nodes_that_pass_filters(state, pod, nodes, diagnosis)
+        if self.extenders and feasible:
+            from .extender import find_nodes_that_pass_extenders
+
+            feasible = find_nodes_that_pass_extenders(
+                self.extenders, pod, feasible, diagnosis
+            )
         return feasible, diagnosis
 
     def _filter_one(self, state, pod, ni: NodeInfo, diagnosis: Diagnosis) -> bool:
@@ -165,6 +174,16 @@ class SchedulingAlgorithm:
         scores, st = self.fw.run_score_plugins(state, pod, nodes)
         if not st.is_success:
             raise RuntimeError(f"score failed: {st.reasons}")
+        if self.extenders:
+            from .extender import extender_scores
+
+            ext = extender_scores(self.extenders, pod, nodes)
+            if ext:
+                for nps in scores:
+                    bonus = ext.get(nps.name, 0)
+                    if bonus:
+                        nps.scores.append(("extenders", bonus))
+                        nps.total_score += bonus
         return scores
 
     def select_host(self, node_scores: list, count: int = 1) -> tuple[str, list]:
@@ -184,6 +203,21 @@ class SchedulingAlgorithm:
         """schedulePod:568 — the complete algorithm for one pod."""
         if snapshot.num_nodes() == 0:
             raise FitError(pod, 0, Diagnosis())
+        # opportunistic batching (findNodesThatFitPod:654 GetNodeHint): an
+        # identical pod signed earlier this batch window reuses its sorted
+        # score list — only the hinted node is re-Filtered
+        signature = None
+        if self.batch is not None and not pod.status.nominated_node_name:
+            signature = self.fw.sign_pod(pod)
+            # only pay the hint-path PreFilter when a fresh entry exists —
+            # otherwise the full path below runs PreFilter exactly once
+            if signature is not None and self.batch.has_fresh(signature):
+                hinted = self._try_node_hint(state, pod, snapshot, signature)
+                if hinted is not None:
+                    return ScheduleResult(
+                        suggested_host=hinted, evaluated_nodes=1, feasible_nodes=1
+                    )
+
         # nominated-node fast path: a preemptor retries its nomination first
         # (schedule_one.go:718 evaluateNominatedNode)
         nominated = pod.status.nominated_node_name
@@ -199,12 +233,33 @@ class SchedulingAlgorithm:
                 feasible_nodes=1,
             )
         scores = self.prioritize_nodes(state, pod, feasible)
-        host, _ = self.select_host(scores)
+        host, ordered = self.select_host(scores)
+        if signature is not None:
+            self.batch.store_schedule_results(
+                signature, [s.name for s in ordered]
+            )
         return ScheduleResult(
             suggested_host=host,
             evaluated_nodes=len(feasible) + len(diagnosis.node_to_status.node_to_status),
             feasible_nodes=len(feasible),
         )
+
+    def _try_node_hint(self, state, pod, snapshot, signature: str) -> str | None:
+        """Run PreFilter (CycleState must be populated for the Filter
+        re-check and the later Reserve/PreBind), then consult the batch
+        cache."""
+        all_nodes = snapshot.list_nodes()
+        _, status = self.fw.run_pre_filter_plugins(state, pod, all_nodes)
+        if not status.is_success:
+            return None
+
+        def filter_fn(node_name: str) -> bool:
+            ni = snapshot.get(node_name)
+            if ni is None:
+                return False
+            return self._filter_one(state, pod, ni, Diagnosis())
+
+        return self.batch.get_node_hint(signature, filter_fn)
 
 
 class ScheduleOneLoop:
@@ -227,6 +282,7 @@ class ScheduleOneLoop:
         async_binding: bool = False,
         event_recorder=None,
         names=None,
+        api_cacher=None,
     ):
         from ..api.resource import ResourceNames
 
@@ -240,6 +296,7 @@ class ScheduleOneLoop:
         self.metrics = metrics
         self.async_binding = async_binding
         self.event_recorder = event_recorder
+        self.api_cacher = api_cacher  # SchedulerAsyncAPICalls path
         self._binding_threads: list = []
 
     def framework_for_pod(self, pod: Pod) -> Framework | None:
@@ -381,7 +438,7 @@ class ScheduleOneLoop:
             self._handle_binding_failure(state, fw, qpi, host, st)
             return
 
-        st = fw.run_bind_plugins(state, pod, host)
+        st = self._bind(state, fw, pod, host)
         if not st.is_success and not st.is_skip:
             self._handle_binding_failure(state, fw, qpi, host, st)
             return
@@ -398,6 +455,33 @@ class ScheduleOneLoop:
         gk = self._group_key(pod)
         if gk is not None:
             self.cache.pod_group_states.pod_scheduled(gk, pod.meta.key)
+
+    def _bind(self, state, fw: Framework, pod: Pod, host: str) -> Status:
+        """bind:1136 — an interested binder extender takes precedence over
+        the bind plugins (extendersBinding, schedule_one.go:1160); with
+        SchedulerAsyncAPICalls the store write goes through the dispatcher
+        (DefaultBinder via APICacher.BindPod)."""
+        algo = self.algorithms.get(fw.profile_name)
+        for ext in getattr(algo, "extenders", []) or []:
+            if ext.is_binder() and ext.is_interested(pod):
+                return ext.bind(pod, host)
+        if self.api_cacher is not None:
+            from .api_dispatcher import CallSkippedError
+
+            try:
+                call = self.api_cacher.bind_pod(pod, host)
+            except CallSkippedError as e:
+                return Status.as_error(e)
+            # binding cycle already runs off the scheduling loop; waiting here
+            # preserves failure handling without blocking scheduling
+            if not call.done.wait(timeout=30):
+                return Status.as_error(
+                    TimeoutError(f"async bind of {pod.meta.key} timed out")
+                )
+            if call.error is not None:
+                return Status.as_error(call.error)
+            return Status()
+        return fw.run_bind_plugins(state, pod, host)
 
     def _handle_binding_failure(self, state, fw, qpi, host, status: Status) -> None:
         """handleBindingCycleError (schedule_one.go:504) — unreserve, forget,

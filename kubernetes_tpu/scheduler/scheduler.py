@@ -55,7 +55,10 @@ class Scheduler:
         metrics=None,
         seed: int = 0,
         async_binding: bool = False,
+        async_api_calls: bool = False,
+        parallelism: int = 16,
         event_recorder=None,
+        extenders: list | None = None,
     ):
         from ..utils.clock import Clock
 
@@ -66,6 +69,12 @@ class Scheduler:
         self.cache = Cache(self.names)
         self.snapshot = Snapshot()
         self.feature_gates = dict(feature_gates or {})
+        from .extender import ExtenderConfig, HTTPExtender
+
+        self.extenders = [
+            e if isinstance(e, HTTPExtender) else HTTPExtender(e)
+            for e in (extenders or [])
+        ]
 
         profiles = profiles or [Profile()]
         self.frameworks: dict[str, Framework] = {}
@@ -89,9 +98,11 @@ class Scheduler:
                 self.algorithms[prof.name] = TPUSchedulingAlgorithm(
                     fw, backend, rng=random.Random(seed)
                 )
+                self.algorithms[prof.name].extenders = self.extenders
             else:
                 self.algorithms[prof.name] = SchedulingAlgorithm(
-                    fw, prof.percentage_of_nodes_to_score, rng=random.Random(seed)
+                    fw, prof.percentage_of_nodes_to_score, rng=random.Random(seed),
+                    extenders=self.extenders,
                 )  # nominator wired below once the queue exists
             pre_enqueue = fw.pre_enqueue_plugins  # last profile wins (single-profile typical)
             hint_map.update(fw.queueing_hint_map())
@@ -104,8 +115,26 @@ class Scheduler:
             pre_enqueue_plugins=pre_enqueue,
             queueing_hint_map=hint_map,
         )
+        # OpportunisticBatching (KEP-5598, alpha -> default off as in the
+        # reference): one shared batch cache; flushed on node-shape events
+        self.batch_cache = None
+        if self.feature_gates.get("OpportunisticBatching", False):
+            from .framework.batch import BatchCache
+
+            self.batch_cache = BatchCache(metrics=metrics)
         for algo in self.algorithms.values():
             algo.nominator = self.queue
+            algo.batch = self.batch_cache
+
+        # SchedulerAsyncAPICalls: bind/status writes through the dispatcher
+        self.api_dispatcher = None
+        self.api_cacher = None
+        if async_api_calls:
+            from .api_dispatcher import APICacher, APIDispatcher
+
+            self.api_dispatcher = APIDispatcher(parallelism, metrics=metrics)
+            self.api_dispatcher.run()
+            self.api_cacher = APICacher(store, self.api_dispatcher)
 
         # wire handles into stateful plugins
         self.handle = Handle(store, self.cache, self.queue, self.snapshot)
@@ -126,6 +155,7 @@ class Scheduler:
             async_binding=async_binding,
             event_recorder=event_recorder,
             names=self.names,
+            api_cacher=self.api_cacher,
         )
 
         self._last_leftover_flush = self.clock.now()
@@ -226,6 +256,9 @@ class Scheduler:
         return action
 
     def _on_node_event(self, etype: str, old: Node | None, new: Node) -> None:
+        if self.batch_cache is not None:
+            # node shape changed: cached sorted score lists are stale
+            self.batch_cache.flush()
         if etype == ADDED:
             self.cache.add_node(new)
             self.queue.move_all_to_active_or_backoff(
@@ -284,6 +317,9 @@ class Scheduler:
         if now - self._last_leftover_flush > 30.0:
             self._last_leftover_flush = now
             self.queue.flush_unschedulable_leftover()
+        if self.metrics is not None and hasattr(self.metrics, "update_queue_gauges"):
+            active, backoff, unsched = self.queue.pending_pods()
+            self.metrics.update_queue_gauges(active, backoff, unsched)
         return n
 
     def schedule_pending(self, max_cycles: int = 100_000) -> int:

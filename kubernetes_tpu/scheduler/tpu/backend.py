@@ -317,7 +317,10 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         # run host-side only — a claim-backed pod needs the full host chain
         from ...api.storage import pod_claim_names
 
-        if pod_claim_names(pod):
+        if pod_claim_names(pod) or pod.spec.resource_claims:
+            return True
+        # configured HTTP extenders veto/score out-of-process — host path only
+        if self.extenders and any(e.is_interested(pod) for e in self.extenders):
             return True
         # preemption aftermath: nominated pods must be simulated onto nodes
         # during filtering (schedule_one.go:1190) — host path handles it

@@ -1,0 +1,161 @@
+"""DynamicResources (DRA) plugin tests.
+
+Modeled on test/integration/scheduler dra suites and
+pkg/scheduler/framework/plugins/dynamicresources/dynamicresources_test.go.
+"""
+
+from kubernetes_tpu.api.dra import (
+    Device,
+    DeviceClass,
+    DeviceRequest,
+    DeviceSelector,
+    PodResourceClaim,
+    ResourceClaim,
+    ResourceClaimSpec,
+    ResourceSlice,
+)
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+def make_slice(node, driver="tpu.example.com", n_devices=4, pool="p0", **attrs):
+    return ResourceSlice(
+        meta=ObjectMeta(name=f"slice-{node}-{pool}", namespace=""),
+        node_name=node,
+        driver=driver,
+        pool=pool,
+        devices=tuple(
+            Device(name=f"dev-{i}", attributes={"index": str(i), **attrs})
+            for i in range(n_devices)
+        ),
+    )
+
+
+def make_claim(name, requests=None, namespace="default"):
+    return ResourceClaim(
+        meta=ObjectMeta(name=name, namespace=namespace),
+        spec=ResourceClaimSpec(
+            requests=tuple(requests or (DeviceRequest(name="gpu", count=1),))
+        ),
+    )
+
+
+def claim_pod(pod, *claim_names):
+    pod.spec.resource_claims = tuple(
+        PodResourceClaim(name=c, resource_claim_name=c) for c in claim_names
+    )
+    return pod
+
+
+def new_scheduler(store, **kw):
+    s = Scheduler(store, **kw)
+    s.start()
+    return s
+
+
+def node_of(store, pod_name):
+    return store.get("Pod", f"default/{pod_name}").spec.node_name
+
+
+class TestDynamicResources:
+    def test_allocates_on_node_with_devices(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_slice("n2", n_devices=2))
+        store.create(make_claim("c1"))
+        store.create(claim_pod(make_pod("p1", cpu="1"), "c1"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        assert node_of(store, "p1") == "n2"
+        claim = store.get("ResourceClaim", "default/c1")
+        assert claim.is_allocated
+        assert claim.status.allocation.node_name == "n2"
+        assert claim.status.reserved_for == ("default/p1",)
+
+    def test_pod_gated_until_claim_exists(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_slice("n1"))
+        store.create(claim_pod(make_pod("p1", cpu="1"), "missing"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert node_of(store, "p1") == ""
+        store.create(make_claim("missing"))
+        s.schedule_pending()
+        assert node_of(store, "p1") == "n1"
+
+    def test_device_exhaustion(self):
+        """3 pods, each wanting 2 of the 4 devices on the only slice node:
+        the third pod must stay pending."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_slice("n1", n_devices=4))
+        for i in range(3):
+            store.create(make_claim(f"c{i}", requests=(
+                DeviceRequest(name="gpu", count=2),)))
+            store.create(claim_pod(make_pod(f"p{i}", cpu="1"), f"c{i}"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        placed = sorted(i for i in range(3) if node_of(store, f"p{i}"))
+        assert len(placed) == 2
+        taken = set()
+        for i in placed:
+            claim = store.get("ResourceClaim", f"default/c{i}")
+            devs = {(d.driver, d.pool, d.device) for d in claim.status.allocation.devices}
+            assert len(devs) == 2
+            assert not (devs & taken)  # no double-booking
+            taken |= devs
+
+    def test_selector_and_device_class(self):
+        """DeviceClass narrows driver + attributes; only n2's slice has
+        fast devices."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_slice("n1", speed="slow"))
+        store.create(make_slice("n2", speed="fast"))
+        store.create(DeviceClass(
+            meta=ObjectMeta(name="fast-tpu", namespace=""),
+            driver="tpu.example.com",
+            selectors=(DeviceSelector("speed", "In", ("fast",)),),
+        ))
+        store.create(make_claim("c1", requests=(
+            DeviceRequest(name="d", device_class_name="fast-tpu"),)))
+        store.create(claim_pod(make_pod("p1", cpu="1"), "c1"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        assert node_of(store, "p1") == "n2"
+
+    def test_shared_claim_second_pod_follows_allocation(self):
+        """A claim already allocated to n1's devices pins later consumers to
+        n1 (Filter: allocation.node_name must match)."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_slice("n1"))
+        store.create(make_claim("shared"))
+        store.create(claim_pod(make_pod("p1", cpu="1"), "shared"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        assert node_of(store, "p1") == "n1"
+        store.create(claim_pod(make_pod("p2", cpu="1"), "shared"))
+        s.schedule_pending()
+        assert node_of(store, "p2") == "n1"
+        claim = store.get("ResourceClaim", "default/shared")
+        assert set(claim.status.reserved_for) == {"default/p1", "default/p2"}
+
+    def test_gt_selector(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_slice("n1", n_devices=4))
+        store.create(make_claim("c1", requests=(
+            DeviceRequest(name="d", selectors=(DeviceSelector("index", "Gt", ("1",)),),
+                          count=2),)))
+        store.create(claim_pod(make_pod("p1", cpu="1"), "c1"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        claim = store.get("ResourceClaim", "default/c1")
+        assert {d.device for d in claim.status.allocation.devices} == {"dev-2", "dev-3"}

@@ -1,0 +1,153 @@
+"""HTTP extender tests against a real in-process webhook server.
+
+Modeled on test/integration/scheduler/extender/extender_test.go and
+pkg/scheduler/extender_test.go.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.extender import ExtenderConfig
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+class _ExtenderHandler(BaseHTTPRequestHandler):
+    behavior = {}  # {"filter": fn(args)->result, "prioritize": ..., "bind": ...}
+    calls = []
+
+    def do_POST(self):
+        verb = self.path.strip("/")
+        length = int(self.headers["Content-Length"])
+        args = json.loads(self.rfile.read(length))
+        type(self).calls.append((verb, args))
+        fn = self.behavior.get(verb)
+        if fn is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = json.dumps(fn(args)).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # silence
+        pass
+
+
+@pytest.fixture
+def extender_server():
+    server = HTTPServer(("127.0.0.1", 0), _ExtenderHandler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    _ExtenderHandler.behavior = {}
+    _ExtenderHandler.calls = []
+    yield f"http://127.0.0.1:{server.server_port}", _ExtenderHandler
+    server.shutdown()
+
+
+def new_scheduler(store, **kw):
+    s = Scheduler(store, **kw)
+    s.start()
+    return s
+
+
+def node_of(store, pod_name):
+    return store.get("Pod", f"default/{pod_name}").spec.node_name
+
+
+def test_extender_filter_restricts_nodes(extender_server):
+    url, handler = extender_server
+    handler.behavior["filter"] = lambda args: {"nodenames": ["n2"]}
+    store = Store()
+    store.create(make_node("n1"))
+    store.create(make_node("n2"))
+    store.create(make_node("n3"))
+    store.create(make_pod("p1", cpu="1"))
+    s = new_scheduler(store, extenders=[ExtenderConfig(
+        url_prefix=url, filter_verb="filter", node_cache_capable=True)])
+    assert s.schedule_pending() == 1
+    assert node_of(store, "p1") == "n2"
+    assert any(v == "filter" for v, _ in handler.calls)
+
+
+def test_extender_prioritize_wins(extender_server):
+    url, handler = extender_server
+    handler.behavior["prioritize"] = lambda args: [
+        {"host": n, "score": 10 if n == "n3" else 0}
+        for n in args.get("nodenames", [])
+    ]
+    store = Store()
+    for i in range(1, 4):
+        store.create(make_node(f"n{i}"))
+    store.create(make_pod("p1", cpu="1"))
+    s = new_scheduler(store, extenders=[ExtenderConfig(
+        url_prefix=url, prioritize_verb="prioritize", weight=5,
+        node_cache_capable=True)])
+    assert s.schedule_pending() == 1
+    assert node_of(store, "p1") == "n3"
+
+
+def test_extender_bind_delegation(extender_server):
+    url, handler = extender_server
+    bound = {}
+
+    def do_bind(args):
+        bound[args["podName"]] = args["node"]
+        return {}
+
+    handler.behavior["bind"] = do_bind
+    store = Store()
+    store.create(make_node("n1"))
+    store.create(make_pod("p1", cpu="1"))
+    s = new_scheduler(store, extenders=[ExtenderConfig(
+        url_prefix=url, bind_verb="bind", node_cache_capable=True)])
+    s.schedule_pending()
+    assert bound == {"p1": "n1"}  # extender did the binding, not DefaultBinder
+    # the store pod is not bound by the scheduler — the webhook owns the write
+    assert node_of(store, "p1") == ""
+
+
+def test_ignorable_extender_failure_tolerated(extender_server):
+    url, handler = extender_server  # no behaviors -> 404 on every verb
+    store = Store()
+    store.create(make_node("n1"))
+    store.create(make_pod("p1", cpu="1"))
+    s = new_scheduler(store, extenders=[ExtenderConfig(
+        url_prefix=url, filter_verb="filter", ignorable=True,
+        node_cache_capable=True)])
+    assert s.schedule_pending() == 1
+    assert node_of(store, "p1") == "n1"
+
+
+def test_non_ignorable_extender_failure_errors(extender_server):
+    url, handler = extender_server
+    store = Store()
+    store.create(make_node("n1"))
+    store.create(make_pod("p1", cpu="1"))
+    s = new_scheduler(store, extenders=[ExtenderConfig(
+        url_prefix=url, filter_verb="filter", node_cache_capable=True)])
+    s.schedule_pending()
+    assert node_of(store, "p1") == ""  # scheduling errored, pod retried later
+
+
+def test_managed_resources_interest(extender_server):
+    url, handler = extender_server
+    handler.behavior["filter"] = lambda args: {"nodenames": []}  # rejects all
+    store = Store()
+    store.create(make_node("n1"))
+    store.create(make_pod("plain", cpu="1"))
+    store.create(make_pod("special", cpu="1",
+                          requests={"example.com/foo": "1"}))
+    s = new_scheduler(store, extenders=[ExtenderConfig(
+        url_prefix=url, filter_verb="filter", node_cache_capable=True,
+        managed_resources=("example.com/foo",))])
+    s.schedule_pending()
+    assert node_of(store, "plain") == "n1"  # extender not interested
+    assert node_of(store, "special") == ""  # extender rejected every node

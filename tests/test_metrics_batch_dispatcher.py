@@ -1,0 +1,134 @@
+"""Tests: metrics registry, opportunistic batching, async API dispatcher.
+
+Modeled on pkg/scheduler/framework/runtime/batch_test.go,
+backend/api_dispatcher tests, and component-base/metrics behavior.
+"""
+
+import time
+
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.api_dispatcher import (
+    APICall,
+    APIDispatcher,
+    POD_BINDING,
+    POD_STATUS_PATCH,
+)
+from kubernetes_tpu.scheduler.framework.batch import BatchCache
+from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.utils.metrics import Registry
+from tests.wrappers import make_node, make_pod
+
+
+def new_scheduler(store, **kw):
+    s = Scheduler(store, **kw)
+    s.start()
+    return s
+
+
+class TestMetrics:
+    def test_scheduler_counts_and_exposition(self):
+        store = Store()
+        store.create(make_node("n1", cpu="4"))
+        store.create(make_pod("fits", cpu="1"))
+        store.create(make_pod("too-big", cpu="64"))
+        m = SchedulerMetrics()
+        s = new_scheduler(store, metrics=m)
+        s.schedule_pending()
+        assert m.schedule_attempts.get("scheduled", "default-scheduler") == 1
+        assert m.schedule_attempts.get("unschedulable", "default-scheduler") >= 1
+        assert m.unschedulable_reasons.get("NodeResourcesFit", "default-scheduler") >= 1
+        text = m.expose()
+        assert "scheduler_schedule_attempts_total" in text
+        assert 'result="scheduled"' in text
+        # plugin execution durations recorded via framework _timed
+        assert m.plugin_execution_duration.values
+
+    def test_histogram_percentile(self):
+        r = Registry()
+        h = r.histogram("h", buckets=(1, 2, 4, 8))
+        for v in (0.5, 1.5, 3, 7):
+            h.observe(v)
+        assert h.count() == 4
+        assert 0 < h.percentile(0.5) <= 4
+        assert h.average() == (0.5 + 1.5 + 3 + 7) / 4
+
+
+class TestBatchCache:
+    def test_hint_reuse_and_advance(self):
+        cache = BatchCache()
+        cache.store_schedule_results("sig", ["n1", "n2", "n3"])
+        full = {"n1"}
+        fn = lambda n: n not in full  # noqa: E731
+        assert cache.get_node_hint("sig", fn) == "n2"
+        full.add("n2")
+        assert cache.get_node_hint("sig", fn) == "n3"
+        full.add("n3")
+        assert cache.get_node_hint("sig", fn) is None  # exhausted, evicted
+        assert cache.get_node_hint("sig", fn) is None
+
+    def test_entry_expiry(self):
+        cache = BatchCache(max_age=0.01)
+        cache.store_schedule_results("sig", ["n1"])
+        time.sleep(0.02)
+        assert cache.get_node_hint("sig", lambda n: True) is None
+
+    def test_identical_pods_batch_e2e(self):
+        """A run of identical pods reuses the first pod's scoring pass —
+        visible through the batch hit counter."""
+        store = Store()
+        for i in range(4):
+            store.create(make_node(f"n{i}", cpu="8"))
+        m = SchedulerMetrics()
+        s = new_scheduler(store, metrics=m,
+                          feature_gates={"OpportunisticBatching": True})
+        for i in range(6):
+            store.create(make_pod(f"p{i}", cpu="1", labels={"app": "web"}))
+        assert s.schedule_pending() == 6
+        assert m.batch_attempts.get("hit") >= 4  # first pod scores, rest hint
+        for i in range(6):
+            assert store.get("Pod", f"default/p{i}").spec.node_name
+
+    def test_flush_on_node_event(self):
+        store = Store()
+        store.create(make_node("n1", cpu="8"))
+        store.create(make_node("n1b", cpu="8"))
+        s = new_scheduler(store, feature_gates={"OpportunisticBatching": True})
+        store.create(make_pod("p0", cpu="1"))
+        s.schedule_pending()
+        assert s.batch_cache.entries  # stored from full pass
+        store.create(make_node("n2", cpu="8"))
+        s.pump()
+        assert not s.batch_cache.entries  # flushed by node event
+
+
+class TestAPIDispatcher:
+    def test_merge_same_object(self):
+        d = APIDispatcher(parallelism=0)
+        calls = []
+        c1 = d.add(APICall(POD_STATUS_PATCH, "default/p", lambda: calls.append("patch1")))
+        c2 = d.add(APICall(POD_STATUS_PATCH, "default/p", lambda: calls.append("patch2")))
+        assert c1 is c2  # merged: latest wins
+        d.drain()
+        assert calls == ["patch2"]
+
+    def test_less_relevant_call_skipped(self):
+        import pytest
+
+        from kubernetes_tpu.scheduler.api_dispatcher import CallSkippedError
+
+        d = APIDispatcher(parallelism=0)
+        d.add(APICall(POD_BINDING, "default/p", lambda: None))
+        with pytest.raises(CallSkippedError):
+            d.add(APICall(POD_STATUS_PATCH, "default/p", lambda: None))
+
+    def test_async_binding_e2e(self):
+        store = Store()
+        store.create(make_node("n1", cpu="8"))
+        for i in range(5):
+            store.create(make_pod(f"p{i}", cpu="1"))
+        s = new_scheduler(store, async_api_calls=True)
+        assert s.schedule_pending() == 5
+        for i in range(5):
+            assert store.get("Pod", f"default/p{i}").spec.node_name == "n1"
+        s.api_dispatcher.close()
