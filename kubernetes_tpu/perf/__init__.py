@@ -1,0 +1,15 @@
+"""scheduler_perf-compatible performance harness (SURVEY.md §4 tier 4)."""
+
+from .harness import (
+    DataItem,
+    ThroughputCollector,
+    WorkloadExecutor,
+    WorkloadResult,
+    load_config,
+    run_workloads,
+)
+
+__all__ = [
+    "DataItem", "ThroughputCollector", "WorkloadExecutor", "WorkloadResult",
+    "load_config", "run_workloads",
+]

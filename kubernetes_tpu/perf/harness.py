@@ -1,0 +1,480 @@
+"""scheduler_perf-compatible workload harness.
+
+Reference: test/integration/scheduler_perf/scheduler_perf.go (opcodes :65-79,
+runner :690-738), executor.go (WorkloadExecutor:54, runOp:76), util.go
+(1 Hz throughput sampler :68,459-603, DataItem JSON :200-285). The YAML
+schema is the reference's: a list of test cases, each with a workloadTemplate
+(list of ops with $param substitution) and workloads ({name, labels,
+featureGates, params, threshold}).
+
+Differences: the control plane is in-process (our store stands in for
+apiserver+etcd exactly like the reference runs them in-process), and the
+throughput sampler derives its 1-second windows from per-pod bind timestamps
+instead of a polling goroutine — same windows, no sampling thread jitter.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import yaml
+
+from ..api.meta import ObjectMeta
+from ..api.types import GangPolicy, PodGroup, PodGroupSpec
+from ..scheduler import Profile, Scheduler
+from ..scheduler.metrics import SchedulerMetrics
+from ..store.store import MODIFIED, Store
+from .templates import node_from_manifest, pod_from_manifest
+
+DEFAULT_POD_TEMPLATE = {
+    "spec": {
+        "containers": [
+            {"name": "pause", "image": "registry.k8s.io/pause:3.10",
+             "resources": {"requests": {"cpu": "100m", "memory": "50Mi"}}}
+        ]
+    }
+}
+DEFAULT_NODE_TEMPLATE: dict = {}
+
+
+def _resolve(value, params: dict):
+    """$param substitution (scheduler_perf.go countParam semantics)."""
+    if isinstance(value, str) and value.startswith("$"):
+        return params[value[1:]]
+    return value
+
+
+@dataclass
+class DataItem:
+    """util.go DataItem — one measured series for perf-dash."""
+
+    data: dict[str, float]
+    unit: str
+    labels: dict[str, str] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"data": self.data, "unit": self.unit, "labels": self.labels}
+
+
+@dataclass
+class WorkloadResult:
+    name: str
+    data_items: list[DataItem]
+    threshold: float | None
+    passed: bool
+    scheduled: int
+    duration_s: float
+
+    @property
+    def throughput(self) -> float:
+        for item in self.data_items:
+            if item.unit == "pods/s":
+                return item.data.get("Average", 0.0)
+        return 0.0
+
+
+class ThroughputCollector:
+    """Windowed pods/s from bind timestamps (util.go collector semantics:
+    1-second windows over the measurement phase, then
+    Average/Perc50/90/95/99 over the window series)."""
+
+    def __init__(self, store: Store, namespace_filter: str | None = None):
+        self.store = store
+        self.bind_times: dict[str, float] = {}
+        self._watch = None
+
+    def start(self) -> None:
+        self._watch = self.store.watch("Pod")
+
+    def pump(self) -> None:
+        if self._watch is None:
+            return
+        for ev in self._watch.drain():
+            pod = ev.obj
+            if ev.type == MODIFIED and pod.spec.node_name:
+                # ev.ts is the store write time — the true bind instant, not
+                # the (batched) drain time
+                self.bind_times.setdefault(pod.meta.key, ev.ts)
+
+    def stop(self) -> DataItem:
+        self.pump()
+        if self._watch is not None:
+            self._watch.stop()
+        times = sorted(self.bind_times.values())
+        if len(times) < 2:
+            return DataItem({"Average": 0.0}, "pods/s")
+        start, end = times[0], times[-1]
+        total = len(times)
+        span = max(end - start, 1e-6)
+        # 1-second windows (partial last window scaled)
+        windows: list[float] = []
+        w_start = start
+        while w_start < end:
+            w_end = min(w_start + 1.0, end)
+            n = sum(1 for t in times if w_start <= t < w_end) if w_end > w_start else 0
+            if w_end - w_start > 1e-6:
+                windows.append(n / (w_end - w_start))
+            w_start = w_end
+        windows.sort()
+
+        def perc(q: float) -> float:
+            if not windows:
+                return 0.0
+            idx = min(int(q * len(windows)), len(windows) - 1)
+            return windows[idx]
+
+        return DataItem(
+            {
+                "Average": round(total / span, 2),
+                "Perc50": round(perc(0.50), 2),
+                "Perc90": round(perc(0.90), 2),
+                "Perc95": round(perc(0.95), 2),
+                "Perc99": round(perc(0.99), 2),
+            },
+            "pods/s",
+        )
+
+
+class WorkloadExecutor:
+    """executor.go WorkloadExecutor — interprets one workload's op list."""
+
+    def __init__(self, test_case: dict, workload: dict, backend: str = "host"):
+        self.test_case = test_case
+        self.workload = workload
+        self.params = dict(workload.get("params", {}))
+        self.feature_gates = dict(test_case.get("featureGates", {}))
+        self.feature_gates.update(workload.get("featureGates", {}))
+        self.backend = backend
+        self.store = Store()
+        self.metrics = SchedulerMetrics()
+        self.scheduler = Scheduler(
+            self.store,
+            profiles=[Profile(backend=backend)],
+            feature_gates=self.feature_gates,
+            metrics=self.metrics,
+            async_api_calls=self.feature_gates.get("SchedulerAsyncAPICalls", False),
+        )
+        self.scheduler.start()
+        self.collector = ThroughputCollector(self.store)
+        self._collecting = False
+        self._node_seq = 0
+        self._pod_seq = 0
+        self._measured = 0
+        self.data_items: list[DataItem] = []
+        base = test_case.get("_base_dir", ".")
+        self.pod_template = self._load_template(
+            test_case.get("defaultPodTemplatePath"), base, DEFAULT_POD_TEMPLATE
+        )
+        self.node_template = self._load_template(
+            test_case.get("defaultNodeTemplatePath"), base, DEFAULT_NODE_TEMPLATE
+        )
+
+    @staticmethod
+    def _load_template(path: str | None, base: str, default: dict) -> dict:
+        if not path:
+            return default
+        p = Path(base) / path
+        return yaml.safe_load(p.read_text())
+
+    # -- opcodes (scheduler_perf.go:65-79) -----------------------------------
+
+    def run(self) -> WorkloadResult:
+        t0 = time.perf_counter()
+        for op in self.test_case.get("workloadTemplate", []):
+            self._run_op(op)
+        self._barrier()
+        duration = time.perf_counter() - t0
+        if self._collecting:
+            self._stop_collecting()
+        threshold = self.workload.get("threshold")
+        result = WorkloadResult(
+            name=f"{self.test_case['name']}/{self.workload['name']}",
+            data_items=self.data_items,
+            threshold=threshold,
+            passed=True,
+            scheduled=sum(1 for p in self.store.pods() if p.spec.node_name),
+            duration_s=duration,
+        )
+        if threshold is not None and result.throughput < threshold:
+            result.passed = False
+        if self.scheduler.api_dispatcher is not None:
+            self.scheduler.api_dispatcher.close()
+        return result
+
+    def _run_op(self, op: dict) -> None:
+        opcode = op["opcode"]
+        fn = getattr(self, f"_op_{opcode}", None)
+        if fn is None:
+            raise ValueError(f"unknown opcode {opcode}")
+        fn(op)
+
+    def _count(self, op: dict) -> int:
+        if "countParam" in op:
+            return int(_resolve(op["countParam"], self.params))
+        return int(op.get("count", 0))
+
+    def _op_createNodes(self, op: dict) -> None:
+        template = op.get("nodeTemplate", self.node_template)
+        if isinstance(template, str):
+            template = self._load_template(
+                template, self.test_case.get("_base_dir", "."), DEFAULT_NODE_TEMPLATE
+            )
+        n = self._count(op)
+        zones = int(_resolve(op.get("zones", 8), self.params) or 8)
+        for _ in range(n):
+            i = self._node_seq
+            self._node_seq += 1
+            self.store.create(
+                node_from_manifest(template, f"node-{i}", zone=f"zone-{i % zones}")
+            )
+        self.scheduler.pump()
+
+    def _op_createPods(self, op: dict) -> None:
+        template = op.get("podTemplate", self.pod_template)
+        if isinstance(template, str):
+            template = self._load_template(
+                template, self.test_case.get("_base_dir", "."), DEFAULT_POD_TEMPLATE
+            )
+        n = self._count(op)
+        collect = bool(op.get("collectMetrics"))
+        if collect and not self._collecting:
+            self._start_collecting()
+        namespace = op.get("namespace", "default")
+        pvc_t = op.get("persistentVolumeClaimTemplate")
+        pv_t = op.get("persistentVolumeTemplate")
+        claims_spec = op.get("resourceClaimTemplate")  # DRA per-pod claims
+        for _ in range(n):
+            i = self._pod_seq
+            self._pod_seq += 1
+            pod = pod_from_manifest(template, f"pod-{i}", namespace)
+            if pvc_t is not None:
+                self._attach_volume(pod, i, pvc_t, pv_t, namespace)
+            if claims_spec is not None:
+                self._attach_claim(pod, i, claims_spec, namespace)
+            self.store.create(pod)
+        if collect:
+            self._measured += n
+        # steady-state scheduling after each creation op (the reference's
+        # scheduler runs continuously; barrier waits for completion)
+        self._barrier(wait_all=bool(op.get("skipWaitToCompletion")) is False)
+
+    def _attach_volume(self, pod, i: int, pvc_t: dict, pv_t: dict | None,
+                       namespace: str) -> None:
+        """Per-pod PVC (+ optional pre-provisioned PV), mirroring the
+        reference's persistentVolumeClaimTemplatePath support."""
+        from ..api.storage import (
+            PersistentVolume,
+            PersistentVolumeClaim,
+            PersistentVolumeClaimSpec,
+            PersistentVolumeSpec,
+            Volume,
+        )
+
+        claim_name = f"claim-{i}"
+        sc = pvc_t.get("storageClassName", "")
+        if sc and self.store.try_get("StorageClass", sc) is None:
+            from ..api.storage import (
+                BINDING_WAIT_FOR_FIRST_CONSUMER,
+                StorageClass,
+            )
+
+            self.store.create(StorageClass(
+                meta=ObjectMeta(name=sc, namespace=""),
+                provisioner=pvc_t.get("provisioner", "kubernetes.io/no-provisioner"),
+                volume_binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER,
+            ))
+        if pv_t is not None:
+            self.store.create(PersistentVolume(
+                meta=ObjectMeta(name=f"pv-{i}", namespace=""),
+                spec=PersistentVolumeSpec(
+                    capacity=dict(pv_t.get("capacity", {"storage": "10Gi"})),
+                    access_modes=tuple(pv_t.get("accessModes", ("ReadWriteOnce",))),
+                    storage_class_name=sc,
+                    csi_driver=pv_t.get("csiDriver", ""),
+                ),
+            ))
+        self.store.create(PersistentVolumeClaim(
+            meta=ObjectMeta(name=claim_name, namespace=namespace),
+            spec=PersistentVolumeClaimSpec(
+                access_modes=tuple(pvc_t.get("accessModes", ("ReadWriteOnce",))),
+                storage_class_name=sc,
+                request=dict(pvc_t.get("request", {"storage": "5Gi"})),
+            ),
+        ))
+        pod.spec.volumes = tuple(pod.spec.volumes) + (
+            Volume(name="data", persistent_volume_claim=claim_name),
+        )
+
+    def _attach_claim(self, pod, i: int, claims_spec: dict, namespace: str) -> None:
+        """Per-pod ResourceClaim (reference: claim templates generated by the
+        resourceclaim controller; the harness creates them directly)."""
+        from ..api.dra import (
+            DeviceRequest,
+            PodResourceClaim,
+            ResourceClaim,
+            ResourceClaimSpec,
+        )
+
+        name = f"rclaim-{i}"
+        self.store.create(ResourceClaim(
+            meta=ObjectMeta(name=name, namespace=namespace),
+            spec=ResourceClaimSpec(requests=(
+                DeviceRequest(
+                    name="req",
+                    device_class_name=claims_spec.get("deviceClassName", ""),
+                    count=int(claims_spec.get("count", 1)),
+                ),
+            )),
+        ))
+        pod.spec.resource_claims = (
+            PodResourceClaim(name=name, resource_claim_name=name),
+        )
+
+    def _op_createResourceSlices(self, op: dict) -> None:
+        """DRA inventory: one slice per existing node (scheduler_perf
+        createResourceDriver analogue)."""
+        from ..api.dra import Device, ResourceSlice
+
+        per_node = int(_resolve(op.get("devicesPerNode", 4), self.params))
+        driver = op.get("driver", "perf.example.com")
+        for node in self.store.nodes():
+            self.store.create(ResourceSlice(
+                meta=ObjectMeta(name=f"slice-{node.meta.name}", namespace=""),
+                node_name=node.meta.name,
+                driver=driver,
+                devices=tuple(
+                    Device(name=f"dev-{j}", attributes={"index": str(j)})
+                    for j in range(per_node)
+                ),
+            ))
+        self.scheduler.pump()
+
+    def _op_createPodGroups(self, op: dict) -> None:
+        """Gang workloads: one PodGroup + minCount member pods per group."""
+        n = self._count(op)
+        size = int(_resolve(op.get("podsPerGroup", 2), self.params))
+        template = op.get("podTemplate", self.pod_template)
+        for g in range(n):
+            name = f"group-{g}-{self._pod_seq}"
+            self.store.create(
+                PodGroup(
+                    meta=ObjectMeta(name=name),
+                    spec=PodGroupSpec(policy=GangPolicy(min_count=size)),
+                )
+            )
+            for _ in range(size):
+                i = self._pod_seq
+                self._pod_seq += 1
+                pod = pod_from_manifest(template, f"pod-{i}")
+                from ..api.types import SchedulingGroup
+
+                pod.spec.scheduling_group = SchedulingGroup(pod_group_name=name)
+                self.store.create(pod)
+        self._barrier()
+
+    def _op_churn(self, op: dict) -> None:
+        """churn op: delete + recreate pods to stress event handling."""
+        n = self._count(op) or 10
+        pods = [p for p in self.store.pods() if p.spec.node_name][:n]
+        for p in pods:
+            self.store.delete("Pod", p.meta.key)
+        self.scheduler.pump()
+        template = op.get("podTemplate", self.pod_template)
+        for _ in range(len(pods)):
+            i = self._pod_seq
+            self._pod_seq += 1
+            self.store.create(pod_from_manifest(template, f"churn-pod-{i}"))
+        self._barrier()
+
+    def _op_barrier(self, op: dict) -> None:
+        self._barrier()
+
+    def _op_sleep(self, op: dict) -> None:
+        time.sleep(float(op.get("duration", 0.01)))
+
+    def _op_startCollectingMetrics(self, op: dict) -> None:
+        self._start_collecting()
+
+    def _op_stopCollectingMetrics(self, op: dict) -> None:
+        self._stop_collecting()
+
+    # -- helpers -------------------------------------------------------------
+
+    def _barrier(self, wait_all: bool = True) -> None:
+        """operations.go barrier:498-537 — wait until every pending pod got a
+        scheduling attempt and bindings landed."""
+        self.scheduler.schedule_pending()
+        self.collector.pump()
+
+    def _start_collecting(self) -> None:
+        self._collecting = True
+        self.collector.start()
+
+    def _stop_collecting(self) -> None:
+        self._collecting = False
+        self.data_items.append(self.collector.stop())
+
+
+def load_config(path: str | Path) -> list[dict]:
+    path = Path(path)
+    cases = yaml.safe_load(path.read_text())
+    for case in cases:
+        case["_base_dir"] = str(path.parent)
+    return cases
+
+
+def run_workloads(
+    config_path: str | Path,
+    labels: set[str] | None = None,
+    backend: str = "host",
+    name_filter: str | None = None,
+) -> list[WorkloadResult]:
+    """Run every workload matching the label selector (CI behavior: pick by
+    labels like integration-test/short/performance)."""
+    results = []
+    for case in load_config(config_path):
+        for workload in case.get("workloads", []):
+            wl_labels = set(workload.get("labels", []))
+            if labels is not None and not (labels & wl_labels):
+                continue
+            full = f"{case['name']}/{workload['name']}"
+            if name_filter and name_filter not in full:
+                continue
+            executor = WorkloadExecutor(case, workload, backend=backend)
+            results.append(executor.run())
+    return results
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="scheduler_perf harness")
+    parser.add_argument("configs", nargs="+", help="performance-config YAMLs")
+    parser.add_argument("--labels", default="integration-test",
+                        help="comma-separated label selector")
+    parser.add_argument("--backend", default="host", choices=["host", "tpu"])
+    parser.add_argument("--filter", default=None, help="substring name filter")
+    args = parser.parse_args(argv)
+    labels = set(args.labels.split(",")) if args.labels else None
+    all_ok = True
+    for config in args.configs:
+        for result in run_workloads(config, labels, args.backend, args.filter):
+            status = "ok" if result.passed else "BELOW THRESHOLD"
+            print(json.dumps({
+                "workload": result.name,
+                "throughput": result.throughput,
+                "scheduled": result.scheduled,
+                "duration_s": round(result.duration_s, 2),
+                "threshold": result.threshold,
+                "status": status,
+                "dataItems": [d.as_dict() for d in result.data_items],
+            }))
+            all_ok = all_ok and result.passed
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,186 @@
+"""Manifest-to-object converters for the perf harness.
+
+Reference: test/integration/scheduler_perf uses real k8s YAML manifests as
+pod/node templates (templates/pod-default.yaml etc.). This parses the
+scheduling-relevant subset of that manifest shape into our API objects.
+"""
+
+from __future__ import annotations
+
+from ..api.labels import LabelSelector
+from ..api.meta import ObjectMeta
+from ..api.types import (
+    Affinity,
+    Container,
+    ContainerPort,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+
+
+def _selector_terms(terms: list) -> tuple[NodeSelectorTerm, ...]:
+    out = []
+    for t in terms or []:
+        out.append(
+            NodeSelectorTerm(
+                match_expressions=tuple(
+                    NodeSelectorRequirement(
+                        e["key"], e.get("operator", "In"), tuple(e.get("values", ()))
+                    )
+                    for e in t.get("matchExpressions", [])
+                ),
+                match_fields=tuple(
+                    NodeSelectorRequirement(
+                        e["key"], e.get("operator", "In"), tuple(e.get("values", ()))
+                    )
+                    for e in t.get("matchFields", [])
+                ),
+            )
+        )
+    return tuple(out)
+
+
+def _label_selector(sel: dict | None) -> LabelSelector | None:
+    if not sel:
+        return None
+    return LabelSelector.of(dict(sel.get("matchLabels", {})))
+
+
+def _pod_affinity_terms(terms: list) -> tuple[PodAffinityTerm, ...]:
+    return tuple(
+        PodAffinityTerm(
+            label_selector=_label_selector(t.get("labelSelector")),
+            topology_key=t.get("topologyKey", ""),
+            namespaces=tuple(t.get("namespaces", ())),
+        )
+        for t in terms or []
+    )
+
+
+def pod_from_manifest(manifest: dict, name: str, namespace: str = "default") -> Pod:
+    """Build a Pod from a (subset) k8s manifest dict; `name` overrides
+    metadata.name (the harness generates unique names per instance)."""
+    meta_m = manifest.get("metadata", {})
+    spec_m = manifest.get("spec", {})
+    containers = []
+    for c in spec_m.get("containers", [{}]):
+        req = dict(c.get("resources", {}).get("requests", {}))
+        ports = tuple(
+            ContainerPort(
+                container_port=p.get("containerPort", p.get("hostPort", 0)),
+                host_port=p.get("hostPort", 0),
+                protocol=p.get("protocol", "TCP"),
+            )
+            for p in c.get("ports", [])
+        )
+        containers.append(
+            Container(name=c.get("name", "c"), image=c.get("image", ""),
+                      requests=req, ports=ports)
+        )
+    affinity = None
+    aff_m = spec_m.get("affinity", {})
+    if aff_m:
+        node_aff = None
+        na = aff_m.get("nodeAffinity", {})
+        if na:
+            req = na.get("requiredDuringSchedulingIgnoredDuringExecution")
+            required = (
+                NodeSelector(terms=_selector_terms(req.get("nodeSelectorTerms")))
+                if req
+                else None
+            )
+            preferred = tuple(
+                PreferredSchedulingTerm(
+                    weight=p.get("weight", 1),
+                    preference=_selector_terms([p.get("preference", {})])[0],
+                )
+                for p in na.get("preferredDuringSchedulingIgnoredDuringExecution", [])
+            )
+            node_aff = NodeAffinity(required=required, preferred=preferred)
+        pod_aff = None
+        pa = aff_m.get("podAffinity", {})
+        if pa:
+            pod_aff = PodAffinity(
+                required=_pod_affinity_terms(
+                    pa.get("requiredDuringSchedulingIgnoredDuringExecution")
+                )
+            )
+        anti = None
+        paa = aff_m.get("podAntiAffinity", {})
+        if paa:
+            anti = PodAntiAffinity(
+                required=_pod_affinity_terms(
+                    paa.get("requiredDuringSchedulingIgnoredDuringExecution")
+                )
+            )
+        affinity = Affinity(
+            node_affinity=node_aff, pod_affinity=pod_aff, pod_anti_affinity=anti
+        )
+    spread = tuple(
+        TopologySpreadConstraint(
+            max_skew=t.get("maxSkew", 1),
+            topology_key=t["topologyKey"],
+            when_unsatisfiable=t.get("whenUnsatisfiable", "DoNotSchedule"),
+            label_selector=_label_selector(t.get("labelSelector")),
+            min_domains=t.get("minDomains"),
+        )
+        for t in spec_m.get("topologySpreadConstraints", [])
+    )
+    tolerations = tuple(
+        Toleration(
+            key=t.get("key", ""), operator=t.get("operator", "Equal"),
+            value=t.get("value", ""), effect=t.get("effect", ""),
+        )
+        for t in spec_m.get("tolerations", [])
+    )
+    return Pod(
+        meta=ObjectMeta(
+            name=name, namespace=namespace, labels=dict(meta_m.get("labels", {}))
+        ),
+        spec=PodSpec(
+            containers=containers,
+            node_selector=dict(spec_m.get("nodeSelector", {})),
+            affinity=affinity,
+            tolerations=tolerations,
+            topology_spread_constraints=spread,
+            priority=spec_m.get("priority", 0),
+            priority_class_name=spec_m.get("priorityClassName", ""),
+        ),
+    )
+
+
+def node_from_manifest(manifest: dict, name: str, zone: str | None = None) -> Node:
+    meta_m = manifest.get("metadata", {})
+    status_m = manifest.get("status", {})
+    spec_m = manifest.get("spec", {})
+    labels = dict(meta_m.get("labels", {}))
+    labels.setdefault("kubernetes.io/hostname", name)
+    if zone is not None:
+        labels["topology.kubernetes.io/zone"] = zone
+    alloc = dict(
+        status_m.get("allocatable")
+        or {"cpu": "32", "memory": "64Gi", "pods": 110, "ephemeral-storage": "100Gi"}
+    )
+    taints = tuple(
+        Taint(key=t["key"], value=t.get("value", ""), effect=t.get("effect", "NoSchedule"))
+        for t in spec_m.get("taints", [])
+    )
+    return Node(
+        meta=ObjectMeta(name=name, namespace="", labels=labels),
+        spec=NodeSpec(unschedulable=spec_m.get("unschedulable", False), taints=taints),
+        status=NodeStatus(capacity=dict(alloc), allocatable=alloc),
+    )

@@ -78,10 +78,25 @@ class APIDispatcher:
                         f"{call.call_type} for {call.object_key} skipped: "
                         f"{pending.call_type} already queued"
                     )
-                # replace the queued call's work in place (merge = latest wins)
-                pending.call_type = call.call_type
-                pending.execute = call.execute
-                pending.on_finish = call.on_finish
+                if call.call_type == pending.call_type:
+                    # same type: COMPOSE — two status patches touch
+                    # independent fields; dropping one loses an update
+                    old_exec, new_exec = pending.execute, call.execute
+
+                    def composed(old_exec=old_exec, new_exec=new_exec):
+                        old_exec()
+                        new_exec()
+
+                    pending.execute = composed
+                else:
+                    # higher relevance replaces (binding supersedes patches)
+                    pending.call_type = call.call_type
+                    pending.execute = call.execute
+                old_finish, new_finish = pending.on_finish, call.on_finish
+                if old_finish is not None and new_finish is not None:
+                    pending.on_finish = lambda err: (old_finish(err), new_finish(err))
+                else:
+                    pending.on_finish = new_finish or old_finish
                 return pending
             self._queued[call.object_key] = call
             self._order.put(call.object_key)

@@ -126,6 +126,8 @@ class SchedulerMetrics:
         )
         self._first_attempt: dict[str, float] = {}
         self._attempt_counts: dict[str, int] = {}
+        # plugin -> currently-unschedulable pod keys (true gauge semantics)
+        self._unsched_by_plugin: dict[str, set[str]] = {}
 
     # -- call sites used by the framework/loop -------------------------------
 
@@ -153,16 +155,34 @@ class SchedulerMetrics:
             self.pod_scheduling_sli_duration.observe(
                 time.time() - start, str(min(attempts, 16))
             )
+        self._clear_unschedulable(key)
 
     def pod_unschedulable(self, qpi) -> None:
         self.attempt_started(qpi)
         self.schedule_attempts.inc(UNSCHEDULABLE, self.profile)
+        key = qpi.pod.meta.key
         for plugin in qpi.unschedulable_plugins:
-            self.unschedulable_reasons.inc(plugin, self.profile)
+            pods = self._unsched_by_plugin.setdefault(plugin, set())
+            if key not in pods:
+                pods.add(key)
+                self.unschedulable_reasons.set(len(pods), plugin, self.profile)
 
     def pod_error(self, qpi) -> None:
         self.attempt_started(qpi)
         self.schedule_attempts.inc(ERROR, self.profile)
+
+    def _clear_unschedulable(self, key: str) -> None:
+        for plugin, pods in self._unsched_by_plugin.items():
+            if key in pods:
+                pods.discard(key)
+                self.unschedulable_reasons.set(len(pods), plugin, self.profile)
+
+    def forget_pod(self, key: str) -> None:
+        """Pod left the system (deleted) — drop all per-pod tracking so
+        churn of permanently-unschedulable pods doesn't leak state."""
+        self._first_attempt.pop(key, None)
+        self._attempt_counts.pop(key, None)
+        self._clear_unschedulable(key)
 
     def update_queue_gauges(self, active: int, backoff: int, unschedulable: int,
                             gated: int = 0) -> None:

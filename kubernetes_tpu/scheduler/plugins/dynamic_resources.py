@@ -38,9 +38,14 @@ ERR_TOO_MANY_CONSUMERS = "resourceclaim has reached its maximum consumer count"
 
 @dataclass
 class _ClaimState:
-    """Per-cycle DRA state (dynamicresources.go stateData)."""
+    """Per-cycle DRA state (dynamicresources.go stateData). The taken-device
+    set and slice list are computed ONCE at PreFilter (the reference builds
+    its allocator there too) — Filter only copies the small taken set."""
 
     claims: list[ResourceClaim] = field(default_factory=list)
+    base_taken: set = field(default_factory=set)  # (driver, pool, device)
+    slices: list = field(default_factory=list)
+    needs_allocation: bool = False
     # node name -> {claim key -> AllocationResult} computed by Filter
     allocations_per_node: dict[str, dict[str, AllocationResult]] = field(
         default_factory=dict
@@ -49,7 +54,8 @@ class _ClaimState:
     reserved_node: str = ""
 
     def clone(self) -> "_ClaimState":
-        c = _ClaimState(list(self.claims))
+        c = _ClaimState(list(self.claims), set(self.base_taken),
+                        list(self.slices), self.needs_allocation)
         c.allocations_per_node = {
             n: dict(m) for n, m in self.allocations_per_node.items()
         }
@@ -109,23 +115,33 @@ class Allocator:
                 selectors.extend(dc.selectors)
         return driver, selectors
 
-    def node_inventory(self, node_name: str):
-        """(driver, pool, device) inventory visible to one node."""
+    @staticmethod
+    def node_inventory(slices: list, node_name: str):
+        """(driver, pool, device) inventory visible to one node, from a
+        pre-listed slice set.
+
+        Device identity is (driver, pool, device); node-local slices get a
+        node-scoped pool so equally-named devices on different nodes stay
+        distinct (resource/v1 semantics: a pool belongs to one driver and
+        names are unique within it — drivers publish per-node pools)."""
         out = []
-        slices, _ = self.store.list("ResourceSlice")
         for sl in slices:
             if sl.all_nodes or sl.node_name == node_name:
+                pool = sl.pool if sl.all_nodes else f"{sl.node_name}/{sl.pool}"
                 for dev in sl.devices:
-                    out.append((sl.driver, sl.pool, dev))
+                    out.append((sl.driver, pool, dev))
         return out
 
     def allocate(
         self, claim: ResourceClaim, node_name: str,
         taken: set[tuple[str, str, str]],
+        slices: list | None = None,
     ) -> AllocationResult | None:
         """Greedy per-request allocation; mutates `taken` on success so one
         Filter pass can allocate several claims without double-booking."""
-        inventory = self.node_inventory(node_name)
+        if slices is None:
+            slices, _ = self.store.list("ResourceSlice")
+        inventory = self.node_inventory(slices, node_name)
         picked: list[DeviceAllocationResult] = []
         newly: list[tuple[str, str, str]] = []
         for request in claim.spec.requests:
@@ -195,6 +211,14 @@ class DynamicResources(Plugin):
             if claim is None:
                 return None, Status.unresolvable(ERR_CLAIM_NOT_FOUND, plugin=self.name)
             s.claims.append(claim)
+        # allocator setup happens once per cycle (dynamicresources.go
+        # PreFilter:408) — Filter must not re-list the store per node
+        s.needs_allocation = any(
+            self.manager.effective_allocation(c) is None for c in s.claims
+        )
+        if s.needs_allocation:
+            s.base_taken = self.manager.allocated_device_ids()
+            s.slices, _ = self.store.list("ResourceSlice")
         state.write(self.STATE_KEY, s)
         return None, None
 
@@ -203,7 +227,7 @@ class DynamicResources(Plugin):
         if s is None:
             return Status()
         node_name = node_info.name
-        taken = None  # lazy: only hit the store when an allocation is needed
+        taken = None  # per-node copy of the PreFilter-computed base set
         node_allocs: dict[str, AllocationResult] = {}
         for claim in s.claims:
             alloc = self.manager.effective_allocation(claim)
@@ -222,8 +246,8 @@ class DynamicResources(Plugin):
                     )
                 continue
             if taken is None:
-                taken = self.manager.allocated_device_ids()
-            alloc = self.allocator.allocate(claim, node_name, taken)
+                taken = set(s.base_taken)
+            alloc = self.allocator.allocate(claim, node_name, taken, s.slices)
             if alloc is None:
                 return Status.unschedulable(ERR_CANNOT_ALLOCATE, plugin=self.name)
             node_allocs[claim.meta.key] = alloc

@@ -75,11 +75,17 @@ class SchedulingAlgorithm:
     # -- filtering -----------------------------------------------------------
 
     def find_nodes_that_fit_pod(
-        self, state: CycleState, pod: Pod, snapshot, nominated_node: str = ""
+        self, state: CycleState, pod: Pod, snapshot, nominated_node: str = "",
+        pre_filter_done: tuple | None = None,
     ) -> tuple[list[NodeInfo], Diagnosis]:
         all_nodes = snapshot.list_nodes()
         diagnosis = Diagnosis()
-        result, status = self.fw.run_pre_filter_plugins(state, pod, all_nodes)
+        if pre_filter_done is not None:
+            # PreFilter already ran this cycle (batch hint path) — rerunning
+            # it would double the hot-path setup work
+            result, status = pre_filter_done
+        else:
+            result, status = self.fw.run_pre_filter_plugins(state, pod, all_nodes)
         if not status.is_success:
             if status.is_rejected:
                 diagnosis.pre_filter_msg = status.message()
@@ -207,12 +213,15 @@ class SchedulingAlgorithm:
         # identical pod signed earlier this batch window reuses its sorted
         # score list — only the hinted node is re-Filtered
         signature = None
+        pre_filter_done = None
         if self.batch is not None and not pod.status.nominated_node_name:
             signature = self.fw.sign_pod(pod)
             # only pay the hint-path PreFilter when a fresh entry exists —
             # otherwise the full path below runs PreFilter exactly once
             if signature is not None and self.batch.has_fresh(signature):
-                hinted = self._try_node_hint(state, pod, snapshot, signature)
+                hinted, pre_filter_done = self._try_node_hint(
+                    state, pod, snapshot, signature
+                )
                 if hinted is not None:
                     return ScheduleResult(
                         suggested_host=hinted, evaluated_nodes=1, feasible_nodes=1
@@ -222,7 +231,8 @@ class SchedulingAlgorithm:
         # (schedule_one.go:718 evaluateNominatedNode)
         nominated = pod.status.nominated_node_name
         feasible, diagnosis = self.find_nodes_that_fit_pod(
-            state, pod, snapshot, nominated_node=nominated
+            state, pod, snapshot, nominated_node=nominated,
+            pre_filter_done=pre_filter_done,
         )
         if not feasible:
             raise FitError(pod, snapshot.num_nodes(), diagnosis)
@@ -244,14 +254,15 @@ class SchedulingAlgorithm:
             feasible_nodes=len(feasible),
         )
 
-    def _try_node_hint(self, state, pod, snapshot, signature: str) -> str | None:
+    def _try_node_hint(self, state, pod, snapshot, signature: str):
         """Run PreFilter (CycleState must be populated for the Filter
         re-check and the later Reserve/PreBind), then consult the batch
-        cache."""
+        cache. Returns (hinted_node | None, pre_filter_done) so a miss hands
+        its PreFilter work to the full path instead of rerunning it."""
         all_nodes = snapshot.list_nodes()
-        _, status = self.fw.run_pre_filter_plugins(state, pod, all_nodes)
+        result, status = self.fw.run_pre_filter_plugins(state, pod, all_nodes)
         if not status.is_success:
-            return None
+            return None, (result, status)
 
         def filter_fn(node_name: str) -> bool:
             ni = snapshot.get(node_name)
@@ -259,7 +270,7 @@ class SchedulingAlgorithm:
                 return False
             return self._filter_one(state, pod, ni, Diagnosis())
 
-        return self.batch.get_node_hint(signature, filter_fn)
+        return self.batch.get_node_hint(signature, filter_fn), (result, status)
 
 
 class ScheduleOneLoop:
@@ -464,6 +475,11 @@ class ScheduleOneLoop:
         algo = self.algorithms.get(fw.profile_name)
         for ext in getattr(algo, "extenders", []) or []:
             if ext.is_binder() and ext.is_interested(pod):
+                # the webhook owns the binding API write (extender.go Bind:362
+                # delegates to the extender process). Until the external
+                # writer's update lands in the store, the pod stays assumed in
+                # cache; if the webhook never writes, the assume expires and
+                # the pod is retried — same crash-consistency as the reference
                 return ext.bind(pod, host)
         if self.api_cacher is not None:
             from .api_dispatcher import CallSkippedError

@@ -229,6 +229,8 @@ class Scheduler:
         elif etype == DELETED:
             if gk:
                 self.cache.pod_group_states.pod_removed(gk, new.meta.key)
+            if self.metrics is not None and hasattr(self.metrics, "forget_pod"):
+                self.metrics.forget_pod(new.meta.key)
             if new.is_scheduled:
                 self.cache.remove_pod(new)
                 self.queue.move_all_to_active_or_backoff(

@@ -47,6 +47,9 @@ class Event:
     type: str  # ADDED | MODIFIED | DELETED
     obj: Any
     revision: int
+    # wall-clock emit time (time.perf_counter); consumers like the perf
+    # harness's throughput collector need true write times, not drain times
+    ts: float = 0.0
 
 
 class Watch:
@@ -147,7 +150,7 @@ class Store:
             rev = self._bump()
             obj.meta.resource_version = rev
             objs[key] = obj
-            self._emit(kind, Event(ADDED, copy.deepcopy(obj), rev))
+            self._emit(kind, Event(ADDED, copy.deepcopy(obj), rev, time.perf_counter()))
             return copy.deepcopy(obj)
 
     def get(self, kind: str, key: str) -> Any:
@@ -181,7 +184,7 @@ class Store:
             rev = self._bump()
             obj.meta.resource_version = rev
             objs[key] = obj
-            self._emit(kind, Event(MODIFIED, copy.deepcopy(obj), rev))
+            self._emit(kind, Event(MODIFIED, copy.deepcopy(obj), rev, time.perf_counter()))
             return copy.deepcopy(obj)
 
     def delete(self, kind: str, key: str) -> Any:
@@ -192,7 +195,7 @@ class Store:
                 raise NotFoundError(f"{kind} {key}")
             rev = self._bump()
             cur.meta.resource_version = rev
-            self._emit(kind, Event(DELETED, copy.deepcopy(cur), rev))
+            self._emit(kind, Event(DELETED, copy.deepcopy(cur), rev, time.perf_counter()))
             return cur
 
     def list(self, kind: str) -> tuple[list[Any], int]:

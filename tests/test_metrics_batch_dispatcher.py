@@ -108,9 +108,10 @@ class TestAPIDispatcher:
         calls = []
         c1 = d.add(APICall(POD_STATUS_PATCH, "default/p", lambda: calls.append("patch1")))
         c2 = d.add(APICall(POD_STATUS_PATCH, "default/p", lambda: calls.append("patch2")))
-        assert c1 is c2  # merged: latest wins
+        assert c1 is c2  # merged into one queued call
         d.drain()
-        assert calls == ["patch2"]
+        # same-type merge COMPOSES: both independent mutations must land
+        assert calls == ["patch1", "patch2"]
 
     def test_less_relevant_call_skipped(self):
         import pytest
