@@ -1,0 +1,161 @@
+"""Workload + networking API types: ReplicaSet, Deployment, Job, Service,
+EndpointSlice, Namespace.
+
+Reference: staging/src/k8s.io/api/apps/v1/types.go (Deployment, ReplicaSet),
+batch/v1/types.go (Job), core/v1 (Service, Namespace),
+discovery/v1/types.go (EndpointSlice). Scheduling/controller-relevant subset.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .labels import LabelSelector
+from .meta import ObjectMeta
+from .types import PodSpec
+
+
+@dataclass
+class PodTemplateSpec:
+    labels: dict[str, str] = field(default_factory=dict)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+# --- apps/v1 ----------------------------------------------------------------
+
+
+@dataclass
+class ReplicaSetSpec:
+    replicas: int = 1
+    selector: LabelSelector | None = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class ReplicaSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicaSet:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicaSetSpec = field(default_factory=ReplicaSetSpec)
+    status: ReplicaSetStatus = field(default_factory=ReplicaSetStatus)
+
+    kind = "ReplicaSet"
+
+
+@dataclass
+class DeploymentStrategy:
+    type: str = "RollingUpdate"  # RollingUpdate | Recreate
+    max_surge: int = 1
+    max_unavailable: int = 0
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    selector: LabelSelector | None = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    strategy: DeploymentStrategy = field(default_factory=DeploymentStrategy)
+
+
+@dataclass
+class DeploymentStatus:
+    replicas: int = 0
+    updated_replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class Deployment:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+    kind = "Deployment"
+
+
+# --- batch/v1 ---------------------------------------------------------------
+
+
+@dataclass
+class JobSpec:
+    completions: int = 1
+    parallelism: int = 1
+    backoff_limit: int = 6
+    selector: LabelSelector | None = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    completed: bool = False
+
+
+@dataclass
+class Job:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    kind = "Job"
+
+
+# --- core/v1 Service + discovery/v1 EndpointSlice ---------------------------
+
+
+@dataclass(frozen=True)
+class ServicePort:
+    port: int
+    target_port: int = 0
+    protocol: str = "TCP"
+    name: str = ""
+
+
+@dataclass
+class ServiceSpec:
+    selector: dict[str, str] = field(default_factory=dict)
+    ports: tuple[ServicePort, ...] = ()
+    cluster_ip: str = ""
+    type: str = "ClusterIP"
+
+
+@dataclass
+class Service:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+    kind = "Service"
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    addresses: tuple[str, ...]
+    node_name: str = ""
+    ready: bool = True
+    target_pod: str = ""  # pod key
+
+
+@dataclass
+class EndpointSlice:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    service_name: str = ""
+    endpoints: tuple[Endpoint, ...] = ()
+    ports: tuple[ServicePort, ...] = ()
+
+    kind = "EndpointSlice"
+
+
+@dataclass
+class Namespace:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    phase: str = "Active"
+
+    kind = "Namespace"

@@ -1,0 +1,5 @@
+"""API server layer (cmd/kube-apiserver + staging apiserver equivalent)."""
+
+from .server import AdmissionError, APIServer
+
+__all__ = ["APIServer", "AdmissionError"]

@@ -1,0 +1,254 @@
+"""API server: REST + watch streaming over the versioned store.
+
+Reference: cmd/kube-apiserver + staging/src/k8s.io/apiserver — the server
+chain (CreateServerChain, cmd/kube-apiserver/app/server.go:176) collapses to
+one handler here because aggregation/apiextensions don't apply; what is
+preserved is the resource REST contract every component programs against:
+
+  GET    /api/v1/{kind}                          list (+ ?watch=1&resourceVersion=N)
+  GET    /api/v1/{kind}/{key...}                 get
+  POST   /api/v1/{kind}                          create
+  PUT    /api/v1/{kind}/{key...}                 update (resourceVersion CAS -> 409)
+  DELETE /api/v1/{kind}/{key...}                 delete
+  POST   /api/v1/{kind}/{key...}/binding         pod binding subresource
+
+Watch responses stream JSON lines ({"type": ADDED|MODIFIED|DELETED,
+"object": ...}) exactly like the reference's watch event frames. The etcd3
+storage.Interface role is played by store.Store; the watch cache is the
+store's per-kind event fan-out.
+
+An admission-plugin chain runs on create/update (mutating + validating),
+mirroring the generic server's handler chain (authn/authz are pluggable
+no-ops by default — in-tree clients are trusted the way localhost:8080
+insecure serving was).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+from urllib.parse import parse_qs, urlparse
+
+from ..api.serialization import decode, encode, kind_class
+from ..store.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Store,
+)
+
+# admission: fn(operation, obj) -> None | raises AdmissionError
+AdmissionFn = Callable[[str, object], None]
+
+
+class AdmissionError(Exception):
+    def __init__(self, message: str, code: int = 422):
+        super().__init__(message)
+        self.code = code
+
+
+class APIServer:
+    def __init__(self, store: Store, admission: list[AdmissionFn] | None = None):
+        self.store = store
+        self.admission = list(admission or [])
+        self._http: ThreadingHTTPServer | None = None
+        self.port = 0
+
+    # -- request handling ----------------------------------------------------
+
+    def _build_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send_json(self, code: int, payload) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _error(self, code: int, reason: str, message: str) -> None:
+                # metav1.Status error shape
+                self._send_json(code, {
+                    "kind": "Status", "status": "Failure",
+                    "reason": reason, "message": message, "code": code,
+                })
+
+            def _route(self):
+                parsed = urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                # /api/v1/{kind}[/{ns or name}[/{name}[/{subresource}]]]
+                if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
+                    return None
+                kind = parts[2]
+                rest = parts[3:]
+                sub = ""
+                if rest and rest[-1] in ("binding", "status"):
+                    sub = rest[-1]
+                    rest = rest[:-1]
+                key = "/".join(rest)
+                return kind, key, sub, query
+
+            def _read_body(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b"{}"
+                return json.loads(raw or b"{}")
+
+            def do_GET(self):
+                if self.path == "/healthz" or self.path == "/readyz":
+                    self._send_json(200, {"status": "ok"})
+                    return
+                if self.path == "/version":
+                    self._send_json(200, {"gitVersion": "v1.36.0-tpu",
+                                          "platform": "tpu"})
+                    return
+                route = self._route()
+                if route is None:
+                    self._error(404, "NotFound", "unknown path")
+                    return
+                kind, key, _, query = route
+                try:
+                    if key:
+                        obj = server.store.get(kind, key)
+                        self._send_json(200, encode(obj))
+                    elif query.get("watch"):
+                        self._serve_watch(kind, int(query.get("resourceVersion", 0)))
+                    else:
+                        items, rev = server.store.list(kind)
+                        self._send_json(200, {
+                            "kind": f"{kind}List",
+                            "metadata": {"resourceVersion": rev},
+                            "items": [encode(o) for o in items],
+                        })
+                except NotFoundError as e:
+                    self._error(404, "NotFound", str(e))
+
+            def _serve_watch(self, kind: str, from_revision: int) -> None:
+                watch = server.store.watch(kind, from_revision=from_revision)
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def write_chunk(data: bytes) -> None:
+                        self.wfile.write(f"{len(data):X}\r\n".encode())
+                        self.wfile.write(data + b"\r\n")
+                        self.wfile.flush()
+
+                    while not watch.stopped:
+                        ev = watch.next(timeout=0.5)
+                        if ev is None:
+                            # heartbeat chunk: a dead client surfaces as a
+                            # broken pipe here instead of leaking the handler
+                            # thread + store watch forever on quiet kinds
+                            write_chunk(b"\n")
+                            continue
+                        frame = json.dumps(
+                            {"type": ev.type, "object": encode(ev.obj),
+                             "revision": ev.revision}
+                        ).encode()
+                        write_chunk(frame + b"\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    watch.stop()
+
+            def do_POST(self):
+                route = self._route()
+                if route is None:
+                    self._error(404, "NotFound", "unknown path")
+                    return
+                kind, key, sub, _ = route
+                body = self._read_body()
+                try:
+                    if sub == "binding":
+                        # pods/binding subresource (registry/core/pod BindingREST)
+                        pod = server.store.get(kind, key)
+                        pod.spec.node_name = body.get("target_node") or body.get(
+                            "target", {}
+                        ).get("name", "")
+                        server.store.update(pod, check_version=False)
+                        self._send_json(201, {"status": "Success"})
+                        return
+                    cls = kind_class(kind)
+                    obj = decode(body, cls)
+                    server._admit("CREATE", obj)
+                    created = server.store.create(obj)
+                    self._send_json(201, encode(created))
+                except AdmissionError as e:
+                    self._error(e.code, "Invalid", str(e))
+                except AlreadyExistsError as e:
+                    self._error(409, "AlreadyExists", str(e))
+                except NotFoundError as e:
+                    self._error(404, "NotFound", str(e))
+                except (KeyError, TypeError, ValueError) as e:
+                    self._error(400, "BadRequest", f"undecodable body: {e}")
+
+            def do_PUT(self):
+                route = self._route()
+                if route is None:
+                    self._error(404, "NotFound", "unknown path")
+                    return
+                kind, key, sub, query = route
+                body = self._read_body()
+                try:
+                    cls = kind_class(kind)
+                    obj = decode(body, cls)
+                    server._admit("UPDATE", obj)
+                    check = query.get("force") != "true"
+                    updated = server.store.update(obj, check_version=check)
+                    self._send_json(200, encode(updated))
+                except AdmissionError as e:
+                    self._error(e.code, "Invalid", str(e))
+                except ConflictError as e:
+                    self._error(409, "Conflict", str(e))
+                except NotFoundError as e:
+                    self._error(404, "NotFound", str(e))
+                except (KeyError, TypeError, ValueError) as e:
+                    self._error(400, "BadRequest", f"undecodable body: {e}")
+
+            def do_DELETE(self):
+                route = self._route()
+                if route is None:
+                    self._error(404, "NotFound", "unknown path")
+                    return
+                kind, key, _, _ = route
+                try:
+                    deleted = server.store.delete(kind, key)
+                    self._send_json(200, encode(deleted))
+                except NotFoundError as e:
+                    self._error(404, "NotFound", str(e))
+
+            def log_message(self, *a):
+                pass
+
+        return Handler
+
+    def _admit(self, operation: str, obj) -> None:
+        for fn in self.admission:
+            fn(operation, obj)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def serve(self, port: int = 0) -> int:
+        self._http = ThreadingHTTPServer(("127.0.0.1", port), self._build_handler())
+        self._http.daemon_threads = True
+        t = threading.Thread(target=self._http.serve_forever, daemon=True)
+        t.start()
+        self.port = self._http.server_port
+        return self.port
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def shutdown(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()

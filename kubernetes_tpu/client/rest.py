@@ -1,0 +1,189 @@
+"""REST client: the store interface over HTTP against an APIServer.
+
+Reference: staging/src/k8s.io/client-go/rest + kubernetes typed clientsets.
+RESTStore implements the same surface as store.Store (create/get/update/
+delete/list/watch), so informers, controllers, and the scheduler can run
+in a separate process from the API server without code changes — the
+client-go role in the reference's distributed control plane (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from collections import deque
+
+from ..api.serialization import decode, encode
+from ..store.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExistsError,
+    ConflictError,
+    Event,
+    NotFoundError,
+)
+
+
+class RESTError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+def _raise_for(code: int, message: str, reason: str = ""):
+    if code == 404:
+        raise NotFoundError(message)
+    if code == 409:
+        if reason == "AlreadyExists":
+            raise AlreadyExistsError(message)
+        raise ConflictError(message)
+    raise RESTError(code, message)
+
+
+class RESTWatch:
+    """A streaming watch connection (client-go watch.Interface shape,
+    drop-in for store.Watch)."""
+
+    def __init__(self, url: str):
+        self._events: deque[Event] = deque()
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._resp = urllib.request.urlopen(url)  # noqa: S310 - loopback
+        self._thread = threading.Thread(target=self._reader, daemon=True)
+        self._thread.start()
+
+    def _reader(self) -> None:
+        try:
+            for line in self._resp:
+                line = line.strip()
+                if not line:
+                    continue
+                frame = json.loads(line)
+                ev = Event(frame["type"], decode(frame["object"]),
+                           frame.get("revision", 0))
+                with self._cond:
+                    self._events.append(ev)
+                    self._cond.notify_all()
+        except Exception:  # noqa: BLE001 - connection torn down
+            pass
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    def next(self, timeout: float | None = None) -> Event | None:
+        with self._cond:
+            if not self._events and not self._stopped:
+                self._cond.wait(timeout)
+            return self._events.popleft() if self._events else None
+
+    def drain(self) -> list[Event]:
+        with self._cond:
+            out = list(self._events)
+            self._events.clear()
+            return out
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        # shut the socket down FIRST: close() alone deadlocks against the
+        # reader thread blocked inside a buffered read on the same fp
+        import socket as _socket
+
+        try:
+            sock = self._resp.fp.raw._sock  # noqa: SLF001
+            sock.shutdown(_socket.SHUT_RDWR)
+        except Exception:  # noqa: BLE001
+            pass
+        self._thread.join(timeout=2)
+        try:
+            self._resp.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+
+class RESTStore:
+    """Typed client over the API server; same surface as store.Store."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: dict | None = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode()
+            reason = ""
+            try:
+                status = json.loads(payload)
+                message = status.get("message", payload)
+                reason = status.get("reason", "")
+            except json.JSONDecodeError:
+                message = payload
+            _raise_for(e.code, message, reason)
+
+    # -- store surface -------------------------------------------------------
+
+    def create(self, obj):
+        out = self._request("POST", f"/api/v1/{obj.kind}", encode(obj))
+        return decode(out)
+
+    def get(self, kind: str, key: str):
+        return decode(self._request("GET", f"/api/v1/{kind}/{key}"))
+
+    def try_get(self, kind: str, key: str):
+        try:
+            return self.get(kind, key)
+        except NotFoundError:
+            return None
+
+    def update(self, obj, *, check_version: bool = True):
+        suffix = "" if check_version else "?force=true"
+        out = self._request(
+            "PUT", f"/api/v1/{obj.kind}/{obj.meta.key}{suffix}", encode(obj)
+        )
+        return decode(out)
+
+    def delete(self, kind: str, key: str):
+        return decode(self._request("DELETE", f"/api/v1/{kind}/{key}"))
+
+    def list(self, kind: str):
+        out = self._request("GET", f"/api/v1/{kind}")
+        items = [decode(item) for item in out.get("items", [])]
+        return items, out.get("metadata", {}).get("resourceVersion", 0)
+
+    def watch(self, kind: str, from_revision: int = 0) -> RESTWatch:
+        return RESTWatch(
+            f"{self.base_url}/api/v1/{kind}?watch=1&resourceVersion={from_revision}"
+        )
+
+    def bind(self, pod_key: str, node_name: str) -> None:
+        self._request(
+            "POST", f"/api/v1/Pod/{pod_key}/binding", {"target_node": node_name}
+        )
+
+    # convenience parity with Store
+    def pods(self):
+        return self.list("Pod")[0]
+
+    def nodes(self):
+        return self.list("Node")[0]
+
+    def iter_kind(self, kind: str):
+        return iter(self.list(kind)[0])

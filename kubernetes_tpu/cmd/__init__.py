@@ -1,0 +1,1 @@
+"""Component entry points (the cmd/ layer of the reference)."""

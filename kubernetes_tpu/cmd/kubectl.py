@@ -1,0 +1,218 @@
+"""kubectl-equivalent CLI over the REST API.
+
+Reference: staging/src/k8s.io/kubectl + cmd/kubectl — the verb surface
+(get, describe, create -f, apply -f, delete, scale, cordon/uncordon) over
+client-go. Manifests use the api/serialization wire shape; `apply` is
+create-or-update (server-side apply's patch semantics collapse to full-object
+update against our store).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..api.serialization import decode, encode
+from ..client.rest import RESTStore
+from ..store.store import AlreadyExistsError, NotFoundError
+
+DEFAULT_SERVER = "http://127.0.0.1:6443"
+
+# kubectl resource aliases
+ALIASES = {
+    "pod": "Pod", "pods": "Pod", "po": "Pod",
+    "node": "Node", "nodes": "Node", "no": "Node",
+    "deployment": "Deployment", "deployments": "Deployment", "deploy": "Deployment",
+    "replicaset": "ReplicaSet", "replicasets": "ReplicaSet", "rs": "ReplicaSet",
+    "job": "Job", "jobs": "Job",
+    "service": "Service", "services": "Service", "svc": "Service",
+    "endpointslice": "EndpointSlice", "endpointslices": "EndpointSlice",
+    "pv": "PersistentVolume", "persistentvolume": "PersistentVolume",
+    "pvc": "PersistentVolumeClaim", "persistentvolumeclaim": "PersistentVolumeClaim",
+    "storageclass": "StorageClass", "sc": "StorageClass",
+    "podgroup": "PodGroup", "podgroups": "PodGroup", "pg": "PodGroup",
+    "resourceclaim": "ResourceClaim", "resourceclaims": "ResourceClaim",
+    "resourceslice": "ResourceSlice", "resourceslices": "ResourceSlice",
+    "lease": "Lease", "leases": "Lease",
+}
+
+
+def _kind(resource: str) -> str:
+    return ALIASES.get(resource.lower(), resource)
+
+
+def _key(kind: str, name: str, namespace: str) -> str:
+    cluster_scoped = kind in ("Node", "PersistentVolume", "StorageClass",
+                              "CSINode", "ResourceSlice", "DeviceClass",
+                              "Namespace")
+    return name if cluster_scoped else f"{namespace}/{name}"
+
+
+def _status_of(obj) -> str:
+    if obj.kind == "Pod":
+        return obj.status.phase if not obj.spec.node_name else (
+            f"{obj.status.phase} on {obj.spec.node_name}"
+        )
+    if obj.kind == "Node":
+        ready = next((c for c in obj.status.conditions if c.type == "Ready"), None)
+        return "Ready" if ready and ready.status == "True" else "NotReady"
+    if obj.kind in ("Deployment", "ReplicaSet"):
+        return f"{obj.status.ready_replicas}/{obj.spec.replicas} ready"
+    if obj.kind == "Job":
+        return "Complete" if obj.status.completed else f"{obj.status.succeeded} succeeded"
+    if obj.kind == "PersistentVolumeClaim":
+        return obj.status.phase
+    return ""
+
+
+def cmd_get(client: RESTStore, args) -> int:
+    kind = _kind(args.resource)
+    if args.name:
+        try:
+            obj = client.get(kind, _key(kind, args.name, args.namespace))
+        except NotFoundError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        if args.output == "json":
+            print(json.dumps(encode(obj), indent=2))
+        else:
+            print(f"{obj.meta.name}\t{_status_of(obj)}")
+        return 0
+    items, _ = client.list(kind)
+    visible = [
+        obj for obj in sorted(items, key=lambda o: o.meta.key)
+        if obj.meta.namespace in ("", args.namespace) or args.all_namespaces
+    ]
+    if args.output == "json":
+        print(json.dumps([encode(o) for o in visible], indent=2))
+    else:
+        print(f"NAME\tSTATUS")
+        for obj in visible:
+            print(f"{obj.meta.name}\t{_status_of(obj)}")
+    return 0
+
+
+def cmd_describe(client: RESTStore, args) -> int:
+    kind = _kind(args.resource)
+    try:
+        obj = client.get(kind, _key(kind, args.name, args.namespace))
+    except NotFoundError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(encode(obj), indent=2))
+    return 0
+
+
+def _load_manifests(path: str) -> list[dict]:
+    import yaml
+
+    source = sys.stdin if path == "-" else open(path)
+    with source if path != "-" else sys.stdin as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def cmd_apply(client: RESTStore, args) -> int:
+    for doc in _load_manifests(args.filename):
+        obj = decode(doc)
+        try:
+            client.create(obj)
+            print(f"{obj.kind.lower()}/{obj.meta.name} created")
+        except AlreadyExistsError:
+            cur = client.get(obj.kind, obj.meta.key)
+            obj.meta.resource_version = cur.meta.resource_version
+            obj.meta.uid = cur.meta.uid
+            client.update(obj, check_version=False)
+            print(f"{obj.kind.lower()}/{obj.meta.name} configured")
+    return 0
+
+
+def cmd_create(client: RESTStore, args) -> int:
+    for doc in _load_manifests(args.filename):
+        obj = decode(doc)
+        client.create(obj)
+        print(f"{obj.kind.lower()}/{obj.meta.name} created")
+    return 0
+
+
+def cmd_delete(client: RESTStore, args) -> int:
+    kind = _kind(args.resource)
+    try:
+        client.delete(kind, _key(kind, args.name, args.namespace))
+        print(f"{kind.lower()}/{args.name} deleted")
+        return 0
+    except NotFoundError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+def cmd_scale(client: RESTStore, args) -> int:
+    kind = _kind(args.resource)
+    obj = client.get(kind, _key(kind, args.name, args.namespace))
+    obj.spec.replicas = args.replicas
+    client.update(obj, check_version=False)
+    print(f"{kind.lower()}/{args.name} scaled to {args.replicas}")
+    return 0
+
+
+def cmd_cordon(client: RESTStore, args, unschedulable: bool = True) -> int:
+    node = client.get("Node", args.name)
+    node.spec.unschedulable = unschedulable
+    client.update(node, check_version=False)
+    print(f"node/{args.name} {'cordoned' if unschedulable else 'uncordoned'}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="kubectl-tpu")
+    parser.add_argument("--server", "-s", default=DEFAULT_SERVER)
+    parser.add_argument("--namespace", "-n", default="default")
+    sub = parser.add_subparsers(dest="verb", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-o", "--output", choices=["wide", "json"], default="wide")
+    g.add_argument("-A", "--all-namespaces", action="store_true")
+
+    d = sub.add_parser("describe")
+    d.add_argument("resource")
+    d.add_argument("name")
+
+    for verb in ("apply", "create"):
+        a = sub.add_parser(verb)
+        a.add_argument("-f", "--filename", required=True)
+
+    rm = sub.add_parser("delete")
+    rm.add_argument("resource")
+    rm.add_argument("name")
+
+    sc = sub.add_parser("scale")
+    sc.add_argument("resource")
+    sc.add_argument("name")
+    sc.add_argument("--replicas", type=int, required=True)
+
+    for verb in ("cordon", "uncordon"):
+        c = sub.add_parser(verb)
+        c.add_argument("name")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    client = RESTStore(args.server)
+    verbs = {
+        "get": cmd_get,
+        "describe": cmd_describe,
+        "apply": cmd_apply,
+        "create": cmd_create,
+        "delete": cmd_delete,
+        "scale": cmd_scale,
+        "cordon": lambda c, a: cmd_cordon(c, a, True),
+        "uncordon": lambda c, a: cmd_cordon(c, a, False),
+    }
+    return verbs[args.verb](client, args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,211 @@
+"""Scheduler CLI/server: config loading, leader election, health + metrics.
+
+Reference: cmd/kube-scheduler/app/server.go (NewSchedulerCommand:93, Run:174,
+leader election :301-345, healthz/metrics mux :367-390). argparse stands in
+for cobra; the serving mux exposes /healthz, /readyz, /metrics and
+/debug/pprof-style stats.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..config.types import SchedulerConfiguration, load_config_file
+from ..scheduler import Profile, Scheduler
+from ..scheduler.metrics import SchedulerMetrics
+from ..store.store import Store
+from ..utils.featuregate import FeatureGate
+
+
+class SchedulerServer:
+    """One running scheduler instance + its serving mux."""
+
+    def __init__(self, store: Store, config: SchedulerConfiguration,
+                 identity: str = "scheduler-0"):
+        self.config = config
+        self.store = store
+        self.identity = identity
+        self.metrics = SchedulerMetrics()
+        gates = FeatureGate()
+        gates.set_from_map(config.feature_gates)
+        self.feature_gates = gates
+        profiles = [
+            Profile(
+                name=p.scheduler_name,
+                percentage_of_nodes_to_score=(
+                    p.percentage_of_nodes_to_score
+                    if p.percentage_of_nodes_to_score is not None
+                    else config.percentage_of_nodes_to_score
+                ),
+                plugin_args=p.plugin_args,
+                backend=p.backend,
+            )
+            for p in config.profiles
+        ]
+        self.scheduler = Scheduler(
+            store,
+            profiles=profiles,
+            feature_gates=gates.as_map(),
+            metrics=self.metrics,
+            async_api_calls=gates.enabled("SchedulerAsyncAPICalls"),
+            parallelism=config.parallelism,
+            extenders=config.extenders,
+        )
+        self.elector = None
+        if config.leader_election.leader_elect:
+            from ..client.leaderelection import LeaderElector
+
+            le = config.leader_election
+            self.elector = LeaderElector(
+                store=store,
+                identity=identity,
+                name=le.resource_name,
+                namespace=le.resource_namespace,
+                lease_duration=le.lease_duration,
+                renew_deadline=le.renew_deadline,
+                retry_period=le.retry_period,
+            )
+        self._stop = threading.Event()
+        self._http: ThreadingHTTPServer | None = None
+        self._threads: list[threading.Thread] = []
+        self.started = False
+
+    # -- serving mux (server.go:367-390) -------------------------------------
+
+    def _build_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: str, ctype="text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, "ok")
+                elif self.path == "/readyz":
+                    # readyz includes informer sync + leadership watchdog
+                    # (server.go:199-253)
+                    ready = server.started and (
+                        server.elector is None or server.elector.is_leader()
+                    )
+                    self._send(200 if ready else 503, "ok" if ready else "not ready")
+                elif self.path == "/metrics":
+                    self._send(200, server.metrics.expose(),
+                               "text/plain; version=0.0.4")
+                elif self.path == "/configz":
+                    self._send(200, json.dumps({
+                        "parallelism": server.config.parallelism,
+                        "featureGates": server.feature_gates.as_map(),
+                        "profiles": [p.scheduler_name for p in server.config.profiles],
+                    }), "application/json")
+                else:
+                    self._send(404, "not found")
+
+            def log_message(self, *a):
+                pass
+
+        return Handler
+
+    def serve(self, port: int = 0) -> int:
+        """Start the health/metrics mux; returns the bound port."""
+        self._http = ThreadingHTTPServer(("127.0.0.1", port), self._build_handler())
+        t = threading.Thread(target=self._http.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self._http.server_port
+
+    # -- run -----------------------------------------------------------------
+
+    def run(self, block: bool = True) -> None:
+        """server.go Run: serve health endpoints immediately, schedule only
+        while holding leadership (if enabled)."""
+        if self._http is None and self.config.health_bind_port:
+            self.serve(self.config.health_bind_port)
+        if self.elector is not None:
+            self.elector.on_started_leading = self._start_scheduling
+            self.elector.on_stopped_leading = self._stop_scheduling
+            t = threading.Thread(target=self.elector.run, daemon=True)
+            t.start()
+            self._threads.append(t)
+        else:
+            self._start_scheduling()
+        if block:
+            try:
+                while not self._stop.wait(0.2):
+                    pass
+            except KeyboardInterrupt:
+                pass
+            self.shutdown()
+
+    def _start_scheduling(self) -> None:
+        # per-leadership-term stop event: losing the lease MUST halt this
+        # term's loop (split-brain double-binding otherwise), and a
+        # re-acquired term starts a fresh loop
+        if self.started:
+            return
+        self._sched_stop = threading.Event()
+        self.scheduler.start()
+        self.started = True
+
+        def run_term(stop=self._sched_stop):
+            while not stop.is_set() and not self._stop.is_set():
+                self.scheduler.pump()
+                self.scheduler.loop.schedule_one(timeout=0.05)
+
+        t = threading.Thread(target=run_term, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _stop_scheduling(self) -> None:
+        self.started = False
+        stop = getattr(self, "_sched_stop", None)
+        if stop is not None:
+            stop.set()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self.elector is not None:
+            self.elector.stop()
+        if self._http is not None:
+            self._http.shutdown()
+        if self.scheduler.api_dispatcher is not None:
+            self.scheduler.api_dispatcher.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpu-scheduler",
+        description="TPU-native scheduler (cmd/kube-scheduler equivalent)",
+    )
+    parser.add_argument("--config", help="KubeSchedulerConfiguration YAML")
+    parser.add_argument("--backend", choices=["host", "tpu"], default=None,
+                        help="override profile backend")
+    parser.add_argument("--port", type=int, default=10259,
+                        help="health/metrics port")
+    parser.add_argument("--leader-elect", action="store_true")
+    args = parser.parse_args(argv)
+
+    config = (
+        load_config_file(args.config) if args.config else SchedulerConfiguration()
+    )
+    if args.backend:
+        for p in config.profiles:
+            p.backend = args.backend
+    if args.leader_elect:
+        config.leader_election.leader_elect = True
+    config.health_bind_port = args.port
+    server = SchedulerServer(Store(), config)
+    server.run(block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

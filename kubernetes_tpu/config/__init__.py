@@ -1,0 +1,15 @@
+"""ComponentConfig (pkg/scheduler/apis/config equivalent)."""
+
+from .types import (
+    LeaderElectionConfig,
+    PluginSet,
+    ProfileConfig,
+    SchedulerConfiguration,
+    load_config,
+    load_config_file,
+)
+
+__all__ = [
+    "LeaderElectionConfig", "PluginSet", "ProfileConfig",
+    "SchedulerConfiguration", "load_config", "load_config_file",
+]

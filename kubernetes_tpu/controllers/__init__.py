@@ -1,0 +1,36 @@
+"""Controller manager layer (cmd/kube-controller-manager + pkg/controller)."""
+
+from .base import Controller, ControllerManager
+from .lifecycle import (
+    EndpointSliceController,
+    GarbageCollector,
+    NodeLifecycleController,
+    ResourceClaimController,
+)
+from .workloads import DeploymentController, JobController, ReplicaSetController
+
+
+def default_controllers(store, clock=None) -> list[Controller]:
+    """The controller set kube-controller-manager starts by default, all on
+    ONE shared informer factory (SharedInformerFactory semantics — each kind
+    gets a single watch + cache, fanned out to every controller)."""
+    from ..client.informer import InformerFactory
+
+    informers = InformerFactory(store)
+    return [
+        DeploymentController(store, informers),
+        ReplicaSetController(store, informers),
+        JobController(store, informers),
+        GarbageCollector(store, informers),
+        NodeLifecycleController(store, informers, clock=clock),
+        ResourceClaimController(store, informers),
+        EndpointSliceController(store, informers),
+    ]
+
+
+__all__ = [
+    "Controller", "ControllerManager", "DeploymentController",
+    "EndpointSliceController", "GarbageCollector", "JobController",
+    "NodeLifecycleController", "ReplicaSetController",
+    "ResourceClaimController", "default_controllers",
+]

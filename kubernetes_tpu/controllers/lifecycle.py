@@ -1,0 +1,245 @@
+"""Lifecycle controllers: garbage collection, node lifecycle, taint eviction,
+resource-claim cleanup, endpoint slices.
+
+Reference: pkg/controller/garbagecollector/ (ownerReference cascade),
+pkg/controller/nodelifecycle/node_lifecycle_controller.go (Lease-staleness ->
+NotReady + unreachable taint), pkg/controller/tainteviction/,
+pkg/controller/resourceclaim/, pkg/controller/endpointslice/.
+"""
+
+from __future__ import annotations
+
+from ..api.types import NO_EXECUTE, NodeCondition, Taint
+from ..api.workloads import Endpoint, EndpointSlice
+from ..api.meta import ObjectMeta
+from ..store.store import NotFoundError
+from .base import Controller
+
+UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
+
+# kinds the GC walks (an informer per watched kind; the reference discovers
+# these dynamically via the RESTMapper)
+GC_KINDS = ("Pod", "ReplicaSet", "Deployment", "Job", "PersistentVolumeClaim",
+            "ResourceClaim", "EndpointSlice")
+
+
+class GarbageCollector(Controller):
+    """garbagecollector — delete objects whose controller owner is gone.
+
+    The reference builds a dependency graph; with the store's cheap listing
+    the same effect comes from checking each dependent's owners on events.
+    """
+
+    name = "garbage-collector"
+    watches = GC_KINDS
+
+    def key_of(self, kind: str, obj) -> str | None:
+        if not obj.meta.owner_references:
+            return None
+        return f"{kind}|{obj.meta.key}"
+
+    def _owner_exists(self, namespace: str, ref) -> bool:
+        key = f"{namespace}/{ref.name}" if namespace else ref.name
+        owner = self.store.try_get(ref.kind, key)
+        return owner is not None and (not ref.uid or owner.meta.uid == ref.uid)
+
+    def reconcile(self, key: str) -> None:
+        kind, _, obj_key = key.partition("|")
+        obj = self.store.try_get(kind, obj_key)
+        if obj is None:
+            return
+        refs = obj.meta.owner_references
+        if refs and not any(self._owner_exists(obj.meta.namespace, r) for r in refs):
+            try:
+                self.store.delete(kind, obj_key)
+            except NotFoundError:
+                pass
+
+    def sweep(self) -> int:
+        """Full-resync mark pass (the reference's graph rebuild on sync)."""
+        n = 0
+        for kind in GC_KINDS:
+            for obj in list(self.store.iter_kind(kind)):
+                if obj.meta.owner_references:
+                    self.queue.add(f"{kind}|{obj.meta.key}")
+                    n += 1
+        return n
+
+
+class NodeLifecycleController(Controller):
+    """node_lifecycle_controller.go — Lease-staleness drives Ready condition
+    and the unreachable NoExecute taint; pods on unreachable nodes are
+    evicted (tainteviction collapsed in, as the reference does when
+    TaintBasedEvictions became the only path)."""
+
+    name = "node-lifecycle"
+    watches = ("Node", "Lease")
+    grace_period = 40.0  # node-monitor-grace-period default
+
+    def __init__(self, store, informers=None, clock=None):
+        super().__init__(store, informers)
+        from ..utils.clock import Clock
+
+        self.clock = clock or Clock()
+
+    def key_of(self, kind: str, obj) -> str | None:
+        if kind == "Lease":
+            if obj.meta.namespace != "kube-node-lease":
+                return None
+            return obj.meta.name
+        return obj.meta.name
+
+    def _lease_fresh(self, node_name: str) -> bool:
+        lease = self.store.try_get("Lease", f"kube-node-lease/{node_name}")
+        if lease is None:
+            return False
+        return self.clock.now() - lease.spec.renew_time < self.grace_period
+
+    def reconcile(self, key: str) -> None:
+        node = self.store.try_get("Node", key)
+        if node is None:
+            return
+        fresh = self._lease_fresh(key)
+        ready = next(
+            (c for c in node.status.conditions if c.type == "Ready"), None
+        )
+        changed = False
+        if ready is None:
+            ready = NodeCondition(type="Ready", status="Unknown")
+            node.status.conditions.append(ready)
+            changed = True
+        want_status = "True" if fresh else "Unknown"
+        if ready.status != want_status:
+            ready.status = want_status
+            changed = True
+        has_taint = any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+        if not fresh and not has_taint:
+            node.spec.taints = tuple(node.spec.taints) + (
+                Taint(key=UNREACHABLE_TAINT, effect=NO_EXECUTE),
+            )
+            changed = True
+        elif fresh and has_taint:
+            node.spec.taints = tuple(
+                t for t in node.spec.taints if t.key != UNREACHABLE_TAINT
+            )
+            changed = True
+        if changed:
+            self.store.update(node, check_version=False)
+        if not fresh:
+            self._evict_pods(key)
+
+    def _evict_pods(self, node_name: str) -> None:
+        """tainteviction — NoExecute evicts pods lacking a matching
+        toleration (tolerationSeconds treated as immediate at reconcile)."""
+        taint = Taint(key=UNREACHABLE_TAINT, effect=NO_EXECUTE)
+        for pod in self.store.pods():
+            if pod.spec.node_name != node_name:
+                continue
+            if any(tol.tolerates(taint) for tol in pod.spec.tolerations):
+                continue
+            try:
+                self.store.delete("Pod", pod.meta.key)
+            except NotFoundError:
+                pass
+
+    def sweep(self) -> None:
+        for node in self.store.nodes():
+            self.queue.add(node.meta.name)
+
+
+class ResourceClaimController(Controller):
+    """resourceclaim controller — drop reservedFor entries of deleted pods;
+    deallocate a claim once nothing reserves it (allowing reuse)."""
+
+    name = "resourceclaim"
+    watches = ("ResourceClaim", "Pod")
+
+    def key_of(self, kind: str, obj) -> str | None:
+        if kind == "ResourceClaim":
+            return obj.meta.key
+        # pod deletions may strand reservations on any claim it referenced
+        from ..api.dra import pod_resource_claim_keys
+
+        keys = pod_resource_claim_keys(obj)
+        for k in keys[1:]:
+            self.queue.add(k)
+        return keys[0] if keys else None
+
+    def reconcile(self, key: str) -> None:
+        claim = self.store.try_get("ResourceClaim", key)
+        if claim is None:
+            return
+        live = tuple(
+            pod_key for pod_key in claim.status.reserved_for
+            if self.store.try_get("Pod", pod_key) is not None
+        )
+        if live != claim.status.reserved_for:
+            claim.status.reserved_for = live
+            if not live:
+                claim.status.allocation = None  # deallocate idle claim
+            self.store.update(claim, check_version=False)
+
+
+class EndpointSliceController(Controller):
+    """endpointslice controller — one slice per Service tracking ready
+    running pods matching the selector."""
+
+    name = "endpointslice"
+    watches = ("Service", "Pod")
+
+    def key_of(self, kind: str, obj) -> str | None:
+        if kind == "Service":
+            return obj.meta.key
+        # pods map back to services by label match
+        for svc in self.store.iter_kind("Service"):
+            if svc.meta.namespace == obj.meta.namespace and svc.spec.selector and all(
+                obj.meta.labels.get(k) == v for k, v in svc.spec.selector.items()
+            ):
+                self.queue.add(svc.meta.key)
+        return None
+
+    def reconcile(self, key: str) -> None:
+        svc = self.store.try_get("Service", key)
+        slice_key = f"{key}-endpoints"
+        if svc is None:
+            existing = self.store.try_get("EndpointSlice", slice_key)
+            if existing is not None:
+                self.store.delete("EndpointSlice", existing.meta.key)
+            return
+        from ..api.types import RUNNING
+
+        import zlib
+
+        def pod_ip(p) -> str:
+            # stable per-pod address derived from its uid (crc32: stable
+            # across processes, unlike salted hash()) — churn elsewhere in
+            # the cluster must not rewrite this slice's endpoints
+            h = zlib.crc32((p.meta.uid or p.meta.key).encode()) & 0xFFFF
+            return f"10.0.{h >> 8}.{h & 0xFF}"
+
+        endpoints = tuple(
+            Endpoint(
+                addresses=(pod_ip(p),),
+                node_name=p.spec.node_name,
+                ready=p.status.phase == RUNNING,
+                target_pod=p.meta.key,
+            )
+            for p in self.store.pods()
+            if p.meta.namespace == svc.meta.namespace
+            and p.spec.node_name
+            and svc.spec.selector
+            and all(p.meta.labels.get(k) == v for k, v in svc.spec.selector.items())
+        )
+        name = f"{svc.meta.name}-endpoints"
+        existing = self.store.try_get("EndpointSlice", f"{svc.meta.namespace}/{name}")
+        if existing is None:
+            self.store.create(EndpointSlice(
+                meta=ObjectMeta(name=name, namespace=svc.meta.namespace),
+                service_name=svc.meta.name,
+                endpoints=endpoints,
+                ports=svc.spec.ports,
+            ))
+        elif existing.endpoints != endpoints or existing.ports != svc.spec.ports:
+            existing.endpoints = endpoints
+            existing.ports = svc.spec.ports
+            self.store.update(existing, check_version=False)

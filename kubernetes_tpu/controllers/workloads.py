@@ -1,0 +1,254 @@
+"""Workload controllers: ReplicaSet, Deployment, Job.
+
+Reference: pkg/controller/replicaset/replica_set.go (syncReplicaSet,
+manageReplicas), pkg/controller/deployment/ (syncDeployment, rolling.go),
+pkg/controller/job/job_controller.go (syncJob). Each reconciles one object
+key against the pods it owns (ownerReferences-based adoption, the
+ControllerRefManager pattern).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..api.meta import ObjectMeta, OwnerReference
+from ..api.types import Pod, PodSpec, SUCCEEDED, FAILED, RUNNING
+from ..api.workloads import (
+    Deployment,
+    ReplicaSet,
+    ReplicaSetSpec,
+    ReplicaSetStatus,
+)
+from ..api.labels import LabelSelector
+from ..store.store import NotFoundError
+from .base import Controller
+
+
+def _owned_by(obj, owner_uid: str) -> bool:
+    return any(r.uid == owner_uid and r.controller for r in obj.meta.owner_references)
+
+
+def _controller_ref(owner) -> OwnerReference:
+    return OwnerReference(
+        kind=owner.kind, name=owner.meta.name, uid=owner.meta.uid, controller=True
+    )
+
+
+def _clone_pod_spec(template) -> PodSpec:
+    import copy
+
+    return copy.deepcopy(template.spec)
+
+
+class ReplicaSetController(Controller):
+    """replica_set.go — converge owned-pod count to spec.replicas."""
+
+    name = "replicaset"
+    watches = ("ReplicaSet", "Pod")
+
+    def key_of(self, kind: str, obj) -> str | None:
+        if kind == "ReplicaSet":
+            return obj.meta.key
+        for ref in obj.meta.owner_references:
+            if ref.kind == "ReplicaSet" and ref.controller:
+                return f"{obj.meta.namespace}/{ref.name}"
+        return None
+
+    def _active_owned_pods(self, rs: ReplicaSet) -> list[Pod]:
+        return [
+            p for p in self.store.pods()
+            if p.meta.namespace == rs.meta.namespace
+            and _owned_by(p, rs.meta.uid)
+            and p.status.phase not in (SUCCEEDED, FAILED)
+            and not p.is_terminating
+        ]
+
+    def reconcile(self, key: str) -> None:
+        try:
+            rs = self.store.get("ReplicaSet", key)
+        except NotFoundError:
+            return  # GC deletes the orphans
+        pods = self._active_owned_pods(rs)
+        diff = rs.spec.replicas - len(pods)
+        if diff > 0:
+            from ..api.meta import new_uid
+
+            for _ in range(diff):
+                # generateName semantics: unique suffix, never a collision
+                # with a pod that existed before (pod-template-hash pattern)
+                suffix = new_uid().rsplit("-", 1)[-1]
+                pod = Pod(
+                    meta=ObjectMeta(
+                        name=f"{rs.meta.name}-{suffix}",
+                        namespace=rs.meta.namespace,
+                        labels=dict(rs.spec.template.labels),
+                        owner_references=[_controller_ref(rs)],
+                    ),
+                    spec=_clone_pod_spec(rs.spec.template),
+                )
+                self.store.create(pod)
+        elif diff < 0:
+            # scale down: prefer unscheduled, then newest (getPodsToDelete rank)
+            pods.sort(key=lambda p: (bool(p.spec.node_name), -p.meta.resource_version))
+            for p in pods[: -diff]:
+                self.store.delete("Pod", p.meta.key)
+        new_status = ReplicaSetStatus(
+            replicas=max(len(pods) + diff, 0) if diff > 0 else rs.spec.replicas,
+            ready_replicas=sum(1 for p in pods if p.status.phase == RUNNING),
+            observed_generation=rs.meta.generation,
+        )
+        # status writes only on change — an unconditional update would emit a
+        # MODIFIED event that re-enqueues this key forever
+        if new_status != rs.status:
+            rs.status = new_status
+            self.store.update(rs, check_version=False)
+
+
+def _template_hash(dep: Deployment) -> str:
+    import json
+
+    from ..api.serialization import encode
+
+    payload = json.dumps(encode(dep.spec.template), sort_keys=True)
+    return hashlib.sha1(payload.encode()).hexdigest()[:10]
+
+
+class DeploymentController(Controller):
+    """deployment controller — one ReplicaSet per template hash; template
+    changes roll by scaling the new RS up and old ones to 0 (the rolling.go
+    surge/maxUnavailable dance collapsed to its fixed point, which is what
+    the in-process control loop converges to in one pass)."""
+
+    name = "deployment"
+    watches = ("Deployment", "ReplicaSet")
+
+    def key_of(self, kind: str, obj) -> str | None:
+        if kind == "Deployment":
+            return obj.meta.key
+        for ref in obj.meta.owner_references:
+            if ref.kind == "Deployment" and ref.controller:
+                return f"{obj.meta.namespace}/{ref.name}"
+        return None
+
+    def reconcile(self, key: str) -> None:
+        try:
+            dep = self.store.get("Deployment", key)
+        except NotFoundError:
+            return
+        want_hash = _template_hash(dep)
+        want_name = f"{dep.meta.name}-{want_hash}"
+        owned = [
+            rs for rs in self.store.iter_kind("ReplicaSet")
+            if rs.meta.namespace == dep.meta.namespace and _owned_by(rs, dep.meta.uid)
+        ]
+        new_rs = next((rs for rs in owned if rs.meta.name == want_name), None)
+        if new_rs is None:
+            labels = dict(dep.spec.template.labels)
+            labels["pod-template-hash"] = want_hash
+            template = type(dep.spec.template)(
+                labels=labels, spec=_clone_pod_spec(dep.spec.template)
+            )
+            new_rs = ReplicaSet(
+                meta=ObjectMeta(
+                    name=want_name,
+                    namespace=dep.meta.namespace,
+                    labels=labels,
+                    owner_references=[_controller_ref(dep)],
+                ),
+                spec=ReplicaSetSpec(
+                    replicas=dep.spec.replicas,
+                    selector=LabelSelector.of(labels),
+                    template=template,
+                ),
+            )
+            self.store.create(new_rs)
+        elif new_rs.spec.replicas != dep.spec.replicas:
+            new_rs.spec.replicas = dep.spec.replicas
+            self.store.update(new_rs, check_version=False)
+        for rs in owned:
+            if rs.meta.name != want_name and rs.spec.replicas != 0:
+                rs.spec.replicas = 0
+                self.store.update(rs, check_version=False)
+        from ..api.workloads import DeploymentStatus
+
+        new_status = DeploymentStatus(
+            replicas=dep.spec.replicas,
+            updated_replicas=new_rs.spec.replicas,
+            ready_replicas=new_rs.status.ready_replicas,
+            observed_generation=dep.meta.generation,
+        )
+        if new_status != dep.status:
+            dep.status = new_status
+            self.store.update(dep, check_version=False)
+
+
+class JobController(Controller):
+    """job_controller.go syncJob — run `parallelism` pods at a time until
+    `completions` have succeeded."""
+
+    name = "job"
+    watches = ("Job", "Pod")
+
+    def key_of(self, kind: str, obj) -> str | None:
+        if kind == "Job":
+            return obj.meta.key
+        for ref in obj.meta.owner_references:
+            if ref.kind == "Job" and ref.controller:
+                return f"{obj.meta.namespace}/{ref.name}"
+        return None
+
+    def reconcile(self, key: str) -> None:
+        try:
+            job = self.store.get("Job", key)
+        except NotFoundError:
+            return
+        owned = [
+            p for p in self.store.pods()
+            if p.meta.namespace == job.meta.namespace and _owned_by(p, job.meta.uid)
+        ]
+        succeeded = sum(1 for p in owned if p.status.phase == SUCCEEDED)
+        failed = sum(1 for p in owned if p.status.phase == FAILED)
+        active = [
+            p for p in owned
+            if p.status.phase not in (SUCCEEDED, FAILED) and not p.is_terminating
+        ]
+        import copy
+
+        old_status = copy.copy(job.status)
+        job.status.active = len(active)
+        job.status.succeeded = succeeded
+        job.status.failed = failed
+        if succeeded >= job.spec.completions:
+            job.status.completed = True
+            for p in active:
+                self.store.delete("Pod", p.meta.key)
+            if job.status != old_status:
+                self.store.update(job, check_version=False)
+            return
+        if failed > job.spec.backoff_limit:
+            # terminal failure (job_controller.go syncJob BackoffLimitExceeded):
+            # stop replacing pods and tear down the active ones
+            for p in active:
+                self.store.delete("Pod", p.meta.key)
+            if job.status != old_status:
+                self.store.update(job, check_version=False)
+            return
+        want_active = min(
+            job.spec.parallelism, job.spec.completions - succeeded
+        )
+        from ..api.meta import new_uid
+
+        for _ in range(want_active - len(active)):
+            pod = Pod(
+                meta=ObjectMeta(
+                    name=f"{job.meta.name}-{new_uid().rsplit('-', 1)[-1]}",
+                    namespace=job.meta.namespace,
+                    labels=dict(job.spec.template.labels),
+                    owner_references=[_controller_ref(job)],
+                ),
+                spec=_clone_pod_spec(job.spec.template),
+            )
+            pod.spec.restart_policy = "Never"
+            self.store.create(pod)
+        if job.status != old_status:
+            self.store.update(job, check_version=False)

@@ -1,0 +1,5 @@
+"""Node agent layer (pkg/kubelet in its kubemark hollow form)."""
+
+from .hollow import FakeRuntime, HollowKubelet, start_hollow_nodes
+
+__all__ = ["FakeRuntime", "HollowKubelet", "start_hollow_nodes"]

@@ -1,0 +1,186 @@
+"""Hollow kubelet: a node agent with a fake container runtime.
+
+Reference: pkg/kubelet (Run:1833, syncLoop:2602, SyncPod:2002, node lease
+heartbeat kubelet.go:1122-1128) in its kubemark form
+(pkg/kubemark/hollow_kubelet.go:62 — real kubelet logic, fake CRI). The sync
+loop here is the same shape: watch pods assigned to this node, drive them
+through a fake runtime (Pending -> Running -> Succeeded), report NodeStatus,
+heartbeat a Lease, and finalize deletions.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..api.coordination import Lease, LeaseSpec
+from ..api.meta import ObjectMeta
+from ..api.types import (
+    FAILED,
+    Node,
+    NodeCondition,
+    PENDING,
+    RUNNING,
+    SUCCEEDED,
+    PodCondition,
+)
+from ..store.store import ConflictError, NotFoundError
+
+LEASE_NAMESPACE = "kube-node-lease"
+
+
+class FakeRuntime:
+    """The kubemark fake CRI: containers 'run' instantly; a spec'd run
+    duration lets Jobs complete."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.containers: dict[str, float] = {}  # pod key -> start time
+
+    def start_pod(self, pod) -> None:
+        self.containers[pod.meta.key] = self.clock.now()
+
+    def pod_finished(self, pod) -> bool:
+        """Pods annotated with a run duration complete; service pods don't."""
+        duration = pod.meta.annotations.get("kubemark.io/run-seconds")
+        if duration is None:
+            return False
+        start = self.containers.get(pod.meta.key)
+        return start is not None and self.clock.now() - start >= float(duration)
+
+    def kill_pod(self, key: str) -> None:
+        self.containers.pop(key, None)
+
+
+class HollowKubelet:
+    """One hollow node agent (cmd/kubemark hollow-node)."""
+
+    def __init__(self, store, node: Node, clock=None,
+                 lease_duration: float = 40.0):
+        from ..utils.clock import Clock
+
+        self.store = store
+        self.node = node
+        self.node_name = node.meta.name
+        self.clock = clock or Clock()
+        self.lease_duration = lease_duration
+        self.runtime = FakeRuntime(self.clock)
+        self._watch = None
+
+    # -- registration + heartbeat -------------------------------------------
+
+    def register(self) -> None:
+        """kubelet registerWithAPIServer: create/refresh Node + first lease."""
+        existing = self.store.try_get("Node", self.node_name)
+        ready = NodeCondition(type="Ready", status="True")
+        self.node.status.conditions = [
+            c for c in self.node.status.conditions if c.type != "Ready"
+        ] + [ready]
+        if existing is None:
+            self.store.create(self.node)
+        else:
+            existing.status = self.node.status
+            self.store.update(existing, check_version=False)
+            self.node = existing
+        self.heartbeat()
+        self._watch = self.store.watch("Pod")
+
+    def heartbeat(self) -> None:
+        """NodeLease heartbeat (kubelet.go:1122-1128 fast path)."""
+        key = f"{LEASE_NAMESPACE}/{self.node_name}"
+        now = self.clock.now()
+        lease = self.store.try_get("Lease", key)
+        if lease is None:
+            self.store.create(Lease(
+                meta=ObjectMeta(name=self.node_name, namespace=LEASE_NAMESPACE),
+                spec=LeaseSpec(
+                    holder_identity=self.node_name,
+                    lease_duration_seconds=self.lease_duration,
+                    acquire_time=now, renew_time=now,
+                ),
+            ))
+            return
+        lease.spec.renew_time = now
+        try:
+            self.store.update(lease, check_version=False)
+        except (ConflictError, NotFoundError):
+            pass
+
+    # -- pod sync loop -------------------------------------------------------
+
+    def _my_pods(self):
+        return [p for p in self.store.pods() if p.spec.node_name == self.node_name]
+
+    def sync_once(self) -> int:
+        """One syncLoopIteration: converge every assigned pod; returns the
+        number of pods whose status changed."""
+        self.heartbeat()
+        if self._watch is not None:
+            self._watch.drain()  # consume; state is re-listed below
+        changed = 0
+        seen = set()
+        for pod in self._my_pods():
+            seen.add(pod.meta.key)
+            if pod.is_terminating:
+                # finalize: the runtime stops containers, then the API object
+                # goes away (kubelet's graceful deletion handshake)
+                self.runtime.kill_pod(pod.meta.key)
+                try:
+                    self.store.delete("Pod", pod.meta.key)
+                except NotFoundError:
+                    pass
+                changed += 1
+                continue
+            if pod.status.phase == PENDING:
+                self.runtime.start_pod(pod)
+                pod.status.phase = RUNNING
+                pod.status.start_time = self.clock.now()
+                ready = PodCondition(type="Ready", status="True")
+                pod.status.conditions = [
+                    c for c in pod.status.conditions if c.type != "Ready"
+                ] + [ready]
+                self._update_status(pod)
+                changed += 1
+            elif pod.status.phase == RUNNING and self.runtime.pod_finished(pod):
+                pod.status.phase = (
+                    SUCCEEDED if pod.spec.restart_policy != "Always" else RUNNING
+                )
+                if pod.status.phase == SUCCEEDED:
+                    self.runtime.kill_pod(pod.meta.key)
+                    self._update_status(pod)
+                    changed += 1
+        # reap runtime state for pods that vanished without deletion_timestamp
+        for key in list(self.runtime.containers):
+            if key not in seen:
+                self.runtime.kill_pod(key)
+        return changed
+
+    def _update_status(self, pod) -> None:
+        try:
+            self.store.update(pod, check_version=False)
+        except (ConflictError, NotFoundError):
+            pass
+
+    def run(self, stop_event: threading.Event, sync_period: float = 0.05) -> threading.Thread:
+        def loop():
+            while not stop_event.is_set():
+                self.sync_once()
+                stop_event.wait(sync_period)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+
+def start_hollow_nodes(store, n: int, clock=None, cpu: str = "32",
+                       mem: str = "64Gi", zones: int = 8) -> list[HollowKubelet]:
+    """kubemark cluster bootstrap: n hollow nodes registered and synced."""
+    from ..testing.wrappers import make_node
+
+    kubelets = []
+    for i in range(n):
+        node = make_node(f"hollow-node-{i}", cpu=cpu, mem=mem,
+                         zone=f"zone-{i % zones}")
+        k = HollowKubelet(store, node, clock=clock)
+        k.register()
+        kubelets.append(k)
+    return kubelets

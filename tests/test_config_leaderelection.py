@@ -1,0 +1,176 @@
+"""Tests: ComponentConfig loading/validation, feature gates, leader election,
+and the scheduler server's health/metrics endpoints."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.cmd.scheduler import SchedulerServer
+from kubernetes_tpu.config import SchedulerConfiguration, load_config
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.featuregate import FeatureGate
+from tests.wrappers import make_node, make_pod
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = SchedulerConfiguration()
+        assert cfg.parallelism == 16
+        assert cfg.validate() == []
+
+    def test_load_full_document(self):
+        cfg = load_config({
+            "apiVersion": "kubescheduler.config.tpu.io/v1",
+            "kind": "KubeSchedulerConfiguration",
+            "parallelism": 8,
+            "percentageOfNodesToScore": 50,
+            "featureGates": {"OpportunisticBatching": True},
+            "profiles": [
+                {"schedulerName": "default-scheduler", "backend": "tpu"},
+                {"schedulerName": "cpu-sched",
+                 "pluginConfig": [{"name": "NodeResourcesFit",
+                                   "args": {"strategy": "MostAllocated"}}]},
+            ],
+            "extenders": [
+                {"urlPrefix": "http://localhost:9999", "filterVerb": "filter",
+                 "ignorable": True},
+            ],
+            "leaderElection": {"leaderElect": True, "leaseDurationSeconds": 6,
+                               "renewDeadlineSeconds": 4},
+        })
+        assert cfg.parallelism == 8
+        assert cfg.profiles[0].backend == "tpu"
+        assert cfg.profiles[1].plugin_args["NodeResourcesFit"]["strategy"] == "MostAllocated"
+        assert cfg.extenders[0].ignorable
+        assert cfg.leader_election.leader_elect
+
+    def test_validation_rejects_bad_config(self):
+        with pytest.raises(ValueError, match="percentageOfNodesToScore"):
+            load_config({"percentageOfNodesToScore": 150})
+        with pytest.raises(ValueError, match="unique"):
+            load_config({"profiles": [{"schedulerName": "a"}, {"schedulerName": "a"}]})
+
+    def test_feature_gate_catalog(self):
+        g = FeatureGate()
+        assert g.enabled("DynamicResourceAllocation")
+        assert not g.enabled("OpportunisticBatching")
+        g.set_from_map({"OpportunisticBatching": True})
+        assert g.enabled("OpportunisticBatching")
+        with pytest.raises(KeyError):
+            g.set_from_map({"NoSuchGate": True})
+
+
+class TestLeaderElection:
+    def _elector(self, store, identity, clock, **kw):
+        return LeaderElector(
+            store=store, identity=identity, clock=clock,
+            lease_duration=15.0, renew_deadline=10.0, retry_period=2.0, **kw
+        )
+
+    def test_single_candidate_acquires(self):
+        store, clock = Store(), FakeClock()
+        e = self._elector(store, "a", clock)
+        assert e.run_once()
+        lease = store.get("Lease", "kube-system/kube-scheduler")
+        assert lease.spec.holder_identity == "a"
+
+    def test_second_candidate_waits_then_takes_over(self):
+        store, clock = Store(), FakeClock()
+        a = self._elector(store, "a", clock)
+        b = self._elector(store, "b", clock)
+        assert a.run_once()
+        assert not b.run_once()  # lease held and fresh
+        clock.step(16)  # past lease_duration without renewal
+        assert b.run_once()
+        lease = store.get("Lease", "kube-system/kube-scheduler")
+        assert lease.spec.holder_identity == "b"
+        assert lease.spec.lease_transitions == 1
+        # a notices it lost on its next tick
+        assert not a.run_once()
+        assert not a.is_leader()
+
+    def test_release_on_stop(self):
+        store, clock = Store(), FakeClock()
+        a = self._elector(store, "a", clock)
+        b = self._elector(store, "b", clock)
+        assert a.run_once()
+        a.release()
+        assert not a.is_leader()
+        assert b.run_once()  # released lease is free immediately
+
+    def test_callbacks(self):
+        store, clock = Store(), FakeClock()
+        events = []
+        a = self._elector(store, "a", clock,
+                          on_started_leading=lambda: events.append("started"),
+                          on_stopped_leading=lambda: events.append("stopped"),
+                          on_new_leader=lambda l: events.append(f"leader={l}"))
+        a.run_once()
+        a.release()
+        assert events == ["leader=a", "started", "stopped"]
+
+
+class TestSchedulerServer:
+    def test_endpoints_and_scheduling(self):
+        store = Store()
+        store.create(make_node("n1", cpu="8"))
+        cfg = SchedulerConfiguration()
+        server = SchedulerServer(store, cfg)
+        port = server.serve(0)
+        server.run(block=False)
+        try:
+            store.create(make_pod("p1", cpu="1"))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if store.get("Pod", "default/p1").spec.node_name:
+                    break
+                time.sleep(0.02)
+            assert store.get("Pod", "default/p1").spec.node_name == "n1"
+
+            def get(path):
+                with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+                    return r.status, r.read().decode()
+
+            assert get("/healthz") == (200, "ok")
+            assert get("/readyz")[0] == 200
+            code, metrics = get("/metrics")
+            assert code == 200 and "scheduler_schedule_attempts_total" in metrics
+            code, configz = get("/configz")
+            assert code == 200 and json.loads(configz)["parallelism"] == 16
+        finally:
+            server.shutdown()
+
+    def test_only_leader_schedules(self):
+        store = Store()
+        store.create(make_node("n1", cpu="8"))
+        cfg = SchedulerConfiguration()
+        cfg.leader_election.leader_elect = True
+        cfg.leader_election.retry_period = 0.05
+        cfg.leader_election.lease_duration = 1.0
+        cfg.leader_election.renew_deadline = 0.5
+        s1 = SchedulerServer(store, cfg, identity="s1")
+        s2 = SchedulerServer(store, cfg, identity="s2")
+        s1.serve(0)
+        s2.serve(0)
+        s1.run(block=False)
+        time.sleep(0.2)  # s1 acquires first
+        s2.run(block=False)
+        try:
+            time.sleep(0.3)
+            assert s1.elector.is_leader()
+            assert not s2.elector.is_leader()
+            store.create(make_pod("p1", cpu="1"))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if store.get("Pod", "default/p1").spec.node_name:
+                    break
+                time.sleep(0.02)
+            assert store.get("Pod", "default/p1").spec.node_name == "n1"
+        finally:
+            s1.shutdown()
+            s2.shutdown()

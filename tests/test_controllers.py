@@ -1,0 +1,255 @@
+"""Controller-manager + hollow-kubelet tests.
+
+Modeled on pkg/controller/*/..._test.go and the kubemark flow: controllers
+reconcile desired state, the scheduler binds, hollow kubelets run pods.
+"""
+
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import PodSpec, Container, RUNNING, SUCCEEDED
+from kubernetes_tpu.api.workloads import (
+    Deployment,
+    DeploymentSpec,
+    Job,
+    JobSpec,
+    PodTemplateSpec,
+    ReplicaSet,
+    ReplicaSetSpec,
+    Service,
+    ServiceSpec,
+)
+from kubernetes_tpu.controllers import (
+    ControllerManager,
+    default_controllers,
+)
+from kubernetes_tpu.kubelet import HollowKubelet, start_hollow_nodes
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.utils.clock import FakeClock
+from tests.wrappers import make_node, make_pod
+
+
+def template(labels=None, cpu="100m"):
+    return PodTemplateSpec(
+        labels=dict(labels or {"app": "x"}),
+        spec=PodSpec(containers=[Container(requests={"cpu": cpu})]),
+    )
+
+
+def converge(store, cm, scheduler=None, kubelets=(), rounds=8):
+    """Drive controllers + scheduler + kubelets to a fixed point."""
+    for _ in range(rounds):
+        n = cm.sync_once()
+        if scheduler is not None:
+            n += scheduler.schedule_pending()
+        for k in kubelets:
+            n += k.sync_once()
+        if n == 0:
+            break
+
+
+class TestReplicaSet:
+    def test_scales_up_and_down(self):
+        store = Store()
+        cm = ControllerManager(store, default_controllers(store))
+        rs = ReplicaSet(
+            meta=ObjectMeta(name="web"),
+            spec=ReplicaSetSpec(replicas=3, template=template()),
+        )
+        store.create(rs)
+        converge(store, cm)
+        pods = [p for p in store.pods() if p.meta.labels.get("app") == "x"]
+        assert len(pods) == 3
+        assert all(r.controller for p in pods for r in p.meta.owner_references)
+        cur = store.get("ReplicaSet", "default/web")
+        cur.spec.replicas = 1
+        store.update(cur, check_version=False)
+        converge(store, cm)
+        assert len([p for p in store.pods()]) == 1
+
+    def test_replaces_deleted_pod(self):
+        store = Store()
+        cm = ControllerManager(store, default_controllers(store))
+        store.create(ReplicaSet(
+            meta=ObjectMeta(name="web"),
+            spec=ReplicaSetSpec(replicas=2, template=template()),
+        ))
+        converge(store, cm)
+        victim = store.pods()[0]
+        store.delete("Pod", victim.meta.key)
+        converge(store, cm)
+        assert len(store.pods()) == 2
+
+
+class TestDeployment:
+    def test_creates_replicaset_and_rolls_template(self):
+        store = Store()
+        cm = ControllerManager(store, default_controllers(store))
+        dep = Deployment(
+            meta=ObjectMeta(name="api"),
+            spec=DeploymentSpec(replicas=2, template=template(cpu="100m")),
+        )
+        store.create(dep)
+        converge(store, cm)
+        rsets = list(store.iter_kind("ReplicaSet"))
+        assert len(rsets) == 1 and rsets[0].spec.replicas == 2
+        assert len(store.pods()) == 2
+        old_rs_name = rsets[0].meta.name
+        # template change -> new RS, old scaled to 0, orphan pods GC'd
+        cur = store.get("Deployment", "default/api")
+        cur.spec.template = template(cpu="200m")
+        store.update(cur, check_version=False)
+        converge(store, cm, rounds=12)
+        rsets = {rs.meta.name: rs for rs in store.iter_kind("ReplicaSet")}
+        assert len(rsets) == 2
+        assert rsets[old_rs_name].spec.replicas == 0
+        pods = store.pods()
+        assert len(pods) == 2
+        assert all(
+            str(p.spec.containers[0].requests["cpu"]) == "200m" for p in pods
+        )
+
+
+class TestJob:
+    def test_job_completes_via_kubelet(self):
+        clock = FakeClock()
+        store = Store()
+        cm = ControllerManager(store, default_controllers(store, clock=clock))
+        store.create(make_node("n1", cpu="8"))
+        s = Scheduler(store)
+        s.start()
+        kubelet = HollowKubelet(store, store.get("Node", "n1"), clock=clock)
+        kubelet.register()
+        tpl = template({"job": "batch"})
+        tpl.spec.containers[0].requests = {"cpu": "100m"}
+        job = Job(meta=ObjectMeta(name="batch"),
+                  spec=JobSpec(completions=3, parallelism=2, template=tpl))
+        store.create(job)
+        # annotate run duration so the fake runtime finishes pods
+        for _ in range(14):
+            cm.sync_once()
+            for p in store.pods():
+                if "kubemark.io/run-seconds" not in p.meta.annotations:
+                    p.meta.annotations["kubemark.io/run-seconds"] = "1"
+                    store.update(p, check_version=False)
+            s.schedule_pending()
+            kubelet.sync_once()
+            clock.step(2)  # containers finish
+            if store.get("Job", "default/batch").status.completed:
+                break
+        job = store.get("Job", "default/batch")
+        assert job.status.completed
+        assert job.status.succeeded >= 3
+
+
+class TestGarbageCollector:
+    def test_cascade_delete(self):
+        store = Store()
+        cm = ControllerManager(store, default_controllers(store))
+        store.create(ReplicaSet(
+            meta=ObjectMeta(name="web"),
+            spec=ReplicaSetSpec(replicas=2, template=template()),
+        ))
+        converge(store, cm)
+        assert len(store.pods()) == 2
+        store.delete("ReplicaSet", "default/web")
+        converge(store, cm)
+        gc = next(c for c in cm.controllers if c.name == "garbage-collector")
+        gc.sweep()
+        converge(store, cm)
+        assert store.pods() == []
+
+
+class TestNodeLifecycle:
+    def test_stale_lease_taints_and_evicts(self):
+        clock = FakeClock()
+        store = Store()
+        controllers = default_controllers(store, clock=clock)
+        nlc = next(c for c in controllers if c.name == "node-lifecycle")
+        cm = ControllerManager(store, controllers)
+        kubelets = start_hollow_nodes(store, 2, clock=clock)
+        s = Scheduler(store)
+        s.start()
+        # controller-owned pod: eviction deletes it, the RS recreates it
+        # (a bare pod would be gone for good — same as the reference)
+        store.create(ReplicaSet(
+            meta=ObjectMeta(name="web"),
+            spec=ReplicaSetSpec(replicas=1, template=template()),
+        ))
+        converge(store, cm, s, kubelets)
+        pod = store.pods()[0]
+        assert pod.spec.node_name and pod.status.phase == RUNNING
+        victim_node = pod.spec.node_name
+        # the node's kubelet dies: lease goes stale
+        dead = next(k for k in kubelets if k.node_name == victim_node)
+        kubelets = [k for k in kubelets if k is not dead]
+        clock.step(60)
+        for k in kubelets:
+            k.sync_once()  # survivors heartbeat
+        nlc.sweep()
+        converge(store, cm, s, kubelets)
+        node = store.get("Node", victim_node)
+        assert any(t.key == "node.kubernetes.io/unreachable" for t in node.spec.taints)
+        ready = next(c for c in node.status.conditions if c.type == "Ready")
+        assert ready.status == "Unknown"
+        # pod evicted and rescheduled onto the surviving node
+        pods = store.pods()
+        assert pods and all(p.spec.node_name != victim_node for p in pods)
+
+
+class TestEndpointSlice:
+    def test_slice_tracks_running_pods(self):
+        store = Store()
+        clock = FakeClock()
+        cm = ControllerManager(store, default_controllers(store, clock=clock))
+        kubelets = start_hollow_nodes(store, 1, clock=clock)
+        s = Scheduler(store)
+        s.start()
+        store.create(Service(
+            meta=ObjectMeta(name="svc"),
+            spec=ServiceSpec(selector={"app": "x"}),
+        ))
+        store.create(make_pod("p1", cpu="1", labels={"app": "x"}))
+        store.create(make_pod("other", cpu="1", labels={"app": "y"}))
+        converge(store, cm, s, kubelets)
+        es = store.get("EndpointSlice", "default/svc-endpoints")
+        assert len(es.endpoints) == 1
+        assert es.endpoints[0].target_pod == "default/p1"
+        assert es.endpoints[0].ready
+
+
+class TestResourceClaimCleanup:
+    def test_claim_released_when_pod_deleted(self):
+        from kubernetes_tpu.api.dra import (
+            Device,
+            DeviceRequest,
+            PodResourceClaim,
+            ResourceClaim,
+            ResourceClaimSpec,
+            ResourceSlice,
+        )
+
+        store = Store()
+        cm = ControllerManager(store, default_controllers(store))
+        store.create(make_node("n1"))
+        store.create(ResourceSlice(
+            meta=ObjectMeta(name="sl", namespace=""), node_name="n1",
+            driver="d", devices=(Device(name="d0"),),
+        ))
+        store.create(ResourceClaim(
+            meta=ObjectMeta(name="c"),
+            spec=ResourceClaimSpec(requests=(DeviceRequest(name="r"),)),
+        ))
+        pod = make_pod("p1", cpu="1")
+        pod.spec.resource_claims = (PodResourceClaim(name="c", resource_claim_name="c"),)
+        store.create(pod)
+        s = Scheduler(store)
+        s.start()
+        s.schedule_pending()
+        claim = store.get("ResourceClaim", "default/c")
+        assert claim.is_allocated and claim.status.reserved_for
+        store.delete("Pod", "default/p1")
+        converge(store, cm)
+        claim = store.get("ResourceClaim", "default/c")
+        assert claim.status.reserved_for == ()
+        assert claim.status.allocation is None  # deallocated for reuse
