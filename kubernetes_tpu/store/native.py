@@ -1,0 +1,331 @@
+"""Native store: the C++ etcd-equivalent L0 engine behind the Store surface.
+
+Reference: the reference's L0 is etcd (a native external process) behind
+storage.Interface (staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go).
+Here the native engine (native/store_core.cpp) is linked in-process via
+ctypes: revisioned KV + gap-free event log + CAS + compaction + durable
+snapshot save/load (checkpoint/resume — §5.4 "etcd IS the checkpoint").
+
+NativeStore implements the same surface as store.Store, so every component
+(apiserver, informers, scheduler, controllers) runs on it unchanged. Objects
+cross the boundary as JSON (api/serialization wire form).
+"""
+
+from __future__ import annotations
+
+import copy
+import ctypes
+import json
+import struct
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+from ..api.meta import new_uid
+from ..api.serialization import decode, encode
+from .store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExistsError,
+    ConflictError,
+    Event,
+    NotFoundError,
+    Watch,
+)
+
+SC_ERR_NOT_FOUND = -1
+SC_ERR_ALREADY_EXISTS = -2
+SC_ERR_CONFLICT = -3
+_EVENT_TYPES = {0: ADDED, 1: MODIFIED, 2: DELETED}
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libstore_core.so"
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_library() -> Path:
+    subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR)], check=True, capture_output=True
+    )
+    return _LIB_PATH
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building on first use) the native core; raises OSError if the
+    toolchain is unavailable — callers fall back to the Python store."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not _LIB_PATH.exists():
+            _build_library()
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.sc_new.restype = ctypes.c_void_p
+        lib.sc_free.argtypes = [ctypes.c_void_p]
+        lib.sc_buf_free.argtypes = [ctypes.c_char_p]
+        lib.sc_revision.argtypes = [ctypes.c_void_p]
+        lib.sc_revision.restype = ctypes.c_int64
+        lib.sc_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint32, ctypes.c_int64, ctypes.c_int, ctypes.c_double,
+        ]
+        lib.sc_put.restype = ctypes.c_int64
+        lib.sc_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.sc_get.restype = ctypes.c_int64
+        lib.sc_delete.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_double,
+        ]
+        lib.sc_delete.restype = ctypes.c_int64
+        lib.sc_list.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.sc_list.restype = ctypes.c_int64
+        lib.sc_log_since.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.sc_log_since.restype = ctypes.c_int64
+        lib.sc_compact.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sc_compact.restype = ctypes.c_int64
+        lib.sc_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.sc_save.restype = ctypes.c_int64
+        lib.sc_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.sc_load.restype = ctypes.c_int64
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        load_library()
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+class _Buf:
+    """Scoped out-buffer: copies to bytes, frees the malloc'd native side."""
+
+    def __init__(self, lib):
+        self.lib = lib
+        self.ptr = ctypes.c_void_p()
+        self.size = ctypes.c_size_t()
+
+    def __enter__(self):
+        return self
+
+    def take(self) -> bytes:
+        if not self.ptr:
+            return b""
+        return ctypes.string_at(self.ptr, self.size.value)
+
+    def __exit__(self, *exc):
+        if self.ptr:
+            self.lib.sc_buf_free(ctypes.cast(self.ptr, ctypes.c_char_p))
+
+
+class NativeStore:
+    """Store-compatible facade over the native core."""
+
+    def __init__(self, clock=time.time):
+        self.lib = load_library()
+        self.h = self.lib.sc_new()
+        self._clock = clock
+        self._mu = threading.RLock()
+        self._watches: dict[str, list[Watch]] = {}
+
+    def __del__(self):
+        h, self.h = getattr(self, "h", None), None
+        if h and getattr(self, "lib", None) is not None:
+            self.lib.sc_free(h)
+
+    # -- serialization boundary ---------------------------------------------
+
+    @staticmethod
+    def _to_bytes(obj) -> bytes:
+        return json.dumps(encode(obj), separators=(",", ":")).encode()
+
+    @staticmethod
+    def _from_bytes(raw: bytes):
+        return decode(json.loads(raw))
+
+    # -- CRUD ----------------------------------------------------------------
+
+    def create(self, obj):
+        with self._mu:
+            obj = copy.deepcopy(obj)
+            if not obj.meta.uid:
+                obj.meta.uid = new_uid()
+            if not obj.meta.creation_timestamp:
+                obj.meta.creation_timestamp = self._clock()
+            kind, key = obj.kind, obj.meta.key
+            # two-phase: stamp the revision the put will get (serialized
+            # under _mu, so the next revision is deterministic)
+            obj.meta.resource_version = self.lib.sc_revision(self.h) + 1
+            raw = self._to_bytes(obj)
+            ts = time.perf_counter()
+            rev = self.lib.sc_put(self.h, kind.encode(), key.encode(), raw,
+                                  len(raw), -1, 1, ts)
+            if rev == SC_ERR_ALREADY_EXISTS:
+                raise AlreadyExistsError(f"{kind} {key}")
+            self._emit(kind, Event(ADDED, obj, rev, ts))
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, key: str):
+        with _Buf(self.lib) as buf:
+            rev = self.lib.sc_get(self.h, kind.encode(), key.encode(),
+                                  ctypes.byref(buf.ptr), ctypes.byref(buf.size))
+            if rev == SC_ERR_NOT_FOUND:
+                raise NotFoundError(f"{kind} {key}")
+            return self._from_bytes(buf.take())
+
+    def try_get(self, kind: str, key: str):
+        try:
+            return self.get(kind, key)
+        except NotFoundError:
+            return None
+
+    def update(self, obj, *, check_version: bool = True):
+        with self._mu:
+            kind, key = obj.kind, obj.meta.key
+            cur = self.try_get(kind, key)
+            if cur is None:
+                raise NotFoundError(f"{kind} {key}")
+            if check_version and obj.meta.resource_version != cur.meta.resource_version:
+                raise ConflictError(
+                    f"{kind} {key}: rv {obj.meta.resource_version} != "
+                    f"{cur.meta.resource_version}"
+                )
+            obj = copy.deepcopy(obj)
+            obj.meta.uid = cur.meta.uid
+            obj.meta.creation_timestamp = cur.meta.creation_timestamp
+            expected = cur.meta.resource_version if check_version else -1
+            obj.meta.resource_version = self.lib.sc_revision(self.h) + 1
+            raw = self._to_bytes(obj)
+            ts = time.perf_counter()
+            rev = self.lib.sc_put(self.h, kind.encode(), key.encode(), raw,
+                                  len(raw), expected, 0, ts)
+            if rev == SC_ERR_NOT_FOUND:
+                raise NotFoundError(f"{kind} {key}")
+            if rev == SC_ERR_CONFLICT:
+                raise ConflictError(f"{kind} {key}")
+            self._emit(kind, Event(MODIFIED, obj, rev, ts))
+            return copy.deepcopy(obj)
+
+    def delete(self, kind: str, key: str):
+        with self._mu:
+            ts = time.perf_counter()
+            with _Buf(self.lib) as buf:
+                rev = self.lib.sc_delete(self.h, kind.encode(), key.encode(),
+                                         ctypes.byref(buf.ptr),
+                                         ctypes.byref(buf.size), ts)
+                if rev == SC_ERR_NOT_FOUND:
+                    raise NotFoundError(f"{kind} {key}")
+                obj = self._from_bytes(buf.take())
+            obj.meta.resource_version = rev
+            self._emit(kind, Event(DELETED, obj, rev, ts))
+            return obj
+
+    def list(self, kind: str):
+        with _Buf(self.lib) as buf:
+            rev = self.lib.sc_list(self.h, kind.encode(), ctypes.byref(buf.ptr),
+                                   ctypes.byref(buf.size))
+            raw = buf.take()
+        out = []
+        off = 0
+        while off < len(raw):
+            (key_len,) = struct.unpack_from("<I", raw, off)
+            off += 4 + key_len
+            (val_len,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            out.append(self._from_bytes(raw[off:off + val_len]))
+            off += val_len
+        return out, rev
+
+    @property
+    def revision(self) -> int:
+        return self.lib.sc_revision(self.h)
+
+    # -- watch ---------------------------------------------------------------
+
+    def _emit(self, kind: str, ev: Event) -> None:
+        for w in self._watches.get(kind, []):
+            w._push(ev)
+
+    def _remove_watch(self, kind: str, w: Watch) -> None:
+        with self._mu:
+            ws = self._watches.get(kind)
+            if ws and w in ws:
+                ws.remove(w)
+
+    def watch(self, kind: str, from_revision: int = 0) -> Watch:
+        """Replay from the NATIVE log (survives beyond the Python process's
+        watch lifetimes), then live-push. If compaction dropped events this
+        watch needed (sc_log_since returns -1), fall back to relist
+        semantics: synthesize ADDED for the current state — exactly the
+        reflector's resync-on-"too old resource version"."""
+        with self._mu:
+            w = Watch(self, kind)
+            with _Buf(self.lib) as buf:
+                n = self.lib.sc_log_since(self.h, kind.encode(), from_revision,
+                                          ctypes.byref(buf.ptr),
+                                          ctypes.byref(buf.size))
+                raw = buf.take()
+            if n < 0:
+                now = time.perf_counter()
+                objs, rev = self.list(kind)
+                for obj in objs:
+                    w._push(Event(ADDED, obj, rev, now))
+            else:
+                off = 0
+                while off < len(raw):
+                    etype = raw[off]
+                    off += 1
+                    (rev,) = struct.unpack_from("<q", raw, off)
+                    off += 8
+                    (ts,) = struct.unpack_from("<d", raw, off)
+                    off += 8
+                    (key_len,) = struct.unpack_from("<I", raw, off)
+                    off += 4 + key_len
+                    (val_len,) = struct.unpack_from("<I", raw, off)
+                    off += 4
+                    obj = self._from_bytes(raw[off:off + val_len])
+                    off += val_len
+                    w._push(Event(_EVENT_TYPES[etype], obj, rev, ts))
+            self._watches.setdefault(kind, []).append(w)
+            return w
+
+    # -- durability (checkpoint/resume) --------------------------------------
+
+    def save(self, path: str) -> None:
+        rc = self.lib.sc_save(self.h, str(path).encode())
+        if rc != 0:
+            raise OSError(f"native store save failed ({rc})")
+
+    def load(self, path: str) -> None:
+        rc = self.lib.sc_load(self.h, str(path).encode())
+        if rc != 0:
+            raise OSError(f"native store load failed ({rc})")
+
+    def compact(self, revision: int) -> int:
+        return int(self.lib.sc_compact(self.h, revision))
+
+    # -- convenience parity with Store ---------------------------------------
+
+    def pods(self):
+        return self.list("Pod")[0]
+
+    def nodes(self):
+        return self.list("Node")[0]
+
+    def iter_kind(self, kind: str):
+        return iter(self.list(kind)[0])

@@ -1,0 +1,321 @@
+// Native store core: the etcd-equivalent L0 storage engine.
+//
+// Reference role: etcd + staging/src/k8s.io/apiserver/pkg/storage/etcd3/
+// (store.go, watcher via event.go, compact.go). The reference's L0 is a
+// native (Go) external process; this is the TPU framework's native
+// equivalent, linked in-process: a revisioned KV map with a gap-free event
+// log (watch cache), CAS updates, compaction, and durable snapshot
+// save/load (checkpoint/resume, SURVEY.md §5.4 — "etcd IS the checkpoint").
+//
+// C ABI for ctypes. All out-buffers are malloc'd and must be released with
+// sc_buf_free. Values are opaque bytes (the Python layer stores JSON).
+//
+// Wire framing for lists/logs (little-endian):
+//   list:  repeat { u32 key_len, key, u32 val_len, val }
+//   log:   repeat { u8 type, i64 rev, f64 ts, u32 key_len, key, u32 val_len, val }
+//     type: 0=ADDED 1=MODIFIED 2=DELETED
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Entry {
+  std::string value;
+  int64_t mod_rev = 0;
+  int64_t create_rev = 0;
+};
+
+struct LogEvent {
+  uint8_t type;  // 0 add, 1 modify, 2 delete
+  int64_t rev;
+  double ts;  // caller-supplied write timestamp (Python time.perf_counter)
+  std::string key;
+  std::string value;
+};
+
+struct Core {
+  std::mutex mu;
+  int64_t revision = 0;
+  // kind -> key -> entry
+  std::map<std::string, std::map<std::string, Entry>> objects;
+  // kind -> event log (ascending revisions)
+  std::map<std::string, std::deque<LogEvent>> logs;
+  // kind -> highest revision dropped from that kind's log (compaction or
+  // cap-trimming); watches from below this horizon must relist
+  std::map<std::string, int64_t> compacted;
+  size_t log_cap = 200000;
+};
+
+void append_u32(std::string& buf, uint32_t v) {
+  buf.append(reinterpret_cast<const char*>(&v), sizeof(v));
+}
+void append_i64(std::string& buf, int64_t v) {
+  buf.append(reinterpret_cast<const char*>(&v), sizeof(v));
+}
+void append_f64(std::string& buf, double v) {
+  buf.append(reinterpret_cast<const char*>(&v), sizeof(v));
+}
+
+char* out_copy(const std::string& s, size_t* out_len) {
+  char* p = static_cast<char*>(std::malloc(s.size() ? s.size() : 1));
+  std::memcpy(p, s.data(), s.size());
+  *out_len = s.size();
+  return p;
+}
+
+void log_emit(Core* c, const std::string& kind, uint8_t type, int64_t rev,
+              double ts, const std::string& key, const std::string& value) {
+  auto& log = c->logs[kind];
+  log.push_back(LogEvent{type, rev, ts, key, value});
+  if (log.size() > c->log_cap) {
+    for (size_t i = 0; i < c->log_cap / 2; ++i) {
+      c->compacted[kind] = log.front().rev;
+      log.pop_front();
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// error codes (negative returns)
+enum {
+  SC_OK = 0,
+  SC_ERR_NOT_FOUND = -1,
+  SC_ERR_ALREADY_EXISTS = -2,
+  SC_ERR_CONFLICT = -3,
+  SC_ERR_IO = -4,
+};
+
+void* sc_new() { return new Core(); }
+
+void sc_free(void* h) { delete static_cast<Core*>(h); }
+
+void sc_buf_free(char* p) { std::free(p); }
+
+int64_t sc_revision(void* h) {
+  Core* c = static_cast<Core*>(h);
+  std::lock_guard<std::mutex> lock(c->mu);
+  return c->revision;
+}
+
+// Returns new revision (>0) or error code. expected_rev: -1 = no CAS check.
+// is_create: 1 -> fail if key exists; 0 -> fail if key missing.
+int64_t sc_put(void* h, const char* kind, const char* key, const char* val,
+               uint32_t val_len, int64_t expected_rev, int is_create,
+               double ts) {
+  Core* c = static_cast<Core*>(h);
+  std::lock_guard<std::mutex> lock(c->mu);
+  auto& objs = c->objects[kind];
+  auto it = objs.find(key);
+  if (is_create) {
+    if (it != objs.end()) return SC_ERR_ALREADY_EXISTS;
+  } else {
+    if (it == objs.end()) return SC_ERR_NOT_FOUND;
+    if (expected_rev >= 0 && it->second.mod_rev != expected_rev)
+      return SC_ERR_CONFLICT;
+  }
+  int64_t rev = ++c->revision;
+  Entry& e = objs[key];
+  e.value.assign(val, val_len);
+  e.mod_rev = rev;
+  if (is_create) e.create_rev = rev;
+  log_emit(c, kind, is_create ? 0 : 1, rev, ts, key, e.value);
+  return rev;
+}
+
+// Returns mod revision (>0) or SC_ERR_NOT_FOUND. *out malloc'd.
+int64_t sc_get(void* h, const char* kind, const char* key, char** out,
+               size_t* out_len) {
+  Core* c = static_cast<Core*>(h);
+  std::lock_guard<std::mutex> lock(c->mu);
+  auto kit = c->objects.find(kind);
+  if (kit == c->objects.end()) return SC_ERR_NOT_FOUND;
+  auto it = kit->second.find(key);
+  if (it == kit->second.end()) return SC_ERR_NOT_FOUND;
+  *out = out_copy(it->second.value, out_len);
+  return it->second.mod_rev;
+}
+
+// Returns deletion revision or SC_ERR_NOT_FOUND; *out = last value.
+int64_t sc_delete(void* h, const char* kind, const char* key, char** out,
+                  size_t* out_len, double ts) {
+  Core* c = static_cast<Core*>(h);
+  std::lock_guard<std::mutex> lock(c->mu);
+  auto kit = c->objects.find(kind);
+  if (kit == c->objects.end()) return SC_ERR_NOT_FOUND;
+  auto it = kit->second.find(key);
+  if (it == kit->second.end()) return SC_ERR_NOT_FOUND;
+  int64_t rev = ++c->revision;
+  std::string value = std::move(it->second.value);
+  kit->second.erase(it);
+  log_emit(c, kind, 2, rev, ts, key, value);
+  *out = out_copy(value, out_len);
+  return rev;
+}
+
+// Returns store revision; *out = framed (key, value) pairs in key order.
+int64_t sc_list(void* h, const char* kind, char** out, size_t* out_len) {
+  Core* c = static_cast<Core*>(h);
+  std::lock_guard<std::mutex> lock(c->mu);
+  std::string buf;
+  auto kit = c->objects.find(kind);
+  if (kit != c->objects.end()) {
+    for (const auto& [key, entry] : kit->second) {
+      append_u32(buf, static_cast<uint32_t>(key.size()));
+      buf += key;
+      append_u32(buf, static_cast<uint32_t>(entry.value.size()));
+      buf += entry.value;
+    }
+  }
+  *out = out_copy(buf, out_len);
+  return c->revision;
+}
+
+// Events with revision > from_rev. Returns count; -1 if compaction dropped
+// events this watch would have needed (from_rev below the kind's horizon —
+// revisions are store-global, so only the per-kind compaction marker can
+// prove a gap; a sparse log alone cannot).
+int64_t sc_log_since(void* h, const char* kind, int64_t from_rev, char** out,
+                     size_t* out_len) {
+  Core* c = static_cast<Core*>(h);
+  std::lock_guard<std::mutex> lock(c->mu);
+  auto cit = c->compacted.find(kind);
+  if (cit != c->compacted.end() && from_rev < cit->second) {
+    *out = out_copy("", out_len);
+    return -1;
+  }
+  std::string buf;
+  int64_t n = 0;
+  auto lit = c->logs.find(kind);
+  if (lit != c->logs.end()) {
+    for (const auto& ev : lit->second) {
+      if (ev.rev <= from_rev) continue;
+      buf.push_back(static_cast<char>(ev.type));
+      append_i64(buf, ev.rev);
+      append_f64(buf, ev.ts);
+      append_u32(buf, static_cast<uint32_t>(ev.key.size()));
+      buf += ev.key;
+      append_u32(buf, static_cast<uint32_t>(ev.value.size()));
+      buf += ev.value;
+      ++n;
+    }
+  }
+  *out = out_copy(buf, out_len);
+  return n;
+}
+
+// Drop log events with revision <= rev (etcd compaction).
+int64_t sc_compact(void* h, int64_t rev) {
+  Core* c = static_cast<Core*>(h);
+  std::lock_guard<std::mutex> lock(c->mu);
+  int64_t dropped = 0;
+  for (auto& [kind, log] : c->logs) {
+    while (!log.empty() && log.front().rev <= rev) {
+      c->compacted[kind] = log.front().rev;
+      log.pop_front();
+      ++dropped;
+    }
+  }
+  return dropped;
+}
+
+// Durable snapshot: revision + all entries (log is not persisted — watches
+// relist after restore, which is exactly the reference's resync-on-compact).
+int64_t sc_save(void* h, const char* path) {
+  Core* c = static_cast<Core*>(h);
+  std::lock_guard<std::mutex> lock(c->mu);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return SC_ERR_IO;
+  std::string buf;
+  buf += "SCK1";
+  append_i64(buf, c->revision);
+  for (const auto& [kind, objs] : c->objects) {
+    for (const auto& [key, e] : objs) {
+      append_u32(buf, static_cast<uint32_t>(kind.size()));
+      buf += kind;
+      append_u32(buf, static_cast<uint32_t>(key.size()));
+      buf += key;
+      append_i64(buf, e.mod_rev);
+      append_i64(buf, e.create_rev);
+      append_u32(buf, static_cast<uint32_t>(e.value.size()));
+      buf += e.value;
+    }
+  }
+  size_t written = std::fwrite(buf.data(), 1, buf.size(), f);
+  std::fclose(f);
+  return written == buf.size() ? SC_OK : SC_ERR_IO;
+}
+
+int64_t sc_load(void* h, const char* path) {
+  Core* c = static_cast<Core*>(h);
+  std::lock_guard<std::mutex> lock(c->mu);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return SC_ERR_IO;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  if (size < 0) { std::fclose(f); return SC_ERR_IO; }
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(size), '\0');
+  if (std::fread(buf.data(), 1, buf.size(), f) != buf.size()) {
+    std::fclose(f);
+    return SC_ERR_IO;
+  }
+  std::fclose(f);
+  if (size < 0 || buf.size() < 12 || buf.compare(0, 4, "SCK1") != 0)
+    return SC_ERR_IO;
+  size_t off = 4;
+  bool bad = false;
+  // every read bounds-checks: a truncated/corrupt checkpoint must yield
+  // SC_ERR_IO, never an OOB read or a C++ exception crossing the C ABI
+  auto read_u32 = [&](uint32_t* v) {
+    if (off + 4 > buf.size()) { bad = true; *v = 0; return; }
+    std::memcpy(v, buf.data() + off, 4);
+    off += 4;
+  };
+  auto read_i64 = [&](int64_t* v) {
+    if (off + 8 > buf.size()) { bad = true; *v = 0; return; }
+    std::memcpy(v, buf.data() + off, 8);
+    off += 8;
+  };
+  auto read_str = [&](std::string* s_out, uint32_t len) {
+    if (bad || off + len > buf.size()) { bad = true; return; }
+    s_out->assign(buf, off, len);
+    off += len;
+  };
+  int64_t revision = 0;
+  read_i64(&revision);
+  std::map<std::string, std::map<std::string, Entry>> objects;
+  while (!bad && off < buf.size()) {
+    uint32_t kind_len = 0, key_len = 0, val_len = 0;
+    std::string kind, key;
+    Entry e;
+    read_u32(&kind_len);
+    read_str(&kind, kind_len);
+    read_u32(&key_len);
+    read_str(&key, key_len);
+    read_i64(&e.mod_rev);
+    read_i64(&e.create_rev);
+    read_u32(&val_len);
+    read_str(&e.value, val_len);
+    if (bad) break;
+    objects[kind][key] = std::move(e);
+  }
+  if (bad) return SC_ERR_IO;
+  c->revision = revision;
+  c->objects = std::move(objects);
+  c->logs.clear();
+  c->compacted.clear();
+  return SC_OK;
+}
+
+}  // extern "C"

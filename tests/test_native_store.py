@@ -1,0 +1,142 @@
+"""Native (C++) store core tests: parity with the Python store, plus the
+native-only capabilities (durable checkpoint, compaction)."""
+
+import pytest
+
+from kubernetes_tpu.store.native import NativeStore, native_available
+from kubernetes_tpu.store.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+from tests.wrappers import make_node, make_pod
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+class TestParity:
+    def test_crud_and_versions(self):
+        s = NativeStore()
+        created = s.create(make_pod("p1", cpu="1"))
+        assert created.meta.resource_version == 1
+        assert created.meta.uid
+        got = s.get("Pod", "default/p1")
+        assert str(got.spec.containers[0].requests["cpu"]) == "1"
+        got.spec.node_name = "n1"
+        updated = s.update(got)
+        assert updated.meta.resource_version == 2
+        with pytest.raises(ConflictError):
+            s.update(got)  # stale rv
+        with pytest.raises(AlreadyExistsError):
+            s.create(make_pod("p1"))
+        deleted = s.delete("Pod", "default/p1")
+        assert deleted.spec.node_name == "n1"
+        with pytest.raises(NotFoundError):
+            s.get("Pod", "default/p1")
+
+    def test_list_and_watch_replay(self):
+        s = NativeStore()
+        s.create(make_pod("a"))
+        pods, rev = s.list("Pod")
+        assert len(pods) == 1 and rev == 1
+        # watch from rev: only later events replayed — gap-free ListAndWatch
+        w = s.watch("Pod", from_revision=rev)
+        s.create(make_pod("b"))
+        pod = s.get("Pod", "default/b")
+        pod.spec.node_name = "n1"
+        s.update(pod)
+        s.delete("Pod", "default/a")
+        events = w.drain()
+        assert [e.type for e in events] == [ADDED, MODIFIED, DELETED]
+        assert events[1].obj.spec.node_name == "n1"
+        # watch from 0 replays everything from the native log
+        w0 = s.watch("Pod", from_revision=0)
+        assert len(w0.drain()) == 4
+
+    def test_full_stack_on_native_store(self):
+        """Scheduler + controllers run unchanged on the native engine."""
+        from kubernetes_tpu.controllers import ControllerManager, default_controllers
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.workloads import (
+            PodTemplateSpec,
+            ReplicaSet,
+            ReplicaSetSpec,
+        )
+        from kubernetes_tpu.api.types import Container, PodSpec
+        from kubernetes_tpu.kubelet import start_hollow_nodes
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        s = NativeStore()
+        cm = ControllerManager(s, default_controllers(s, clock=clock))
+        sched = Scheduler(s)
+        sched.start()
+        kubelets = start_hollow_nodes(s, 2, clock=clock)
+        s.create(ReplicaSet(
+            meta=ObjectMeta(name="web"),
+            spec=ReplicaSetSpec(replicas=4, template=PodTemplateSpec(
+                labels={"app": "x"},
+                spec=PodSpec(containers=[Container(requests={"cpu": "500m"})]),
+            )),
+        ))
+        for _ in range(8):
+            n = cm.sync_once() + sched.schedule_pending()
+            n += sum(k.sync_once() for k in kubelets)
+            if n == 0:
+                break
+        pods = s.pods()
+        assert len(pods) == 4
+        assert all(p.spec.node_name and p.status.phase == "Running" for p in pods)
+
+
+class TestNativeOnly:
+    def test_checkpoint_resume(self, tmp_path):
+        s = NativeStore()
+        s.create(make_node("n1"))
+        s.create(make_pod("p1", cpu="2"))
+        pod = s.get("Pod", "default/p1")
+        pod.spec.node_name = "n1"
+        s.update(pod)
+        path = tmp_path / "store.ckpt"
+        s.save(str(path))
+        # a fresh process restores the full control-plane state
+        s2 = NativeStore()
+        s2.load(str(path))
+        assert s2.revision == s.revision
+        restored = s2.get("Pod", "default/p1")
+        assert restored.spec.node_name == "n1"
+        assert restored.meta.resource_version == pod.meta.resource_version + 1
+        assert len(s2.nodes()) == 1
+
+    def test_compaction(self):
+        s = NativeStore()
+        for i in range(10):
+            s.create(make_pod(f"p{i}"))
+        dropped = s.compact(5)
+        assert dropped == 5
+        # watch below the horizon returns the remaining tail only
+        w = s.watch("Pod", from_revision=5)
+        assert len(w.drain()) == 5
+
+    def test_throughput_vs_python(self):
+        """Micro-bench sanity: the native core sustains control-plane write
+        rates (correctness bar, not a race with the zero-serialization
+        Python dict store)."""
+        import time
+
+        s = NativeStore()
+        pod = make_pod("warm")
+        n = 300
+        t0 = time.perf_counter()
+        for i in range(n):
+            p = make_pod(f"p{i}", cpu="1")
+            s.create(p)
+        dt = time.perf_counter() - t0
+        ops = n / dt
+        assert ops > 500, f"native store too slow: {ops:.0f} creates/s"
