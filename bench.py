@@ -45,7 +45,7 @@ def main() -> None:
     planes = backend.sync(snapshot)
     feats = stack_features([backend.extractor.features(p, planes) for p in pods])
     dev_planes = backend.device_inputs(planes)
-    cfg = backend.kernel_config(planes)
+    cfg = backend.kernel_config(planes, feats)
 
     import jax
 

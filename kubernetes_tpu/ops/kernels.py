@@ -42,8 +42,12 @@ FILTER_NAMES = (
     "NodePorts", "NodeResourcesFit",
 )
 
-_IMG_MIN = 23 * 1024 * 1024             # image_locality.go:34
-_IMG_MAX_PER_CONTAINER = 1024 ** 3      # image_locality.go:35
+# image_locality.go:34-35 thresholds, in KiB: image totals are tracked in
+# KiB so the whole kernel stays int32 (int64 is emulated on the TPU VPU;
+# divergence from the reference's byte math is < 1 score point of rounding,
+# and the host plugin uses the same KiB math so host/device parity is exact)
+_IMG_MIN_KIB = 23 * 1024
+_IMG_MAX_PER_CONTAINER_KIB = 1024 * 1024
 
 
 @dataclass(frozen=True)
@@ -72,6 +76,12 @@ class KernelConfig:
     # materializing a [dk, Nb] one-hot each step
     matmul_domain_cap: int = 2048
     max_constraints: int = 4
+    # number of constraint SLOTS actually traced (hard / soft). Feature
+    # arrays stay max_constraints wide; slots >= n_hard/n_soft are known-
+    # inactive at compile time, so their segment reductions never enter the
+    # program. Callers derive these from the pod batch (backend.kernel_config)
+    n_hard: int = 4
+    n_soft: int = 4
 
     def weight(self, name: str) -> int:
         return dict(self.weights).get(name, 1)
@@ -204,9 +214,15 @@ def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
     too_many = planes["used"][:, PODS] + 1 > planes["alloc"][:, PODS]
     f_fit = insufficient.any(axis=1) | too_many
 
-    # PodTopologySpread hard constraints (filtering.go:314)
+    # PodTopologySpread hard constraints (filtering.go:314); slots beyond
+    # cfg.n_hard are compile-time inactive — no reduction is traced for them
     pts_missing, pts_skew = [], []
+    false_row = jnp.zeros(nb, bool)
     for c in range(cfg.max_constraints):
+        if c >= cfg.n_hard:
+            pts_missing.append(false_row)
+            pts_skew.append(false_row)
+            continue
         active = f["hard_active"][c]
         has_key, count, min_count, _ = _pts_domain_stats(
             cfg, planes, valid, f["hard_key"][c], f["hard_sel"][c]
@@ -325,8 +341,10 @@ def _pts_score(cfg: KernelConfig, planes, f, feasible):
     log(domains+2) float32, inverted min/max over the feasible set."""
     nb = planes["valid"].shape[0]
     cost = jnp.zeros(nb, jnp.float32)
+    if cfg.n_soft == 0:
+        return jnp.zeros(nb, jnp.int32)
     any_active = f["soft_active"].any()
-    for c in range(cfg.max_constraints):
+    for c in range(min(cfg.max_constraints, cfg.n_soft)):
         active = f["soft_active"][c]
         has_key, count, _, nd = _pts_domain_stats(
             cfg, planes, feasible, f["soft_key"][c], f["soft_sel"][c]
@@ -353,12 +371,12 @@ def _image_score(planes, f):
     [23MB, 1GB × containers]."""
     idx = jnp.clip(f["img_idx"], 0)
     present = f["img_idx"] >= 0
-    sizes = jnp.take(planes["image_bytes"], idx, axis=1)     # [Nb, 8]
+    sizes = jnp.take(planes["image_kib"], idx, axis=1)       # [Nb, 8]
     total = jnp.where(present[None, :], sizes, 0).sum(axis=1)
-    max_thr = jnp.int64(_IMG_MAX_PER_CONTAINER) * f["num_containers"].astype(jnp.int64)
-    span = jnp.maximum(max_thr - _IMG_MIN, 1)
-    mid = MAX_NODE_SCORE * (total - _IMG_MIN) // span
-    score = jnp.where(total < _IMG_MIN, 0, jnp.where(total > max_thr, MAX_NODE_SCORE, mid))
+    max_thr = jnp.int32(_IMG_MAX_PER_CONTAINER_KIB) * f["num_containers"].astype(jnp.int32)
+    span = jnp.maximum(max_thr - _IMG_MIN_KIB, 1)
+    mid = MAX_NODE_SCORE * (total - _IMG_MIN_KIB) // span
+    score = jnp.where(total < _IMG_MIN_KIB, 0, jnp.where(total > max_thr, MAX_NODE_SCORE, mid))
     return score.astype(jnp.int32)
 
 
@@ -384,14 +402,6 @@ def scores(cfg: KernelConfig, planes: dict, f: dict, feasible):
 # --------------------------------------------------------------------------
 
 
-def _ensure_x64() -> None:
-    """int64 image-byte math must not be silently downcast inside jit; flip
-    the flag lazily at first kernel use instead of at import so merely
-    importing this package never mutates process-global JAX config."""
-    if not jax.config.jax_enable_x64:
-        jax.config.update("jax_enable_x64", True)
-
-
 @functools.partial(jax.jit, static_argnums=0)
 def _fit_and_score_jit(cfg: KernelConfig, planes: dict, f: dict):
     fails, feasible, insufficient, too_many = filter_masks(cfg, planes, f)
@@ -409,35 +419,204 @@ def _fit_and_score_jit(cfg: KernelConfig, planes: dict, f: dict):
 def fit_and_score(cfg: KernelConfig, planes: dict, f: dict):
     """One pod against all nodes: the fused findNodesThatFitPod +
     prioritizeNodes kernel (schedule_one.go:626,941)."""
-    _ensure_x64()
     return _fit_and_score_jit(cfg, planes, f)
 
 
-def _assign_step(cfg: KernelConfig, planes: dict, carry, f):
-    """One greedy step: filter+score under the carry's assumed state, pick the
-    best node (first-index tie-break), apply the pod's deltas."""
-    used, nonzero_used, sel_counts = carry
+def _static_pod_parts(cfg: KernelConfig, planes: dict, f: dict) -> dict:
+    """Everything in filter_masks/scores that does NOT depend on the scan
+    carry (used/nonzero_used/sel_counts): the static filter masks
+    (unschedulable, name, taints, affinity, ports) and the static raw score
+    inputs (PreferNoSchedule taint counts, affinity preference raw, image).
+
+    Hoisting these out of the per-pod scan step — one vmapped [P, Nb] pass —
+    is the batched path's main throughput lever: the step keeps only the
+    carry-dependent math (fit, balanced, spread)."""
+    valid = planes["valid"]
+    nb = valid.shape[0]
+    iota = jnp.arange(nb, dtype=jnp.int32)
+    f_unsched = planes["unsched"] & ~f["tol_unsched"]
+    f_name = (f["name_idx"] != -1) & (iota != f["name_idx"])
+    tid = planes["taints"]
+    tol = jnp.take(f["tol"], jnp.clip(tid, 0), axis=0)
+    f_taint = ((tid >= 0) & ~tol).any(axis=1)
+    row = jnp.take(planes["aff_match"], f["aff_sig"], axis=0)
+    allow = jnp.take(planes["aff_allow"], f["aff_sig"], axis=0)
+    f_aff = ~(jnp.take(row, planes["group_id"]) & allow)
+    conflict = (planes["port_words"] & f["ports"][None, :]) != 0
+    f_ports = f["has_ports"] & conflict.any(axis=1)
+    static_ok = valid & ~(f_unsched | f_name | f_taint | f_aff | f_ports)
+
+    ptid = planes["prefer_taints"]
+    tolp = jnp.take(f["tol_prefer"], jnp.clip(ptid, 0), axis=0)
+    taint_cnt = ((ptid >= 0) & ~tolp).sum(axis=1).astype(jnp.int32)
+    aff_raw = jnp.take(
+        jnp.take(planes["aff_pref"], f["aff_sig"], axis=0), planes["group_id"]
+    )
+    aff_has_pref = jnp.take(planes["aff_has_pref"], f["aff_sig"])
+    return {
+        "static_ok": static_ok,
+        "taint_cnt": taint_cnt,
+        "aff_raw": aff_raw,
+        "aff_has_pref": aff_has_pref,
+        "img": _image_score(planes, f),
+    }
+
+
+def _dom_counts_init(cfg: KernelConfig, planes: dict):
+    """Carried per-domain selector-count tensors for the scan's hard-spread
+    path: dom_counts [K, Dmax, S] (sum of sel_counts over each domain's
+    valid nodes) and the static presence mask present [K, Dmax] (domain has
+    >= 1 valid node carrying the key). One matmul per key slot, ONCE per
+    wave — the per-step matmuls this replaces were the scan's last big cost."""
+    valid = planes["valid"]
+    sel = planes["sel_counts"]
+    dmax = max((dk for dk in cfg.topo_domains if dk > 0), default=0)
+    if dmax == 0 or cfg.n_hard == 0:
+        return None, None
+    counts, present = [], []
+    for k, dk in enumerate(cfg.topo_domains):
+        if dk == 0:
+            counts.append(jnp.zeros((dmax, sel.shape[1]), jnp.int32))
+            present.append(jnp.zeros(dmax, bool))
+            continue
+        dom = planes["domain"][:, k]
+        part = valid & (dom >= 0)
+        dom_c = jnp.clip(dom, 0, dk - 1)
+        oh = (jnp.arange(dk, dtype=jnp.int32)[:, None] == dom_c[None, :]
+              ).astype(jnp.float32) * part.astype(jnp.float32)[None, :]
+        seg = jnp.matmul(oh, sel.astype(jnp.float32),
+                         precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+        pres = oh.sum(axis=1) > 0.5
+        pad = dmax - dk
+        if pad:
+            seg = jnp.pad(seg, ((0, pad), (0, 0)))
+            pres = jnp.pad(pres, (0, pad))
+        counts.append(seg)
+        present.append(pres)
+    return jnp.stack(counts), jnp.stack(present)
+
+
+def _pts_hard_carried(cfg: KernelConfig, planes, sel_counts, dom_counts,
+                      present, key_i, sel_i):
+    """Hard-constraint domain stats from the carried dom_counts — the
+    gather-only replacement for _pts_domain_stats inside the scan."""
+    dom_all = planes["domain"]
+    big = jnp.iinfo(jnp.int32).max
+    nb = dom_all.shape[0]
+    cnt = jnp.take(sel_counts, sel_i, axis=1)
+    has_key_o = jnp.zeros(nb, bool)
+    count_o = jnp.zeros(nb, jnp.int32)
+    min_o = jnp.int32(0)
+    for k, dk in enumerate(cfg.topo_domains):
+        dom = dom_all[:, k]
+        has_key = dom >= 0
+        if dk == 0:
+            # singleton: per-node count IS the domain count
+            part = planes["valid"] & has_key
+            count = cnt
+            min_c = jnp.where(part.any(), jnp.min(jnp.where(part, cnt, big)), 0)
+        else:
+            seg = jnp.take(dom_counts[k], sel_i, axis=1)  # [Dmax]
+            count = jnp.take(seg, jnp.clip(dom, 0, dom_counts.shape[1] - 1))
+            pres = present[k]
+            min_c = jnp.where(pres.any(), jnp.min(jnp.where(pres, seg, big)), 0)
+        sel_k = key_i == k
+        has_key_o = jnp.where(sel_k, has_key, has_key_o)
+        count_o = jnp.where(sel_k, count, count_o)
+        min_o = jnp.where(sel_k, min_c, min_o)
+    return has_key_o, count_o, min_o
+
+
+def _assign_step(cfg: KernelConfig, planes: dict, present, carry, inp):
+    """One greedy step: carry-dependent filter+score only (static parts come
+    precomputed via the scan xs), pick the best node (first-index tie-break),
+    apply the pod's deltas. Score math is identical to filter_masks+scores —
+    just partitioned by carry-dependence."""
+    f, sp = inp
+    used, nonzero_used, sel_counts, dom_counts = carry
     p = dict(planes)
     p["used"], p["nonzero_used"], p["sel_counts"] = used, nonzero_used, sel_counts
-    _, feasible, _, _ = filter_masks(cfg, p, f)
-    total, _ = scores(cfg, p, f, feasible)
+
+    # dynamic filters: NodeResourcesFit + PodTopologySpread hard constraints
+    free = p["alloc"] - used
+    insufficient = (f["req"][None, :] > 0) & (f["req"][None, :] > free)
+    insufficient = insufficient.at[:, PODS].set(False)
+    too_many = used[:, PODS] + 1 > p["alloc"][:, PODS]
+    f_fit = insufficient.any(axis=1) | too_many
+    pts_fail = jnp.zeros_like(f_fit)
+    for c in range(min(cfg.max_constraints, cfg.n_hard)):
+        active = f["hard_active"][c]
+        if dom_counts is not None:
+            has_key, count, min_count = _pts_hard_carried(
+                cfg, p, sel_counts, dom_counts, present,
+                f["hard_key"][c], f["hard_sel"][c]
+            )
+        else:
+            has_key, count, min_count, _ = _pts_domain_stats(
+                cfg, p, p["valid"], f["hard_key"][c], f["hard_sel"][c]
+            )
+        skew = count + f["hard_self"][c] - min_count
+        pts_fail = pts_fail | (active & ~has_key) | (
+            active & has_key & (skew > f["hard_skew"][c])
+        )
+    feasible = sp["static_ok"] & ~f_fit & ~pts_fail
+
+    # dynamic scores + static raws normalized over the live feasible set
+    total = (
+        _fit_score(cfg, p, f) * cfg.weight("NodeResourcesFit")
+        + _balanced_score(cfg, p, f) * cfg.weight("NodeResourcesBalancedAllocation")
+        + _pts_score(cfg, p, f, feasible) * cfg.weight("PodTopologySpread")
+        + sp["img"] * cfg.weight("ImageLocality")
+    )
+    max_tc = jnp.max(jnp.where(feasible, sp["taint_cnt"], 0))
+    taint = jnp.where(
+        max_tc > 0,
+        MAX_NODE_SCORE - sp["taint_cnt"] * MAX_NODE_SCORE // jnp.maximum(max_tc, 1),
+        MAX_NODE_SCORE,
+    )
+    mx_aff = jnp.max(jnp.where(feasible, sp["aff_raw"], 0))
+    aff_normed = jnp.where(
+        mx_aff > 0,
+        sp["aff_raw"] * MAX_NODE_SCORE // jnp.maximum(mx_aff, 1),
+        sp["aff_raw"],
+    )
+    total = (
+        total
+        + taint * cfg.weight("TaintToleration")
+        + jnp.where(sp["aff_has_pref"], aff_normed, 0) * cfg.weight("NodeAffinity")
+    )
+
     key = jnp.where(feasible, total, -1)
     win = jnp.argmax(key).astype(jnp.int32)
     found = key[win] >= 0
-    onehot = (jnp.arange(used.shape[0]) == win) & found
-    oh_i = onehot.astype(jnp.int32)
-    used = used + oh_i[:, None] * f["req"][None, :]
-    nonzero_used = nonzero_used + oh_i[:, None] * f["nz_req"][None, :]
-    sel_counts = sel_counts + oh_i[:, None] * f["sig_match"][None, :]
+    # single-row scatter-adds, not [Nb, R] one-hot multiplies — the update
+    # touches one node's row, so the step shouldn't write whole planes
+    gate = found.astype(jnp.int32)
+    used = used.at[win].add(gate * f["req"])
+    nonzero_used = nonzero_used.at[win].add(gate * f["nz_req"])
+    sel_counts = sel_counts.at[win].add(gate * f["sig_match"])
+    if dom_counts is not None:
+        # the placed pod joins its domains: one scatter-add per key slot
+        for k, dk in enumerate(cfg.topo_domains):
+            if dk == 0:
+                continue
+            idx = planes["domain"][win, k]
+            delta = jnp.where(found & (idx >= 0), f["sig_match"], 0)
+            dom_counts = dom_counts.at[k, jnp.clip(idx, 0)].add(delta)
     winner = jnp.where(found, win, -1)
-    return (used, nonzero_used, sel_counts), winner
+    return (used, nonzero_used, sel_counts, dom_counts), winner
 
 
 @functools.partial(jax.jit, static_argnums=0)
 def _batched_assign_jit(cfg: KernelConfig, planes: dict, batched_f: dict):
-    init = (planes["used"], planes["nonzero_used"], planes["sel_counts"])
-    step = functools.partial(_assign_step, cfg, planes)
-    (used, nonzero_used, sel_counts), winners = jax.lax.scan(step, init, batched_f)
+    static = jax.vmap(lambda f: _static_pod_parts(cfg, planes, f))(batched_f)
+    dom_counts, present = _dom_counts_init(cfg, planes)
+    init = (planes["used"], planes["nonzero_used"], planes["sel_counts"],
+            dom_counts)
+    step = functools.partial(_assign_step, cfg, planes, present)
+    (used, nonzero_used, sel_counts, _), winners = jax.lax.scan(
+        step, init, (batched_f, static), unroll=4
+    )
     return winners, {"used": used, "nonzero_used": nonzero_used, "sel_counts": sel_counts}
 
 
@@ -454,5 +633,4 @@ def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict):
 
     Returns (winners [P] int32 node index or -1, updated used/nonzero/sel
     planes)."""
-    _ensure_x64()
     return _batched_assign_jit(cfg, planes, batched_f)

@@ -37,7 +37,6 @@ from jax.sharding import PartitionSpec as P
 from ..ops.kernels import (
     KernelConfig,
     _batched_assign_jit,
-    _ensure_x64,
     _fit_and_score_jit,
     filter_masks,
     scores,
@@ -50,7 +49,7 @@ WAVE_AXIS = "wave"
 _NODE_DIM = {
     "alloc": 0, "used": 0, "nonzero_used": 0, "valid": 0, "unsched": 0,
     "group_id": 0, "taints": 0, "prefer_taints": 0, "domain": 0,
-    "sel_counts": 0, "port_words": 0, "image_bytes": 0,
+    "sel_counts": 0, "port_words": 0, "image_kib": 0,
     # affinity signature tables: [A, G] rows replicate, [A, Nb] shards dim 1
     "aff_match": None, "aff_pref": None, "aff_has_pref": None,
     "aff_allow": 1,
@@ -119,14 +118,12 @@ def replicate(mesh: Mesh, tree):
 
 def sharded_fit_and_score(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict, f: dict):
     """One pod against the node-sharded cluster (fused filter+score)."""
-    _ensure_x64()
     return _fit_and_score_jit(cfg, sharded_planes, replicate(mesh, f))
 
 
 def sharded_batched_assign(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
                            batched_f: dict):
     """Sequential-greedy wave over node-sharded planes (lax.scan on pods)."""
-    _ensure_x64()
     return _batched_assign_jit(cfg, sharded_planes, replicate(mesh, batched_f))
 
 
@@ -152,7 +149,6 @@ def wave_fit_and_score(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
 
     Returns (feasible [P, Nb] bool, total [P, Nb] int32 with -1 infeasible).
     """
-    _ensure_x64()
     wave = mesh.shape[WAVE_AXIS]
     sh = NamedSharding(mesh, P(WAVE_AXIS))
     bf = {}

@@ -212,14 +212,16 @@ class ImageLocality(Plugin):
     into [23MB, 1GB * containers] (:34-35,93-105)."""
 
     name = "ImageLocality"
-    MIN_THRESHOLD = 23 * 1024 * 1024
-    MAX_CONTAINER_THRESHOLD = 1024 * 1024 * 1024
+    # KiB units (matching the device kernel's int32 math; < 1 score point of
+    # rounding vs the reference's byte thresholds image_locality.go:34-35)
+    MIN_THRESHOLD = 23 * 1024
+    MAX_CONTAINER_THRESHOLD = 1024 * 1024
 
     def score(self, state, pod: Pod, node_info: NodeInfo):
         total = 0
         for c in pod.spec.containers:
             if c.image and c.image in node_info.image_sizes:
-                total += node_info.image_sizes[c.image]
+                total += node_info.image_sizes[c.image] >> 10
         max_threshold = self.MAX_CONTAINER_THRESHOLD * max(len(pod.spec.containers), 1)
         if total < self.MIN_THRESHOLD:
             score = 0

@@ -87,13 +87,23 @@ class TPUBackend:
 
     # -- config / planes -----------------------------------------------------
 
-    def kernel_config(self, planes) -> KernelConfig:
+    def kernel_config(self, planes, feats=None) -> KernelConfig:
+        """feats (one dict or a stacked batch) tightens n_hard/n_soft so the
+        kernel only traces the constraint slots this pod wave actually uses
+        — inactive slots cost segment reductions per scan step otherwise."""
+        mc = self.extractor.MAX_CONSTRAINTS
+        n_hard = n_soft = mc
+        if feats is not None:
+            n_hard = int(np.asarray(feats["hard_active"]).sum(axis=-1).max())
+            n_soft = int(np.asarray(feats["soft_active"]).sum(axis=-1).max())
         return KernelConfig(
             strategy=self.strategy,
             fit_resources=self.fit_resources,
             rtc_shape=self.rtc_shape,
             topo_domains=self.builder.topo_domains(planes),
-            max_constraints=self.extractor.MAX_CONSTRAINTS,
+            max_constraints=mc,
+            n_hard=n_hard,
+            n_soft=n_soft,
         )
 
     def sync(self, snapshot):
@@ -146,7 +156,7 @@ class TPUBackend:
         planes = self.sync(snapshot)
         f = self.extractor.features(pod, planes)
         dev = self.device_inputs(planes)
-        cfg = self.kernel_config(planes)
+        cfg = self.kernel_config(planes, f)
         out = fit_and_score(cfg, dev, f)
         return planes, {
             "fails": np.asarray(out["fails"]),
@@ -169,7 +179,7 @@ class TPUBackend:
         planes = self.sync(snapshot)
         feats = stack_features([self.extractor.features(p, planes) for p in pods])
         dev = self.device_inputs(planes)
-        cfg = self.kernel_config(planes)
+        cfg = self.kernel_config(planes, feats)
         winners, _ = batched_assign(cfg, dev, feats)
         winners = np.asarray(winners)
         return [planes.node_names[w] if w >= 0 else None for w in winners], planes
