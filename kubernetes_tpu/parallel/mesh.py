@@ -64,6 +64,15 @@ def scheduler_mesh(n_devices: int | None = None, wave: int = 1, devices=None) ->
     """
     devs = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"scheduler_mesh wants {n_devices} devices but only "
+                f"{len(devs)} are visible ({devs[0].platform if devs else 'none'}); "
+                "provision a virtual CPU mesh first "
+                "(XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                "JAX_PLATFORMS=cpu before jax init, or "
+                "__graft_entry__._ensure_devices)"
+            )
         devs = devs[:n_devices]
     n = len(devs)
     if n == 0:

@@ -1,19 +1,20 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-The environment pins JAX_PLATFORMS to the real accelerator tunnel, so env
-setdefault is not enough — tests must override the resolved config after
-import. XLA_FLAGS still must be set before the CPU backend initializes.
+The environment pins JAX_PLATFORMS to the real accelerator tunnel, so the
+platform must be overridden before the backend resolves. The provisioning
+recipe itself (XLA_FLAGS before jax import, backend reset fallback) lives in
+__graft_entry__._ensure_devices — one copy, shared with the driver contract.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "true")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _ensure_devices  # noqa: E402
+
+_ensure_devices(8)
 
 import jax  # noqa: E402
 
