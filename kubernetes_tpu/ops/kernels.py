@@ -64,6 +64,7 @@ class KernelConfig:
     # plugin weights (apis/config/v1/default_plugins.go:29-73)
     weights: tuple[tuple[str, int], ...] = (
         ("TaintToleration", 3), ("NodeAffinity", 2), ("PodTopologySpread", 2),
+        ("InterPodAffinity", 2),
         ("NodeResourcesFit", 1), ("NodeResourcesBalancedAllocation", 1),
         ("ImageLocality", 1),
     )
@@ -82,9 +83,28 @@ class KernelConfig:
     # program. Callers derive these from the pod batch (backend.kernel_config)
     n_hard: int = 4
     n_soft: int = 4
+    # inter-pod affinity statics. ipa_existing_anti/pref: any node (or any
+    # pod of the current wave, for the scan carry) contributes to the
+    # ipa_anti / ipa_pref planes — when False the existing→incoming matmuls
+    # are never traced. n_ipa_aff/anti/pref: max active incoming term slots
+    # in the pod batch (like n_hard/n_soft).
+    ipa_existing_anti: bool = False
+    ipa_existing_pref: bool = False
+    n_ipa_aff: int = 0
+    n_ipa_anti: int = 0
+    n_ipa_pref: int = 0
+    max_ipa_terms: int = 4
+    max_ipa_pref: int = 8
+    ipa_ignore_preferred_existing: bool = False
 
     def weight(self, name: str) -> int:
         return dict(self.weights).get(name, 1)
+
+    @property
+    def ipa_active(self) -> bool:
+        return (self.ipa_existing_anti or self.ipa_existing_pref
+                or self.n_ipa_aff > 0 or self.n_ipa_anti > 0
+                or self.n_ipa_pref > 0)
 
 
 # --------------------------------------------------------------------------
@@ -173,6 +193,137 @@ def _pts_domain_stats(cfg, planes, mask, key_i, sel_i):
     return has_key_o, count_o, min_o, ndom_o
 
 
+def _domain_sum_at_node(cfg: KernelConfig, planes: dict, k: int, col, part):
+    """Domain-aggregate a per-node int32 column over topology key slot k:
+    returns (has_key [Nb], at_node [Nb]) where at_node[i] = sum of col over
+    participating nodes in i's domain of key k. Singleton keys (topo_domains
+    slot 0) skip the reduction entirely — the domain sum IS the node value."""
+    dk = cfg.topo_domains[k]
+    dom = planes["domain"][:, k]
+    has_key = dom >= 0
+    p = part & has_key
+    masked = jnp.where(p, col, 0)
+    if dk == 0:
+        return has_key, masked
+    dom_c = jnp.clip(dom, 0, dk - 1)
+    if dk <= cfg.matmul_domain_cap:
+        oh = (jnp.arange(dk, dtype=jnp.int32)[:, None] == dom_c[None, :]
+              ).astype(jnp.float32)
+        seg = jnp.matmul(oh, masked.astype(jnp.float32),
+                         precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+    else:
+        seg = jax.ops.segment_sum(masked, dom_c, num_segments=dk)
+    return has_key, jnp.take(seg, dom_c)
+
+
+def _ipa_term_stats(cfg: KernelConfig, planes: dict, cnt_col, key_i, part):
+    """Per-term domain stats for an incoming pod's term with dynamic key
+    slot key_i: (has_key [Nb], count_at_node [Nb], anywhere scalar bool).
+    Statically unrolled over key slots (same pattern as _pts_domain_stats)."""
+    nb = planes["valid"].shape[0]
+    has_key_o = jnp.zeros(nb, bool)
+    count_o = jnp.zeros(nb, jnp.int32)
+    any_o = jnp.bool_(False)
+    for k in range(len(cfg.topo_domains)):
+        has_key, at = _domain_sum_at_node(cfg, planes, k, cnt_col, part)
+        anywhere = jnp.sum(jnp.where(part & has_key, cnt_col, 0)) > 0
+        sel = key_i == k
+        has_key_o = jnp.where(sel, has_key, has_key_o)
+        count_o = jnp.where(sel, at, count_o)
+        any_o = jnp.where(sel, anywhere, any_o)
+    return has_key_o, count_o, any_o
+
+
+def _ipa_filters(cfg: KernelConfig, planes: dict, f: dict):
+    """InterPodAffinity's 3 predicate checks (filtering.go:352-412) as dense
+    masks: (existing-anti reject, incoming-anti reject, incoming-aff reject).
+    Inactive paths are compile-time zero rows."""
+    valid = planes["valid"]
+    nb = valid.shape[0]
+    zero = jnp.zeros(nb, bool)
+    fail1, fail2, fail3 = zero, zero, zero
+
+    # 1. existing pods' required anti-affinity vs the incoming pod: per key
+    # slot, count matching (pod, term) pairs on each node via one [Nb,Ta]
+    # matvec, then domain-aggregate; reject nodes whose domain count > 0
+    if cfg.ipa_existing_anti:
+        tkey = planes["ipa_term_key"]
+        for k in range(len(cfg.topo_domains)):
+            w = (f["ipa_match"] & (tkey == k)).astype(jnp.float32)
+            col = jnp.matmul(
+                planes["ipa_anti"].astype(jnp.float32), w,
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(jnp.int32)
+            has_key, at = _domain_sum_at_node(cfg, planes, k, col, valid)
+            fail1 = fail1 | (has_key & (at > 0))
+
+    # 2. incoming required anti-affinity terms (filtering.go:389)
+    for s in range(min(cfg.max_ipa_terms, cfg.n_ipa_anti)):
+        t = f["ipa_anti_t"][s]
+        active = t >= 0
+        cnt_col = jnp.take(planes["ipa_counts"], jnp.clip(t, 0), axis=1)
+        key_i = jnp.take(planes["ipa_term_key"], jnp.clip(t, 0))
+        has_key, at, _ = _ipa_term_stats(cfg, planes, cnt_col, key_i, valid)
+        fail2 = fail2 | (active & has_key & (at > 0))
+
+    # 3. incoming required affinity terms (filtering.go:404): every term must
+    # match in the node's domain, unless it matches nowhere and the pod
+    # matches its own term (self-match bootstrap)
+    for s in range(min(cfg.max_ipa_terms, cfg.n_ipa_aff)):
+        t = f["ipa_aff_t"][s]
+        active = t >= 0
+        cnt_col = jnp.take(planes["ipa_counts"], jnp.clip(t, 0), axis=1)
+        key_i = jnp.take(planes["ipa_term_key"], jnp.clip(t, 0))
+        has_key, at, anywhere = _ipa_term_stats(cfg, planes, cnt_col, key_i, valid)
+        ok = has_key & (at > 0)
+        bootstrap = ~anywhere & f["ipa_aff_self"][s]
+        fail3 = fail3 | (active & ~(ok | bootstrap))
+    return fail1, fail2, fail3
+
+
+def _ipa_score(cfg: KernelConfig, planes: dict, f: dict, feasible):
+    """InterPodAffinity score (scoring.go:81-257): weighted preferred-term
+    matches accumulated per domain over FEASIBLE nodes (the host PreScore
+    runs on the filtered list), min/max-normalized to [0,100]."""
+    nb = planes["valid"].shape[0]
+    if cfg.n_ipa_pref == 0 and not cfg.ipa_existing_pref:
+        return jnp.zeros(nb, jnp.int32)
+    raw = jnp.zeros(nb, jnp.int32)
+
+    # incoming pod's preferred terms vs existing pods
+    for s in range(min(cfg.max_ipa_pref, cfg.n_ipa_pref)):
+        t = f["ipa_pref_t"][s]
+        active = t >= 0
+        w = f["ipa_pref_w"][s]
+        cnt_col = jnp.take(planes["ipa_counts"], jnp.clip(t, 0), axis=1)
+        key_i = jnp.take(planes["ipa_term_key"], jnp.clip(t, 0))
+        has_key, at, _ = _ipa_term_stats(cfg, planes, cnt_col, key_i, feasible)
+        raw = raw + jnp.where(active & has_key, w * at, 0)
+
+    # existing pods' preferred terms vs the incoming pod (signed weights are
+    # pre-folded into the ipa_pref plane)
+    if cfg.ipa_existing_pref and not cfg.ipa_ignore_preferred_existing:
+        tkey = planes["ipa_term_key"]
+        for k in range(len(cfg.topo_domains)):
+            w = (f["ipa_match"] & (tkey == k)).astype(jnp.float32)
+            col = jnp.matmul(
+                planes["ipa_pref"].astype(jnp.float32), w,
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(jnp.int32)
+            has_key, at = _domain_sum_at_node(cfg, planes, k, col, feasible)
+            raw = raw + jnp.where(has_key, at, 0)
+
+    big = jnp.iinfo(jnp.int32).max
+    mx = jnp.max(jnp.where(feasible, raw, -big))
+    mn = jnp.min(jnp.where(feasible, raw, big))
+    spread = mx - mn
+    return jnp.where(
+        spread == 0,
+        jnp.where(mx > 0, MAX_NODE_SCORE, 0),
+        MAX_NODE_SCORE * (raw - mn) // jnp.maximum(spread, 1),
+    )
+
+
 def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
     """All filter plugins at once → (fails [F, Nb] bool, feasible [Nb] bool,
     fit_insufficient [R, Nb], too_many_pods [Nb]).
@@ -231,8 +382,12 @@ def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
         pts_missing.append(active & ~has_key)
         pts_skew.append(active & has_key & (skew > f["hard_skew"][c]))
 
+    # InterPodAffinity (after PTS in registry filter order; 3 rows)
+    ipa1, ipa2, ipa3 = _ipa_filters(cfg, planes, f)
+
     fails = jnp.stack(
-        [f_unsched, f_name, f_taint, f_aff, f_ports, f_fit] + pts_missing + pts_skew
+        [f_unsched, f_name, f_taint, f_aff, f_ports, f_fit]
+        + pts_missing + pts_skew + [ipa1, ipa2, ipa3]
     )
     feasible = valid & ~fails.any(axis=0)
     return fails, feasible, insufficient.T, too_many
@@ -389,6 +544,7 @@ def scores(cfg: KernelConfig, planes: dict, f: dict, feasible):
         "TaintToleration": _taint_score(planes, f, feasible),
         "NodeAffinity": _node_affinity_score(planes, f, feasible),
         "PodTopologySpread": _pts_score(cfg, planes, f, feasible),
+        "InterPodAffinity": _ipa_score(cfg, planes, f, feasible),
         "ImageLocality": _image_score(planes, f),
     }
     total = jnp.zeros_like(per["NodeResourcesFit"])
@@ -533,9 +689,11 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, carry, inp):
     apply the pod's deltas. Score math is identical to filter_masks+scores —
     just partitioned by carry-dependence."""
     f, sp = inp
-    used, nonzero_used, sel_counts, dom_counts = carry
+    used, nonzero_used, sel_counts, dom_counts, ipa = carry
     p = dict(planes)
     p["used"], p["nonzero_used"], p["sel_counts"] = used, nonzero_used, sel_counts
+    if ipa is not None:
+        p["ipa_counts"], p["ipa_anti"], p["ipa_pref"] = ipa
 
     # dynamic filters: NodeResourcesFit + PodTopologySpread hard constraints
     free = p["alloc"] - used
@@ -559,13 +717,19 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, carry, inp):
         pts_fail = pts_fail | (active & ~has_key) | (
             active & has_key & (skew > f["hard_skew"][c])
         )
-    feasible = sp["static_ok"] & ~f_fit & ~pts_fail
+    if cfg.ipa_active:
+        ipa1, ipa2, ipa3 = _ipa_filters(cfg, p, f)
+        ipa_fail = ipa1 | ipa2 | ipa3
+    else:
+        ipa_fail = jnp.zeros_like(f_fit)
+    feasible = sp["static_ok"] & ~f_fit & ~pts_fail & ~ipa_fail
 
     # dynamic scores + static raws normalized over the live feasible set
     total = (
         _fit_score(cfg, p, f) * cfg.weight("NodeResourcesFit")
         + _balanced_score(cfg, p, f) * cfg.weight("NodeResourcesBalancedAllocation")
         + _pts_score(cfg, p, f, feasible) * cfg.weight("PodTopologySpread")
+        + _ipa_score(cfg, p, f, feasible) * cfg.weight("InterPodAffinity")
         + sp["img"] * cfg.weight("ImageLocality")
     )
     max_tc = jnp.max(jnp.where(feasible, sp["taint_cnt"], 0))
@@ -603,18 +767,29 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, carry, inp):
             idx = planes["domain"][win, k]
             delta = jnp.where(found & (idx >= 0), f["sig_match"], 0)
             dom_counts = dom_counts.at[k, jnp.clip(idx, 0)].add(delta)
+    if ipa is not None:
+        # the placed pod joins each matching term's count column and
+        # contributes its own carried anti/preferred terms
+        ipa_counts, ipa_anti, ipa_pref = ipa
+        ipa = (
+            ipa_counts.at[win].add(gate * f["ipa_match"].astype(jnp.int32)),
+            ipa_anti.at[win].add(gate * f["ipa_anti_add"]),
+            ipa_pref.at[win].add(gate * f["ipa_pref_add"]),
+        )
     winner = jnp.where(found, win, -1)
-    return (used, nonzero_used, sel_counts, dom_counts), winner
+    return (used, nonzero_used, sel_counts, dom_counts, ipa), winner
 
 
 @functools.partial(jax.jit, static_argnums=0)
 def _batched_assign_jit(cfg: KernelConfig, planes: dict, batched_f: dict):
     static = jax.vmap(lambda f: _static_pod_parts(cfg, planes, f))(batched_f)
     dom_counts, present = _dom_counts_init(cfg, planes)
+    ipa = ((planes["ipa_counts"], planes["ipa_anti"], planes["ipa_pref"])
+           if cfg.ipa_active else None)
     init = (planes["used"], planes["nonzero_used"], planes["sel_counts"],
-            dom_counts)
+            dom_counts, ipa)
     step = functools.partial(_assign_step, cfg, planes, present)
-    (used, nonzero_used, sel_counts, _), winners = jax.lax.scan(
+    (used, nonzero_used, sel_counts, _, _), winners = jax.lax.scan(
         step, init, (batched_f, static), unroll=4
     )
     return winners, {"used": used, "nonzero_used": nonzero_used, "sel_counts": sel_counts}

@@ -19,6 +19,12 @@ Planes (all numpy host-side; the backend uploads them to device HBM):
 - sel_counts        [Nb, S]  int32   pods on node matching selector signature s
 - port_words        [Nb, W]  uint32  used host-port bitset over the port vocab
 - image_kib         [Nb, I]  int32   per-image KiB present on node
+- ipa_counts        [Nb, Ta] int32   pods on node matching IPA term selector t
+- ipa_anti          [Nb, Ta] int32   (pod, required-anti-affinity term) pairs
+                                     on node with term id t (filtering.go:91)
+- ipa_pref          [Nb, Ta] int32   signed preferred-term weight sums of pods
+                                     on node per term id (scoring.go:81)
+- ipa_term_key      [Ta]     int32   topology-key slot per term (global table)
 
 Pod features (PodFeatureExtractor) are the per-pod side of the same split:
 everything string-shaped is resolved host-side against the vocabularies, so
@@ -46,7 +52,8 @@ class Planes:
         "node_names", "node_index", "n", "nb", "r",
         "alloc", "used", "nonzero_used", "valid", "unsched", "group_id",
         "taints", "prefer_taints", "domain", "sel_counts", "port_words",
-        "image_kib", "version", "bucket_sizes",
+        "image_kib", "ipa_counts", "ipa_anti", "ipa_pref", "ipa_term_key",
+        "version", "bucket_sizes",
     )
 
     def as_dict(self) -> dict[str, np.ndarray]:
@@ -64,6 +71,10 @@ class Planes:
             "sel_counts": self.sel_counts,
             "port_words": self.port_words,
             "image_kib": self.image_kib,
+            "ipa_counts": self.ipa_counts,
+            "ipa_anti": self.ipa_anti,
+            "ipa_pref": self.ipa_pref,
+            "ipa_term_key": self.ipa_term_key,
         }
 
 
@@ -73,6 +84,7 @@ def _canonical_fingerprint(vocabs: ClusterVocabs, names: ResourceNames) -> tuple
         len(vocabs.topo_keys),
         tuple(len(vocabs.domain_vocab(i)) for i in range(len(vocabs.topo_keys))),
         len(vocabs.selectors), len(vocabs.ports), len(vocabs.images),
+        len(vocabs.ipa_terms),
         names.width,
     )
 
@@ -174,6 +186,17 @@ class PlaneBuilder:
             v.ports.id((proto, port))
         for img_name in ni.image_sizes:
             v.images.id(img_name)
+        # existing pods' (anti)affinity terms — required AND preferred, so the
+        # planes cover both filter (filtering.go:91) and score (scoring.go:81)
+        for epi in ni.pods_with_affinity:
+            for term in epi.required_affinity_terms:
+                v.ipa_term_id(term)
+            for term in epi.required_anti_affinity_terms:
+                v.ipa_term_id(term)
+            for _w, term in epi.preferred_affinity_terms:
+                v.ipa_term_id(term)
+            for _w, term in epi.preferred_anti_affinity_terms:
+                v.ipa_term_id(term)
 
     def _bucket_sizes(self, n: int, fp: tuple) -> tuple:
         # node bucket stays pow2: measured on v5e, a 5120 bucket ran ~16%
@@ -190,10 +213,11 @@ class PlaneBuilder:
             next_pow2(max(len(v.selectors), 1), 1),       # S
             next_pow2((len(v.ports) + 31) // 32, 1),      # W port words
             next_pow2(max(len(v.images), 1), 1),          # I
+            next_pow2(max(len(v.ipa_terms), 1), 1),       # Ta IPA terms
         )
 
     def _full_build(self, nodes, names, buckets, fp) -> Planes:
-        nb, r, t, tp, k, s, w, im = buckets
+        nb, r, t, tp, k, s, w, im, ta = buckets
         p = Planes()
         p.node_names = names
         p.node_index = {nm: i for i, nm in enumerate(names)}
@@ -213,6 +237,14 @@ class PlaneBuilder:
         p.sel_counts = np.zeros((nb, s), np.int32)
         p.port_words = np.zeros((nb, w), np.uint32)
         p.image_kib = np.zeros((nb, im), np.int32)
+        p.ipa_counts = np.zeros((nb, ta), np.int32)
+        p.ipa_anti = np.zeros((nb, ta), np.int32)
+        p.ipa_pref = np.zeros((nb, ta), np.int32)
+        # global term → topology-key-slot table (padded slots map to -1 so
+        # the kernel's per-key unroll never picks them up)
+        p.ipa_term_key = np.full(ta, -1, np.int32)
+        for ti, (_ns, _sel, ki) in enumerate(self.vocabs.ipa_term_matchers):
+            p.ipa_term_key[ti] = ki
         self._row_cache.clear()
         for i, ni in enumerate(nodes):
             self._write_row(p, i, ni, fp)
@@ -276,6 +308,37 @@ class PlaneBuilder:
             ii = v.images.id(img_name)
             if ii < p.image_kib.shape[1]:
                 p.image_kib[i, ii] = size >> 10  # KiB keeps int32 on-device
+        # inter-pod affinity planes (the dense topologyToMatchedTermCount:
+        # per-term matching-pod counts + per-term carried anti/preferred
+        # terms; domain aggregation happens on device)
+        p.ipa_counts[i, :] = 0
+        p.ipa_anti[i, :] = 0
+        p.ipa_pref[i, :] = 0
+        if v.ipa_terms:
+            ta = p.ipa_counts.shape[1]
+            for ti, (ns_set, sel, _ki) in enumerate(v.ipa_term_matchers):
+                if ti >= ta or sel is None:
+                    continue  # None-selector terms match nothing
+                c = 0
+                for epi in ni.iter_pods():
+                    pod = epi.pod
+                    if pod.meta.namespace in ns_set and sel.matches(pod.meta.labels):
+                        c += 1
+                p.ipa_counts[i, ti] = c
+            for epi in ni.pods_with_required_anti_affinity:
+                for term in epi.required_anti_affinity_terms:
+                    ti = v.ipa_term_id(term)
+                    if ti < ta:
+                        p.ipa_anti[i, ti] += 1
+            for epi in ni.pods_with_affinity:
+                for w_, term in epi.preferred_affinity_terms:
+                    ti = v.ipa_term_id(term)
+                    if ti < ta:
+                        p.ipa_pref[i, ti] += w_
+                for w_, term in epi.preferred_anti_affinity_terms:
+                    ti = v.ipa_term_id(term)
+                    if ti < ta:
+                        p.ipa_pref[i, ti] -= w_
         self._row_cache[ni.name] = (ni.generation, fp)
 
 
@@ -287,12 +350,15 @@ class FallbackNeeded(Exception):
 class PodFeatureExtractor:
     """Resolves one Pod against the vocabularies into fixed-shape arrays.
 
-    Raises FallbackNeeded for the long-tail features kept host-side in this
-    round (inter-pod affinity, match_fields beyond the In(metadata.name) fast
-    path, host ports with specific hostIPs).
+    Raises FallbackNeeded for the long-tail features kept host-side
+    (match_fields beyond the In(metadata.name) fast path, host ports with
+    specific hostIPs, constraint/term counts beyond the kernel slots).
+    Inter-pod (anti)affinity is fully kernelized.
     """
 
     MAX_CONSTRAINTS = 4  # padded constraint slots per pod
+    MAX_IPA_TERMS = 4    # required (anti)affinity term slots per pod
+    MAX_IPA_PREF = 8     # preferred (anti)affinity term slots per pod
 
     def __init__(self, names: ResourceNames, vocabs: ClusterVocabs,
                  system_default_spread: bool = True):
@@ -319,6 +385,18 @@ class PodFeatureExtractor:
                 sel = c.label_selector
                 if sel is not None:
                     self.vocabs.selector_id(pod.meta.namespace, sel)
+        aff = pod.spec.affinity
+        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
+            from ..scheduler.nodeinfo import PodInfo
+
+            pi = PodInfo(pod, self.names)
+            for term in pi.required_affinity_terms + pi.required_anti_affinity_terms:
+                ti = self.vocabs.ipa_term_id(term)
+                self.vocabs.domain_vocab(self.vocabs.ipa_term_matchers[ti][2])
+            for _w, term in (pi.preferred_affinity_terms
+                             + pi.preferred_anti_affinity_terms):
+                ti = self.vocabs.ipa_term_id(term)
+                self.vocabs.domain_vocab(self.vocabs.ipa_term_matchers[ti][2])
         for c in pod.spec.containers:
             for prt in c.ports:
                 if prt.host_port > 0:
@@ -335,12 +413,13 @@ class PodFeatureExtractor:
 
         v = self.vocabs
         nb = planes.nb
-        _, r, t, tp, k, s, w, im = planes.bucket_sizes
+        _, r, t, tp, k, s, w, im, ta = planes.bucket_sizes
         f: dict[str, np.ndarray] = {}
 
-        aff = pod.spec.affinity
-        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
-            raise FallbackNeeded("inter-pod (anti)affinity is host-side in r1")
+        # inter-pod (anti)affinity features: the pod's own term slots plus its
+        # match vector against every interned term — the per-pod side of the
+        # dense topologyToMatchedTermCount (interpodaffinity/filtering.go:91)
+        self._ipa_features(pod, f, ta)
 
         # resources (noderesources/fit.go:317 computePodResourceRequest)
         req = pod_request_vec(pod, self.names)
@@ -447,6 +526,77 @@ class PodFeatureExtractor:
                 sig[si] = 1
         f["sig_match"] = sig
         return f
+
+    def _ipa_features(self, pod: Pod, f: dict, ta: int) -> None:
+        """Inter-pod affinity per-pod inputs (all bucket-aligned to Ta):
+
+        - ipa_match  [Ta] bool  term t's (ns, selector) matches THIS pod —
+          drives the existing→incoming direction (check 1 of filtering.go:352
+          and the existing-preferred side of scoring.go:81), and the scan
+          carry update (a placed pod joins each matching term's counts).
+        - ipa_aff_t/ipa_anti_t [MAX_IPA_TERMS] int32 term ids of the pod's
+          required (anti)affinity terms, -1 pad; ipa_aff_self marks terms
+          that match the pod itself (self-match bootstrap, filtering.go:404).
+        - ipa_pref_t [MAX_IPA_PREF] int32 + ipa_pref_w signed weights for the
+          pod's preferred terms (anti terms carry negative weight).
+        - ipa_anti_add/ipa_pref_add [Ta] int32: the pod's own contribution to
+          the ipa_anti/ipa_pref planes if placed (batched-scan carry).
+        """
+        from ..scheduler.nodeinfo import PodInfo
+
+        v = self.vocabs
+        match = np.zeros(ta, bool)
+        for ti, (ns_set, sel, _ki) in enumerate(v.ipa_term_matchers):
+            if ti >= ta or sel is None:
+                continue
+            match[ti] = (pod.meta.namespace in ns_set
+                         and sel.matches(pod.meta.labels))
+        f["ipa_match"] = match
+
+        aff = pod.spec.affinity
+        aff_t = np.full(self.MAX_IPA_TERMS, -1, np.int32)
+        aff_self = np.zeros(self.MAX_IPA_TERMS, bool)
+        anti_t = np.full(self.MAX_IPA_TERMS, -1, np.int32)
+        pref_t = np.full(self.MAX_IPA_PREF, -1, np.int32)
+        pref_w = np.zeros(self.MAX_IPA_PREF, np.int32)
+        anti_add = np.zeros(ta, np.int32)
+        pref_add = np.zeros(ta, np.int32)
+        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
+            pi = PodInfo(pod, self.names)
+            if (len(pi.required_affinity_terms) > self.MAX_IPA_TERMS
+                    or len(pi.required_anti_affinity_terms) > self.MAX_IPA_TERMS):
+                raise FallbackNeeded("more required IPA terms than kernel slots")
+            prefs = pi.preferred_affinity_terms + pi.preferred_anti_affinity_terms
+            if len(prefs) > self.MAX_IPA_PREF:
+                raise FallbackNeeded("more preferred IPA terms than kernel slots")
+            def term_id(term):
+                ti = v.ipa_term_lookup(term)
+                if ti is None or ti >= ta:
+                    raise FallbackNeeded("IPA vocab stale; re-register pod")
+                return ti
+
+            for j, term in enumerate(pi.required_affinity_terms):
+                ti = term_id(term)
+                aff_t[j] = ti
+                aff_self[j] = term.matches(pod)
+            for j, term in enumerate(pi.required_anti_affinity_terms):
+                ti = term_id(term)
+                anti_t[j] = ti
+                anti_add[ti] += 1
+            n_aff_pref = len(pi.preferred_affinity_terms)
+            for j, (w_, term) in enumerate(prefs):
+                ti = term_id(term)
+                sign = 1 if j < n_aff_pref else -1
+                pref_t[j] = ti
+                pref_w[j] = sign * w_
+                pref_add[ti] += sign * w_
+        f["ipa_aff_t"] = aff_t
+        f["ipa_aff_self"] = aff_self
+        f["ipa_anti_t"] = anti_t
+        f["ipa_pref_t"] = pref_t
+        f["ipa_pref_w"] = pref_w
+        f["ipa_anti_add"] = anti_add
+        f["ipa_pref_add"] = pref_add
 
     def _affinity_sig(self, pod: Pod) -> int:
         """Intern the pod's (nodeSelector, node affinity) spec into a

@@ -95,6 +95,35 @@ class ClusterVocabs:
         self.ports = Vocab()
         # image name → column
         self.images = Vocab()
+        # inter-pod affinity terms: (namespaces, selector canonical, topo key
+        # idx) → term column (interpodaffinity/filtering.go:91 — the dense
+        # analogue of topologyToMatchedTermCount keys its planes by term)
+        self.ipa_terms = Vocab()
+        self.ipa_term_matchers: list[tuple[frozenset, object, int]] = []
+
+    def ipa_term_id(self, term) -> int:
+        """Intern an AffinityTerm (nodeinfo.AffinityTerm shape: resolved
+        namespaces frozenset + selector + topology_key)."""
+        ki = self.topo_keys.id(term.topology_key)
+        sel = term.selector
+        key = (term.namespaces, sel.canonical() if sel is not None else None, ki)
+        existing = self.ipa_terms.get(key)
+        if existing is not None:
+            return existing
+        i = self.ipa_terms.id(key)
+        self.ipa_term_matchers.append((term.namespaces, sel, ki))
+        return i
+
+    def ipa_term_lookup(self, term) -> int | None:
+        """Existing id for an AffinityTerm, or None when not interned (the
+        read-only counterpart of ipa_term_id — must mirror its key shape)."""
+        ki = self.topo_keys.get(term.topology_key)
+        if ki is None:
+            return None
+        sel = term.selector
+        return self.ipa_terms.get(
+            (term.namespaces, sel.canonical() if sel is not None else None, ki)
+        )
 
     def domain_vocab(self, key_idx: int) -> Vocab:
         v = self.topo_domains.get(key_idx)

@@ -50,6 +50,9 @@ _NODE_DIM = {
     "alloc": 0, "used": 0, "nonzero_used": 0, "valid": 0, "unsched": 0,
     "group_id": 0, "taints": 0, "prefer_taints": 0, "domain": 0,
     "sel_counts": 0, "port_words": 0, "image_kib": 0,
+    "ipa_counts": 0, "ipa_anti": 0, "ipa_pref": 0,
+    # global term → topology-key table replicates
+    "ipa_term_key": None,
     # affinity signature tables: [A, G] rows replicate, [A, Nb] shards dim 1
     "aff_match": None, "aff_pref": None, "aff_has_pref": None,
     "aff_allow": 1,

@@ -46,6 +46,7 @@ def default_plugins(store, names: ResourceNames, feature_gates=None, args: dict 
     """The default-profile plugin list, in extension-point order."""
     args = args or {}
     fit_args = args.get("NodeResourcesFit", {})
+    ipa_args = args.get("InterPodAffinity", {})
     plugins = [
         SchedulingGates(),
         PrioritySort(),
@@ -65,7 +66,8 @@ def default_plugins(store, names: ResourceNames, feature_gates=None, args: dict 
         VolumeBinding(store),
         VolumeZone(store),
         PodTopologySpread(),
-        InterPodAffinity(),
+        InterPodAffinity(ignore_preferred_terms_of_existing_pods=ipa_args.get(
+            "ignorePreferredTermsOfExistingPods", False)),
         BalancedAllocation(names),
         ImageLocality(),
         DefaultBinder(store),

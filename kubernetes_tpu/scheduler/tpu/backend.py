@@ -14,11 +14,13 @@ selection reduces to (max total score, seeded-rng tie-break over winners in
 snapshot node order) — which is exactly what this backend computes, so TPU
 and host decisions are identical. Golden tests enforce it.
 
-Fallback: pods using features the kernel doesn't model yet (inter-pod
-affinity, exotic match_fields, hostIP-specific ports), clusters whose
-existing pods carry (anti)affinity, and preemption aftermath (nominated
-pods) run the host path via super() — mirroring how the reference composes
-host + extender paths in one cycle.
+Fallback: pods using features the kernel doesn't model yet (exotic
+match_fields, hostIP-specific ports, term-slot overflow), claim/extender
+pods, and preemption aftermath (nominated pods) run the host path via
+super() — mirroring how the reference composes host + extender paths in one
+cycle. Inter-pod (anti)affinity — both incoming-pod terms and existing-pod
+terms — runs fully in-kernel (the dense topologyToMatchedTermCount of
+interpodaffinity/filtering.go:91-185, scoring.go:81-257).
 """
 
 from __future__ import annotations
@@ -62,6 +64,10 @@ class TPUBackend:
         import jax
 
         args = (plugin_args or {}).get("NodeResourcesFit", {})
+        ipa_args = (plugin_args or {}).get("InterPodAffinity", {})
+        self.ipa_ignore_preferred_existing = bool(
+            ipa_args.get("ignorePreferredTermsOfExistingPods", False)
+        )
         self.names = names
         self.builder = PlaneBuilder(names)
         self.extractor = PodFeatureExtractor(
@@ -88,14 +94,29 @@ class TPUBackend:
     # -- config / planes -----------------------------------------------------
 
     def kernel_config(self, planes, feats=None) -> KernelConfig:
-        """feats (one dict or a stacked batch) tightens n_hard/n_soft so the
-        kernel only traces the constraint slots this pod wave actually uses
-        — inactive slots cost segment reductions per scan step otherwise."""
+        """feats (one dict or a stacked batch) tightens n_hard/n_soft (and
+        the IPA slot counts) so the kernel only traces the constraint slots
+        this pod wave actually uses — inactive slots cost segment reductions
+        per scan step otherwise."""
         mc = self.extractor.MAX_CONSTRAINTS
         n_hard = n_soft = mc
+        n_ipa_aff = n_ipa_anti = self.extractor.MAX_IPA_TERMS
+        n_ipa_pref = self.extractor.MAX_IPA_PREF
         if feats is not None:
             n_hard = int(np.asarray(feats["hard_active"]).sum(axis=-1).max())
             n_soft = int(np.asarray(feats["soft_active"]).sum(axis=-1).max())
+            n_ipa_aff = int((np.asarray(feats["ipa_aff_t"]) >= 0).sum(axis=-1).max())
+            n_ipa_anti = int((np.asarray(feats["ipa_anti_t"]) >= 0).sum(axis=-1).max())
+            n_ipa_pref = int((np.asarray(feats["ipa_pref_t"]) >= 0).sum(axis=-1).max())
+        # existing-direction statics: true when the planes already carry
+        # anti/preferred terms OR the wave itself does (a placed wave pod
+        # joins the carried planes mid-scan)
+        wave_anti = bool(feats is not None
+                         and np.asarray(feats["ipa_anti_add"]).any())
+        wave_pref = bool(feats is not None
+                         and np.asarray(feats["ipa_pref_add"]).any())
+        existing_anti = bool(planes.ipa_anti[: planes.n].any()) or wave_anti
+        existing_pref = bool(planes.ipa_pref[: planes.n].any()) or wave_pref
         return KernelConfig(
             strategy=self.strategy,
             fit_resources=self.fit_resources,
@@ -104,6 +125,14 @@ class TPUBackend:
             max_constraints=mc,
             n_hard=n_hard,
             n_soft=n_soft,
+            ipa_existing_anti=existing_anti,
+            ipa_existing_pref=existing_pref,
+            n_ipa_aff=n_ipa_aff,
+            n_ipa_anti=n_ipa_anti,
+            n_ipa_pref=n_ipa_pref,
+            max_ipa_terms=self.extractor.MAX_IPA_TERMS,
+            max_ipa_pref=self.extractor.MAX_IPA_PREF,
+            ipa_ignore_preferred_existing=self.ipa_ignore_preferred_existing,
         )
 
     def sync(self, snapshot):
@@ -132,26 +161,11 @@ class TPUBackend:
             self._tables_src = tables
         return {**self._device_planes, **self._device_tables}
 
-    # -- eligibility ----------------------------------------------------------
-
-    def cluster_fallback_reason(self, snapshot) -> str | None:
-        """Existing-pod (anti)affinity makes *every* pod's filter/score depend
-        on pod×pod term matching (interpodaffinity filtering.go:91) — host
-        path until the IPA kernel lands."""
-        if snapshot.have_pods_with_required_anti_affinity_list:
-            return "existing pods with required anti-affinity"
-        if snapshot.have_pods_with_affinity_list:
-            return "existing pods with (anti)affinity terms"
-        return None
-
     # -- single-pod kernel cycle ---------------------------------------------
 
     def run(self, pod: Pod, snapshot):
         """One pod against the whole cluster; returns kernel outputs (numpy)
         plus the planes used. Raises FallbackNeeded when not kernelizable."""
-        reason = self.cluster_fallback_reason(snapshot)
-        if reason:
-            raise FallbackNeeded(reason)
         self.extractor.register(pod)
         planes = self.sync(snapshot)
         f = self.extractor.features(pod, planes)
@@ -171,9 +185,6 @@ class TPUBackend:
 
         Returns (node names per pod or None, planes). The caller applies the
         same assumes host-side so cache and device state stay coherent."""
-        reason = self.cluster_fallback_reason(snapshot)
-        if reason:
-            raise FallbackNeeded(reason)
         for pod in pods:
             self.extractor.register(pod)
         planes = self.sync(snapshot)
@@ -200,6 +211,13 @@ class TPUBackend:
         for c in range(c_max):
             order.append((f"pts_missing:{c}", len(FILTER_NAMES) + c))
             order.append((f"pts_skew:{c}", len(FILTER_NAMES) + c_max + c))
+        # InterPodAffinity rows follow PTS (registry filter order); within
+        # the plugin the host checks existing-anti, then incoming-anti, then
+        # incoming-affinity (filtering.go:352-412)
+        base = len(FILTER_NAMES) + 2 * c_max
+        order.append(("ipa_existing_anti", base))
+        order.append(("ipa_anti", base + 1))
+        order.append(("ipa_aff", base + 2))
         hard_keys = self._hard_constraint_keys(pod)
         # tolerance per taint-vocab entry, for host-identical taint messages
         from ...api.types import Taint
@@ -259,6 +277,21 @@ class TPUBackend:
             return Status.unschedulable(
                 "node(s) didn't match pod topology spread constraints",
                 plugin="PodTopologySpread",
+            )
+        if name == "ipa_existing_anti":
+            return Status.unschedulable(
+                "node(s) had pods with anti-affinity rules rejecting the pod",
+                plugin="InterPodAffinity",
+            )
+        if name == "ipa_anti":
+            return Status.unschedulable(
+                "node(s) didn't satisfy pod anti-affinity rules",
+                plugin="InterPodAffinity",
+            )
+        if name == "ipa_aff":
+            return Status.unschedulable(
+                "node(s) didn't satisfy pod affinity rules",
+                plugin="InterPodAffinity",
             )
         kind, msg = _ROW_STATUS[name]
         ctor = Status.unresolvable if kind == "unresolvable" else Status.unschedulable

@@ -62,6 +62,46 @@ class TestBatchedAssign:
             counts[n] = counts.get(n, 0) + 1
         assert max(counts.values()) <= 2
 
+    def test_anti_affinity_carry_between_wave_pods(self):
+        """A placed wave pod's anti-affinity terms must constrain later wave
+        pods (the carried ipa planes play cache.AssumePod for IPA state)."""
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.api.types import (
+            Affinity,
+            PodAntiAffinity,
+            PodAffinityTerm,
+        )
+
+        names, cache, snap = make_cluster(n_nodes=6)
+        anti = Affinity(pod_anti_affinity=PodAntiAffinity(required=(
+            PodAffinityTerm(label_selector=LabelSelector.of({"app": "w"}),
+                            topology_key="kubernetes.io/hostname"),)))
+        pods = []
+        for i in range(6):
+            p = make_pod(f"p{i}", cpu="100m", labels={"app": "w"})
+            p.spec.affinity = anti
+            pods.append(p)
+
+        backend_b = TPUBackend(names)
+        batched_names, _ = backend_b.run_batched(pods, snap)
+        # each pod rejects nodes already hosting an app=w pod → all distinct
+        assert None not in batched_names
+        assert len(set(batched_names)) == 6
+
+        # parity with the sequential per-pod kernel + host assumes
+        backend_s = TPUBackend(names)
+        seq_names = []
+        for pod in pods:
+            planes, out = backend_s.run(pod, snap)
+            total = out["total"][: planes.n]
+            win = int(np.argmax(total))
+            assert total[win] >= 0
+            node = planes.node_names[win]
+            cache.assume_pod(pod, node)
+            cache.update_snapshot(snap)
+            seq_names.append(node)
+        assert batched_names == seq_names
+
     def test_capacity_exhaustion_returns_minus_one(self):
         names, cache, snap = make_cluster(n_nodes=2)
         pods = [make_pod(f"p{i}", cpu="3") for i in range(4)]  # 2×4cpu total

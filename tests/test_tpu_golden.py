@@ -223,6 +223,118 @@ class TestFeasibilityAndScoreParity:
         assert str(hosterr.value) == str(tpuerr.value)
 
 
+class TestInterPodAffinityParity:
+    """The IPA kernel (dense topologyToMatchedTermCount) must match the host
+    plugin bit-for-bit: filtering.go:352-412 checks, scoring.go:81-257."""
+
+    @staticmethod
+    def _affinity(required=None, anti=None, preferred=None, anti_preferred=None):
+        from kubernetes_tpu.api.types import (
+            Affinity,
+            PodAffinity,
+            PodAntiAffinity,
+        )
+
+        pa = PodAffinity(required=tuple(required or ()),
+                         preferred=tuple(preferred or ()))
+        paa = PodAntiAffinity(required=tuple(anti or ()),
+                              preferred=tuple(anti_preferred or ()))
+        return Affinity(pod_affinity=pa, pod_anti_affinity=paa)
+
+    @staticmethod
+    def _term(sel_labels, key="topology.kubernetes.io/zone"):
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.api.types import PodAffinityTerm
+
+        return PodAffinityTerm(label_selector=LabelSelector.of(sel_labels),
+                               topology_key=key)
+
+    @staticmethod
+    def _weighted(weight, term):
+        from kubernetes_tpu.api.types import WeightedPodAffinityTerm
+
+        return WeightedPodAffinityTerm(weight=weight, term=term)
+
+    def _cluster_with_affinity(self):
+        nodes = hetero_nodes(12)
+        existing = hetero_existing(nodes, 20)
+        existing[0].spec.affinity = self._affinity(
+            anti=[self._term({"app": "web"})])
+        existing[2].spec.affinity = self._affinity(
+            anti=[self._term({"app": "db"}, key="kubernetes.io/hostname")])
+        existing[3].spec.affinity = self._affinity(
+            preferred=[self._weighted(10, self._term({"app": "web"},
+                                                     key="kubernetes.io/hostname"))],
+            anti_preferred=[self._weighted(3, self._term({"app": "db"}))])
+        return build_pair(nodes, existing)
+
+    def test_existing_anti_affinity_rejection(self):
+        host, tpu, _, snap = self._cluster_with_affinity()
+        assert_parity(host, tpu, make_pod("p", cpu="100m",
+                                          labels={"app": "web"}), snap)
+        assert_parity(host, tpu, make_pod("q", cpu="100m",
+                                          labels={"app": "db"}), snap)
+        assert_parity(host, tpu, make_pod("r", cpu="100m",
+                                          labels={"app": "other"}), snap)
+
+    def test_incoming_required_affinity(self):
+        host, tpu, _, snap = self._cluster_with_affinity()
+        pod = make_pod("p", cpu="100m", labels={"app": "x"})
+        pod.spec.affinity = self._affinity(required=[self._term({"app": "web"})])
+        assert_parity(host, tpu, pod, snap)
+
+    def test_incoming_affinity_self_match_bootstrap(self):
+        """A required term matching no existing pod but matching the pod
+        itself passes everywhere (filtering.go:404 bootstrap case)."""
+        host, tpu, _, snap = self._cluster_with_affinity()
+        pod = make_pod("p", cpu="100m", labels={"tier": "new"})
+        pod.spec.affinity = self._affinity(required=[self._term({"tier": "new"})])
+        assert_parity(host, tpu, pod, snap)
+
+    def test_incoming_anti_affinity(self):
+        host, tpu, _, snap = self._cluster_with_affinity()
+        pod = make_pod("p", cpu="100m", labels={"app": "solo"})
+        pod.spec.affinity = self._affinity(
+            anti=[self._term({"app": "web"}, key="kubernetes.io/hostname")])
+        assert_parity(host, tpu, pod, snap)
+
+    def test_preferred_scoring_both_directions(self):
+        host, tpu, _, snap = self._cluster_with_affinity()
+        pod = make_pod("p", cpu="100m", labels={"app": "web"})
+        pod.spec.affinity = self._affinity(
+            preferred=[self._weighted(7, self._term({"app": "db"}))],
+            anti_preferred=[self._weighted(2, self._term({"app": "web"},
+                                                         key="kubernetes.io/hostname"))])
+        assert_parity(host, tpu, pod, snap)
+
+    def test_all_nodes_rejected_diagnosis(self):
+        nodes = [make_node(f"n{i}", cpu="8", mem="16Gi", zone="z0")
+                 for i in range(3)]
+        blocker = make_pod("blocker", cpu="100m", node_name="n0",
+                           labels={"app": "web"})
+        blocker.spec.affinity = self._affinity(anti=[self._term({"app": "web"})])
+        host, tpu, _, snap = build_pair(nodes, [blocker])
+        pod = make_pod("p", cpu="100m", labels={"app": "web"})
+        with pytest.raises(FitError) as hosterr:
+            host.schedule_pod(CycleState(), pod, snap)
+        with pytest.raises(FitError) as tpuerr:
+            tpu.schedule_pod(CycleState(), pod, snap)
+        assert str(hosterr.value) == str(tpuerr.value)
+
+    def test_kernel_runs_with_affinity_in_cluster(self):
+        """Regression for the r1 cluster-wide fallback: existing-pod
+        (anti)affinity must NOT push pods off the kernel path."""
+        import random as _random
+
+        host, tpu, _, snap = self._cluster_with_affinity()
+        tpu.rng = _random.Random(0)
+        before = tpu.kernel_count
+        tpu.schedule_pod(CycleState(), make_pod("p", cpu="100m",
+                                                labels={"app": "other"}), snap)
+        assert tpu.kernel_count == before + 1
+        assert tpu.fallback_count == 0
+
+
 class TestEndToEndDecisionParity:
     """Two full schedulers over identical stores must produce identical
     bindings for every pod (the reference's golden-diff requirement)."""
@@ -266,6 +378,31 @@ class TestEndToEndDecisionParity:
         assert host_bind == tpu_bind
         algo = s.algorithms["default-scheduler"]
         assert algo.kernel_count > 0, "kernel path never ran"
+        assert algo.fallback_count == 0
+
+    def test_sequence_parity_with_affinity(self):
+        """Pods with (anti)affinity schedule through the kernel with
+        decisions identical to the host path — no fallback."""
+        nodes, pods = self._nodes_and_pods(seed=5, n_pods=24)
+        mk = TestInterPodAffinityParity
+        for i, p in enumerate(pods):
+            if i % 6 == 1:
+                p.spec.affinity = mk._affinity(
+                    anti=[mk._term({"app": p.meta.labels["app"]},
+                                   key="kubernetes.io/hostname")])
+            elif i % 6 == 3:
+                p.spec.affinity = mk._affinity(
+                    required=[mk._term({"app": p.meta.labels["app"]})])
+            elif i % 6 == 5:
+                p.spec.affinity = mk._affinity(
+                    preferred=[mk._weighted(9, mk._term({"app": "a"}))])
+        import copy
+
+        host_bind, _ = self._run("host", copy.deepcopy(nodes), copy.deepcopy(pods))
+        tpu_bind, s = self._run("tpu", nodes, pods)
+        assert host_bind == tpu_bind
+        algo = s.algorithms["default-scheduler"]
+        assert algo.kernel_count > 0
         assert algo.fallback_count == 0
 
     def test_sequence_parity_most_allocated(self):
