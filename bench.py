@@ -1,72 +1,79 @@
-"""Headline bench: batched TPU scheduling throughput on a 5k-node cluster.
+"""Headline bench: FULL-PIPELINE scheduling throughput on a 5k-node cluster.
 
-Mirrors scheduler_perf SchedulingBasic (5000 nodes, measured pod wave;
-test/integration/scheduler_perf/misc/performance-config.yaml:71-80) scheduled
-through the dense batched kernel: one lax.scan program where pod i+1 sees pod
-i's assumed deltas. Baseline is the reference's CI threshold for the same
-workload shape: 270 pods/s on the 16-goroutine host path (BASELINE.md).
+Runs the scheduler_perf SchedulingBasic 5000Nodes_10000Pods workload
+(kubernetes_tpu/perf/configs/misc.yaml, mirroring the reference's
+test/integration/scheduler_perf/misc/performance-config.yaml:71-80) through
+the real pipeline: store → informers → scheduling queue → batched TPU wave
+kernel → assume/reserve/permit → bind writeback to the store — the same
+path the reference measures against a real apiserver+etcd. Decisions are
+bit-identical to the sequential host path (seeded tie-break included).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's CI threshold for this workload, 270 pods/s on the
+16-goroutine host path (BASELINE.md). Throughput is the measured-phase
+Average from 1-second bind windows (util.go:459-603 semantics); p50/p99 of
+the pod-scheduling SLI latency ride along.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
-import time
+import os
+import sys
 
-N_NODES = 5000
-N_PODS = 2000
 BASELINE_PODS_PER_S = 270.0
+WAVE_SIZE = 512
 
 
 def main() -> None:
-    import numpy as np
+    base = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, base)
+    # persistent XLA compilation cache: the big wave programs compile once
+    # per machine; repeat runs measure steady-state scheduling, not compiles
+    os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "true")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(base, ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
-    from kubernetes_tpu.api.resource import ResourceNames
-    from kubernetes_tpu.ops import stack_features
-    from kubernetes_tpu.ops.kernels import batched_assign
-    from kubernetes_tpu.scheduler.tpu.backend import TPUBackend
-    from kubernetes_tpu.testing import make_pod, synthetic_cluster, with_spread
+    from kubernetes_tpu.perf.harness import WorkloadExecutor, load_config
 
-    names = ResourceNames()
-    _, snapshot = synthetic_cluster(N_NODES, init_pods_per_node=1, names=names)
-    backend = TPUBackend(names)
+    cases = load_config(os.path.join(base, "kubernetes_tpu/perf/configs/misc.yaml"))
+    case = next(c for c in cases if c["name"] == "SchedulingBasic")
+    workload = next(w for w in case["workloads"]
+                    if w["name"] == "5000Nodes_10000Pods")
 
-    pods = []
-    for i in range(N_PODS):
-        p = make_pod(f"measure-{i}", cpu="900m", mem="1Gi", labels={"app": "measure"})
-        p = with_spread(p, max_skew=5, key="topology.kubernetes.io/zone",
-                        when="DoNotSchedule")
-        pods.append(p)
+    executor = WorkloadExecutor(case, workload, backend="tpu",
+                                wave_size=WAVE_SIZE)
+    result = executor.run()
 
-    # host-side prep: vocab registration + planes + per-pod features
-    for p in pods:
-        backend.extractor.register(p)
-    planes = backend.sync(snapshot)
-    feats = stack_features([backend.extractor.features(p, planes) for p in pods])
-    dev_planes = backend.device_inputs(planes)
-    cfg = backend.kernel_config(planes, feats)
-
-    import jax
-
-    # warm-up compiles the exact program shape; steady-state is what CI
-    # thresholds measure (throughput over a long measured wave)
-    winners, _ = batched_assign(cfg, dev_planes, feats)
-    jax.block_until_ready(winners)
-
-    t0 = time.perf_counter()
-    winners, _ = batched_assign(cfg, dev_planes, feats)
-    winners = np.asarray(winners)
-    dt = time.perf_counter() - t0
-
-    placed = int((winners >= 0).sum())
-    assert placed == N_PODS, f"only {placed}/{N_PODS} pods placed"
-    pods_per_s = N_PODS / dt
+    sli = {}
+    for item in result.data_items:
+        if item.unit == "seconds":
+            sli = item.data
+    algo = executor.scheduler.algorithms["default-scheduler"]
+    pods_per_s = result.throughput
+    expected = sum(int(v) for k, v in workload["params"].items()
+                   if k.endswith("Pods"))
+    if result.scheduled < expected:
+        print(json.dumps({
+            "metric": "full_pipeline_scheduling_throughput_5k_nodes",
+            "value": 0.0,
+            "unit": "pods/s",
+            "vs_baseline": 0.0,
+            "error": f"only {result.scheduled}/{expected} pods scheduled",
+        }))
+        sys.exit(1)
     print(json.dumps({
-        "metric": "batched_tpu_scheduling_throughput_5k_nodes",
+        "metric": "full_pipeline_scheduling_throughput_5k_nodes",
         "value": round(pods_per_s, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_s / BASELINE_PODS_PER_S, 2),
+        "scheduled": result.scheduled,
+        "sli_p50_s": sli.get("Perc50"),
+        "sli_p99_s": sli.get("Perc99"),
+        "kernel_pods": algo.kernel_count,
+        "fallback_pods": algo.fallback_count,
     }))
 
 

@@ -18,6 +18,7 @@ host path and the device kernels see the *same* numbers.
 from __future__ import annotations
 
 from fractions import Fraction
+from functools import lru_cache
 
 # Decimal and binary SI suffixes, as in apimachinery's Quantity.
 _SUFFIX: dict[str, Fraction] = {
@@ -40,7 +41,11 @@ _SUFFIX: dict[str, Fraction] = {
 _MIB = Fraction(2**20)
 
 
+@lru_cache(maxsize=4096)
 def _parse(s: str | int | float) -> Fraction:
+    """Memoized: clusters use a handful of distinct quantity strings across
+    millions of parses (every PodInfo/NodeInfo build); Fraction results are
+    immutable so sharing is safe."""
     if isinstance(s, (int, float)):
         return Fraction(s).limit_denominator(10**9)
     s = s.strip()

@@ -9,6 +9,7 @@ dataclass, treated as immutable once written to the store.
 
 from __future__ import annotations
 
+import copy as copy_mod
 from dataclasses import dataclass, field
 from typing import Mapping
 
@@ -342,3 +343,78 @@ class Binding:
     target_node: str = ""
 
     kind = "Binding"
+
+
+# --- fast deepcopy hooks --------------------------------------------------
+#
+# The store's etcd-style isolation deepcopies objects on every write and
+# event emit (store/store.py); generic copy.deepcopy recurses ~100 frames
+# per Pod and dominated the full-pipeline profile. These hooks keep the
+# exact copy semantics while sharing the immutable fragments: every
+# frozen dataclass here holds only str/int/tuples of frozen values, so
+# returning self is a correct deepcopy (the client-go convention — spec
+# fragments are never mutated in place, new values replace them).
+
+
+def _identity_deepcopy(self, memo):
+    return self
+
+
+for _frozen in (
+    NodeSelectorRequirement, NodeSelectorTerm, NodeSelector,
+    PreferredSchedulingTerm, NodeAffinity, PodAffinityTerm,
+    WeightedPodAffinityTerm, PodAffinity, PodAntiAffinity, Affinity,
+    Taint, Toleration, TopologySpreadConstraint, ContainerPort,
+    SchedulingGroup, ContainerImage, GangPolicy, TopologyConstraint,
+    SchedulingConstraints,
+):
+    _frozen.__deepcopy__ = _identity_deepcopy  # type: ignore[attr-defined]
+
+
+def _container_deepcopy(self: Container, memo) -> Container:
+    return Container(self.name, self.image, dict(self.requests),
+                     dict(self.limits), self.ports)
+
+
+def _podspec_deepcopy(self: PodSpec, memo) -> PodSpec:
+    s = copy_mod.copy(self)  # shallow: immutable/str fields carried over
+    s.containers = [_container_deepcopy(c, memo) for c in self.containers]
+    s.init_containers = [_container_deepcopy(c, memo) for c in self.init_containers]
+    s.overhead = dict(self.overhead)
+    s.node_selector = dict(self.node_selector)
+    return s
+
+
+def _podstatus_deepcopy(self: PodStatus, memo) -> PodStatus:
+    s = copy_mod.copy(self)
+    s.conditions = [copy_mod.copy(c) for c in self.conditions]
+    return s
+
+
+def _pod_deepcopy(self: Pod, memo) -> Pod:
+    return Pod(meta=self.meta.copy(),
+               spec=_podspec_deepcopy(self.spec, memo),
+               status=_podstatus_deepcopy(self.status, memo))
+
+
+def _nodestatus_deepcopy(self: NodeStatus, memo) -> NodeStatus:
+    s = copy_mod.copy(self)
+    s.capacity = dict(self.capacity)
+    s.allocatable = dict(self.allocatable)
+    s.conditions = [copy_mod.copy(c) for c in self.conditions]
+    s.images = list(self.images)  # ContainerImage is frozen: share entries
+    return s
+
+
+def _node_deepcopy(self: Node, memo) -> Node:
+    return Node(meta=self.meta.copy(),
+                spec=copy_mod.copy(self.spec),  # taints tuple shared (frozen)
+                status=_nodestatus_deepcopy(self.status, memo))
+
+
+Container.__deepcopy__ = _container_deepcopy  # type: ignore[attr-defined]
+PodSpec.__deepcopy__ = _podspec_deepcopy  # type: ignore[attr-defined]
+PodStatus.__deepcopy__ = _podstatus_deepcopy  # type: ignore[attr-defined]
+Pod.__deepcopy__ = _pod_deepcopy  # type: ignore[attr-defined]
+NodeStatus.__deepcopy__ = _nodestatus_deepcopy  # type: ignore[attr-defined]
+Node.__deepcopy__ = _node_deepcopy  # type: ignore[attr-defined]

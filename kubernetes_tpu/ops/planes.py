@@ -369,6 +369,8 @@ class PodFeatureExtractor:
         self._aff_specs: list = []
         self._aff_tables: dict | None = None
         self._aff_tables_key: tuple | None = None
+        self._feat_cache: dict = {}
+        self._feat_cache_key: tuple | None = None
 
     # -- vocab registration (must run before PlaneBuilder.sync) -------------
 
@@ -405,6 +407,31 @@ class PodFeatureExtractor:
                 self.vocabs.images.id(c.image)
 
     # -- extraction ----------------------------------------------------------
+
+    def features_cached(self, pod: Pod, planes: Planes) -> dict[str, np.ndarray]:
+        """features() memoized by pod shape: pods identical up to their name
+        share one extraction (the dense analogue of SignPod sharing one
+        score list, staging/.../framework/signers.go). Safe because every
+        feature is a pure function of (spec, namespace, labels) and the
+        vocab/bucket epoch — the cache clears when either changes. Callers
+        must not mutate the returned arrays (stack_features copies)."""
+        # epoch: features are pure in (spec, ns, labels) given vocab contents
+        # (fingerprint = exact vocab lengths), bucket shapes, and the node
+        # list (name_idx; node_index is fixed per Planes object). Plane ROW
+        # content (used/counts) never enters features, so the cache survives
+        # across waves.
+        epoch = (planes.bucket_sizes, id(planes),
+                 _canonical_fingerprint(self.vocabs, self.names))
+        if self._feat_cache_key != epoch:
+            self._feat_cache.clear()
+            self._feat_cache_key = epoch
+        key = (pod.meta.namespace, tuple(sorted(pod.meta.labels.items())),
+               repr(pod.spec))
+        f = self._feat_cache.get(key)
+        if f is None:
+            f = self.features(pod, planes)
+            self._feat_cache[key] = f
+        return f
 
     def features(self, pod: Pod, planes: Planes) -> dict[str, np.ndarray]:
         """Fixed-shape per-pod kernel inputs, aligned to `planes` buckets."""

@@ -79,33 +79,69 @@ class WorkloadResult:
 class ThroughputCollector:
     """Windowed pods/s from bind timestamps (util.go collector semantics:
     1-second windows over the measurement phase, then
-    Average/Perc50/90/95/99 over the window series)."""
+    Average/Perc50/90/95/99 over the window series), plus the pod-scheduling
+    SLI latency series (create→bind per pod; the in-process analogue of
+    scheduler_pod_scheduling_sli_duration_seconds, metrics.go:312, collected
+    per workload at util.go:364-457)."""
 
     def __init__(self, store: Store, namespace_filter: str | None = None):
         self.store = store
         self.bind_times: dict[str, float] = {}
+        self.create_times: dict[str, float] = {}
         self._watch = None
 
     def start(self) -> None:
-        self._watch = self.store.watch("Pod")
+        # watch from the CURRENT revision: replaying the full log would pull
+        # pre-measurement init pods into the throughput span and SLI series
+        self._watch = self.store.watch("Pod", from_revision=self.store.revision)
 
     def pump(self) -> None:
         if self._watch is None:
             return
+        from ..store.store import ADDED
+
         for ev in self._watch.drain():
             pod = ev.obj
-            if ev.type == MODIFIED and pod.spec.node_name:
+            if ev.type == ADDED and not pod.spec.node_name:
+                self.create_times.setdefault(pod.meta.key, ev.ts)
+            elif ev.type == MODIFIED and pod.spec.node_name:
                 # ev.ts is the store write time — the true bind instant, not
                 # the (batched) drain time
                 self.bind_times.setdefault(pod.meta.key, ev.ts)
 
-    def stop(self) -> DataItem:
+    def sli_latency(self) -> DataItem:
+        lats = sorted(
+            self.bind_times[k] - t0
+            for k, t0 in self.create_times.items()
+            if k in self.bind_times
+        )
+
+        def perc(q: float) -> float:
+            if not lats:
+                return 0.0
+            return lats[min(int(q * len(lats)), len(lats) - 1)]
+
+        avg = sum(lats) / len(lats) if lats else 0.0
+        return DataItem(
+            {
+                "Average": round(avg, 4),
+                "Perc50": round(perc(0.50), 4),
+                "Perc90": round(perc(0.90), 4),
+                "Perc95": round(perc(0.95), 4),
+                "Perc99": round(perc(0.99), 4),
+            },
+            "seconds",
+            labels={"Metric": "scheduler_pod_scheduling_sli_duration_seconds"},
+        )
+
+    def stop(self) -> list[DataItem]:
         self.pump()
         if self._watch is not None:
             self._watch.stop()
+        sli = self.sli_latency()
         times = sorted(self.bind_times.values())
         if len(times) < 2:
-            return DataItem({"Average": 0.0}, "pods/s")
+            return [DataItem({"Average": 0.0}, "pods/s"), sli]
         start, end = times[0], times[-1]
         total = len(times)
         span = max(end - start, 1e-6)
@@ -126,22 +162,26 @@ class ThroughputCollector:
             idx = min(int(q * len(windows)), len(windows) - 1)
             return windows[idx]
 
-        return DataItem(
-            {
-                "Average": round(total / span, 2),
-                "Perc50": round(perc(0.50), 2),
-                "Perc90": round(perc(0.90), 2),
-                "Perc95": round(perc(0.95), 2),
-                "Perc99": round(perc(0.99), 2),
-            },
-            "pods/s",
-        )
+        return [
+            DataItem(
+                {
+                    "Average": round(total / span, 2),
+                    "Perc50": round(perc(0.50), 2),
+                    "Perc90": round(perc(0.90), 2),
+                    "Perc95": round(perc(0.95), 2),
+                    "Perc99": round(perc(0.99), 2),
+                },
+                "pods/s",
+            ),
+            sli,
+        ]
 
 
 class WorkloadExecutor:
     """executor.go WorkloadExecutor — interprets one workload's op list."""
 
-    def __init__(self, test_case: dict, workload: dict, backend: str = "host"):
+    def __init__(self, test_case: dict, workload: dict, backend: str = "host",
+                 wave_size: int = 0):
         self.test_case = test_case
         self.workload = workload
         self.params = dict(workload.get("params", {}))
@@ -152,7 +192,10 @@ class WorkloadExecutor:
         self.metrics = SchedulerMetrics()
         self.scheduler = Scheduler(
             self.store,
-            profiles=[Profile(backend=backend)],
+            profiles=[Profile(
+                backend=backend,
+                wave_size=wave_size if backend == "tpu" else 0,
+            )],
             feature_gates=self.feature_gates,
             metrics=self.metrics,
             async_api_calls=self.feature_gates.get("SchedulerAsyncAPICalls", False),
@@ -415,7 +458,7 @@ class WorkloadExecutor:
 
     def _stop_collecting(self) -> None:
         self._collecting = False
-        self.data_items.append(self.collector.stop())
+        self.data_items.extend(self.collector.stop())
 
 
 def load_config(path: str | Path) -> list[dict]:
@@ -431,6 +474,7 @@ def run_workloads(
     labels: set[str] | None = None,
     backend: str = "host",
     name_filter: str | None = None,
+    wave_size: int = 0,
 ) -> list[WorkloadResult]:
     """Run every workload matching the label selector (CI behavior: pick by
     labels like integration-test/short/performance)."""
@@ -443,7 +487,8 @@ def run_workloads(
             full = f"{case['name']}/{workload['name']}"
             if name_filter and name_filter not in full:
                 continue
-            executor = WorkloadExecutor(case, workload, backend=backend)
+            executor = WorkloadExecutor(case, workload, backend=backend,
+                                        wave_size=wave_size)
             results.append(executor.run())
     return results
 
@@ -457,11 +502,14 @@ def main(argv: list[str] | None = None) -> int:
                         help="comma-separated label selector")
     parser.add_argument("--backend", default="host", choices=["host", "tpu"])
     parser.add_argument("--filter", default=None, help="substring name filter")
+    parser.add_argument("--wave", type=int, default=0,
+                        help="batched wave size (tpu backend only)")
     args = parser.parse_args(argv)
     labels = set(args.labels.split(",")) if args.labels else None
     all_ok = True
     for config in args.configs:
-        for result in run_workloads(config, labels, args.backend, args.filter):
+        for result in run_workloads(config, labels, args.backend, args.filter,
+                                    wave_size=args.wave):
             status = "ok" if result.passed else "BELOW THRESHOLD"
             print(json.dumps({
                 "workload": result.name,

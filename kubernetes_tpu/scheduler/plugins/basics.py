@@ -248,9 +248,14 @@ class DefaultBinder(Plugin):
         from ...store.store import ConflictError, NotFoundError
 
         try:
-            cur = self._store.get("Pod", pod.meta.key)
-            cur.spec.node_name = node_name
-            self._store.update(cur, check_version=False)
+            bind_sub = getattr(self._store, "bind_pod", None)
+            if bind_sub is not None:
+                # binding subresource — the reference's actual API shape
+                bind_sub(pod.meta.key, node_name)
+            else:
+                cur = self._store.get("Pod", pod.meta.key)
+                cur.spec.node_name = node_name
+                self._store.update(cur, check_version=False)
         except (NotFoundError, ConflictError) as e:
             return Status.as_error(e, self.name)
         return Status()

@@ -350,11 +350,16 @@ class ScheduleOneLoop:
         if not status.is_success:
             self._handle_scheduling_failure(fw, qpi, status, scheduling_cycle)
             return
-        # A pod parked at Permit (gang quorum wait) MUST bind on a thread even
-        # in sync mode: the scheduling loop has to keep scheduling its
-        # siblings or quorum never arrives (reference: bindingCycle is always
-        # a goroutine, schedule_one.go:146).
-        must_thread = fw.waiting_pod(pod.meta.key) is not None
+        self._dispatch_binding(state, fw, qpi, result)
+
+    def _dispatch_binding(self, state, fw: Framework, qpi: QueuedPodInfo,
+                          result: ScheduleResult) -> None:
+        """Run the binding cycle inline or on a thread. A pod parked at
+        Permit (gang quorum wait) MUST bind on a thread even in sync mode:
+        the scheduling loop has to keep scheduling its siblings or quorum
+        never arrives (reference: bindingCycle is always a goroutine,
+        schedule_one.go:146)."""
+        must_thread = fw.waiting_pod(qpi.pod.meta.key) is not None
         if self.async_binding or must_thread:
             import threading
 
@@ -365,6 +370,121 @@ class ScheduleOneLoop:
             t.start()
         else:
             self._binding_cycle(state, fw, qpi, result)
+
+    # -- batched wave -------------------------------------------------------------
+
+    def schedule_wave(self, max_pods: int = 256, timeout: float | None = 0.0) -> int:
+        """Pop a run of wave-eligible pods and schedule them in ONE device
+        program (TPUBackend.run_batched), then run the normal per-pod
+        assume/reserve/permit/bind cycle for each winner.
+
+        Decisions are bit-identical to popping the same pods one at a time
+        (the scan carries assumes between pods and draws the host selectHost
+        tie-break from the algorithm's rng). Ineligible pods — gang members,
+        claim/extender pods, nominated pods, non-TPU profiles — end the wave
+        and go through the per-pod path, preserving queue order semantics.
+
+        Returns the number of pods processed (0 = queue empty)."""
+        from .tpu.backend import TPUSchedulingAlgorithm
+
+        wave: list[QueuedPodInfo] = []
+        wave_algo = None
+        trailer: QueuedPodInfo | None = None
+        while len(wave) < max_pods:
+            qpi = self.queue.pop(timeout=timeout if not wave and not trailer else 0.0)
+            if qpi is None:
+                break
+            pod = qpi.pod
+            fw = self.framework_for_pod(pod)
+            if fw is None:
+                self.queue.done(qpi.key)
+                continue
+            if self._skip_pod_schedule(fw, pod):
+                self.queue.done(qpi.key)
+                continue
+            algo = self.algorithms.get(fw.profile_name)
+            eligible = (
+                isinstance(algo, TPUSchedulingAlgorithm)
+                and pod.spec.scheduling_group is None
+                and not algo._must_fall_back(pod)
+                and (wave_algo is None or algo is wave_algo)
+            )
+            if not eligible:
+                trailer = qpi
+                break
+            wave_algo = algo
+            wave.append(qpi)
+
+        if not wave:
+            if trailer is not None:
+                self.schedule_pod_info(trailer)
+                return 1
+            return 0
+
+        # split into power-of-two chunks (descending) so the device sees a
+        # bounded set of program shapes — variable remainder sizes would
+        # force a fresh XLA compile per odd-sized wave. Chunks < 8 pods go
+        # through the per-pod path (tiny programs aren't worth a compile).
+        processed = 0
+        i = 0
+        while i < len(wave):
+            remaining = len(wave) - i
+            chunk = 1 << (remaining.bit_length() - 1)  # largest pow2 <= remaining
+            chunk = min(chunk, max_pods)
+            if chunk < 8:
+                for qpi in wave[i:]:
+                    self.schedule_pod_info(qpi)
+                    processed += 1
+                break
+            processed += self._run_wave(wave_algo, wave[i : i + chunk])
+            i += chunk
+        if trailer is not None:
+            self.schedule_pod_info(trailer)
+            processed += 1
+        return processed
+
+    def _run_wave(self, algo, wave: list) -> int:
+        from ..ops import FallbackNeeded
+
+        self.cache.update_snapshot(self.snapshot)
+        pods = [qpi.pod for qpi in wave]
+        try:
+            hosts, planes = algo.backend.run_batched(
+                pods, self.snapshot, rng=algo.rng
+            )
+        except FallbackNeeded:
+            algo.fallback_count += len(wave)
+            for qpi in wave:
+                self.schedule_pod_info(qpi)
+            return len(wave)
+        algo.kernel_count += len(wave)
+        invalidated = False
+        for i, (qpi, host) in enumerate(zip(wave, hosts)):
+            if invalidated or host is None:
+                # host=None: re-run the per-pod cycle — it reproduces the
+                # FitError with a full diagnosis and drives preemption.
+                # invalidated: a prior wave member failed assume/reserve/
+                # permit, so the scan's carry (which assumed it placed) no
+                # longer matches the cache — later precomputed placements
+                # are stale; recompute each per-pod against live state.
+                self.schedule_pod_info(qpi)
+                continue
+            fw = self.framework_for_pod(qpi.pod)
+            state = CycleState()
+            result = ScheduleResult(
+                suggested_host=host,
+                evaluated_nodes=planes.n,
+                feasible_nodes=1,
+            )
+            result, status = self._finish_scheduling_cycle(state, fw, qpi, result)
+            if not status.is_success:
+                self._handle_scheduling_failure(
+                    fw, qpi, status, self.queue.moved_count
+                )
+                invalidated = True
+                continue
+            self._dispatch_binding(state, fw, qpi, result)
+        return len(wave)
 
     # -- scheduling cycle ---------------------------------------------------------
 
@@ -396,6 +516,16 @@ class ScheduleOneLoop:
         except Exception as e:  # noqa: BLE001
             return None, Status.as_error(e)
 
+        return self._finish_scheduling_cycle(state, fw, qpi, result)
+
+    def _finish_scheduling_cycle(
+        self, state: CycleState, fw: Framework, qpi: QueuedPodInfo,
+        result: ScheduleResult,
+    ) -> tuple[ScheduleResult | None, Status]:
+        """assume + reserve + permit (the post-algorithm half of the
+        scheduling cycle, schedule_one.go:320-393) — shared by the per-pod
+        path and the batched wave path."""
+        pod = qpi.pod
         # assume (schedule_one.go:320,1106): cache sees the pod on the node now
         assumed = pod
         try:

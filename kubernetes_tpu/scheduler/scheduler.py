@@ -42,6 +42,10 @@ class Profile:
     plugin_args: dict = field(default_factory=dict)
     weights: dict = field(default_factory=lambda: dict(DEFAULT_WEIGHTS))
     backend: str = "host"  # "host" | "tpu"
+    # >0 with backend="tpu": schedule_pending pops runs of up to wave_size
+    # pods and schedules each run in ONE device program (bit-identical to
+    # per-pod, see ScheduleOneLoop.schedule_wave) — the throughput mode
+    wave_size: int = 0
 
 
 class Scheduler:
@@ -77,6 +81,8 @@ class Scheduler:
         ]
 
         profiles = profiles or [Profile()]
+        self.wave_size = max((p.wave_size for p in profiles
+                              if p.backend == "tpu"), default=0)
         self.frameworks: dict[str, Framework] = {}
         self.algorithms: dict[str, SchedulingAlgorithm] = {}
         pre_enqueue = []
@@ -333,13 +339,17 @@ class Scheduler:
         idle_rounds = 0
         for _ in range(max_cycles):
             self.pump()
-            if not self.loop.schedule_one(timeout=0.0):
+            if self.wave_size > 0:
+                n = self.loop.schedule_wave(self.wave_size, timeout=0.0)
+            else:
+                n = 1 if self.loop.schedule_one(timeout=0.0) else 0
+            if n == 0:
                 idle_rounds += 1
                 if idle_rounds > 2:
                     break
                 continue
             idle_rounds = 0
-            scheduled += 1
+            scheduled += n
         self.loop.wait_for_bindings()
         self.pump()
         return scheduled

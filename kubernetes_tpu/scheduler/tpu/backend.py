@@ -87,6 +87,8 @@ class TPUBackend:
         )
         self._device_planes: dict | None = None
         self._device_version = -1
+        self._device_buckets: tuple | None = None
+        self._pending_dirty: set[int] | None = set()  # None = full re-put
         self._device_tables: dict | None = None
         self._tables_src: dict | None = None
         self._jax = jax
@@ -136,23 +138,53 @@ class TPUBackend:
         )
 
     def sync(self, snapshot):
-        """Refresh host planes from the snapshot (O(changed) by generation)."""
-        return self.builder.sync(snapshot)
+        """Refresh host planes from the snapshot (O(changed) by generation),
+        accumulating dirty rows for the device delta-upload."""
+        planes = self.builder.sync(snapshot)
+        if self._pending_dirty is not None:
+            dirty = self.builder.dirty_rows
+            if dirty is None:
+                self._pending_dirty = None  # full rebuild happened
+            else:
+                self._pending_dirty.update(dirty)
+        return planes
 
     def device_inputs(self, planes) -> dict:
         """Node planes + affinity signature tables, mirrored to device HBM.
 
         Call AFTER feature extraction — features intern affinity signatures.
-        Unchanged planes cost nothing (version check); tables re-upload only
-        when a new signature, label group, or node set appears. Row-granular
-        device scatter is a round-2 optimization; the arrays are ~1 MB at
-        5k nodes so full re-put is not the bottleneck yet.
+        Unchanged planes cost nothing (version check); when only some node
+        rows changed since the last upload (the steady state: each wave's
+        binds dirty ≤ wave_size rows) the update is a per-plane row scatter
+        instead of a full host→device re-put of every [Nb, ...] array.
         """
-        if self._device_planes is None or self._device_version != planes.version:
+        full = (
+            self._device_planes is None
+            or self._pending_dirty is None
+            or self._device_buckets != planes.bucket_sizes
+        )
+        if full:
             self._device_planes = {
                 k: self._jax.device_put(a) for k, a in planes.as_dict().items()
             }
-            self._device_version = planes.version
+        elif self._device_version != planes.version and self._pending_dirty:
+            # pad the dirty index list to a pow2 bucket (repeat the first
+            # index — duplicate scatter writes of identical rows are benign)
+            # so XLA sees a bounded set of scatter shapes, not one per wave
+            from ...ops.vocab import next_pow2
+
+            rows = sorted(self._pending_dirty)
+            pad = next_pow2(len(rows), 8) - len(rows)
+            idx = np.array(rows + [rows[0]] * pad, np.int32)
+            host = planes.as_dict()
+            dev = self._device_planes
+            for k, a in host.items():
+                if k == "ipa_term_key":
+                    continue  # global table; changes force a full rebuild
+                dev[k] = dev[k].at[idx].set(a[idx])
+        self._device_version = planes.version
+        self._device_buckets = planes.bucket_sizes
+        self._pending_dirty = set()
         tables = self.extractor.affinity_tables(planes)
         if self._tables_src is not tables:
             self._device_tables = {
@@ -196,7 +228,9 @@ class TPUBackend:
         for pod in pods:
             self.extractor.register(pod)
         planes = self.sync(snapshot)
-        feats = stack_features([self.extractor.features(p, planes) for p in pods])
+        feats = stack_features(
+            [self.extractor.features_cached(p, planes) for p in pods]
+        )
         dev = self.device_inputs(planes)
         cfg = self.kernel_config(planes, feats)
         tie_words = rng_state = None

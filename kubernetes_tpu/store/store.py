@@ -187,6 +187,29 @@ class Store:
             self._emit(kind, Event(MODIFIED, copy.deepcopy(obj), rev, time.perf_counter()))
             return copy.deepcopy(obj)
 
+    def bind_pod(self, key: str, node_name: str) -> Any:
+        """pods/binding subresource (reference: POST pods/<name>/binding,
+        registry/core/pod/rest BindingREST): stamp spec.node_name without a
+        full-object round trip. One copy total — the emitted event shares
+        the new stored object (informer convention: event objects are
+        read-only, as in client-go's shared caches)."""
+        with self._mu:
+            objs = self._objects.get("Pod", {})
+            cur = objs.get(key)
+            if cur is None:
+                raise NotFoundError(f"Pod {key}")
+            if cur.spec.node_name:
+                raise ConflictError(
+                    f"pod {key} is already bound to {cur.spec.node_name}"
+                )
+            obj = copy.deepcopy(cur)
+            obj.spec.node_name = node_name
+            rev = self._bump()
+            obj.meta.resource_version = rev
+            objs[key] = obj
+            self._emit("Pod", Event(MODIFIED, obj, rev, time.perf_counter()))
+            return obj
+
     def delete(self, kind: str, key: str) -> Any:
         with self._mu:
             objs = self._objects.get(kind, {})

@@ -62,8 +62,38 @@ def test_throughput_collector_windows():
         pod.spec.node_name = "n1"
         store.update(pod, check_version=False)
         time.sleep(0.005)
-    item = c.stop()
+    items = c.stop()
+    item = items[0]
     assert item.unit == "pods/s"
     # ~20 binds over ~0.1s -> avg in the hundreds, far from the 1e6 regime
     # that drain-time stamping produced
     assert 50 < item.data["Average"] < 5000
+    sli = items[1]
+    assert sli.unit == "seconds"
+    assert sli.labels["Metric"] == "scheduler_pod_scheduling_sli_duration_seconds"
+    assert 0 <= sli.data["Perc50"] <= sli.data["Perc99"] < 1.0
+
+
+def test_wave_mode_bindings_match_host():
+    """The batched wave pipeline (backend=tpu, wave_size>0) must produce the
+    same bindings as the host backend on the same workload — the
+    full-pipeline analogue of the kernel golden tests."""
+    from kubernetes_tpu.perf.harness import WorkloadExecutor, load_config
+
+    cases = load_config(CONFIG_DIR / "misc.yaml")
+    case = next(c for c in cases if c["name"] == "SchedulingBasic")
+    wl = next(w for w in case["workloads"] if w["name"] == "50Nodes")
+
+    host = WorkloadExecutor(case, wl, backend="host")
+    host_result = host.run()
+    host_binds = {p.meta.name: p.spec.node_name for p in host.store.pods()}
+
+    wave = WorkloadExecutor(case, wl, backend="tpu", wave_size=32)
+    wave_result = wave.run()
+    wave_binds = {p.meta.name: p.spec.node_name for p in wave.store.pods()}
+
+    assert host_result.scheduled == wave_result.scheduled
+    assert host_binds == wave_binds
+    algo = wave.scheduler.algorithms["default-scheduler"]
+    assert algo.kernel_count > 0
+    assert algo.fallback_count == 0
