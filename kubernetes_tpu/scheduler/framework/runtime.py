@@ -325,6 +325,10 @@ class Framework:
     def waiting_pod(self, key: str) -> WaitingPod | None:
         return self._waiting_pods.get(key)
 
+    def remove_waiting_pod(self, key: str) -> None:
+        """Drop a permit waiter without a decision (group-cycle revert)."""
+        self._waiting_pods.pop(key, None)
+
     def iterate_waiting_pods(self):
         return list(self._waiting_pods.values())
 

@@ -82,6 +82,10 @@ def default_plugins(store, names: ResourceNames, feature_gates=None, args: dict 
         from .gang_scheduling import GangScheduling
 
         plugins.insert(1, GangScheduling())
+    if gates.get("TopologyAwareWorkloadScheduling", True):
+        from .topology_placement import TopologyPlacementGenerator
+
+        plugins.append(TopologyPlacementGenerator())
     if gates.get("DefaultPreemption", True):
         from .default_preemption import DefaultPreemption
 

@@ -294,6 +294,7 @@ class ScheduleOneLoop:
         event_recorder=None,
         names=None,
         api_cacher=None,
+        pod_group_cycles: bool = True,
     ):
         from ..api.resource import ResourceNames
 
@@ -308,6 +309,7 @@ class ScheduleOneLoop:
         self.async_binding = async_binding
         self.event_recorder = event_recorder
         self.api_cacher = api_cacher  # SchedulerAsyncAPICalls path
+        self.pod_group_cycles = pod_group_cycles
         self._binding_threads: list = []
 
     def framework_for_pod(self, pod: Pod) -> Framework | None:
@@ -342,6 +344,11 @@ class ScheduleOneLoop:
             return
         if self._skip_pod_schedule(fw, pod):
             self.queue.done(qpi.key)
+            return
+        # whole-gang cycle (ScheduleOne, schedule_one.go:77: SchedulingGroup
+        # + GenericWorkload gate routes to scheduleOnePodGroup)
+        if pod.spec.scheduling_group is not None and self.pod_group_cycles:
+            self.schedule_pod_group(qpi, fw)
             return
 
         state = CycleState()
@@ -485,6 +492,198 @@ class ScheduleOneLoop:
                 continue
             self._dispatch_binding(state, fw, qpi, result)
         return len(wave)
+
+    # -- pod-group (gang) cycle ---------------------------------------------------
+
+    def schedule_pod_group(self, qpi: QueuedPodInfo, fw: Framework) -> None:
+        """scheduleOnePodGroup (schedule_one_podgroup.go:42): pop every
+        unscheduled gang sibling, take ONE snapshot, run the per-pod
+        algorithm with in-snapshot assume + revert, then submit — bindings
+        for all members on success, per-pod failure handling otherwise."""
+        pod = qpi.pod
+        gk = self._group_key(pod)
+        group = self.store.try_get("PodGroup", gk)
+        gstate = self.cache.pod_group_states.get(gk)
+        if group is None or gstate is None:
+            # PreEnqueue normally parks group-less members; be defensive
+            self._handle_scheduling_failure(
+                fw, qpi,
+                Status.unschedulable(f"PodGroup {gk} not found",
+                                     plugin="GangScheduling"),
+                self.queue.moved_count,
+            )
+            return
+
+        # podGroupInfoForPod:119,143 — pop every sibling still queued
+        qpis = [qpi]
+        for key in sorted(gstate.unscheduled):
+            if key == pod.meta.key:
+                continue
+            sib = self.queue.pop_specific(key)
+            if sib is not None:
+                qpis.append(sib)
+        # priority desc, then queue timestamp asc (:151)
+        qpis.sort(key=lambda q: (-q.pod.spec.priority, q.timestamp))
+
+        self.cache.update_snapshot(self.snapshot)
+        outcome = self._pod_group_algorithm(fw, gk, qpis)
+        self._submit_pod_group_result(fw, gk, qpis, outcome)
+
+    def _pod_group_algorithm(self, fw: Framework, gk: str, qpis: list):
+        """podGroupSchedulingAlgorithm (:573): placement enumeration when
+        PlacementGenerate plugins produced >1 candidate (each dry-run in a
+        narrowed snapshot, best picked by PlacementScore), else the default
+        whole-snapshot algorithm."""
+        from .cache.snapshot import Placement
+
+        pods = [q.pod for q in qpis]
+        pstate = CycleState()
+        placements = None
+        required = False
+        if fw.placement_generate_plugins:
+            parent = Placement(
+                "all", [ni.name for ni in self.snapshot.list_nodes()]
+            )
+            placements, _st = fw.run_placement_generate_plugins(
+                pstate, pods, parent
+            )
+            for p in fw.placement_generate_plugins:
+                mode = getattr(p, "topology_mode", lambda _p: None)(pods)
+                required = required or mode == "Required"
+        if placements is not None and len(placements) > 1:
+            # podGroupSchedulingPlacementAlgorithm:520 — dry-run per
+            # placement, score the ones that fit, run the real algorithm
+            # under the winner
+            best = None
+            for pl in placements:
+                self.snapshot.assume_placement(pl)
+                try:
+                    ok = self._pod_group_dry_run(fw, qpis)
+                    if ok:
+                        score = fw.run_placement_score_plugins(pstate, pods, pl)
+                        if best is None or score > best[0]:
+                            best = (score, pl)
+                finally:
+                    self.snapshot.forget_placement()
+            if best is not None:
+                self.snapshot.assume_placement(best[1])
+                try:
+                    return self._pod_group_default_algorithm(fw, gk, qpis)
+                finally:
+                    self.snapshot.forget_placement()
+            if required:
+                return ("unschedulable", qpis[0], Status.unschedulable(
+                    "no topology domain can hold the whole pod group",
+                    plugin="TopologyPlacementGenerator",
+                ))
+            # Preferred topology: fall back to the unconstrained snapshot
+        return self._pod_group_default_algorithm(fw, gk, qpis)
+
+    def _pod_group_dry_run(self, fw: Framework, qpis: list) -> bool:
+        """Does the whole gang fit the (placement-narrowed) snapshot?
+        Schedules each member with in-snapshot assumes, reverts everything,
+        restores the tie-break rng (dry runs must not consume the stream)."""
+        algo = self.algorithms[fw.profile_name]
+        rng_state = algo.rng.getstate()
+        placed: list[tuple[str, str]] = []
+        ok = True
+        for q in qpis:
+            state = CycleState()
+            state.is_pod_group_scheduling_cycle = True
+            try:
+                result = algo.schedule_pod(state, q.pod, self.snapshot)
+            except (FitError, Exception):  # noqa: BLE001
+                ok = False
+                break
+            pi = PodInfo(q.pod, self.names)
+            self.snapshot.assume_pod(pi, result.suggested_host)
+            placed.append((q.pod.meta.key, result.suggested_host))
+        for key, host in reversed(placed):
+            self.snapshot.forget_pod(key, host)
+        algo.rng.setstate(rng_state)
+        return ok
+
+    def _pod_group_default_algorithm(self, fw: Framework, gk: str, qpis: list):
+        """podGroupSchedulingDefaultAlgorithm:275 — sequential per-pod
+        algorithm; assumes go into the SNAPSHOT (schedule_one.go:1113-1118),
+        reserve + permit run per pod (the gang plugin returns Wait until the
+        snapshot group state reaches quorum, then allows every sibling)."""
+        algo = self.algorithms[fw.profile_name]
+        placed: list[tuple] = []  # (qpi, state, result, pod_info)
+        gsnap = self.snapshot.pod_group_states.get(gk)
+        for q in qpis:
+            state = CycleState()
+            state.is_pod_group_scheduling_cycle = True
+            try:
+                result = algo.schedule_pod(state, q.pod, self.snapshot)
+            except FitError as fe:
+                self._revert_pod_group(fw, gk, placed)
+                return ("unschedulable", q, fe)
+            except Exception as e:  # noqa: BLE001
+                self._revert_pod_group(fw, gk, placed)
+                return ("error", q, Status.as_error(e))
+            pi = PodInfo(q.pod, self.names)
+            self.snapshot.assume_pod(pi, result.suggested_host)
+            if gsnap is not None:
+                gsnap.unscheduled.discard(q.pod.meta.key)
+                gsnap.assumed.add(q.pod.meta.key)
+            st = fw.run_reserve_plugins_reserve(state, q.pod, result.suggested_host)
+            if st.is_success:
+                st = fw.run_permit_plugins(state, q.pod, result.suggested_host)
+            if not (st.is_success or st.is_wait):
+                placed.append((q, state, result, pi))
+                self._revert_pod_group(fw, gk, placed)
+                return ("unschedulable" if st.is_rejected else "error", q, st)
+            placed.append((q, state, result, pi))
+        return ("success", placed, None)
+
+    def _revert_pod_group(self, fw: Framework, gk: str, placed: list) -> None:
+        """The deferred revertFn of the group algorithm (schedule_one.go:
+        363-393): unreserve, drop permit waiters, forget in-snapshot assumes,
+        restore the snapshot group state."""
+        gsnap = self.snapshot.pod_group_states.get(gk)
+        for q, state, result, pi in reversed(placed):
+            fw.run_reserve_plugins_unreserve(state, q.pod, result.suggested_host)
+            fw.remove_waiting_pod(q.pod.meta.key)
+            self.snapshot.forget_pod(pi.key, result.suggested_host)
+            if gsnap is not None:
+                gsnap.assumed.discard(q.pod.meta.key)
+                gsnap.unscheduled.add(q.pod.meta.key)
+
+    def _submit_pod_group_result(self, fw: Framework, gk: str, qpis: list,
+                                 outcome) -> None:
+        """submitPodGroupAlgorithmResult:410 — success starts every member's
+        binding cycle; failure routes every member through the failure
+        handler (the failing pod with its own diagnosis)."""
+        kind = outcome[0]
+        if kind == "success":
+            for q, state, result, _pi in outcome[1]:
+                try:
+                    self.cache.assume_pod(q.pod, result.suggested_host)
+                except Exception as e:  # noqa: BLE001
+                    self._handle_scheduling_failure(
+                        fw, q, Status.as_error(e), self.queue.moved_count
+                    )
+                    continue
+                self.cache.pod_group_states.pod_assumed(gk, q.pod.meta.key)
+                self._dispatch_binding(state, fw, q, result)
+            return
+        failing, err = outcome[1], outcome[2]
+        if isinstance(err, FitError):
+            for p in err.diagnosis.unschedulable_plugins:
+                failing.unschedulable_plugins.add(p)
+            fail_status = Status.unschedulable(str(err), plugin="")
+        else:
+            fail_status = err
+        sibling_status = Status.unschedulable(
+            f"pod group {gk}: member {failing.pod.meta.key} did not fit",
+            plugin="GangScheduling",
+        )
+        for q in qpis:
+            self._handle_scheduling_failure(
+                fw, q, fail_status if q is failing else sibling_status,
+                self.queue.moved_count,
+            )
 
     # -- scheduling cycle ---------------------------------------------------------
 

@@ -162,6 +162,7 @@ class Scheduler:
             event_recorder=event_recorder,
             names=self.names,
             api_cacher=self.api_cacher,
+            pod_group_cycles=self.feature_gates.get("GenericWorkload", True),
         )
 
         self._last_leftover_flush = self.clock.now()
