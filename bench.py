@@ -64,6 +64,7 @@ def main() -> None:
             "error": f"only {result.scheduled}/{expected} pods scheduled",
         }))
         sys.exit(1)
+    prof = executor.scheduler.loop.phase_profile
     print(json.dumps({
         "metric": "full_pipeline_scheduling_throughput_5k_nodes",
         "value": round(pods_per_s, 1),
@@ -74,6 +75,10 @@ def main() -> None:
         "sli_p99_s": sli.get("Perc99"),
         "kernel_pods": algo.kernel_count,
         "fallback_pods": algo.fallback_count,
+        "phase_profile_s": {
+            k: (round(v, 2) if isinstance(v, float) else v)
+            for k, v in prof.items()
+        },
     }))
 
 

@@ -11,6 +11,7 @@ from .planes import (
     Planes,
     PlaneBuilder,
     PodFeatureExtractor,
+    pad_features,
     stack_features,
 )
 from .kernels import (
@@ -22,6 +23,6 @@ from .kernels import (
 
 __all__ = [
     "ClusterVocabs", "Vocab", "next_pow2", "FallbackNeeded", "Planes",
-    "PlaneBuilder", "PodFeatureExtractor", "stack_features", "FILTER_NAMES",
-    "KernelConfig", "batched_assign", "fit_and_score",
+    "PlaneBuilder", "PodFeatureExtractor", "pad_features", "stack_features",
+    "FILTER_NAMES", "KernelConfig", "batched_assign", "fit_and_score",
 ]

@@ -771,7 +771,10 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, carry, inp
     # take the top k bits of successive 32-bit MT words, reject r >= nw.
     key = jnp.where(feasible, total, -1)
     best = jnp.max(key)
-    found = best >= 0
+    # inactive slots (wave padding to ONE static shape — a fresh XLA compile
+    # per odd wave size costs far more than scanning dead steps) place
+    # nothing and consume no tie-break words
+    found = (best >= 0) & f["active"]
     mask = feasible & (total == best) & found
     nw = mask.sum().astype(jnp.int32)
     k = jnp.int32(32) - jax.lax.clz(jnp.maximum(nw, 1))
@@ -827,11 +830,21 @@ def _batched_assign_jit(cfg: KernelConfig, planes: dict, batched_f: dict,
     init = (planes["used"], planes["nonzero_used"], planes["sel_counts"],
             dom_counts, ipa, jnp.int32(0), jnp.bool_(False))
     step = functools.partial(_assign_step, cfg, planes, present, tie_words)
-    (used, nonzero_used, sel_counts, _, _, cursor, overflow), winners = \
+    (used, nonzero_used, sel_counts, _, ipa_out, cursor, overflow), winners = \
         jax.lax.scan(step, init, (batched_f, static), unroll=4)
-    return winners, {"used": used, "nonzero_used": nonzero_used,
-                     "sel_counts": sel_counts, "tie_consumed": cursor,
-                     "tie_overflow": overflow}
+    # single-transfer result: winners ++ [tie_consumed, tie_overflow] — the
+    # host reads everything it needs in ONE device→host round trip (the
+    # tunnel's per-transfer latency dominates small fetches)
+    packed = jnp.concatenate([
+        winners.astype(jnp.int32),
+        jnp.stack([cursor, overflow.astype(jnp.int32)]),
+    ])
+    out = {"used": used, "nonzero_used": nonzero_used,
+           "sel_counts": sel_counts, "tie_consumed": cursor,
+           "tie_overflow": overflow, "packed": packed}
+    if ipa_out is not None:
+        out["ipa_counts"], out["ipa_anti"], out["ipa_pref"] = ipa_out
+    return winners, out
 
 
 def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,

@@ -552,6 +552,8 @@ class PodFeatureExtractor:
             if si < s and ns == pod.meta.namespace and sel.matches(pod.meta.labels):
                 sig[si] = 1
         f["sig_match"] = sig
+        # real pod slot (pad_features flips this for wave padding)
+        f["active"] = np.bool_(True)
         return f
 
     def _ipa_features(self, pod: Pod, f: dict, ta: int) -> None:
@@ -733,3 +735,21 @@ def stack_features(feats: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
     if not feats:
         raise ValueError("no features to stack")
     return {k: np.stack([f[k] for f in feats]) for k in feats[0]}
+
+
+def pad_features(stacked: dict[str, np.ndarray], pad_to: int) -> dict[str, np.ndarray]:
+    """Pad a stacked feature batch to `pad_to` pod slots with inactive rows
+    (active=False: the scan step discards their placements and draws no
+    tie-break words). One static batch shape per configured wave size means
+    ONE XLA compile — a fresh compile per odd tail size costs far more than
+    scanning dead steps."""
+    p = stacked["active"].shape[0]
+    if p >= pad_to:
+        return stacked
+    out = {}
+    for k, a in stacked.items():
+        pad = np.zeros((pad_to - p,) + a.shape[1:], a.dtype)
+        if k in ("ipa_aff_t", "ipa_anti_t", "ipa_pref_t"):
+            pad -= 1  # -1 = inactive term slot
+        out[k] = np.concatenate([a, pad])
+    return out

@@ -200,6 +200,7 @@ class APICacher:
     def __init__(self, store, dispatcher: APIDispatcher):
         self.store = store
         self.dispatcher = dispatcher
+        self._wave_seq = 0
 
     def bind_pod(self, pod, node_name: str) -> APICall:
         from ..store.store import NotFoundError
@@ -216,6 +217,26 @@ class APICacher:
             APICall(POD_BINDING, pod.meta.key, execute)
         )
 
+    def bind_pods(self, bindings: list[tuple[str, str]],
+                  on_done: Callable[[list[bool] | None, Exception | None], None] | None = None) -> APICall:
+        """One dispatcher call binding a whole wave (store.bind_pods
+        transaction). The synthetic object key makes each wave its own
+        dedup domain — waves never merge with or supersede each other."""
+        results: list = [None]
+
+        def execute():
+            results[0] = self.store.bind_pods(bindings)
+
+        def finish(err):
+            if on_done is not None:
+                on_done(results[0], err)
+
+        self._wave_seq += 1
+        return self.dispatcher.add(APICall(
+            POD_BINDING, f"__wave__/{self._wave_seq}", execute,
+            on_finish=finish,
+        ))
+
     def patch_pod_status(self, pod, condition=None, nominated_node: str | None = None) -> APICall:
         from ..store.store import NotFoundError
 
@@ -225,6 +246,12 @@ class APICacher:
             except NotFoundError:
                 return
             if condition is not None:
+                # stale-failure guard: wave binds queue under their own key,
+                # so a PodScheduled=False patch can still be pending when the
+                # pod gets bound — never write a failure condition onto a
+                # bound pod (the reference's updatePod drops such patches)
+                if cur.spec.node_name and condition.status == "False":
+                    return
                 for c in cur.status.conditions:
                     if c.type == condition.type:
                         c.status = condition.status

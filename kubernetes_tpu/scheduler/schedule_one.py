@@ -311,6 +311,13 @@ class ScheduleOneLoop:
         self.api_cacher = api_cacher  # SchedulerAsyncAPICalls path
         self.pod_group_cycles = pod_group_cycles
         self._binding_threads: list = []
+        # wall-clock seconds per pipeline phase (batched wave path), reported
+        # by bench.py — the in-process analogue of the reference's
+        # FrameworkExtensionPointDuration histograms (metrics.go:340)
+        self.phase_profile = {
+            "snapshot": 0.0, "kernel": 0.0, "finish": 0.0, "bind": 0.0,
+            "pump": 0.0, "waves": 0,
+        }
 
     def framework_for_pod(self, pod: Pod) -> Framework | None:
         return self.profiles.get(pod.spec.scheduler_name)
@@ -428,44 +435,48 @@ class ScheduleOneLoop:
                 return 1
             return 0
 
-        # split into power-of-two chunks (descending) so the device sees a
-        # bounded set of program shapes — variable remainder sizes would
-        # force a fresh XLA compile per odd-sized wave. Chunks < 8 pods go
-        # through the per-pod path (tiny programs aren't worth a compile).
-        processed = 0
-        i = 0
-        while i < len(wave):
-            remaining = len(wave) - i
-            chunk = 1 << (remaining.bit_length() - 1)  # largest pow2 <= remaining
-            chunk = min(chunk, max_pods)
-            if chunk < 8:
-                for qpi in wave[i:]:
-                    self.schedule_pod_info(qpi)
-                    processed += 1
-                break
-            processed += self._run_wave(wave_algo, wave[i : i + chunk])
-            i += chunk
+        # partial waves are PADDED with inactive slots to the next pow2
+        # bucket (floor 8, cap max_pods): the device sees a bounded set of
+        # program shapes — a fresh XLA compile per odd tail size costs
+        # seconds, dead scan steps cost microseconds — while small trickle
+        # waves still use small programs instead of a full max_pods scan
+        pad_to = 8
+        while pad_to < len(wave):
+            pad_to <<= 1
+        processed = self._run_wave(wave_algo, wave, pad_to=min(pad_to, max_pods))
         if trailer is not None:
             self.schedule_pod_info(trailer)
             processed += 1
         return processed
 
-    def _run_wave(self, algo, wave: list) -> int:
+    def _run_wave(self, algo, wave: list, pad_to: int = 0) -> int:
+        import time as _time
+
         from ..ops import FallbackNeeded
 
+        prof = self.phase_profile
+        t0 = _time.perf_counter()
         self.cache.update_snapshot(self.snapshot)
+        t1 = _time.perf_counter()
         pods = [qpi.pod for qpi in wave]
         try:
             hosts, planes = algo.backend.run_batched(
-                pods, self.snapshot, rng=algo.rng
+                pods, self.snapshot, rng=algo.rng, pad_to=pad_to
             )
         except FallbackNeeded:
             algo.fallback_count += len(wave)
+            prof["snapshot"] += t1 - t0
+            prof["kernel"] += _time.perf_counter() - t1
+            prof["waves"] += 1
+            t_fb = _time.perf_counter()
             for qpi in wave:
                 self.schedule_pod_info(qpi)
+            prof["finish"] += _time.perf_counter() - t_fb
             return len(wave)
+        t2 = _time.perf_counter()
         algo.kernel_count += len(wave)
         invalidated = False
+        batch: list[tuple] = []  # pods bound via the wave's one transaction
         for i, (qpi, host) in enumerate(zip(wave, hosts)):
             if invalidated or host is None:
                 # host=None: re-run the per-pod cycle — it reproduces the
@@ -490,8 +501,81 @@ class ScheduleOneLoop:
                 )
                 invalidated = True
                 continue
-            self._dispatch_binding(state, fw, qpi, result)
+            if fw.waiting_pod(qpi.pod.meta.key) is not None or not self._default_bind_only(fw):
+                # permit-wait (gang quorum) binds on a thread so the loop
+                # keeps scheduling siblings (schedule_one.go:146); custom
+                # bind plugins must run the full per-pod bind chain — the
+                # wave transaction is only the DefaultBinder's batched form
+                self._dispatch_binding(state, fw, qpi, result)
+            else:
+                batch.append((state, fw, qpi, result))
+        t3 = _time.perf_counter()
+        self._bind_wave(batch)
+        t4 = _time.perf_counter()
+        prof["snapshot"] += t1 - t0
+        prof["kernel"] += t2 - t1
+        prof["finish"] += t3 - t2
+        prof["bind"] += t4 - t3
+        prof["waves"] += 1
         return len(wave)
+
+    def _default_bind_only(self, fw: Framework) -> bool:
+        """True when the profile's bind chain is exactly the DefaultBinder —
+        the only binder whose store write the wave transaction replicates."""
+        from .plugins.basics import DefaultBinder
+
+        return (len(fw.bind_plugins) == 1
+                and isinstance(fw.bind_plugins[0], DefaultBinder))
+
+    def _bind_wave(self, batch: list[tuple]) -> None:
+        """The binding cycle for a whole wave: PreBind per pod (host chain —
+        no-ops for kernel-eligible pods), then ONE multi-pod bind transaction
+        (store.bind_pods; routed through the async dispatcher when
+        SchedulerAsyncAPICalls is on so the next wave's scheduling overlaps
+        this wave's API writes — the wave-granular form of the reference's
+        always-async bindingCycle, schedule_one.go:146, and its dispatcher,
+        api_dispatcher.go:32-112), then per-pod completion."""
+        if not batch:
+            return
+        ready: list[tuple] = []
+        for state, fw, qpi, result in batch:
+            st = fw.wait_on_permit(qpi.pod)  # instant: no waiting pod in batch
+            if st.is_success:
+                st = fw.run_pre_bind_plugins(state, qpi.pod, result.suggested_host)
+            if not st.is_success:
+                self._handle_binding_failure(
+                    state, fw, qpi, result.suggested_host, st
+                )
+                continue
+            ready.append((state, fw, qpi, result))
+        if not ready:
+            return
+        bindings = [(q.pod.meta.key, r.suggested_host) for _, _, q, r in ready]
+
+        def complete(results, err):
+            from ..store.store import ConflictError
+
+            for entry, ok in zip(ready, results or [False] * len(ready)):
+                state, fw, qpi, result = entry
+                if err is not None or not ok:
+                    e = err or ConflictError(
+                        f"pod {qpi.pod.meta.key} bind rejected"
+                    )
+                    self._handle_binding_failure(
+                        state, fw, qpi, result.suggested_host, Status.as_error(e)
+                    )
+                    continue
+                self._finish_binding(state, fw, qpi, result.suggested_host)
+
+        if self.api_cacher is not None:
+            self.api_cacher.bind_pods(bindings, on_done=complete)
+            return
+        try:
+            results = self.store.bind_pods(bindings)
+        except Exception as e:  # noqa: BLE001
+            complete(None, e)
+            return
+        complete(results, None)
 
     # -- pod-group (gang) cycle ---------------------------------------------------
 
@@ -783,6 +867,11 @@ class ScheduleOneLoop:
             self._handle_binding_failure(state, fw, qpi, host, st)
             return
 
+        self._finish_binding(state, fw, qpi, host)
+
+    def _finish_binding(self, state, fw: Framework, qpi: QueuedPodInfo, host: str) -> None:
+        """Post-bind tail shared by the per-pod cycle and the wave batch."""
+        pod = qpi.pod
         fw.run_post_bind_plugins(state, pod, host)
         # pod leaves the cycle for good: stop in-flight event tracking only now
         # (a done() before bind would drop events needed on bind failure)
@@ -878,18 +967,40 @@ class ScheduleOneLoop:
             if c.type == "PodScheduled":
                 if c.reason == reason and c.message == msg:
                     return
+                break
+        condition = PodCondition("PodScheduled", "False", reason, msg)
+        if self.api_cacher is not None:
+            # SchedulerAsyncAPICalls: status writes ride the dispatcher so
+            # failure handling never blocks the loop (api_cache.go:29-61);
+            # the queued patch dedups/merges per pod key and is dropped if
+            # the pod binds first (relevance ordering, api_calls.go:33)
+            from .api_dispatcher import CallSkippedError
+
+            try:
+                self.api_cacher.patch_pod_status(pod, condition=condition)
+            except CallSkippedError:
+                pass
+            return
+        for c in cur.status.conditions:
+            if c.type == "PodScheduled":
                 c.status, c.reason, c.message = "False", reason, msg
                 break
         else:
-            cur.status.conditions.append(
-                PodCondition("PodScheduled", "False", reason, msg)
-            )
+            cur.status.conditions.append(condition)
         try:
             self.store.update(cur, check_version=False)
         except Exception:  # noqa: BLE001
             pass
 
     def _patch_nominated_node(self, pod: Pod, node_name: str) -> None:
+        if self.api_cacher is not None:
+            from .api_dispatcher import CallSkippedError
+
+            try:
+                self.api_cacher.patch_pod_status(pod, nominated_node=node_name)
+            except CallSkippedError:
+                pass
+            return
         cur = self.store.try_get("Pod", pod.meta.key)
         if cur is None:
             return

@@ -320,7 +320,11 @@ class Scheduler:
 
     def pump(self) -> int:
         """Drain informer events (deterministic single-thread mode)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         n = self.informers.pump_all()
+        self.loop.phase_profile["pump"] += _time.perf_counter() - t0
         # periodic safety net (reference: 30s ticker -> 5 min leftover flush)
         now = self.clock.now()
         if now - self._last_leftover_flush > 30.0:
@@ -346,6 +350,11 @@ class Scheduler:
                 n = 1 if self.loop.schedule_one(timeout=0.0) else 0
             if n == 0:
                 idle_rounds += 1
+                if self.api_dispatcher is not None:
+                    # flush queued async binds so their events confirm
+                    # assumes (and may unblock gated/waiting pods) before
+                    # declaring the queue drained
+                    self.api_dispatcher.drain(timeout=1.0)
                 if idle_rounds > 2:
                     break
                 continue

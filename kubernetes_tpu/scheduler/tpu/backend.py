@@ -25,7 +25,11 @@ interpodaffinity/filtering.go:91-185, scoring.go:81-257).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
+
+import jax
 
 from ...api.resource import ResourceNames
 from ...api.types import Pod
@@ -46,6 +50,13 @@ from ..framework.interface import (
     Status,
 )
 from ..schedule_one import SchedulingAlgorithm
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows_jit(dev: dict, rows: dict, idx):
+    """Row-scatter every plane in one program (one dispatch, donated
+    buffers): dev[k][idx] = rows[k] for all planes simultaneously."""
+    return {k: dev[k].at[idx].set(rows[k]) for k in dev}
+
 
 # Reconstructed host-path messages + codes per filter mask row.
 _ROW_STATUS = {
@@ -178,10 +189,16 @@ class TPUBackend:
             idx = np.array(rows + [rows[0]] * pad, np.int32)
             host = planes.as_dict()
             dev = self._device_planes
-            for k, a in host.items():
-                if k == "ipa_term_key":
-                    continue  # global table; changes force a full rebuild
-                dev[k] = dev[k].at[idx].set(a[idx])
+            # one fused jitted scatter for every plane: eager per-plane
+            # .at[].set() dispatches (and first-compiles) one tiny program
+            # per plane per idx-bucket — a dozen device round-trip latencies
+            # per wave on a tunneled chip. ipa_term_key is a global table;
+            # its changes force a full rebuild elsewhere.
+            scatter_in = {k: v for k, v in dev.items() if k != "ipa_term_key"}
+            rows_host = {k: host[k][idx] for k in scatter_in}
+            updated = _scatter_rows_jit(scatter_in, rows_host, idx)
+            updated["ipa_term_key"] = dev["ipa_term_key"]
+            self._device_planes = updated
         self._device_version = planes.version
         self._device_buckets = planes.bucket_sizes
         self._pending_dirty = set()
@@ -212,7 +229,8 @@ class TPUBackend:
             "total": np.asarray(out["total"]),
         }
 
-    def run_batched(self, pods: list[Pod], snapshot, rng=None):
+    def run_batched(self, pods: list[Pod], snapshot, rng=None,
+                    pad_to: int = 0):
         """Greedy batched assignment of a pod wave in one device program.
 
         With rng (the scheduling algorithm's seeded random.Random) the wave's
@@ -225,12 +243,18 @@ class TPUBackend:
         same assumes host-side so cache and device state stay coherent."""
         from ...ops.kernels import MAX_TIE_DRAWS
 
+        from ...ops import pad_features
+
         for pod in pods:
             self.extractor.register(pod)
         planes = self.sync(snapshot)
         feats = stack_features(
             [self.extractor.features_cached(p, planes) for p in pods]
         )
+        if pad_to > len(pods):
+            # one static batch shape per configured wave size → one compile
+            feats = pad_features(feats, pad_to)
+        n_slots = max(pad_to, len(pods))
         dev = self.device_inputs(planes)
         cfg = self.kernel_config(planes, feats)
         tie_words = rng_state = None
@@ -242,18 +266,23 @@ class TPUBackend:
             _version, mt, _gauss = rng_state
             rs = np.random.RandomState()
             rs.set_state(("MT19937", np.array(mt[:624], dtype=np.uint32), mt[624]))
-            n_words = len(pods) * MAX_TIE_DRAWS + MAX_TIE_DRAWS
+            n_words = n_slots * MAX_TIE_DRAWS + MAX_TIE_DRAWS
             tie_words = rs.randint(0, 2**32, size=n_words,
                                    dtype=np.uint64).astype(np.uint32)
-        winners, info = batched_assign(cfg, dev, feats, tie_words)
-        winners = np.asarray(winners)
+        _winners_dev, info = batched_assign(cfg, dev, feats, tie_words)
+        # ONE device→host transfer for everything the host needs: winners ++
+        # [tie_consumed, tie_overflow] (separate np.asarray calls each pay
+        # the tunnel's full round-trip latency)
+        packed = np.asarray(info["packed"])
+        winners, consumed, overflow = (
+            packed[: len(pods)], int(packed[-2]), bool(packed[-1])
+        )
         if rng is not None:
-            if bool(info["tie_overflow"]):
+            if overflow:
                 # a step exhausted its draw words (p < 2^-16 per tied step):
                 # results past that step are desynced from the host stream —
                 # discard the wave, untouched rng, host path decides
                 raise FallbackNeeded("tie-break draw overflow")
-            consumed = int(info["tie_consumed"])
             if consumed:
                 # advance the live rng by exactly `consumed` words via the
                 # same state transplant (no Python-loop catch-up)

@@ -210,6 +210,32 @@ class Store:
             self._emit("Pod", Event(MODIFIED, obj, rev, time.perf_counter()))
             return obj
 
+    def bind_pods(self, bindings: list[tuple[str, str]]) -> list[bool]:
+        """Batched pods/binding: one lock acquisition + one event-log pass
+        for a whole scheduling wave of (pod key, node name) pairs — the
+        writeback half of the batched TPU wave (the reference's analogue is
+        the async dispatcher draining one binding call per pod,
+        backend/api_dispatcher/api_dispatcher.go:32-112; a wave is our unit
+        of pipelining, so the transaction is too). Returns per-binding
+        success; a missing or already-bound pod yields False and leaves the
+        rest of the wave untouched."""
+        out: list[bool] = []
+        with self._mu:
+            objs = self._objects.get("Pod", {})
+            for key, node_name in bindings:
+                cur = objs.get(key)
+                if cur is None or cur.spec.node_name:
+                    out.append(False)
+                    continue
+                obj = copy.deepcopy(cur)
+                obj.spec.node_name = node_name
+                rev = self._bump()
+                obj.meta.resource_version = rev
+                objs[key] = obj
+                self._emit("Pod", Event(MODIFIED, obj, rev, time.perf_counter()))
+                out.append(True)
+        return out
+
     def delete(self, kind: str, key: str) -> Any:
         with self._mu:
             objs = self._objects.get(kind, {})
