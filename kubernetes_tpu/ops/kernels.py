@@ -822,13 +822,20 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, carry, inp
 
 @functools.partial(jax.jit, static_argnums=0)
 def _batched_assign_jit(cfg: KernelConfig, planes: dict, batched_f: dict,
-                        tie_words):
+                        tie_words, cursor_init, frame_shift):
     static = jax.vmap(lambda f: _static_pod_parts(cfg, planes, f))(batched_f)
     dom_counts, present = _dom_counts_init(cfg, planes)
     ipa = ((planes["ipa_counts"], planes["ipa_anti"], planes["ipa_pref"])
            if cfg.ipa_active else None)
+    # pipelined launch: an uncollected predecessor wave consumes the first
+    # words of this tie stream; its final cursor arrives as a device scalar
+    # (cursor_init) minus the host-side frame shift — the subtract lives in
+    # the trace so back-to-back waves chain with no host round trip and no
+    # eager scalar op (each eager dispatch costs a device round trip)
+    cursor0 = (jnp.asarray(cursor_init, jnp.int32)
+               - jnp.asarray(frame_shift, jnp.int32))
     init = (planes["used"], planes["nonzero_used"], planes["sel_counts"],
-            dom_counts, ipa, jnp.int32(0), jnp.bool_(False))
+            dom_counts, ipa, cursor0, jnp.bool_(False))
     step = functools.partial(_assign_step, cfg, planes, present, tie_words)
     (used, nonzero_used, sel_counts, _, ipa_out, cursor, overflow), winners = \
         jax.lax.scan(step, init, (batched_f, static), unroll=4)
@@ -848,7 +855,7 @@ def _batched_assign_jit(cfg: KernelConfig, planes: dict, batched_f: dict,
 
 
 def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
-                   tie_words=None):
+                   tie_words=None, cursor_init=0, frame_shift=0):
     """Greedy multi-pod assignment: lax.scan over the pod axis; pod i+1 sees
     pod i's assumed deltas (the in-kernel analogue of the cache assume in
     schedule_one.go:320-333 and of the gang default algorithm, and the
@@ -865,4 +872,6 @@ def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
     used/nonzero_used/sel_counts planes + tie_consumed/tie_overflow)."""
     if tie_words is None:
         tie_words = ZERO_TIE_WORDS
-    return _batched_assign_jit(cfg, planes, batched_f, tie_words)
+    return _batched_assign_jit(cfg, planes, batched_f, tie_words,
+                               np.int32(cursor_init) if isinstance(cursor_init, int) else cursor_init,
+                               np.int32(frame_shift))

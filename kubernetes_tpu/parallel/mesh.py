@@ -140,7 +140,8 @@ def sharded_batched_assign(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
     if tie_words is None:
         tie_words = ZERO_TIE_WORDS
     return _batched_assign_jit(cfg, sharded_planes, replicate(mesh, batched_f),
-                               replicate(mesh, tie_words))
+                               replicate(mesh, tie_words), np.int32(0),
+                               np.int32(0))
 
 
 @functools.partial(jax.jit, static_argnums=0)

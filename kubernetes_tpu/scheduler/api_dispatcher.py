@@ -106,6 +106,20 @@ class APIDispatcher:
 
     # -- workers -------------------------------------------------------------
 
+    def supersede(self, keys: list[str], relevance: int) -> None:
+        """Drop queued calls for these objects with lower relevance — used
+        when a wave bind (queued under its own synthetic key) makes per-pod
+        status patches moot (api_calls.go relevance ordering: a binding
+        replaces a queued status patch for the same pod)."""
+        with self._lock:
+            for key in keys:
+                pending = self._queued.get(key)
+                if pending is not None and pending.relevance < relevance:
+                    del self._queued[key]
+                    pending.done.set()
+                    if self.metrics is not None:
+                        self.metrics.async_api_pending.set(len(self._queued))
+
     def run(self) -> None:
         for i in range(self.parallelism):
             t = threading.Thread(target=self._worker, daemon=True,
@@ -231,6 +245,11 @@ class APICacher:
             if on_done is not None:
                 on_done(results[0], err)
 
+        # a queued failure patch for any wave member is now moot — per-pod
+        # binds supersede it via same-key relevance; the wave's synthetic
+        # key needs the explicit form
+        self.dispatcher.supersede([k for k, _ in bindings],
+                                  RELEVANCES[POD_BINDING])
         self._wave_seq += 1
         return self.dispatcher.add(APICall(
             POD_BINDING, f"__wave__/{self._wave_seq}", execute,
@@ -238,31 +257,14 @@ class APICacher:
         ))
 
     def patch_pod_status(self, pod, condition=None, nominated_node: str | None = None) -> APICall:
-        from ..store.store import NotFoundError
-
         def execute():
-            try:
-                cur = self.store.get("Pod", pod.meta.key)
-            except NotFoundError:
-                return
-            if condition is not None:
-                # stale-failure guard: wave binds queue under their own key,
-                # so a PodScheduled=False patch can still be pending when the
-                # pod gets bound — never write a failure condition onto a
-                # bound pod (the reference's updatePod drops such patches)
-                if cur.spec.node_name and condition.status == "False":
-                    return
-                for c in cur.status.conditions:
-                    if c.type == condition.type:
-                        c.status = condition.status
-                        c.reason = condition.reason
-                        c.message = condition.message
-                        break
-                else:
-                    cur.status.conditions.append(condition)
-            if nominated_node is not None:
-                cur.status.nominated_node_name = nominated_node
-            self.store.update(cur, check_version=False)
+            # atomic under the store lock: wave binds run under their own
+            # dispatcher key, so this patch may execute CONCURRENTLY with
+            # the bind — the store primitive both serializes the write and
+            # drops a stale failure condition once the pod is bound
+            self.store.patch_pod_status(
+                pod.meta.key, condition=condition, nominated_node=nominated_node
+            )
 
         return self.dispatcher.add(
             APICall(POD_STATUS_PATCH, pod.meta.key, execute)

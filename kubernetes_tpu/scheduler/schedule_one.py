@@ -318,6 +318,18 @@ class ScheduleOneLoop:
             "snapshot": 0.0, "kernel": 0.0, "finish": 0.0, "bind": 0.0,
             "pump": 0.0, "waves": 0,
         }
+        # the launched-but-unprocessed batched wave: (algo, InflightWave).
+        # While its kernel runs on device, the host processes the PREVIOUS
+        # wave's results — the TPU-native form of the reference's
+        # scheduling/binding pipeline parallelism (schedule_one.go:146)
+        self._inflight_wave: tuple | None = None
+        # async wave-bind completions: dispatcher worker threads only append
+        # here; the scheduling thread drains. Keeping ALL queue/cache/carry
+        # mutation on the scheduling thread avoids check-then-act races on
+        # the pipeline's coherence flags.
+        import collections
+
+        self._wave_completions: "collections.deque[tuple]" = collections.deque()
 
     def framework_for_pod(self, pod: Pod) -> Framework | None:
         return self.profiles.get(pod.spec.scheduler_name)
@@ -430,10 +442,11 @@ class ScheduleOneLoop:
             wave.append(qpi)
 
         if not wave:
+            processed = self._flush_wave_pipeline()
             if trailer is not None:
                 self.schedule_pod_info(trailer)
-                return 1
-            return 0
+                processed += 1
+            return processed
 
         # partial waves are PADDED with inactive slots to the next pow2
         # bucket (floor 8, cap max_pods): the device sees a bounded set of
@@ -443,81 +456,155 @@ class ScheduleOneLoop:
         pad_to = 8
         while pad_to < len(wave):
             pad_to <<= 1
-        processed = self._run_wave(wave_algo, wave, pad_to=min(pad_to, max_pods))
+        processed = self._pipeline_wave(wave_algo, wave, min(pad_to, max_pods))
         if trailer is not None:
+            # the trailer (gang/claim/nominated pod) must run strictly after
+            # the wave that preceded it in queue order
+            processed += self._flush_wave_pipeline()
             self.schedule_pod_info(trailer)
             processed += 1
         return processed
 
-    def _run_wave(self, algo, wave: list, pad_to: int = 0) -> int:
+    def _pipeline_wave(self, algo, wave: list, pad_to: int) -> int:
+        """Launch this wave's kernel (non-blocking, chained on the device
+        carry), then process the PREVIOUS wave's results while it runs.
+        Returns pods fully processed this call (the previous wave's count)."""
+        import time as _time
+
+        from ..ops import FallbackNeeded
+        from .tpu.backend import NeedResync
+
+        prof = self.phase_profile
+        processed = self._drain_wave_completions()
+        infl = self._inflight_wave
+        if infl is not None and (
+            infl[0] is not algo or infl[1].pad != pad_to or infl[1].poisoned
+        ):
+            # incompatible in-flight wave (different profile, different
+            # program shape — the tie-word frame sizing assumes equal pads —
+            # or a poisoned carry): drain before launching
+            processed += self._flush_wave_pipeline()
+
+        t0 = _time.perf_counter()
+        self.cache.update_snapshot(self.snapshot)
+        prof["snapshot"] += _time.perf_counter() - t0
+        pods = [qpi.pod for qpi in wave]
+        fl = None
+        for attempt in (0, 1):
+            t1 = _time.perf_counter()
+            try:
+                fl = algo.backend.launch_batched(
+                    pods, self.snapshot, rng=algo.rng, pad_to=pad_to
+                )
+                prof["kernel"] += _time.perf_counter() - t1
+                break
+            except NeedResync:
+                prof["kernel"] += _time.perf_counter() - t1
+                # drain the pipeline (its phases self-account), re-upload
+                # from host truth, retry once
+                processed += self._flush_wave_pipeline()
+                algo.backend.invalidate_carry()
+                t0 = _time.perf_counter()
+                self.cache.update_snapshot(self.snapshot)
+                prof["snapshot"] += _time.perf_counter() - t0
+            except FallbackNeeded:
+                prof["kernel"] += _time.perf_counter() - t1
+                break
+        if fl is None:
+            # not kernelizable (stale vocab etc.): strict queue order —
+            # whatever is in flight precedes these pods
+            processed += self._flush_wave_pipeline()
+            algo.fallback_count += len(wave)
+            t3 = _time.perf_counter()
+            for qpi in wave:
+                self.schedule_pod_info(qpi)
+            prof["finish"] += _time.perf_counter() - t3
+            return processed + len(wave)
+        fl.qpis = wave
+        prev, self._inflight_wave = self._inflight_wave, (algo, fl)
+        prof["waves"] += 1
+        if prev is not None:
+            processed += self._complete_wave(*prev)
+        return processed
+
+    def _flush_wave_pipeline(self) -> int:
+        """Process the in-flight wave (if any); returns pods processed."""
+        n = self._drain_wave_completions()
+        infl, self._inflight_wave = self._inflight_wave, None
+        if infl is None:
+            return n
+        return n + self._complete_wave(*infl)
+
+    def _complete_wave(self, algo, fl) -> int:
+        """Block on a launched wave's results and run the host half of its
+        scheduling cycles: assume/reserve/permit per pod, then the wave's
+        batched binding (the host half of the pipeline)."""
         import time as _time
 
         from ..ops import FallbackNeeded
 
         prof = self.phase_profile
+        wave = fl.qpis
         t0 = _time.perf_counter()
-        self.cache.update_snapshot(self.snapshot)
-        t1 = _time.perf_counter()
-        pods = [qpi.pod for qpi in wave]
         try:
-            hosts, planes = algo.backend.run_batched(
-                pods, self.snapshot, rng=algo.rng, pad_to=pad_to
-            )
+            hosts, planes = algo.backend.collect(fl, rng=algo.rng)
         except FallbackNeeded:
+            # tie-draw overflow or poisoned carry: results discarded, pods
+            # re-run per-pod against live state; a successor launched on the
+            # bad carry is poisoned too
+            prof["kernel"] += _time.perf_counter() - t0
+            self._poison_successor(algo)
             algo.fallback_count += len(wave)
-            prof["snapshot"] += t1 - t0
-            prof["kernel"] += _time.perf_counter() - t1
-            prof["waves"] += 1
-            t_fb = _time.perf_counter()
+            t1 = _time.perf_counter()
             for qpi in wave:
                 self.schedule_pod_info(qpi)
-            prof["finish"] += _time.perf_counter() - t_fb
+            prof["finish"] += _time.perf_counter() - t1
             return len(wave)
-        t2 = _time.perf_counter()
+        t1 = _time.perf_counter()
+        prof["kernel"] += t1 - t0
         algo.kernel_count += len(wave)
         invalidated = False
-        batch: list[tuple] = []  # pods bound via the wave's one transaction
-        for i, (qpi, host) in enumerate(zip(wave, hosts)):
+        batch: list[tuple] = []
+        for qpi, host in zip(wave, hosts):
             if invalidated or host is None:
-                # host=None: re-run the per-pod cycle — it reproduces the
-                # FitError with a full diagnosis and drives preemption.
-                # invalidated: a prior wave member failed assume/reserve/
-                # permit, so the scan's carry (which assumed it placed) no
-                # longer matches the cache — later precomputed placements
-                # are stale; recompute each per-pod against live state.
+                # host=None re-runs reproduce the FitError (no rng draws, no
+                # state change — safe under a live successor); invalidated
+                # pods re-run because the carry diverged
                 self.schedule_pod_info(qpi)
                 continue
             fw = self.framework_for_pod(qpi.pod)
             state = CycleState()
             result = ScheduleResult(
-                suggested_host=host,
-                evaluated_nodes=planes.n,
-                feasible_nodes=1,
+                suggested_host=host, evaluated_nodes=planes.n, feasible_nodes=1
             )
-            result, status = self._finish_scheduling_cycle(state, fw, qpi, result)
+            result, status = self._finish_scheduling_cycle(
+                state, fw, qpi, result, from_wave=True
+            )
             if not status.is_success:
                 self._handle_scheduling_failure(
                     fw, qpi, status, self.queue.moved_count
                 )
+                # the kernel placed this pod but the host reverted it: the
+                # carry (and any successor computed from it) is wrong
+                self._poison_successor(algo)
                 invalidated = True
                 continue
             if fw.waiting_pod(qpi.pod.meta.key) is not None or not self._default_bind_only(fw):
-                # permit-wait (gang quorum) binds on a thread so the loop
-                # keeps scheduling siblings (schedule_one.go:146); custom
-                # bind plugins must run the full per-pod bind chain — the
-                # wave transaction is only the DefaultBinder's batched form
                 self._dispatch_binding(state, fw, qpi, result)
             else:
                 batch.append((state, fw, qpi, result))
-        t3 = _time.perf_counter()
+        t2 = _time.perf_counter()
+        prof["finish"] += t2 - t1
         self._bind_wave(batch)
-        t4 = _time.perf_counter()
-        prof["snapshot"] += t1 - t0
-        prof["kernel"] += t2 - t1
-        prof["finish"] += t3 - t2
-        prof["bind"] += t4 - t3
-        prof["waves"] += 1
+        prof["bind"] += _time.perf_counter() - t2
         return len(wave)
+
+    def _poison_successor(self, algo) -> None:
+        """Mark the in-flight wave's results unusable and drop the carry —
+        host-side state diverged from what its kernel assumed."""
+        algo.backend.invalidate_carry()
+        if self._inflight_wave is not None:
+            self._inflight_wave[1].poisoned = True
 
     def _default_bind_only(self, fw: Framework) -> bool:
         """True when the profile's bind chain is exactly the DefaultBinder —
@@ -552,30 +639,49 @@ class ScheduleOneLoop:
             return
         bindings = [(q.pod.meta.key, r.suggested_host) for _, _, q, r in ready]
 
-        def complete(results, err):
-            from ..store.store import ConflictError
-
-            for entry, ok in zip(ready, results or [False] * len(ready)):
-                state, fw, qpi, result = entry
-                if err is not None or not ok:
-                    e = err or ConflictError(
-                        f"pod {qpi.pod.meta.key} bind rejected"
-                    )
-                    self._handle_binding_failure(
-                        state, fw, qpi, result.suggested_host, Status.as_error(e)
-                    )
-                    continue
-                self._finish_binding(state, fw, qpi, result.suggested_host)
-
         if self.api_cacher is not None:
-            self.api_cacher.bind_pods(bindings, on_done=complete)
+            # the dispatcher worker ONLY parks the outcome; all queue/cache/
+            # pipeline mutation happens on the scheduling thread when it
+            # drains _wave_completions (no cross-thread check-then-act on
+            # the carry coherence flags)
+            self.api_cacher.bind_pods(
+                bindings,
+                on_done=lambda results, err:
+                    self._wave_completions.append((ready, results, err)),
+            )
             return
         try:
             results = self.store.bind_pods(bindings)
         except Exception as e:  # noqa: BLE001
-            complete(None, e)
+            self._apply_wave_bind_results(ready, None, e)
             return
-        complete(results, None)
+        self._apply_wave_bind_results(ready, results, None)
+
+    def _drain_wave_completions(self) -> int:
+        """Apply parked async wave-bind outcomes (scheduling thread only).
+        Returns 0 — the pods were counted as processed by their wave."""
+        while self._wave_completions:
+            ready, results, err = self._wave_completions.popleft()
+            self._apply_wave_bind_results(ready, results, err)
+        return 0
+
+    def _apply_wave_bind_results(self, ready: list[tuple], results, err) -> None:
+        from ..store.store import ConflictError
+
+        for entry, status in zip(ready, results or ["conflict"] * len(ready)):
+            state, fw, qpi, result = entry
+            if err is not None or status == "conflict":
+                e = err or ConflictError(
+                    f"pod {qpi.pod.meta.key} bind rejected"
+                )
+                self._handle_binding_failure(
+                    state, fw, qpi, result.suggested_host, Status.as_error(e)
+                )
+                continue
+            # "missing" = pod deleted mid-flight: binding is moot, same as
+            # the per-pod APICacher.bind_pod no-op success — the delete
+            # event already released cache state and marked the carry
+            self._finish_binding(state, fw, qpi, result.suggested_host)
 
     # -- pod-group (gang) cycle ---------------------------------------------------
 
@@ -741,6 +847,8 @@ class ScheduleOneLoop:
         handler (the failing pod with its own diagnosis)."""
         kind = outcome[0]
         if kind == "success":
+            # gang placements mutate node state outside the wave pipeline
+            self.mark_wave_external()
             for q, state, result, _pi in outcome[1]:
                 try:
                     self.cache.assume_pod(q.pod, result.suggested_host)
@@ -803,7 +911,7 @@ class ScheduleOneLoop:
 
     def _finish_scheduling_cycle(
         self, state: CycleState, fw: Framework, qpi: QueuedPodInfo,
-        result: ScheduleResult,
+        result: ScheduleResult, from_wave: bool = False,
     ) -> tuple[ScheduleResult | None, Status]:
         """assume + reserve + permit (the post-algorithm half of the
         scheduling cycle, schedule_one.go:320-393) — shared by the per-pod
@@ -815,6 +923,10 @@ class ScheduleOneLoop:
             self.cache.assume_pod(assumed, result.suggested_host)
         except Exception as e:  # noqa: BLE001
             return None, Status.as_error(e)
+        if not from_wave:
+            # a host-path placement changes node state the wave pipeline's
+            # device carry didn't see
+            self.mark_wave_external()
         gk = self._group_key(pod)
         if gk is not None:
             self.cache.pod_group_states.pod_assumed(gk, pod.meta.key)
@@ -840,9 +952,30 @@ class ScheduleOneLoop:
 
     def _forget(self, pod: Pod) -> None:
         self.cache.forget_pod(pod)
+        # forgetting frees node resources outside the wave writeback
+        self.mark_wave_external()
         gk = self._group_key(pod)
         if gk is not None:
             self.cache.pod_group_states.pod_unassumed(gk, pod.meta.key)
+
+    def mark_wave_external(self, poison: bool = True) -> None:
+        """Something outside the wave pipeline's own writeback changed
+        cluster state: the device carry is stale (next launch resyncs).
+
+        poison=True (host-path assume/forget on the scheduling thread): the
+        in-flight wave's results are discarded too — its kernel computed
+        placements without this mutation, and sequential order puts the
+        mutation FIRST. poison=False (informer events): the in-flight wave's
+        pods were popped before the event, so using its results matches the
+        reference's snapshot-at-cycle-start semantics (schedule_one.go:182)."""
+        marked = False
+        for algo in self.algorithms.values():
+            backend = getattr(algo, "backend", None)
+            if backend is not None and backend._carry is not None:
+                backend.mark_external()
+                marked = True
+        if poison and marked and self._inflight_wave is not None:
+            self._inflight_wave[1].poisoned = True
 
     # -- binding cycle --------------------------------------------------------------
 
@@ -1011,6 +1144,10 @@ class ScheduleOneLoop:
             pass
 
     def wait_for_bindings(self) -> None:
+        # a launched-but-uncollected wave holds popped pods — never leave it
+        # behind (its pods would be lost to the queue's accounting)
+        self._flush_wave_pipeline()
         for t in self._binding_threads:
             t.join(timeout=5)
         self._binding_threads.clear()
+        self._drain_wave_completions()

@@ -192,10 +192,19 @@ class Scheduler:
         sg = pod.spec.scheduling_group
         return f"{pod.meta.namespace}/{sg.pod_group_name}" if sg else None
 
+    def _mark_external(self) -> None:
+        """Informer-observed external change: stale the wave carry but keep
+        the in-flight wave's results (its pods were popped before the event
+        — reference snapshot-at-cycle-start semantics)."""
+        self.loop.mark_wave_external(poison=False)
+
     def _on_pod_event(self, etype: str, old: Pod | None, new: Pod) -> None:
         gk = self._group_key(new)
         if etype == ADDED:
             if new.is_scheduled:
+                if not self.cache.is_assumed_pod(new):
+                    # a bound pod we did not place (foreign writer)
+                    self._mark_external()
                 self.cache.add_pod(new)
                 if gk:
                     self.cache.pod_group_states.pod_scheduled(gk, new.meta.key)
@@ -212,6 +221,8 @@ class Scheduler:
         elif etype == MODIFIED:
             if new.is_scheduled:
                 if old is not None and not old.is_scheduled:
+                    if not self.cache.is_assumed_pod(new):
+                        self._mark_external()
                     # bind landed: cache confirms the assume
                     self.cache.add_pod(new)
                     if gk:
@@ -220,6 +231,9 @@ class Scheduler:
                         ClusterEvent(ev.ASSIGNED_POD, ev.ADD), old, new
                     )
                 else:
+                    # update of a placed pod (labels/scale-down) changes the
+                    # node planes outside the wave pipeline's writeback
+                    self._mark_external()
                     self.cache.update_pod(old, new)
                     action = self._pod_update_actions(old, new)
                     if action:
@@ -239,6 +253,7 @@ class Scheduler:
             if self.metrics is not None and hasattr(self.metrics, "forget_pod"):
                 self.metrics.forget_pod(new.meta.key)
             if new.is_scheduled:
+                self._mark_external()
                 self.cache.remove_pod(new)
                 self.queue.move_all_to_active_or_backoff(
                     ClusterEvent(ev.ASSIGNED_POD, ev.DELETE), new, None
@@ -265,6 +280,7 @@ class Scheduler:
         return action
 
     def _on_node_event(self, etype: str, old: Node | None, new: Node) -> None:
+        self._mark_external()
         if self.batch_cache is not None:
             # node shape changed: cached sorted score lists are stale
             self.batch_cache.flush()

@@ -58,6 +58,35 @@ def _scatter_rows_jit(dev: dict, rows: dict, idx):
     return {k: dev[k].at[idx].set(rows[k]) for k in dev}
 
 
+def _mt_stream(rng_state) -> np.random.RandomState:
+    """numpy RandomState sharing the MT19937 position of a CPython
+    random.Random state — uint32 full-range randint maps 1:1 onto genrand
+    words, so the two generators walk the same word stream."""
+    _version, mt, _gauss = rng_state
+    rs = np.random.RandomState()
+    rs.set_state(("MT19937", np.array(mt[:624], dtype=np.uint32), mt[624]))
+    return rs
+
+
+def clone_tie_words(rng, n_words: int) -> np.ndarray:
+    """The rng's next n_words getrandbits(32) outputs, without advancing it."""
+    rs = _mt_stream(rng.getstate())
+    return rs.randint(0, 2**32, size=n_words,
+                      dtype=np.uint64).astype(np.uint32)
+
+
+def advance_rng(rng, n_words: int) -> None:
+    """Advance a live random.Random by exactly n_words getrandbits(32)
+    draws via the same state transplant (no Python-loop catch-up)."""
+    if not n_words:
+        return
+    version, _mt, gauss = rng.getstate()
+    rs = _mt_stream(rng.getstate())
+    rs.randint(0, 2**32, size=n_words, dtype=np.uint64)
+    s = rs.get_state()
+    rng.setstate((version, tuple(int(x) for x in s[1]) + (int(s[2]),), gauss))
+
+
 # Reconstructed host-path messages + codes per filter mask row.
 _ROW_STATUS = {
     "NodeUnschedulable": ("unresolvable", "node(s) were unschedulable"),
@@ -65,6 +94,36 @@ _ROW_STATUS = {
     "NodeAffinity": ("unresolvable", "node(s) didn't match Pod's node affinity/selector"),
     "NodePorts": ("unschedulable", "node(s) didn't have free ports for the requested pod ports"),
 }
+
+
+class NeedResync(Exception):
+    """A pipelined launch cannot proceed on the device-resident carry (an
+    external change touched node rows the carry doesn't account for, or the
+    plane buckets changed shape): the caller must drain the pipeline, after
+    which the next launch re-uploads from host truth."""
+
+
+class InflightWave:
+    """A launched-but-uncollected batched wave: device handles only."""
+
+    __slots__ = ("pods", "qpis", "planes", "info", "pad", "cursor_base_host",
+                 "frame_shift", "poisoned")
+
+    def __init__(self, pods, planes, info, pad, frame_shift):
+        self.pods = pods
+        self.qpis = None  # set by the scheduling loop
+        self.planes = planes
+        self.info = info  # kernel outputs, all still on device
+        self.pad = pad
+        # absolute tie-stream position where this wave's draws started, in
+        # this wave's word-frame; device-known at launch (cursor_init), host-
+        # known once the predecessor is collected
+        self.cursor_base_host: int | None = None
+        # words the live rng advanced between the predecessor's launch and
+        # this launch (collects in between) — converts the predecessor's
+        # final cursor into this wave's frame
+        self.frame_shift = frame_shift
+        self.poisoned = False
 
 
 class TPUBackend:
@@ -103,6 +162,23 @@ class TPUBackend:
         self._device_tables: dict | None = None
         self._tables_src: dict | None = None
         self._jax = jax
+        # pipelined-wave carry: the last launched kernel's output planes
+        # (device arrays) feed the next launch directly, so back-to-back
+        # waves chain on-device while the host processes results one wave
+        # behind (the TPU-native form of the reference's scheduling/binding
+        # overlap, schedule_one.go:146)
+        self._carry: dict | None = None
+        self._carry_rows: set[int] = set()  # rows placed since carry base
+        self._carry_anti = False  # carry holds IPA anti/pref terms the host
+        self._carry_pref = False  # planes may not show yet (binds in flight)
+        self._carry_external = False  # an external event touched the planes
+        self._inflight: InflightWave | None = None  # last launched wave
+        self._advanced_since_launch = 0  # rng words collected since then
+        # (carry dict, allowed dirty rows) of the wave being processed RIGHT
+        # NOW: single-pod re-runs inside that window must see state as of
+        # THAT wave — the live carry already contains the uncollected
+        # successor's placements, which come later in queue order
+        self._rerun_carry: tuple[dict, set[int]] | None = None
 
     # -- config / planes -----------------------------------------------------
 
@@ -128,8 +204,13 @@ class TPUBackend:
                          and np.asarray(feats["ipa_anti_add"]).any())
         wave_pref = bool(feats is not None
                          and np.asarray(feats["ipa_pref_add"]).any())
-        existing_anti = bool(planes.ipa_anti[: planes.n].any()) or wave_anti
-        existing_pref = bool(planes.ipa_pref[: planes.n].any()) or wave_pref
+        # _carry_anti/_carry_pref: a pipelined wave may have placed the first
+        # anti/preferred-term pod on the device carry before the host planes
+        # reflect it — the statics must stay on
+        existing_anti = (bool(planes.ipa_anti[: planes.n].any()) or wave_anti
+                         or self._carry_anti)
+        existing_pref = (bool(planes.ipa_pref[: planes.n].any()) or wave_pref
+                         or self._carry_pref)
         return KernelConfig(
             strategy=self.strategy,
             fit_resources=self.fit_resources,
@@ -210,6 +291,47 @@ class TPUBackend:
             self._tables_src = tables
         return {**self._device_planes, **self._device_tables}
 
+    def _refresh_tables(self, planes) -> None:
+        tables = self.extractor.affinity_tables(planes)
+        if self._tables_src is not tables:
+            self._device_tables = {
+                k: self._jax.device_put(a) for k, a in tables.items()
+            }
+            self._tables_src = tables
+
+    def _carry_view(self, planes) -> dict:
+        """Device inputs for a single-pod cycle while the wave pipeline's
+        carry is live.
+
+        During a wave's result-processing window (collect set _rerun_carry)
+        re-runs read THAT wave's output planes — state as of that wave, not
+        the uncollected successor's (whose pods come later in queue order).
+        Host assumes of the same wave's successful pods dirty exactly the
+        rows the wave's outputs already hold (identical int updates), so
+        those rows are consumable; any other dirt disqualifies the view
+        (e.g. a gang member's in-snapshot assume on the same row)."""
+        if self._carry is not None:
+            compatible = (
+                not self._carry_external
+                and self._device_buckets == planes.bucket_sizes
+                and self._pending_dirty is not None
+            )
+            if compatible and self._rerun_carry is not None:
+                carry, allowed = self._rerun_carry
+                if not (self._pending_dirty - allowed):
+                    self._pending_dirty = set()
+                    self._device_version = planes.version
+                    self._refresh_tables(planes)
+                    return {**self._device_planes, **carry,
+                            **self._device_tables}
+            elif compatible and self._pending_dirty == set():
+                self._device_version = planes.version
+                self._refresh_tables(planes)
+                return {**self._device_planes, **self._carry,
+                        **self._device_tables}
+            self.invalidate_carry()
+        return self.device_inputs(planes)
+
     # -- single-pod kernel cycle ---------------------------------------------
 
     def run(self, pod: Pod, snapshot):
@@ -218,7 +340,7 @@ class TPUBackend:
         self.extractor.register(pod)
         planes = self.sync(snapshot)
         f = self.extractor.features(pod, planes)
-        dev = self.device_inputs(planes)
+        dev = self._carry_view(planes)
         cfg = self.kernel_config(planes, f)
         out = fit_and_score(cfg, dev, f)
         return planes, {
@@ -257,18 +379,13 @@ class TPUBackend:
         n_slots = max(pad_to, len(pods))
         dev = self.device_inputs(planes)
         cfg = self.kernel_config(planes, feats)
-        tie_words = rng_state = None
+        tie_words = None
         if rng is not None:
-            # vectorized stream cloning: transplant the MT19937 state into
-            # numpy (uint32 full-range randint maps 1:1 onto genrand words)
-            # instead of len(pods)*16 interpreter-level getrandbits calls
-            rng_state = rng.getstate()
-            _version, mt, _gauss = rng_state
-            rs = np.random.RandomState()
-            rs.set_state(("MT19937", np.array(mt[:624], dtype=np.uint32), mt[624]))
-            n_words = n_slots * MAX_TIE_DRAWS + MAX_TIE_DRAWS
-            tie_words = rs.randint(0, 2**32, size=n_words,
-                                   dtype=np.uint64).astype(np.uint32)
+            # vectorized stream cloning instead of n_slots*16 interpreter-
+            # level getrandbits calls
+            tie_words = clone_tie_words(
+                rng, n_slots * MAX_TIE_DRAWS + MAX_TIE_DRAWS
+            )
         _winners_dev, info = batched_assign(cfg, dev, feats, tie_words)
         # ONE device→host transfer for everything the host needs: winners ++
         # [tie_consumed, tie_overflow] (separate np.asarray calls each pay
@@ -283,18 +400,159 @@ class TPUBackend:
                 # results past that step are desynced from the host stream —
                 # discard the wave, untouched rng, host path decides
                 raise FallbackNeeded("tie-break draw overflow")
-            if consumed:
-                # advance the live rng by exactly `consumed` words via the
-                # same state transplant (no Python-loop catch-up)
-                version, mt, gauss = rng_state
-                rs2 = np.random.RandomState()
-                rs2.set_state(("MT19937", np.array(mt[:624], dtype=np.uint32),
-                               mt[624]))
-                rs2.randint(0, 2**32, size=consumed, dtype=np.uint64)
-                s = rs2.get_state()
-                rng.setstate((version,
-                              tuple(int(x) for x in s[1]) + (int(s[2]),), gauss))
+            advance_rng(rng, consumed)
         return [planes.node_names[w] if w >= 0 else None for w in winners], planes
+
+    # -- pipelined wave launch/collect ----------------------------------------
+
+    def invalidate_carry(self) -> None:
+        """Drop the device-resident carry; the next device_inputs re-uploads
+        every plane from host truth."""
+        self._carry = None
+        self._carry_rows = set()
+        self._carry_anti = self._carry_pref = False
+        self._carry_external = False
+        self._rerun_carry = None
+        self._pending_dirty = None  # carried planes on device are stale
+
+    def mark_external(self) -> None:
+        """An event outside the wave pipeline's own writeback touched
+        cluster state (node change, foreign pod add/update/delete, host-path
+        assume/forget): the carry no longer mirrors host truth — the next
+        launch drains the pipeline and re-uploads. Cheap no-op when no carry
+        is live."""
+        if self._carry is not None:
+            self._carry_external = True
+
+    def launch_batched(self, pods: list[Pod], snapshot, rng=None,
+                       pad_to: int = 0) -> InflightWave:
+        """Dispatch one wave's kernel WITHOUT waiting for results.
+
+        The kernel's input planes are the previous launch's output planes
+        (still on device — XLA sequences the dependency), so consecutive
+        launches chain with no host round trip; the host processes wave i-1
+        while the device runs wave i. The tie-break stream is cloned from
+        the live rng into the in-flight frame; an uncollected predecessor's
+        final cursor rides along as a device scalar (cursor_init).
+
+        Raises NeedResync when the carry can't absorb host-side changes
+        (external dirty rows / bucket reshape) — caller drains the pipeline
+        and retries — and FallbackNeeded for non-kernelizable pods."""
+        from ...ops import pad_features
+        from ...ops.kernels import MAX_TIE_DRAWS
+
+        self._rerun_carry = None  # a new launch closes any re-run window
+        for pod in pods:
+            self.extractor.register(pod)
+        planes = self.sync(snapshot)
+        feats = stack_features(
+            [self.extractor.features_cached(p, planes) for p in pods]
+        )
+        if pad_to > len(pods):
+            feats = pad_features(feats, pad_to)
+        pad = max(pad_to, len(pods))
+
+        prev = self._inflight
+        if prev is not None and self._carry is None:
+            # a single-pod cycle (or divergence) dropped the carry while a
+            # wave is still in flight: host planes lack that wave's
+            # placements, so a host re-upload here would double-book nodes
+            raise NeedResync("carry dropped while a wave is in flight")
+        if self._carry is not None:
+            if self._carry_external:
+                raise NeedResync("external event touched cluster state")
+            if self._device_buckets != planes.bucket_sizes:
+                raise NeedResync("plane buckets changed under the carry")
+            if self._pending_dirty is None:
+                raise NeedResync("full plane rebuild required")
+            external = self._pending_dirty - self._carry_rows
+            if external:
+                raise NeedResync(f"{len(external)} externally-dirtied rows")
+            # remaining dirty rows are our own collected binds — the carry
+            # already holds their exact values (same int updates), so the
+            # host-truth scatter is redundant
+            self._pending_dirty = set()
+            self._device_version = planes.version
+            self._refresh_tables(planes)
+            dev = {**self._device_planes, **self._carry, **self._device_tables}
+        else:
+            dev = self.device_inputs(planes)
+
+        cfg = self.kernel_config(planes, feats)
+        tie_words = None
+        # np.int32, not a python int: a weak-typed scalar would give the
+        # first launch a different jit signature than chained ones (whose
+        # cursor rides in as a device array) — one full recompile
+        cursor_init: object = np.int32(0)
+        frame_shift = self._advanced_since_launch
+        if rng is not None:
+            # frame covers a full predecessor + this wave (static shape per
+            # pad): the predecessor may consume up to pad*MAX words first
+            tie_words = clone_tie_words(rng, (2 * pad + 1) * MAX_TIE_DRAWS)
+            if prev is not None:
+                # predecessor's final cursor, shifted into this frame inside
+                # the next kernel's trace — no host sync, no eager op
+                cursor_init = prev.info["tie_consumed"]
+        _winners_dev, info = batched_assign(
+            cfg, dev, feats, tie_words, cursor_init,
+            frame_shift if prev is not None else 0,
+        )
+        # next launch chains on these outputs
+        self._carry = {k: info[k] for k in
+                       ("used", "nonzero_used", "sel_counts")}
+        for k in ("ipa_counts", "ipa_anti", "ipa_pref"):
+            if k in info:
+                self._carry[k] = info[k]
+        self._carry_anti = self._carry_anti or bool(feats["ipa_anti_add"].any())
+        self._carry_pref = self._carry_pref or bool(feats["ipa_pref_add"].any())
+        fl = InflightWave(pods, planes, info, pad, frame_shift)
+        if prev is None:
+            fl.cursor_base_host = 0
+        self._inflight = fl
+        self._advanced_since_launch = 0
+        return fl
+
+    def collect(self, fl: InflightWave, rng=None):
+        """Block on a launched wave's packed result (one transfer), advance
+        the live rng by exactly the words it consumed, and absorb its
+        placements into the carry bookkeeping. Returns (hosts, planes).
+
+        Raises FallbackNeeded on tie-draw overflow (results discarded, rng
+        untouched, carry invalidated — the successor launch, if any, must be
+        poisoned by the caller)."""
+        packed = np.asarray(fl.info["packed"])
+        winners = packed[: len(fl.pods)]
+        final_abs, overflow = int(packed[-2]), bool(packed[-1])
+        if self._inflight is fl:
+            self._inflight = None
+        if fl.poisoned:
+            self.invalidate_carry()
+            raise FallbackNeeded("predecessor wave diverged host-side")
+        if rng is not None and overflow:
+            self.invalidate_carry()
+            raise FallbackNeeded("tie-break draw overflow")
+        if rng is not None:
+            if fl.cursor_base_host is None:
+                raise RuntimeError("wave collected before its predecessor")
+            own = final_abs - fl.cursor_base_host
+            # advance the LIVE rng (already past every previously collected
+            # wave) by exactly this wave's consumption
+            advance_rng(rng, own)
+            self._advanced_since_launch += own
+            succ = self._inflight
+            if succ is not None and succ.cursor_base_host is None:
+                # successor's draws start where ours ended, expressed in the
+                # successor's (shifted) frame
+                succ.cursor_base_host = final_abs - succ.frame_shift
+        win_rows = {int(w) for w in winners if w >= 0}
+        self._carry_rows.update(win_rows)
+        # open this wave's re-run window: single-pod cycles during result
+        # processing see THIS wave's output planes (see _carry_view)
+        if self._carry is not None:
+            carried = {k: fl.info[k] for k in self._carry if k in fl.info}
+            self._rerun_carry = (carried, win_rows)
+        hosts = [fl.planes.node_names[w] if w >= 0 else None for w in winners]
+        return hosts, fl.planes
 
     # -- diagnosis reconstruction ---------------------------------------------
 

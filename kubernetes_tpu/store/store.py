@@ -204,37 +204,88 @@ class Store:
                 )
             obj = copy.deepcopy(cur)
             obj.spec.node_name = node_name
+            self._clear_failed_scheduling_condition(obj)
             rev = self._bump()
             obj.meta.resource_version = rev
             objs[key] = obj
             self._emit("Pod", Event(MODIFIED, obj, rev, time.perf_counter()))
             return obj
 
-    def bind_pods(self, bindings: list[tuple[str, str]]) -> list[bool]:
+    @staticmethod
+    def _clear_failed_scheduling_condition(obj) -> None:
+        """A bind supersedes any earlier PodScheduled=False condition; a
+        stale failure patch racing the bind on another dispatcher worker
+        must not leave a bound pod marked unschedulable."""
+        for c in obj.status.conditions:
+            if c.type == "PodScheduled" and c.status == "False":
+                c.status, c.reason, c.message = "True", "", ""
+
+    def bind_pods(self, bindings: list[tuple[str, str]]) -> list[str]:
         """Batched pods/binding: one lock acquisition + one event-log pass
         for a whole scheduling wave of (pod key, node name) pairs — the
         writeback half of the batched TPU wave (the reference's analogue is
         the async dispatcher draining one binding call per pod,
         backend/api_dispatcher/api_dispatcher.go:32-112; a wave is our unit
-        of pipelining, so the transaction is too). Returns per-binding
-        success; a missing or already-bound pod yields False and leaves the
-        rest of the wave untouched."""
-        out: list[bool] = []
+        of pipelining, so the transaction is too). Returns one of
+        "bound" | "missing" (pod deleted — binding moot) | "conflict"
+        (already bound) per pair; failures leave the rest of the wave
+        untouched."""
+        out: list[str] = []
         with self._mu:
             objs = self._objects.get("Pod", {})
             for key, node_name in bindings:
                 cur = objs.get(key)
-                if cur is None or cur.spec.node_name:
-                    out.append(False)
+                if cur is None:
+                    out.append("missing")
+                    continue
+                if cur.spec.node_name:
+                    out.append("conflict")
                     continue
                 obj = copy.deepcopy(cur)
                 obj.spec.node_name = node_name
+                self._clear_failed_scheduling_condition(obj)
                 rev = self._bump()
                 obj.meta.resource_version = rev
                 objs[key] = obj
                 self._emit("Pod", Event(MODIFIED, obj, rev, time.perf_counter()))
-                out.append(True)
+                out.append("bound")
         return out
+
+    def patch_pod_status(self, key: str, condition: Any = None,
+                         nominated_node: str | None = None) -> Any | None:
+        """Atomic status patch under the store lock (the non-atomic
+        get→mutate→update pattern loses against a concurrent bind: a stale
+        whole-object write would silently unbind the pod). A failure
+        condition (status=False) is dropped when the pod is already bound —
+        the bind superseded it. Returns the stored object or None."""
+        with self._mu:
+            objs = self._objects.get("Pod", {})
+            cur = objs.get(key)
+            if cur is None:
+                return None
+            obj = copy.deepcopy(cur)
+            changed = False
+            if condition is not None:
+                if not (obj.spec.node_name and condition.status == "False"):
+                    for c in obj.status.conditions:
+                        if c.type == condition.type:
+                            c.status = condition.status
+                            c.reason = condition.reason
+                            c.message = condition.message
+                            break
+                    else:
+                        obj.status.conditions.append(condition)
+                    changed = True
+            if nominated_node is not None:
+                obj.status.nominated_node_name = nominated_node
+                changed = True
+            if not changed:
+                return cur
+            rev = self._bump()
+            obj.meta.resource_version = rev
+            objs[key] = obj
+            self._emit("Pod", Event(MODIFIED, obj, rev, time.perf_counter()))
+            return obj
 
     def delete(self, kind: str, key: str) -> Any:
         with self._mu:

@@ -1,0 +1,152 @@
+"""Pipelined wave execution tests.
+
+The wave path launches wave i+1's kernel on the device-resident carry before
+wave i's host-side processing (schedule_one.ScheduleOneLoop._pipeline_wave,
+the TPU-native form of the reference's scheduling/binding overlap,
+pkg/scheduler/schedule_one.go:146). These tests drive the divergence and
+resync edges: external node changes mid-stream, capacity exhaustion, and
+gang trailers that force a pipeline flush.
+"""
+
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.store.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+def _wave_scheduler(store, wave_size=8, **kw):
+    sched = Scheduler(
+        store, profiles=[Profile(backend="tpu", wave_size=wave_size)], **kw
+    )
+    sched.start()
+    return sched
+
+
+def _host_scheduler(store, **kw):
+    sched = Scheduler(store, profiles=[Profile()], **kw)
+    sched.start()
+    return sched
+
+
+def _binds(store):
+    return {p.meta.name: p.spec.node_name for p in store.pods()}
+
+
+def _run_both(build):
+    """Run the same scenario under host and pipelined-wave schedulers and
+    return (host binds, wave binds, wave scheduler)."""
+    store_h = Store()
+    sched_h = _host_scheduler(store_h)
+    build(store_h, sched_h)
+    store_w = Store()
+    sched_w = _wave_scheduler(store_w)
+    build(store_w, sched_w)
+    return _binds(store_h), _binds(store_w), sched_w
+
+
+class TestWavePipeline:
+    def test_external_node_change_mid_stream_resyncs(self):
+        """A node label/allocatable update between scheduling bursts dirties
+        rows the carry doesn't own → NeedResync → drain + re-upload; the
+        final bindings still match the host path exactly."""
+
+        def scenario(store, sched):
+            for i in range(10):
+                store.create(make_node(f"n{i}", cpu="8", mem="16Gi",
+                                       zone=f"z{i % 2}"))
+            for i in range(20):
+                store.create(make_pod(f"a{i:02d}", cpu="1", mem="1Gi"))
+            sched.schedule_pending()
+            # external change: grow node n3 (UpdateNodeAllocatable)
+            node = store.get("Node", "n3")
+            node.status.allocatable = dict(node.status.allocatable, cpu="64")
+            store.update(node, check_version=False)
+            for i in range(20):
+                store.create(make_pod(f"b{i:02d}", cpu="1", mem="1Gi"))
+            sched.schedule_pending()
+
+        host, wave, sched_w = _run_both(scenario)
+        assert host == wave
+        assert all(v for v in wave.values()), "every pod must bind"
+        algo = sched_w.algorithms["default-scheduler"]
+        assert algo.kernel_count >= 40
+
+    def test_capacity_exhaustion_fit_errors_match_host(self):
+        """Pods that exceed cluster capacity come back host=None mid-wave and
+        re-run per-pod under a live successor; placements and failures must
+        match the host path."""
+
+        def scenario(store, sched):
+            for i in range(4):
+                store.create(make_node(f"n{i}", cpu="2", mem="4Gi"))
+            for i in range(20):  # 20 × 1cpu into 8 cpu total: 8 fit, 12 don't
+                store.create(make_pod(f"p{i:02d}", cpu="1", mem="1Gi"))
+            sched.schedule_pending()
+
+        host, wave, _ = _run_both(scenario)
+        assert host == wave
+        assert sum(1 for v in wave.values() if v) == 8
+
+    def test_gang_trailer_flushes_pipeline(self):
+        """A gang pod after plain pods must be scheduled strictly after them
+        (pipeline flush), and the gang still lands atomically."""
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.types import (
+            GangPolicy,
+            PodGroup,
+            PodGroupSpec,
+            SchedulingGroup,
+        )
+
+        def scenario(store, sched):
+            for i in range(8):
+                store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+            for i in range(12):
+                store.create(make_pod(f"plain{i:02d}", cpu="1", mem="1Gi"))
+            store.create(PodGroup(
+                meta=ObjectMeta(name="g1"),
+                spec=PodGroupSpec(policy=GangPolicy(min_count=3)),
+            ))
+            for i in range(3):
+                p = make_pod(f"gang{i}", cpu="1", mem="1Gi")
+                p.spec.scheduling_group = SchedulingGroup(pod_group_name="g1")
+                store.create(p)
+            sched.schedule_pending()
+
+        host, wave, _ = _run_both(scenario)
+        assert host == wave
+        assert all(v for k, v in wave.items() if k.startswith("gang"))
+
+    def test_churn_deletes_between_waves(self):
+        """Deleting bound pods frees rows the carry accounted for via its own
+        placements; the freed capacity must be re-usable and bindings must
+        match the host path."""
+
+        def scenario(store, sched):
+            for i in range(6):
+                store.create(make_node(f"n{i}", cpu="4", mem="8Gi"))
+            for i in range(12):
+                store.create(make_pod(f"a{i:02d}", cpu="1", mem="1Gi"))
+            sched.schedule_pending()
+            bound = [p for p in store.pods() if p.spec.node_name][:6]
+            for p in bound:
+                store.delete("Pod", p.meta.key)
+            for i in range(12):
+                store.create(make_pod(f"b{i:02d}", cpu="1", mem="1Gi"))
+            sched.schedule_pending()
+
+        host, wave, _ = _run_both(scenario)
+        assert host == wave
+
+    def test_async_dispatcher_with_pipeline(self):
+        """SchedulerAsyncAPICalls + pipelined waves: binds land through the
+        dispatcher, everything completes, queue drains."""
+        store = Store()
+        for i in range(12):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        sched = _wave_scheduler(store, wave_size=16, async_api_calls=True)
+        for i in range(50):
+            store.create(make_pod(f"p{i:02d}", cpu="500m", mem="512Mi"))
+        sched.schedule_pending()
+        binds = _binds(store)
+        assert sum(1 for v in binds.values() if v) == 50
+        sched.api_dispatcher.close()
