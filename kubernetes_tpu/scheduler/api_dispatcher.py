@@ -217,13 +217,12 @@ class APICacher:
         self._wave_seq = 0
 
     def bind_pod(self, pod, node_name: str) -> APICall:
-        from ..store.store import NotFoundError
-
         def execute():
-            try:
-                cur = self.store.get("Pod", pod.meta.key)
-            except NotFoundError:
-                return  # pod deleted mid-flight: binding is moot
+            # NotFoundError propagates: a pod deleted mid-flight must fail
+            # the binding cycle so handleBindingCycleError forgets the
+            # cache assume — swallowing it would leak the assumed resources
+            # (the DELETED event for an unbound pod never touches the cache)
+            cur = self.store.get("Pod", pod.meta.key)
             cur.spec.node_name = node_name
             self.store.update(cur, check_version=False)
 

@@ -49,6 +49,10 @@ class NodeResourcesFit(Plugin):
         # RequestedToCapacityRatio shape: (utilization%, score) breakpoints
         self.shape = sorted(shape or [(0, 0), (100, MAX_NODE_SCORE)])
         self.ignored = ignored_resources or set()
+        self.handle = None  # wired by the scheduler (ScorePlacement needs it)
+
+    def set_handle(self, handle) -> None:
+        self.handle = handle
 
     # -- events ------------------------------------------------------------
 
@@ -178,7 +182,11 @@ class NodeResourcesFit(Plugin):
             total_req.add(PodInfo(pod, self.names).request)
         total_alloc = ResourceVec(self.names.width)
         total_used = ResourceVec(self.names.width)
-        for ni in placement:
+        snapshot = self.handle.snapshot if self.handle is not None else None
+        for name in placement.node_names:
+            ni = snapshot.get(name) if snapshot is not None else None
+            if ni is None:
+                continue
             total_alloc.add(ni.allocatable)
             total_used.add(ni.requested)
         score = 0

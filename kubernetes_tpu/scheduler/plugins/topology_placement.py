@@ -42,13 +42,52 @@ class TopologyPlacementGenerator(Plugin):
             return None
         return group.spec.constraints.topology[0].mode
 
+    def _scheduled_pods_domain(self, pods: list[Pod], key: str):
+        """requiredDomain (topology_placement.go:74-93
+        getScheduledPodsTopologyDomain): a partially-scheduled gang is
+        pinned to the single domain its already-scheduled members occupy.
+        Returns (domain | None, error Status | None)."""
+        sg = pods[0].spec.scheduling_group if pods else None
+        if sg is None:
+            return None, None
+        gk = f"{pods[0].meta.namespace}/{sg.pod_group_name}"
+        gstate = self.handle.cache.pod_group_states.get(gk)
+        if gstate is None or not gstate.scheduled:
+            return None, None
+        snapshot = self.handle.snapshot
+        domain = None
+        for pod_key in sorted(gstate.scheduled):
+            pod = self.handle.store.try_get("Pod", pod_key)
+            if pod is None or not pod.spec.node_name:
+                continue
+            ni = snapshot.get(pod.spec.node_name)
+            node = ni.node if ni is not None else None
+            if node is None:
+                continue
+            val = node.meta.labels.get(key)
+            if val is None:
+                return None, Status.as_error(RuntimeError(
+                    f"no topology domain found for scheduled pod {pod_key}"
+                ), self.name)
+            if domain is not None and domain != val:
+                return None, Status.as_error(RuntimeError(
+                    f"more than 1 domain for pod group {gk}: {domain}, {val}"
+                ), self.name)
+            domain = val
+        return domain, None
+
     def generate_placements(self, state, pods: list[Pod], placements):
         """topology_placement.go:61-105 — one child placement per domain
-        value of the group's first topology key, in sorted value order."""
+        value of the group's first topology key, in sorted value order; a
+        partially-scheduled gang only gets its scheduled members' domain
+        (requiredDomain, :74-93), so an incremental gang cannot split."""
         group = self._group_of(pods[0]) if pods else None
         if group is None or not group.spec.constraints.topology:
             return placements, Status.skip()
         key = group.spec.constraints.topology[0].key
+        required_domain, err = self._scheduled_pods_domain(pods, key)
+        if err is not None:
+            return placements, err
         snapshot = self.handle.snapshot
         out: list[Placement] = []
         for parent in placements:
@@ -59,10 +98,16 @@ class TopologyPlacementGenerator(Plugin):
                 if node is None:
                     continue
                 val = node.meta.labels.get(key)
-                if val is not None:
+                if val is not None and (required_domain is None
+                                        or val == required_domain):
                     domains.setdefault(val, []).append(name)
             for val in sorted(domains):
                 out.append(Placement(f"{parent.name}/{key}={val}", domains[val]))
+        if not out and required_domain is not None:
+            # the pinned domain has no candidate nodes left: with Required
+            # topology the gang must not land elsewhere — an empty
+            # placement makes the dry-run fail cleanly
+            return [Placement(f"{key}={required_domain}", [])], Status()
         if not out:
             return placements, Status.skip()
         return out, Status()

@@ -670,17 +670,19 @@ class ScheduleOneLoop:
 
         for entry, status in zip(ready, results or ["conflict"] * len(ready)):
             state, fw, qpi, result = entry
-            if err is not None or status == "conflict":
+            if err is not None or status != "bound":
+                # "missing" (pod deleted mid-flight) must also take the
+                # failure path: the DELETED event for a not-yet-bound pod
+                # never touches the cache, so only _handle_binding_failure's
+                # forget releases the assumed resources (the requeued entry
+                # is dropped at its next pop by _skip_pod_schedule)
                 e = err or ConflictError(
-                    f"pod {qpi.pod.meta.key} bind rejected"
+                    f"pod {qpi.pod.meta.key} bind rejected ({status})"
                 )
                 self._handle_binding_failure(
                     state, fw, qpi, result.suggested_host, Status.as_error(e)
                 )
                 continue
-            # "missing" = pod deleted mid-flight: binding is moot, same as
-            # the per-pod APICacher.bind_pod no-op success — the delete
-            # event already released cache state and marked the carry
             self._finish_binding(state, fw, qpi, result.suggested_host)
 
     # -- pod-group (gang) cycle ---------------------------------------------------
@@ -729,6 +731,7 @@ class ScheduleOneLoop:
         pods = [q.pod for q in qpis]
         pstate = CycleState()
         placements = None
+        narrowed = False
         required = False
         if fw.placement_generate_plugins:
             parent = Placement(
@@ -737,10 +740,17 @@ class ScheduleOneLoop:
             placements, _st = fw.run_placement_generate_plugins(
                 pstate, pods, parent
             )
+            if not _st.is_success and not _st.is_skip:
+                # e.g. requiredDomain inconsistency: scheduled members span
+                # two domains (topology_placement.go getScheduledPods error)
+                return ("error", qpis[0], _st)
+            # a SINGLE placement must still constrain (the requiredDomain
+            # pin of a partially-scheduled gang is exactly one placement)
+            narrowed = placements != [parent]
             for p in fw.placement_generate_plugins:
                 mode = getattr(p, "topology_mode", lambda _p: None)(pods)
                 required = required or mode == "Required"
-        if placements is not None and len(placements) > 1:
+        if placements is not None and narrowed:
             # podGroupSchedulingPlacementAlgorithm:520 — dry-run per
             # placement, score the ones that fit, run the real algorithm
             # under the winner

@@ -283,12 +283,7 @@ class TPUBackend:
         self._device_version = planes.version
         self._device_buckets = planes.bucket_sizes
         self._pending_dirty = set()
-        tables = self.extractor.affinity_tables(planes)
-        if self._tables_src is not tables:
-            self._device_tables = {
-                k: self._jax.device_put(a) for k, a in tables.items()
-            }
-            self._tables_src = tables
+        self._refresh_tables(planes)
         return {**self._device_planes, **self._device_tables}
 
     def _refresh_tables(self, planes) -> None:
