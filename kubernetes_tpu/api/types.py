@@ -332,6 +332,49 @@ class PodGroup:
     kind = "PodGroup"
 
 
+# --- disruption budgets ---------------------------------------------------
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    """policy/v1 PodDisruptionBudgetSpec (scheduling-relevant subset).
+
+    Exactly one of min_available / max_unavailable is meaningful; both are
+    absolute counts (the reference also accepts percentages — resolved by
+    the disruption controller before the scheduler ever reads them, so the
+    scheduler-side contract is identical)."""
+
+    selector: object | None = None  # labels.LabelSelector; None matches nothing
+    min_available: int | None = None
+    max_unavailable: int | None = None
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    """policy/v1 PodDisruptionBudgetStatus — the scheduler reads ONLY
+    disruptions_allowed + disrupted_pods (default_preemption.go:380
+    filterPodsWithPDBViolation)."""
+
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+    # pod name -> eviction time; already-processed disruptions don't count
+    # against the budget again
+    disrupted_pods: dict = field(default_factory=dict)
+
+
+@dataclass
+class PodDisruptionBudget:
+    """Reference: staging/src/k8s.io/api/policy/v1/types.go."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
+    status: PodDisruptionBudgetStatus = field(default_factory=PodDisruptionBudgetStatus)
+
+    kind = "PodDisruptionBudget"
+
+
 # --- binding --------------------------------------------------------------
 
 

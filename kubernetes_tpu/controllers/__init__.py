@@ -1,6 +1,7 @@
 """Controller manager layer (cmd/kube-controller-manager + pkg/controller)."""
 
 from .base import Controller, ControllerManager
+from .disruption import DisruptionController
 from .lifecycle import (
     EndpointSliceController,
     GarbageCollector,
@@ -25,12 +26,13 @@ def default_controllers(store, clock=None) -> list[Controller]:
         NodeLifecycleController(store, informers, clock=clock),
         ResourceClaimController(store, informers),
         EndpointSliceController(store, informers),
+        DisruptionController(store, informers),
     ]
 
 
 __all__ = [
     "Controller", "ControllerManager", "DeploymentController",
-    "EndpointSliceController", "GarbageCollector", "JobController",
-    "NodeLifecycleController", "ReplicaSetController",
+    "DisruptionController", "EndpointSliceController", "GarbageCollector",
+    "JobController", "NodeLifecycleController", "ReplicaSetController",
     "ResourceClaimController", "default_controllers",
 ]

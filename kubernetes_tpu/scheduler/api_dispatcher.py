@@ -25,7 +25,8 @@ from typing import Any, Callable
 # call-type relevance (api_calls.go Relevances): higher wins on conflict
 POD_STATUS_PATCH = "pod_status_patch"
 POD_BINDING = "pod_binding"
-RELEVANCES = {POD_STATUS_PATCH: 1, POD_BINDING: 2}
+POD_DELETE = "pod_delete"  # preemption evictions supersede everything
+RELEVANCES = {POD_STATUS_PATCH: 1, POD_BINDING: 2, POD_DELETE: 3}
 
 
 class CallSkippedError(Exception):
@@ -70,6 +71,7 @@ class APIDispatcher:
         """Queue a call; returns the call actually representing the work (the
         merged-into call when dedup applies). Raises CallSkippedError when a
         more relevant call is already pending for the object."""
+        superseded: APICall | None = None
         with self._lock:
             pending = self._queued.get(call.object_key)
             if pending is not None:
@@ -88,21 +90,37 @@ class APIDispatcher:
                         new_exec()
 
                     pending.execute = composed
-                else:
-                    # higher relevance replaces (binding supersedes patches)
-                    pending.call_type = call.call_type
-                    pending.execute = call.execute
-                old_finish, new_finish = pending.on_finish, call.on_finish
-                if old_finish is not None and new_finish is not None:
-                    pending.on_finish = lambda err: (old_finish(err), new_finish(err))
-                else:
-                    pending.on_finish = new_finish or old_finish
-                return pending
-            self._queued[call.object_key] = call
-            self._order.put(call.object_key)
+                    old_finish, new_finish = pending.on_finish, call.on_finish
+                    if old_finish is not None and new_finish is not None:
+                        pending.on_finish = lambda err: (old_finish(err),
+                                                         new_finish(err))
+                    else:
+                        pending.on_finish = new_finish or old_finish
+                    return pending
+                # higher relevance REPLACES (a delete supersedes a binding):
+                # the superseded call never runs — its waiters must see a
+                # skip error, NOT inherit the new call's outcome (a binder
+                # waiting on a bind replaced by an eviction would otherwise
+                # 'succeed' and mark a deleted pod scheduled)
+                superseded = pending
+                self._queued[call.object_key] = call
+                # the key is already in _order; the worker will pop the
+                # replacement
+            else:
+                self._queued[call.object_key] = call
+                self._order.put(call.object_key)
             if self.metrics is not None:
                 self.metrics.async_api_pending.set(len(self._queued))
-            return call
+        if superseded is not None:
+            err = CallSkippedError(
+                f"{superseded.call_type} for {superseded.object_key} "
+                f"superseded by {call.call_type}"
+            )
+            superseded.error = err
+            if superseded.on_finish is not None:
+                superseded.on_finish(err)
+            superseded.done.set()
+        return call
 
     # -- workers -------------------------------------------------------------
 

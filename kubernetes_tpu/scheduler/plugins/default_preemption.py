@@ -1,12 +1,20 @@
-"""DefaultPreemption: dry-run victim search + eviction.
+"""DefaultPreemption: dry-run victim search + PDB-aware selection + async
+eviction.
 
-Reference: pkg/scheduler/framework/plugins/defaultpreemption/ (SelectVictimsOnNode
-:207 — remove lower-priority pods, re-run Filter, reprieve victims that fit
-back) driving the engine at pkg/scheduler/framework/preemption/preemption.go
-(DryRunPreemption:408, candidate ranking in SelectCandidate).
+Reference: pkg/scheduler/framework/plugins/defaultpreemption/
+(SelectVictimsOnNode :207 — remove lower-priority pods, re-run Filter,
+reprieve PDB-violating victims first then the rest, highest priority first;
+filterPodsWithPDBViolation :380) driving the engine at
+pkg/scheduler/framework/preemption/preemption.go (DryRunPreemption :408,
+candidate sampling GetOffsetAndNumCandidates :174-191,
+pickOneNodeForPreemption :302-360) with the async executor of
+preemption/executor.go (prepareCandidateAsync :145 — nomination happens in
+the scheduling cycle, evictions never block it).
 """
 
 from __future__ import annotations
+
+import time
 
 from ...api.resource import ResourceNames
 from ...api.types import Pod
@@ -20,13 +28,89 @@ from ..framework.interface import (
 )
 from ..nodeinfo import NodeInfo, PodInfo
 
+# preemption.go:45-49 — candidate search is capped, not exhaustive
+MIN_CANDIDATE_NODES_PERCENTAGE = 10
+MIN_CANDIDATE_NODES_ABSOLUTE = 100
+
 
 class _Candidate:
-    __slots__ = ("node_name", "victims")
+    __slots__ = ("node_name", "victims", "num_pdb_violations")
 
-    def __init__(self, node_name: str, victims: list[PodInfo]):
+    def __init__(self, node_name: str, victims: list[PodInfo],
+                 num_pdb_violations: int = 0):
         self.node_name = node_name
         self.victims = victims
+        self.num_pdb_violations = num_pdb_violations
+
+
+class PreemptionExecutor:
+    """executor.go — runs a chosen candidate's preparation off the
+    scheduling loop: clear lower-priority nominations on the node, record
+    the disruption against matching PDBs, evict the victims. With the async
+    dispatcher the evictions ride worker threads (SchedulerAsyncAPICalls /
+    SchedulerAsyncPreemption); without it they run inline (deterministic
+    tests)."""
+
+    def __init__(self, handle):
+        self.handle = handle
+
+    def prepare_candidate(self, candidate: _Candidate, preemptor: Pod,
+                          pdbs: list) -> None:
+        # 1. lower-priority pods nominated onto this node lose their
+        # nomination (executor.go prepareCandidate: they must re-evaluate)
+        queue = self.handle.queue
+        for key in list(queue.nominated_pods_for_node(candidate.node_name)):
+            npi = queue.nominated_pod_info(key)
+            if npi is not None and npi.pod.spec.priority < preemptor.spec.priority:
+                queue.delete_nominated_pod_if_exists(npi.pod)
+        # 2. record the disruption on matching PDBs BEFORE evicting, so
+        # concurrent preemptors see the spent budget (the eviction API's
+        # DisruptedPods bookkeeping)
+        store = self.handle.store
+        now = time.time()
+        for v in candidate.victims:
+            for pdb in pdbs:
+                if pdb.meta.namespace != v.pod.meta.namespace:
+                    continue
+                sel = pdb.spec.selector
+                if sel is None or sel.empty or not sel.matches(v.pod.meta.labels):
+                    continue
+                cur = store.try_get("PodDisruptionBudget", pdb.meta.key)
+                if cur is None:
+                    continue
+                cur.status.disrupted_pods[v.pod.meta.name] = now
+                if cur.status.disruptions_allowed > 0:
+                    cur.status.disruptions_allowed -= 1
+                try:
+                    store.update(cur, check_version=False)
+                except Exception:  # noqa: BLE001
+                    pass
+        # 3. evict — async through the dispatcher when available
+        dispatcher = getattr(self.handle, "api_dispatcher", None)
+        if dispatcher is not None:
+            from ..api_dispatcher import APICall, CallSkippedError, POD_DELETE
+            from ...store.store import NotFoundError
+
+            def make_evict(key):
+                def evict():
+                    try:
+                        store.delete("Pod", key)
+                    except NotFoundError:
+                        pass
+
+                return evict
+
+            for v in candidate.victims:
+                try:
+                    dispatcher.add(APICall(POD_DELETE, v.key, make_evict(v.key)))
+                except CallSkippedError:
+                    pass  # an even-more-relevant call owns the object
+        else:
+            for v in candidate.victims:
+                try:
+                    store.delete("Pod", v.key)
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 class DefaultPreemption(Plugin):
@@ -35,6 +119,7 @@ class DefaultPreemption(Plugin):
     def __init__(self, names: ResourceNames, handle=None):
         self.names = names
         self.handle = handle
+        self._offset = 0  # rotating candidate offset (fairness)
 
     def set_handle(self, handle) -> None:
         self.handle = handle
@@ -59,48 +144,113 @@ class DefaultPreemption(Plugin):
                 return False
         return True
 
+    # -- PDB awareness -------------------------------------------------------
+
+    def _list_pdbs(self) -> list:
+        if self.handle is None:
+            return []
+        return list(self.handle.store.iter_kind("PodDisruptionBudget"))
+
+    @staticmethod
+    def _split_pdb_violation(pod_infos: list[PodInfo], pdbs: list):
+        """filterPodsWithPDBViolation (default_preemption.go:380): walk the
+        victims decrementing each matching PDB's remaining budget; a victim
+        that drives any budget negative is 'violating'."""
+        allowed = [pdb.status.disruptions_allowed for pdb in pdbs]
+        violating: list[PodInfo] = []
+        non_violating: list[PodInfo] = []
+        for pi in pod_infos:
+            pod = pi.pod
+            violated = False
+            if pod.meta.labels:
+                for i, pdb in enumerate(pdbs):
+                    if pdb.meta.namespace != pod.meta.namespace:
+                        continue
+                    sel = pdb.spec.selector
+                    if sel is None or sel.empty or not sel.matches(pod.meta.labels):
+                        continue
+                    if pod.meta.name in pdb.status.disrupted_pods:
+                        continue  # already processed; don't double-count
+                    allowed[i] -= 1
+                    if allowed[i] < 0:
+                        violated = True
+            (violating if violated else non_violating).append(pi)
+        return violating, non_violating
+
     # -- victim search -------------------------------------------------------
 
-    def _select_victims_on_node(self, state, pod: Pod, node_info: NodeInfo):
+    def _select_victims_on_node(self, state, pod: Pod, node_info: NodeInfo,
+                                pdbs: list):
         """SelectVictimsOnNode (default_preemption.go:207): remove all lower-
-        priority pods, check fit, then reprieve as many as possible
-        (highest-priority victims first)."""
+        priority pods, check fit, then reprieve as many as possible — PDB-
+        violating victims first, then the rest, highest priority first.
+        Returns (victims, num_pdb_violations) or None."""
         fw = self.handle.framework
         ni = node_info.clone()
         state = state.clone()
-        lower = sorted(
-            (pi for pi in ni.iter_pods() if pi.pod.spec.priority < pod.spec.priority),
-            key=lambda pi: (-pi.pod.spec.priority, pi.pod.meta.creation_timestamp),
-        )
+        lower = [pi for pi in ni.iter_pods()
+                 if pi.pod.spec.priority < pod.spec.priority]
         if not lower:
             return None
-        removed: list[PodInfo] = []
         for pi in lower:
             ni.remove_pod(pi.key)
             fw.run_pre_filter_extension_remove_pod(state, pod, pi, ni)
-            removed.append(pi)
         if not fw.run_filter_plugins(state, pod, ni).is_success:
             return None  # even with all victims gone the pod doesn't fit
-        # reprieve: re-add highest-priority victims that still fit
+        # MoreImportantPod order: priority desc, then earlier start
+        lower.sort(key=lambda pi: (-pi.pod.spec.priority,
+                                   pi.pod.meta.creation_timestamp))
+        violating, non_violating = self._split_pdb_violation(lower, pdbs)
         victims: list[PodInfo] = []
-        for pi in removed:  # removed is sorted high->low priority
+        num_violations = 0
+
+        def reprieve(pi: PodInfo) -> bool:
             ni.add_pod(pi)
             fw.run_pre_filter_extension_add_pod(state, pod, pi, ni)
-            if not fw.run_filter_plugins(state, pod, ni).is_success:
-                ni.remove_pod(pi.key)
-                fw.run_pre_filter_extension_remove_pod(state, pod, pi, ni)
-                victims.append(pi)
-        return victims if victims else None
+            if fw.run_filter_plugins(state, pod, ni).is_success:
+                return True
+            ni.remove_pod(pi.key)
+            fw.run_pre_filter_extension_remove_pod(state, pod, pi, ni)
+            victims.append(pi)
+            return False
 
-    # -- candidate ranking (preemption.go SelectCandidate) --------------------
+        for pi in violating:
+            if not reprieve(pi):
+                num_violations += 1
+        for pi in non_violating:
+            reprieve(pi)
+        if not victims:
+            return None
+        victims.sort(key=lambda pi: (-pi.pod.spec.priority,
+                                     pi.pod.meta.creation_timestamp))
+        return victims, num_violations
+
+    # -- candidate sampling + ranking ----------------------------------------
+
+    def _num_candidates(self, num_nodes: int) -> int:
+        """GetOffsetAndNumCandidates (preemption.go:174-191)."""
+        n = num_nodes * MIN_CANDIDATE_NODES_PERCENTAGE // 100
+        n = max(n, MIN_CANDIDATE_NODES_ABSOLUTE)
+        return min(n, num_nodes)
 
     @staticmethod
     def _candidate_rank(c: _Candidate):
+        """pickOneNodeForPreemption criteria (preemption.go:302-360), all
+        minimized: PDB violations, highest victim priority, priority sum,
+        victim count, then earliest victim start time (prefer nodes whose
+        highest-priority victim started LATEST => minimize -start)."""
         priorities = [v.pod.spec.priority for v in c.victims]
+        top = max(priorities, default=-(1 << 31))
+        latest_start = max(
+            (v.pod.meta.creation_timestamp for v in c.victims
+             if v.pod.spec.priority == top), default=0.0
+        )
         return (
-            max(priorities, default=-(1 << 31)),  # lowest max victim priority
+            c.num_pdb_violations,
+            top,
             sum(priorities),
             len(c.victims),
+            -latest_start,
         )
 
     # -- post filter -----------------------------------------------------------
@@ -111,27 +261,37 @@ class DefaultPreemption(Plugin):
                 "preemption not allowed for this pod", plugin=self.name
             )
         snapshot = self.handle.snapshot
+        pdbs = self._list_pdbs()
+        nodes = snapshot.list_nodes()
+        num_all = len(nodes)
+        want = self._num_candidates(num_all)
         candidates: list[_Candidate] = []
-        for ni in snapshot.list_nodes():
+        # rotating offset (the reference randomizes; a rotating cursor gives
+        # the same fairness deterministically)
+        start = self._offset % num_all if num_all else 0
+        scanned = 0
+        for i in range(num_all):
+            ni = nodes[(start + i) % num_all]
+            scanned += 1
             if node_to_status.get(ni.name).code != UNSCHEDULABLE:
                 continue  # UnschedulableAndUnresolvable can't be fixed by eviction
-            victims = self._select_victims_on_node(state, pod, ni)
-            if victims:
-                candidates.append(_Candidate(ni.name, victims))
+            found = self._select_victims_on_node(state, pod, ni, pdbs)
+            if found is not None:
+                victims, violations = found
+                candidates.append(_Candidate(ni.name, victims, violations))
+                if len(candidates) >= want:
+                    break
+        self._offset = (start + scanned) % num_all if num_all else 0
         if not candidates:
             return None, Status.unschedulable(
-                "preemption: 0/%d nodes are available" % snapshot.num_nodes(),
+                "preemption: 0/%d nodes are available" % num_all,
                 plugin=self.name,
             )
         best = min(candidates, key=self._candidate_rank)
-        # evict victims via API (async dispatcher in reference; direct here)
-        store = self.handle.store
-        for v in best.victims:
-            try:
-                store.delete("Pod", v.key)
-            except Exception:
-                pass
-        # clear lower-priority nominations on this node (preemption.go:236)
+        # nomination is synchronous (the scheduling cycle needs it); victim
+        # eviction + nomination cleanup run via the executor — off the loop
+        # when the async dispatcher is available (executor.go:145)
+        PreemptionExecutor(self.handle).prepare_candidate(best, pod, pdbs)
         return (
             PostFilterResult(nominated_node_name=best.node_name),
             Status(),

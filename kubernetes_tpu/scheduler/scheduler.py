@@ -33,6 +33,9 @@ class Handle:
     queue: SchedulingQueue
     snapshot: Snapshot
     framework: Framework | None = None
+    # async API pipeline (SchedulerAsyncAPICalls): preemption's executor
+    # routes evictions through it so PostFilter never blocks on API writes
+    api_dispatcher: Any = None
 
 
 @dataclass
@@ -143,7 +146,8 @@ class Scheduler:
             self.api_cacher = APICacher(store, self.api_dispatcher)
 
         # wire handles into stateful plugins
-        self.handle = Handle(store, self.cache, self.queue, self.snapshot)
+        self.handle = Handle(store, self.cache, self.queue, self.snapshot,
+                             api_dispatcher=self.api_dispatcher)
         for fw in self.frameworks.values():
             self.handle.framework = fw
             for p in fw.plugins:

@@ -1,0 +1,227 @@
+"""PDB-aware + async preemption tests.
+
+Reference behavior under test: filterPodsWithPDBViolation
+(default_preemption.go:380), reprieve order (violating first, then
+non-violating, :270-299), pickOneNodeForPreemption criterion #1 (fewest PDB
+violations, preemption.go:327), the async executor (executor.go:145), and
+the disruption controller feeding Status.DisruptionsAllowed
+(pkg/controller/disruption)."""
+
+from kubernetes_tpu.api.labels import LabelSelector
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import (
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+)
+from kubernetes_tpu.controllers import DisruptionController
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.store.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+def _pdb(name: str, match: dict, min_available: int | None = None,
+         max_unavailable: int | None = None):
+    return PodDisruptionBudget(
+        meta=ObjectMeta(name=name),
+        spec=PodDisruptionBudgetSpec(
+            selector=LabelSelector(match_labels=tuple(sorted(match.items()))),
+            min_available=min_available,
+            max_unavailable=max_unavailable,
+        ),
+    )
+
+
+def _setup(n_nodes=2, cpu="4", **sched_kw):
+    store = Store()
+    for i in range(n_nodes):
+        store.create(make_node(f"n{i}", cpu=cpu, mem="8Gi"))
+    sched = Scheduler(store, profiles=[Profile()], **sched_kw)
+    sched.start()
+    return store, sched
+
+
+def _victim(name, node=None, cpu="3", prio=0, labels=None):
+    p = make_pod(name, cpu=cpu, mem="1Gi", labels=labels or {})
+    p.spec.priority = prio
+    return p
+
+
+def _wait_bound(store, sched, key: str, timeout: float = 5.0) -> bool:
+    """Drive scheduling until the pod binds (the preemptor sits out its
+    post-failure backoff first — reference integration tests poll the same
+    way)."""
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        sched.schedule_pending()
+        pod = store.try_get("Pod", key)
+        if pod is not None and pod.spec.node_name:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestDisruptionController:
+    def test_min_available_budget(self):
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        store.create(_pdb("budget", {"app": "web"}, min_available=2))
+        ctrl = DisruptionController(store)
+        for i in range(3):
+            p = make_pod(f"web-{i}", cpu="1", mem="1Gi", labels={"app": "web"})
+            p.spec.node_name = "n0"
+            store.create(p)
+        ctrl.sync_once()
+        pdb = store.get("PodDisruptionBudget", "default/budget")
+        assert pdb.status.current_healthy == 3
+        assert pdb.status.desired_healthy == 2
+        assert pdb.status.disruptions_allowed == 1
+
+    def test_max_unavailable_budget(self):
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        store.create(_pdb("budget", {"app": "db"}, max_unavailable=1))
+        ctrl = DisruptionController(store)
+        for i in range(4):
+            p = make_pod(f"db-{i}", cpu="1", mem="1Gi", labels={"app": "db"})
+            p.spec.node_name = "n0"
+            store.create(p)
+        ctrl.sync_once()
+        pdb = store.get("PodDisruptionBudget", "default/budget")
+        assert pdb.status.desired_healthy == 3
+        assert pdb.status.disruptions_allowed == 1
+
+    def test_unbound_pods_not_healthy(self):
+        store = Store()
+        store.create(_pdb("budget", {"app": "web"}, min_available=1))
+        ctrl = DisruptionController(store)
+        store.create(make_pod("web-0", labels={"app": "web"}))  # unbound
+        ctrl.sync_once()
+        pdb = store.get("PodDisruptionBudget", "default/budget")
+        assert pdb.status.current_healthy == 0
+        assert pdb.status.disruptions_allowed == 0
+
+
+class TestPDBAwarePreemption:
+    def test_protected_victims_reprieved(self):
+        """Two equal victims on two nodes; one is PDB-protected with zero
+        budget — the preemptor must evict the unprotected one."""
+        store, sched = _setup(n_nodes=2, cpu="4")
+        protected = _victim("prot", cpu="3", labels={"app": "critical"})
+        unprotected = _victim("free", cpu="3", labels={"app": "bulk"})
+        store.create(protected)
+        store.create(unprotected)
+        sched.schedule_pending()
+        binds = {p.meta.name: p.spec.node_name for p in store.pods()}
+        assert all(binds.values())
+        pdb = _pdb("crit-budget", {"app": "critical"}, min_available=1)
+        pdb.status.disruptions_allowed = 0
+        pdb.status.current_healthy = 1
+        store.create(pdb)
+        preemptor = make_pod("pre", cpu="3", mem="1Gi")
+        preemptor.spec.priority = 100
+        store.create(preemptor)
+        sched.schedule_pending()
+        names = {p.meta.name for p in store.pods()}
+        assert "prot" in names, "PDB-protected victim must be reprieved"
+        assert "free" not in names, "unprotected victim must be evicted"
+        # preemptor retries after eviction (post-failure backoff) and binds
+        assert _wait_bound(store, sched, "default/pre")
+
+    def test_budget_violating_preemption_still_possible(self):
+        """When ONLY protected victims can make room, preemption proceeds
+        and counts the violation (the reference never hard-blocks on PDBs)."""
+        store, sched = _setup(n_nodes=1, cpu="4")
+        v = _victim("only", cpu="3", labels={"app": "critical"})
+        store.create(v)
+        sched.schedule_pending()
+        pdb = _pdb("crit-budget", {"app": "critical"}, min_available=1)
+        pdb.status.disruptions_allowed = 0
+        store.create(pdb)
+        preemptor = make_pod("pre", cpu="3", mem="1Gi")
+        preemptor.spec.priority = 100
+        store.create(preemptor)
+        sched.schedule_pending()
+        assert store.try_get("Pod", "default/only") is None
+        assert _wait_bound(store, sched, "default/pre")
+
+    def test_pdb_disrupted_pods_recorded(self):
+        store, sched = _setup(n_nodes=1, cpu="4")
+        store.create(_victim("v0", cpu="3", labels={"app": "web"}))
+        sched.schedule_pending()
+        pdb = _pdb("web-budget", {"app": "web"}, min_available=0)
+        pdb.status.disruptions_allowed = 1
+        store.create(pdb)
+        preemptor = make_pod("pre", cpu="3", mem="1Gi")
+        preemptor.spec.priority = 10
+        store.create(preemptor)
+        sched.schedule_pending()
+        cur = store.get("PodDisruptionBudget", "default/web-budget")
+        assert "v0" in cur.status.disrupted_pods
+        assert cur.status.disruptions_allowed == 0
+
+
+class TestAsyncPreemption:
+    def test_evictions_ride_the_dispatcher(self):
+        store, sched = _setup(n_nodes=2, cpu="4", async_api_calls=True)
+        for i in range(2):
+            store.create(_victim(f"v{i}", cpu="3"))
+        sched.schedule_pending()
+        for i in range(2):
+            p = make_pod(f"pre-{i}", cpu="3", mem="1Gi")
+            p.spec.priority = 100
+            store.create(p)
+        sched.schedule_pending()
+        assert _wait_bound(store, sched, "default/pre-0")
+        assert _wait_bound(store, sched, "default/pre-1")
+        assert store.try_get("Pod", "default/v0") is None
+        assert store.try_get("Pod", "default/v1") is None
+        sched.api_dispatcher.close()
+
+    def test_lower_priority_nomination_cleared(self):
+        """A lower-priority preemptor's nomination on the chosen node is
+        cleared when a higher-priority preemptor picks the same node."""
+        store, sched = _setup(n_nodes=1, cpu="4")
+        store.create(_victim("v0", cpu="3", prio=0))
+        sched.schedule_pending()
+        low = make_pod("low", cpu="3", mem="1Gi")
+        low.spec.priority = 10
+        store.create(low)
+        sched.pump()
+        # schedule low once: it nominates n0 (victim terminating)
+        sched.loop.schedule_one(timeout=0)
+        assert "default/low" in sched.queue.nominated_pods_for_node("n0")
+        high = make_pod("high", cpu="3", mem="1Gi")
+        high.spec.priority = 100
+        store.create(high)
+        sched.schedule_pending()
+        # high won the node; low's nomination was cleared at preparation
+        assert store.get("Pod", "default/high").spec.node_name == "n0"
+        low_now = store.try_get("Pod", "default/low")
+        assert low_now is None or not low_now.spec.node_name
+
+
+def test_candidate_ranking_prefers_fewer_pdb_violations():
+    """Two candidate nodes make room; one requires violating a PDB — the
+    engine must pick the violation-free node (criterion #1)."""
+    store = Store()
+    store.create(make_node("n0", cpu="4", mem="8Gi"))
+    store.create(make_node("n1", cpu="4", mem="8Gi"))
+    sched = Scheduler(store, profiles=[Profile()])
+    sched.start()
+    a = _victim("prot", cpu="3", labels={"app": "critical"})
+    a.spec.node_name = "n0"
+    store.create(a)
+    b = _victim("free", cpu="3", labels={"app": "bulk"})
+    b.spec.node_name = "n1"
+    store.create(b)
+    pdb = _pdb("crit", {"app": "critical"}, min_available=1)
+    pdb.status.disruptions_allowed = 0
+    store.create(pdb)
+    preemptor = make_pod("pre", cpu="3", mem="1Gi")
+    preemptor.spec.priority = 50
+    store.create(preemptor)
+    sched.schedule_pending()
+    assert store.try_get("Pod", "default/prot") is not None
+    assert store.try_get("Pod", "default/free") is None
