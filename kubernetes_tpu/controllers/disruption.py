@@ -51,10 +51,8 @@ class DisruptionController(Controller):
                 self.queue.add(pdb.meta.key)
 
     def key_of(self, kind: str, obj) -> str | None:
-        if kind == "PodDisruptionBudget":
-            return obj.meta.key
-        self._enqueue_matching_pdbs(obj)
-        return None
+        # only PDB events reach the base handler ("Pod" has its own above)
+        return obj.meta.key
 
     def reconcile(self, key: str) -> None:
         pdb = self.store.try_get("PodDisruptionBudget", key)

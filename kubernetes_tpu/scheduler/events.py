@@ -1,0 +1,130 @@
+"""EventRecorder: "Scheduled" / "FailedScheduling" events as API objects.
+
+Reference: client-go tools/events EventRecorder + the events.k8s.io Event
+type — the scheduler emits an event per binding and per failure
+(pkg/scheduler/schedule_one.go:1174,1273). The reference's recorder is an
+async broadcaster with aggregation (an EventSeries bumps a count instead of
+minting a new object for repeats); this recorder buffers and aggregates the
+same way and flushes batches to the store, so the hot binding path only
+appends to a list.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..api.meta import ObjectMeta
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    """events.k8s.io/v1 Event (scheduling-relevant subset)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: str = ""  # "<kind>/<namespace>/<name>"
+    type: str = EVENT_TYPE_NORMAL
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+    reporting_controller: str = "default-scheduler"
+
+    kind = "Event"
+
+
+class EventRecorder:
+    """Buffered, aggregating recorder; thread-safe appends, batched flush."""
+
+    # events older than this are garbage-collected (the reference relies on
+    # the apiserver's event TTL, default 1h)
+    EVENT_TTL_S = 3600.0
+    # sweep the stored events after this many writes since the last sweep
+    GC_EVERY_WRITES = 512
+
+    def __init__(self, store, component: str = "default-scheduler",
+                 max_buffer: int = 4096):
+        self.store = store
+        self.component = component
+        self._mu = threading.Lock()
+        # (involved, type, reason, message) -> pending Event
+        self._pending: dict[tuple, Event] = {}
+        self._seq = 0
+        self._max_buffer = max_buffer
+        self._writes_since_gc = 0
+
+    def event(self, obj, etype: str, reason: str, message: str) -> None:
+        """Record one event (schedule_one.go:1174 "Scheduled",
+        :1273 "FailedScheduling"). Repeats aggregate into a count."""
+        involved = f"{obj.kind}/{obj.meta.key}"
+        key = (involved, etype, reason, message)
+        now = time.time()
+        flush_now = False
+        with self._mu:
+            ev = self._pending.get(key)
+            if ev is not None:
+                ev.count += 1
+                ev.last_timestamp = now
+            else:
+                # deterministic name per (involved, type, reason, message):
+                # repeats aggregate into the SAME stored object across
+                # flushes (EventSeries semantics), never a new one per flush
+                import hashlib
+
+                digest = hashlib.sha1(
+                    "|".join(key).encode()
+                ).hexdigest()[:12]
+                name = f"{obj.meta.name}.{digest}"
+                self._pending[key] = Event(
+                    meta=ObjectMeta(name=name, namespace=obj.meta.namespace),
+                    involved_object=involved,
+                    type=etype,
+                    reason=reason,
+                    message=message,
+                    first_timestamp=now,
+                    last_timestamp=now,
+                    reporting_controller=self.component,
+                )
+            flush_now = len(self._pending) >= self._max_buffer
+        if flush_now:
+            self.flush()
+
+    def flush(self) -> int:
+        """Write buffered events to the store; returns how many landed."""
+        with self._mu:
+            pending, self._pending = self._pending, {}
+        n = 0
+        for ev in pending.values():
+            try:
+                existing = self.store.try_get("Event", ev.meta.key)
+                if existing is not None:
+                    existing.count += ev.count
+                    existing.last_timestamp = ev.last_timestamp
+                    self.store.update(existing, check_version=False)
+                else:
+                    self.store.create(ev)
+                n += 1
+            except Exception:  # noqa: BLE001 - events are best-effort
+                pass
+        self._writes_since_gc += n
+        if self._writes_since_gc >= self.GC_EVERY_WRITES:
+            self._writes_since_gc = 0
+            self._gc()
+        return n
+
+    def _gc(self) -> None:
+        """Expire stored events past the TTL — the store has no apiserver
+        event TTL, so unbounded churny runs would otherwise leak objects."""
+        cutoff = time.time() - self.EVENT_TTL_S
+        try:
+            events, _ = self.store.list("Event")
+            for ev in events:
+                if ev.last_timestamp < cutoff:
+                    self.store.delete("Event", ev.meta.key)
+        except Exception:  # noqa: BLE001
+            pass

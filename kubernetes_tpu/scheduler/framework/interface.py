@@ -251,16 +251,34 @@ class Plugin:
 
 @dataclass
 class WaitingPod:
-    """A pod parked at Permit (runtime/waiting_pods_map.go)."""
+    """A pod parked at Permit (runtime/waiting_pods_map.go). Deciders
+    (allow/reject) signal the condition so WaitOnPermit blocks on a real
+    wakeup instead of polling (framework.go:2034 blocks on a channel)."""
 
     pod: Any
     pending_plugins: dict[str, float] = field(default_factory=dict)  # plugin -> deadline
     decision: Status | None = None
 
+    def __post_init__(self):
+        import threading
+
+        self._cond = threading.Condition()
+
     def allow(self, plugin: str) -> None:
-        self.pending_plugins.pop(plugin, None)
-        if not self.pending_plugins and self.decision is None:
-            self.decision = Status()
+        with self._cond:
+            self.pending_plugins.pop(plugin, None)
+            if not self.pending_plugins and self.decision is None:
+                self.decision = Status()
+            self._cond.notify_all()
 
     def reject(self, plugin: str, msg: str) -> None:
-        self.decision = Status.unschedulable(msg, plugin=plugin)
+        with self._cond:
+            self.decision = Status.unschedulable(msg, plugin=plugin)
+            self._cond.notify_all()
+
+    def wait_for_decision(self, timeout: float) -> Status | None:
+        """Block until a decision lands or timeout elapses."""
+        with self._cond:
+            if self.decision is None and timeout > 0:
+                self._cond.wait(timeout)
+            return self.decision

@@ -304,20 +304,37 @@ class Framework:
             return Status.wait()
         return Status()
 
-    def wait_on_permit(self, pod: Pod, poll: float = 0.001, max_wait: float | None = None) -> Status:
-        """framework.go WaitOnPermit:2034 — block until allowed/rejected/timeout."""
+    def wait_on_permit(self, pod: Pod, max_wait: float | None = None) -> Status:
+        """framework.go WaitOnPermit:2034 — block until allowed/rejected/
+        timeout. Blocks on the WaitingPod's condition variable (the
+        reference blocks on a channel) — deciders wake waiters directly, no
+        polling loop burning CPU in every binding thread."""
+        from ...utils.clock import Clock
+
         wp = self._waiting_pods.get(pod.meta.key)
         if wp is None:
             return Status()
         deadline = min(wp.pending_plugins.values()) if wp.pending_plugins else 0.0
-        waited = 0.0
-        while wp.decision is None:
-            if self.clock.now() >= deadline:
+        hard_stop = (self.clock.now() + max_wait) if max_wait is not None else None
+        # an injected virtual clock advances via clock.sleep, not wall time
+        # — a real-time condition wait would block for the full virtual
+        # timeout; keep the clock abstraction with a sleep-driven loop there
+        real_clock = type(self.clock) is Clock
+        while True:
+            now = self.clock.now()
+            if wp.decision is not None:
+                break
+            if now >= deadline:
                 self._waiting_pods.pop(pod.meta.key, None)
                 return Status.unschedulable("pod rejected: permit wait timeout")
-            self.clock.sleep(poll)
-            waited += poll
-            if max_wait is not None and waited >= max_wait:
+            stop = deadline if hard_stop is None else min(deadline, hard_stop)
+            if real_clock:
+                decision = wp.wait_for_decision(stop - now)
+                if decision is not None:
+                    break
+            else:
+                self.clock.sleep(0.001)
+            if hard_stop is not None and self.clock.now() >= hard_stop:
                 break
         self._waiting_pods.pop(pod.meta.key, None)
         return wp.decision if wp.decision is not None else Status.wait()

@@ -57,12 +57,19 @@ class PreemptionExecutor:
     def prepare_candidate(self, candidate: _Candidate, preemptor: Pod,
                           pdbs: list) -> None:
         # 1. lower-priority pods nominated onto this node lose their
-        # nomination (executor.go prepareCandidate: they must re-evaluate)
+        # nomination (executor.go prepareCandidate ClearNominatedNodeName):
+        # queue-side AND status-side — a stale status.nominatedNodeName
+        # would keep forcing the demoted pod onto the host path and keep
+        # simulating it onto a node it will not get
         queue = self.handle.queue
+        store = self.handle.store
         for key in list(queue.nominated_pods_for_node(candidate.node_name)):
             npi = queue.nominated_pod_info(key)
             if npi is not None and npi.pod.spec.priority < preemptor.spec.priority:
                 queue.delete_nominated_pod_if_exists(npi.pod)
+                patch = getattr(store, "patch_pod_status", None)
+                if patch is not None:
+                    patch(key, nominated_node="")
         # 2. record the disruption on matching PDBs BEFORE evicting, so
         # concurrent preemptors see the spent budget (the eviction API's
         # DisruptedPods bookkeeping)

@@ -73,6 +73,13 @@ def default_plugins(store, names: ResourceNames, feature_gates=None, args: dict 
         DefaultBinder(store),
     ]
     gates = feature_gates or {}
+    if gates.get("NodeDeclaredFeatures", True):
+        from .node_declared_features import NodeDeclaredFeatures
+
+        # filters before NodeResourcesFit (default_plugins.go gated adds)
+        idx = next(i for i, p in enumerate(plugins)
+                   if p.name == "NodeResourcesFit")
+        plugins.insert(idx, NodeDeclaredFeatures())
     if gates.get("DynamicResourceAllocation", True):
         from .dynamic_resources import DynamicResources
 

@@ -129,10 +129,24 @@ class SchedulingQueue:
         qpi.gating_plugin = ""
         return True
 
+    # backoffQ ordering window (backoff_queue.go:38): expiries truncate to
+    # 1-second windows so whole windows flush together and backoff ordering
+    # is stable regardless of sub-second arrival jitter
+    BACKOFF_ORDERING_WINDOW = 1.0
+
+    def _align_to_window(self, t: float) -> float:
+        """alignToWindow (backoff_queue.go:140) — lowest timestamp in t's
+        ordering window."""
+        w = self.BACKOFF_ORDERING_WINDOW
+        return (t // w) * w
+
     def _backoff_duration(self, qpi: QueuedPodInfo) -> float:
-        """backoff_queue.go calculateBackoffDuration — exponential, errors and
-        unschedulable rejections tracked separately."""
-        count = max(qpi.consecutive_errors_count, qpi.unschedulable_count)
+        """backoff_queue.go getBackoffTime:217-246 — the error count drives
+        the exponent while the LAST cycle errored (it resets on a plain
+        unschedulable rejection); otherwise the unschedulable count does."""
+        count = qpi.unschedulable_count
+        if qpi.consecutive_errors_count > 0:
+            count = qpi.consecutive_errors_count
         if count == 0:
             return 0.0
         duration = self._initial_backoff * (2 ** (count - 1))
@@ -140,7 +154,7 @@ class SchedulingQueue:
 
     def _move_to_active_or_backoff_locked(self, qpi: QueuedPodInfo, event_label: str) -> None:
         now = self._clock.now()
-        expiry = qpi.timestamp + self._backoff_duration(qpi)
+        expiry = self._align_to_window(qpi.timestamp + self._backoff_duration(qpi))
         if qpi.pending_plugins:
             # Pending (vs Unschedulable) skips backoff (scheduling_queue.go —
             # hinted by a plugin that declared the pod schedulable now)
@@ -210,6 +224,12 @@ class SchedulingQueue:
                 return None
             qpi = self._active.pop()
             qpi.attempts += 1
+            # each attempt reports its OWN rejectors (the reference replaces
+            # UnschedulablePlugins per failure, never accumulates): a stale
+            # set would misclassify a later error as a plugin rejection and
+            # park a retriable pod
+            qpi.unschedulable_plugins = set()
+            qpi.pending_plugins = set()
             if qpi.initial_attempt_timestamp is None:
                 qpi.initial_attempt_timestamp = self._clock.now()
             seq = next(self._event_seq)
@@ -226,6 +246,8 @@ class SchedulingQueue:
             if qpi is None:
                 return None
             qpi.attempts += 1
+            qpi.unschedulable_plugins = set()
+            qpi.pending_plugins = set()
             if qpi.initial_attempt_timestamp is None:
                 qpi.initial_attempt_timestamp = self._clock.now()
             self._in_flight[qpi.key] = _InFlightPod(qpi.key, next(self._event_seq))
@@ -254,6 +276,14 @@ class SchedulingQueue:
             key = qpi.key
             inflight = self._in_flight.pop(key, None)
             qpi.timestamp = self._clock.now()
+            # scheduling_queue.go:924-932 — rejected by no plugin means an
+            # unexpected error (backoff counts errors); a plugin rejection
+            # resets the error streak
+            if not qpi.unschedulable_plugins and not qpi.pending_plugins:
+                qpi.consecutive_errors_count += 1
+            else:
+                qpi.unschedulable_count += 1
+                qpi.consecutive_errors_count = 0
             if qpi.gated:
                 self._unschedulable[key] = qpi
                 self._gc_event_log_locked()
@@ -390,6 +420,22 @@ class SchedulingQueue:
         with self._mu:
             entry = self._nominated.get(pod.meta.key)
             return entry[0] if entry else ""
+
+    def max_nominated_priority(self, exclude_key: str | None = None) -> int | None:
+        """Highest priority among nominated pods (optionally excluding one
+        pod) — None when nothing is nominated. Drives the TPU backend's
+        narrowed fallback: only pods that could be affected by nominated-pod
+        protection (schedule_one.go:1190 filters nominated pods of >= the
+        incoming pod's priority) leave the kernel path."""
+        with self._mu:
+            best: int | None = None
+            for key, (_n, info) in self._nominated.items():
+                if key == exclude_key:
+                    continue
+                p = info.pod.spec.priority
+                if best is None or p > best:
+                    best = p
+            return best
 
     def has_nominated_pods(self) -> bool:
         with self._mu:

@@ -356,6 +356,8 @@ class ScheduleOneLoop:
         return True
 
     def schedule_pod_info(self, qpi: QueuedPodInfo) -> None:
+        from ..utils.trace import Trace
+
         pod = qpi.pod
         fw = self.framework_for_pod(pod)
         if fw is None:
@@ -367,16 +369,28 @@ class ScheduleOneLoop:
         # whole-gang cycle (ScheduleOne, schedule_one.go:77: SchedulingGroup
         # + GenericWorkload gate routes to scheduleOnePodGroup)
         if pod.spec.scheduling_group is not None and self.pod_group_cycles:
+            trace = Trace("SchedulingPodGroup", pod=pod.meta.key)
             self.schedule_pod_group(qpi, fw)
+            trace.log_if_long(0.1)
             return
 
+        # slow-cycle diagnosis (utiltrace LogIfLong, schedule_one.go:570-571):
+        # steps logged only when the cycle breaches 100ms
+        trace = Trace("Scheduling", pod=pod.meta.key,
+                      scheduler=fw.profile_name)
         state = CycleState()
         scheduling_cycle = self.queue.moved_count
         result, status = self._scheduling_cycle(state, fw, qpi)
+        trace.step("Computing pod placement done" if status.is_success
+                   else "Scheduling attempt failed")
         if not status.is_success:
             self._handle_scheduling_failure(fw, qpi, status, scheduling_cycle)
+            trace.step("Failure handled (requeue + condition)")
+            trace.log_if_long(0.1)
             return
         self._dispatch_binding(state, fw, qpi, result)
+        trace.step("Binding dispatched")
+        trace.log_if_long(0.1)
 
     def _dispatch_binding(self, state, fw: Framework, qpi: QueuedPodInfo,
                           result: ScheduleResult) -> None:
@@ -1077,14 +1091,10 @@ class ScheduleOneLoop:
     def _handle_scheduling_failure(
         self, fw: Framework, qpi: QueuedPodInfo, status: Status, cycle: int
     ) -> None:
-        """handleSchedulingFailure:1188 — requeue + PodScheduled condition."""
+        """handleSchedulingFailure:1188 — requeue + PodScheduled condition.
+        Backoff counters are maintained by the queue itself on re-add
+        (scheduling_queue.go:924-932)."""
         pod = qpi.pod
-        if status.code == UNSCHEDULABLE:
-            qpi.unschedulable_count += 1
-        elif status.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
-            pass  # no backoff increment
-        else:
-            qpi.consecutive_errors_count += 1
         if status.plugin:
             qpi.unschedulable_plugins.add(status.plugin)
         self.queue.add_unschedulable_if_not_present(qpi, cycle)

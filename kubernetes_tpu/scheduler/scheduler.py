@@ -73,6 +73,14 @@ class Scheduler:
         self.names = names or ResourceNames()
         self.clock = clock or Clock()
         self.metrics = metrics
+        if event_recorder is None:
+            # every scheduler emits Scheduled/FailedScheduling events
+            # (schedule_one.go:1174,1273); the recorder buffers + aggregates
+            # so the binding path only appends to a dict
+            from .events import EventRecorder
+
+            event_recorder = EventRecorder(store)
+        self.event_recorder = event_recorder
         self.cache = Cache(self.names)
         self.snapshot = Snapshot()
         self.feature_gates = dict(feature_gates or {})
@@ -350,6 +358,8 @@ class Scheduler:
         if now - self._last_leftover_flush > 30.0:
             self._last_leftover_flush = now
             self.queue.flush_unschedulable_leftover()
+        if self.event_recorder is not None:
+            self.event_recorder.flush()
         if self.metrics is not None and hasattr(self.metrics, "update_queue_gauges"):
             active, backoff, unsched = self.queue.pending_pods()
             self.metrics.update_queue_gauges(active, backoff, unsched)

@@ -716,15 +716,28 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
 
         if pod_claim_names(pod) or pod.spec.resource_claims:
             return True
+        # NodeDeclaredFeatures isn't modeled in the kernel's filter planes
+        from ..plugins.node_declared_features import infer_required_features
+
+        if infer_required_features(pod):
+            return True
         # configured HTTP extenders veto/score out-of-process — host path only
         if self.extenders and any(e.is_interested(pod) for e in self.extenders):
             return True
         # preemption aftermath: nominated pods must be simulated onto nodes
-        # during filtering (schedule_one.go:1190) — host path handles it
+        # during filtering — but ONLY nominated pods with priority >= the
+        # incoming pod's matter (schedule_one.go:1190 addNominatedPods), so
+        # a pod that outranks every nomination stays on the kernel path.
+        # One preemption event no longer pushes the whole queue to the
+        # sequential host path.
         if pod.status.nominated_node_name:
             return True
-        if self.nominator is not None and getattr(
-            self.nominator, "has_nominated_pods", lambda: False
-        )():
-            return True
+        if self.nominator is not None:
+            fn = getattr(self.nominator, "max_nominated_priority", None)
+            if fn is not None:
+                top = fn(exclude_key=pod.meta.key)
+                if top is not None and top >= pod.spec.priority:
+                    return True
+            elif getattr(self.nominator, "has_nominated_pods", lambda: False)():
+                return True
         return False

@@ -1,0 +1,50 @@
+"""Latency tracing: utiltrace-style step traces logged only when slow.
+
+Reference: vendor/k8s.io/utils/trace/trace.go:154-216 — schedulePod opens
+utiltrace.New("Scheduling", ...) and LogIfLong(100ms)
+(pkg/scheduler/schedule_one.go:570-571,581,611): steps are recorded cheaply
+(a perf_counter read each) and the trace is only FORMATTED and logged when
+the whole operation exceeded the threshold — the diagnostic exists exactly
+when the perf problem does.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger("kubernetes_tpu.trace")
+
+
+class Trace:
+    """One traced operation; nested steps are (timestamp, message)."""
+
+    __slots__ = ("name", "fields", "start", "steps")
+
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.perf_counter()
+        self.steps: list[tuple[float, str]] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.perf_counter(), msg))
+
+    def total_time(self) -> float:
+        return time.perf_counter() - self.start
+
+    def log_if_long(self, threshold: float = 0.1) -> bool:
+        """Format + log the step timeline iff total exceeded threshold
+        (LogIfLong, trace.go:208). Returns whether it logged."""
+        total = self.total_time()
+        if total < threshold:
+            return False
+        fields = ",".join(f"{k}={v}" for k, v in self.fields.items())
+        lines = [f'Trace "{self.name}" ({fields}): total {total * 1000:.1f}ms '
+                 f'(threshold {threshold * 1000:.0f}ms):']
+        prev = self.start
+        for ts, msg in self.steps:
+            lines.append(f"  +{(ts - prev) * 1000:.1f}ms {msg}")
+            prev = ts
+        logger.warning("\n".join(lines))
+        return True

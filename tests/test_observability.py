@@ -1,0 +1,169 @@
+"""Observability + NodeDeclaredFeatures tests.
+
+Covers VERDICT round-2 items: a real EventRecorder writing Event objects to
+the store (schedule_one.go:1174,1273), the LogIfLong slow-cycle trace
+(utiltrace, trace.go:154-216), the condition-variable permit wait
+(framework.go:2034 — no polling), and the NodeDeclaredFeatures plugin
+(pkg/scheduler/framework/plugins/nodedeclaredfeatures)."""
+
+import logging
+import threading
+import time
+
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.store.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+class TestEventRecorder:
+    def test_scheduled_events_reach_the_store(self):
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        sched = Scheduler(store, profiles=[Profile()])
+        sched.start()
+        for i in range(3):
+            store.create(make_pod(f"p{i}", cpu="1", mem="1Gi"))
+        sched.schedule_pending()
+        sched.event_recorder.flush()
+        events, _ = store.list("Event")
+        scheduled = [e for e in events if e.reason == "Scheduled"]
+        assert len(scheduled) == 3
+        assert all(e.type == "Normal" for e in scheduled)
+        assert all(e.involved_object.startswith("Pod/default/") for e in scheduled)
+
+    def test_failed_scheduling_events_aggregate(self):
+        store = Store()
+        store.create(make_node("n0", cpu="1", mem="1Gi"))
+        sched = Scheduler(store, profiles=[Profile()])
+        sched.start()
+        store.create(make_pod("big", cpu="8", mem="1Gi"))
+        sched.schedule_pending()
+        # a node event requeues the parked pod; it fails again after backoff
+        node = store.get("Node", "n0")
+        node.status.allocatable = dict(node.status.allocatable, cpu="2")
+        store.update(node, check_version=False)
+        time.sleep(1.1)  # sit out the backoff
+        sched.schedule_pending()
+        sched.event_recorder.flush()
+        events, _ = store.list("Event")
+        failed = [e for e in events if e.reason == "FailedScheduling"]
+        assert failed, "failure must emit a FailedScheduling event"
+        # identical repeats aggregate into count, not new objects
+        assert sum(e.count for e in failed) >= 2
+        assert len(failed) == 1
+
+
+class TestSlowCycleTrace:
+    def test_slow_cycle_logs_steps(self, caplog):
+        from kubernetes_tpu.utils.trace import Trace
+
+        t = Trace("Scheduling", pod="default/slow")
+        t.step("step one")
+        time.sleep(0.12)
+        t.step("step two")
+        with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+            assert t.log_if_long(0.1)
+        assert "Scheduling" in caplog.text
+        assert "step two" in caplog.text
+
+    def test_fast_cycle_stays_silent(self, caplog):
+        from kubernetes_tpu.utils.trace import Trace
+
+        t = Trace("Scheduling", pod="default/fast")
+        t.step("quick")
+        with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+            assert not t.log_if_long(0.1)
+        assert caplog.text == ""
+
+
+class TestCondvarPermit:
+    def test_wait_on_permit_wakes_on_allow_without_polling(self):
+        """The waiter must wake promptly when allowed from another thread —
+        and consume ~no CPU while parked (no 1ms poll loop)."""
+        from kubernetes_tpu.scheduler.framework.interface import WaitingPod
+        from kubernetes_tpu.scheduler.framework.runtime import Framework
+
+        fw = Framework([])
+        pod = make_pod("w", cpu="1", mem="1Gi")
+        wp = WaitingPod(pod, {"Gate": time.time() + 30.0})
+        fw._waiting_pods[pod.meta.key] = wp
+        woke = []
+
+        def waiter():
+            st = fw.wait_on_permit(pod)
+            woke.append((st.is_success, time.perf_counter()))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        t0 = time.perf_counter()
+        wp.allow("Gate")
+        t.join(timeout=2)
+        assert woke, "waiter must return"
+        ok, t_wake = woke[0]
+        assert ok
+        assert t_wake - t0 < 0.05, "allow() must wake the waiter immediately"
+
+    def test_wait_on_permit_reject(self):
+        from kubernetes_tpu.scheduler.framework.interface import WaitingPod
+        from kubernetes_tpu.scheduler.framework.runtime import Framework
+
+        fw = Framework([])
+        pod = make_pod("r", cpu="1", mem="1Gi")
+        wp = WaitingPod(pod, {"Gate": time.time() + 30.0})
+        fw._waiting_pods[pod.meta.key] = wp
+        threading.Timer(0.05, lambda: wp.reject("Gate", "denied")).start()
+        st = fw.wait_on_permit(pod)
+        assert st.is_rejected
+
+
+class TestNodeDeclaredFeatures:
+    ANN = "features.k8s.io/required"
+
+    def _cluster(self):
+        store = Store()
+        plain = make_node("plain", cpu="8", mem="16Gi")
+        store.create(plain)
+        featured = make_node("featured", cpu="8", mem="16Gi")
+        featured.status.declared_features = ("FancyNet", "HugePages")
+        store.update(featured, check_version=False) if False else None
+        store.create(featured)
+        sched = Scheduler(store, profiles=[Profile()])
+        sched.start()
+        return store, sched
+
+    def test_pod_requiring_feature_lands_on_declaring_node(self):
+        store, sched = self._cluster()
+        p = make_pod("needs", cpu="1", mem="1Gi")
+        p.meta.annotations[self.ANN] = "FancyNet"
+        store.create(p)
+        sched.schedule_pending()
+        assert store.get("Pod", "default/needs").spec.node_name == "featured"
+
+    def test_pod_requiring_unknown_feature_unschedulable(self):
+        store, sched = self._cluster()
+        p = make_pod("stuck", cpu="1", mem="1Gi")
+        p.meta.annotations[self.ANN] = "Nonexistent"
+        store.create(p)
+        sched.schedule_pending()
+        assert not store.get("Pod", "default/stuck").spec.node_name
+
+    def test_plain_pods_skip_the_filter(self):
+        store, sched = self._cluster()
+        for i in range(4):
+            store.create(make_pod(f"p{i}", cpu="1", mem="1Gi"))
+        sched.schedule_pending()
+        assert all(p.spec.node_name for p in store.pods())
+
+    def test_gate_disables_plugin(self):
+        store = Store()
+        store.create(make_node("plain", cpu="8", mem="16Gi"))
+        sched = Scheduler(store, profiles=[Profile()],
+                          feature_gates={"NodeDeclaredFeatures": False})
+        sched.start()
+        p = make_pod("any", cpu="1", mem="1Gi")
+        p.meta.annotations[self.ANN] = "FancyNet"
+        store.create(p)
+        sched.schedule_pending()
+        # gate off: requirement not enforced
+        assert store.get("Pod", "default/any").spec.node_name == "plain"

@@ -102,8 +102,7 @@ class TestUnschedulableFlow:
         qpi = q.pop()
         cycle = q.moved_count
         qpi.unschedulable_plugins = {"NodeResourcesFit"}
-        qpi.unschedulable_count += 1
-        q.add_unschedulable_if_not_present(qpi, cycle)
+        q.add_unschedulable_if_not_present(qpi, cycle)  # queue bumps counters
         assert q.pending_pods() == (0, 0, 1)  # parked
         q.move_all_to_active_or_backoff(ClusterEvent(ev.NODE, ev.ADD))
         # backoff 1s applies from park timestamp
@@ -153,8 +152,7 @@ class TestUnschedulableFlow:
         # event fires while pod is mid-cycle
         q.move_all_to_active_or_backoff(ClusterEvent(ev.NODE, ev.ADD))
         qpi.unschedulable_plugins = {"F"}
-        qpi.unschedulable_count += 1
-        q.add_unschedulable_if_not_present(qpi, cycle)
+        q.add_unschedulable_if_not_present(qpi, cycle)  # queue bumps counters
         # must have gone to backoff, not unschedulable
         assert q.pending_pods()[2] == 0
         clock.step(1.1)
@@ -166,8 +164,7 @@ class TestUnschedulableFlow:
         qadd(q, make_pod("p"))
         for expected_backoff in (1.0, 2.0, 4.0):
             qpi = q.pop()
-            qpi.unschedulable_count += 1
-            qpi.unschedulable_plugins = set()
+            qpi.unschedulable_plugins = set()  # no rejector = error streak
             q.add_unschedulable_if_not_present(qpi, q.moved_count)
             q.move_all_to_active_or_backoff(ClusterEvent(ev.WILDCARD, ev.ALL))
             assert q.pop(timeout=0.01) is None, f"should back off {expected_backoff}s"
@@ -177,7 +174,7 @@ class TestUnschedulableFlow:
             q.add(got.pod, got.pod_info)
             q.done(got.key)
             got2 = q.pop()
-            got2.unschedulable_count = got.unschedulable_count
+            got2.consecutive_errors_count = got.consecutive_errors_count
             got2.unschedulable_plugins = set()
             # carry state forward for next loop iteration
             qpi = got2

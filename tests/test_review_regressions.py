@@ -42,7 +42,7 @@ def test_error_status_pods_retried_via_backoff():
     pod = make_pod("p")
     q.add(pod, PodInfo(pod, ResourceNames()))
     qpi = q.pop()
-    qpi.consecutive_errors_count += 1  # error path: no unschedulable_plugins
+    # error path: no unschedulable_plugins — the queue bumps the error count
     q.add_unschedulable_if_not_present(qpi, q.moved_count)
     active, backoff, unsched = q.pending_pods()
     assert (backoff, unsched) == (1, 0)
