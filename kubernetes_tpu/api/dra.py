@@ -5,11 +5,10 @@ Reference: staging/src/k8s.io/api/resource/v1/types.go (ResourceClaim,
 ResourceSlice, DeviceClass with structured parameters) — the device-claim
 model behind pkg/scheduler/framework/plugins/dynamicresources/.
 
-Divergence from the reference: device selectors are typed attribute
-requirements instead of CEL expressions. CEL's role there is exactly
-attribute/capacity predicates; a typed requirement list covers the same
-selection semantics with a compilable, kernel-friendly form.
-"""
+Device selectors come in two equivalent forms: typed attribute requirements
+(kernel-friendly, the fast path) and CEL expressions over the `device`
+variable (the reference's API shape, resource/v1 DeviceSelector.CEL —
+evaluated by utils/cel.py's subset compiler)."""
 
 from __future__ import annotations
 
@@ -21,14 +20,24 @@ from .meta import ObjectMeta
 
 @dataclass(frozen=True)
 class DeviceSelector:
-    """One attribute predicate on a device. Operators: In, NotIn, Exists,
-    Gt, Lt (numeric attributes compare as ints)."""
+    """One predicate on a device: either a typed attribute requirement
+    (key/operator/values — In, NotIn, Exists, DoesNotExist, Gt, Lt) or a
+    CEL expression (resource/v1 DeviceSelector.CEL.Expression) evaluated
+    against the whole device context."""
 
-    key: str
+    key: str = ""
     operator: str = "Exists"
     values: tuple[str, ...] = ()
+    cel: str = ""  # when set, the expression IS the predicate
 
-    def matches(self, attributes: Mapping[str, object]) -> bool:
+    def matches(self, attributes: Mapping[str, object], *,
+                capacity: Mapping[str, object] | None = None,
+                driver: str = "", name: str = "") -> bool:
+        if self.cel:
+            from ..utils.cel import evaluate_device
+
+            return evaluate_device(self.cel, driver=driver, name=name,
+                                   attributes=attributes, capacity=capacity)
         present = self.key in attributes
         val = attributes.get(self.key)
         if self.operator == "Exists":

@@ -361,7 +361,10 @@ class WorkloadExecutor:
             ResourceClaimSpec,
         )
 
+        from ..api.dra import DeviceSelector
+
         name = f"rclaim-{i}"
+        cel = claims_spec.get("celSelector", "")
         self.store.create(ResourceClaim(
             meta=ObjectMeta(name=name, namespace=namespace),
             spec=ResourceClaimSpec(requests=(
@@ -369,6 +372,7 @@ class WorkloadExecutor:
                     name="req",
                     device_class_name=claims_spec.get("deviceClassName", ""),
                     count=int(claims_spec.get("count", 1)),
+                    selectors=(DeviceSelector(cel=cel),) if cel else (),
                 ),
             )),
         ))

@@ -309,17 +309,11 @@ class Framework:
         timeout. Blocks on the WaitingPod's condition variable (the
         reference blocks on a channel) — deciders wake waiters directly, no
         polling loop burning CPU in every binding thread."""
-        from ...utils.clock import Clock
-
         wp = self._waiting_pods.get(pod.meta.key)
         if wp is None:
             return Status()
         deadline = min(wp.pending_plugins.values()) if wp.pending_plugins else 0.0
         hard_stop = (self.clock.now() + max_wait) if max_wait is not None else None
-        # an injected virtual clock advances via clock.sleep, not wall time
-        # — a real-time condition wait would block for the full virtual
-        # timeout; keep the clock abstraction with a sleep-driven loop there
-        real_clock = type(self.clock) is Clock
         while True:
             now = self.clock.now()
             if wp.decision is not None:
@@ -328,12 +322,12 @@ class Framework:
                 self._waiting_pods.pop(pod.meta.key, None)
                 return Status.unschedulable("pod rejected: permit wait timeout")
             stop = deadline if hard_stop is None else min(deadline, hard_stop)
-            if real_clock:
-                decision = wp.wait_for_decision(stop - now)
-                if decision is not None:
-                    break
-            else:
-                self.clock.sleep(0.001)
+            # the clock owns the blocking strategy: a real clock parks on
+            # the WaitingPod's condition (woken by allow/reject), a virtual
+            # clock advances its own time instead of blocking wall time
+            decision = self.clock.wait_for(wp.wait_for_decision, stop - now)
+            if decision is not None:
+                break
             if hard_stop is not None and self.clock.now() >= hard_stop:
                 break
         self._waiting_pods.pop(pod.meta.key, None)

@@ -155,7 +155,9 @@ class Allocator:
                 key = (drv, pool, dev.name)
                 if key in taken or key in newly:
                     continue
-                if all(sel.matches(dev.attributes) for sel in selectors):
+                if all(sel.matches(dev.attributes, capacity=dev.capacity,
+                                   driver=drv, name=dev.name)
+                       for sel in selectors):
                     picked.append(
                         DeviceAllocationResult(request.name, drv, pool, dev.name)
                     )
@@ -254,6 +256,36 @@ class DynamicResources(Plugin):
         if node_allocs:
             s.allocations_per_node[node_name] = node_allocs
         return Status()
+
+    def post_filter(self, state, pod: Pod, node_to_status):
+        """PostFilter (dynamicresources.go:787): when the pod is
+        unschedulable and holds an allocated-but-unreserved claim, the
+        allocation may be what pins it to an infeasible node — deallocate
+        so the retry can allocate elsewhere. Always returns Unschedulable
+        (it improves the NEXT attempt; preemption still runs after)."""
+        s: _ClaimState | None = state.read(self.STATE_KEY)
+        if s is None:
+            return None, Status.unschedulable(
+                "no claims to deallocate", plugin=self.name
+            )
+        freed = 0
+        for claim in s.claims:
+            cur = self.store.try_get("ResourceClaim", claim.meta.key)
+            if cur is None or cur.status.allocation is None:
+                continue
+            if cur.status.reserved_for:
+                continue  # another pod holds it; not ours to free
+            cur.status.allocation = None
+            try:
+                self.store.update(cur, check_version=False)
+                freed += 1
+            except Exception:  # noqa: BLE001
+                pass
+        return None, Status.unschedulable(
+            f"deallocation of {freed} ResourceClaims" if freed
+            else "still not schedulable",
+            plugin=self.name,
+        )
 
     def reserve(self, state, pod: Pod, node_name: str) -> Status:
         s: _ClaimState | None = state.read(self.STATE_KEY)

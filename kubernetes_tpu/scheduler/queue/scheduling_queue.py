@@ -129,16 +129,22 @@ class SchedulingQueue:
         qpi.gating_plugin = ""
         return True
 
-    # backoffQ ordering window (backoff_queue.go:38): expiries truncate to
-    # 1-second windows so whole windows flush together and backoff ordering
-    # is stable regardless of sub-second arrival jitter
-    BACKOFF_ORDERING_WINDOW = 1.0
+    # backoffQ ordering window (backoff_queue.go:38): expiries snap to
+    # window boundaries so same-window pods flush together and ordering is
+    # stable under arrival jitter. The reference uses 1s because its flush
+    # ticker fires once per second; our flusher is pop-driven, so a 100ms
+    # window gives the same ordering stability without stretching every
+    # retry by up to a second.
+    BACKOFF_ORDERING_WINDOW = 0.1
 
     def _align_to_window(self, t: float) -> float:
-        """alignToWindow (backoff_queue.go:140) — lowest timestamp in t's
-        ordering window."""
+        """alignToWindow (backoff_queue.go:140): expiries snap to window
+        boundaries so whole windows flush together. We snap UP — a backoff
+        may stretch to the next boundary but can never run SHORTER than
+        computed (flooring against a raw now would cut it by up to a
+        window)."""
         w = self.BACKOFF_ORDERING_WINDOW
-        return (t // w) * w
+        return -(-t // w) * w
 
     def _backoff_duration(self, qpi: QueuedPodInfo) -> float:
         """backoff_queue.go getBackoffTime:217-246 — the error count drives
@@ -154,12 +160,15 @@ class SchedulingQueue:
 
     def _move_to_active_or_backoff_locked(self, qpi: QueuedPodInfo, event_label: str) -> None:
         now = self._clock.now()
-        expiry = self._align_to_window(qpi.timestamp + self._backoff_duration(qpi))
         if qpi.pending_plugins:
             # Pending (vs Unschedulable) skips backoff (scheduling_queue.go —
             # hinted by a plugin that declared the pod schedulable now)
-            expiry = now
-        if expiry > now:
+            self._active.add(qpi)
+            self._mu.notify()
+            return
+        duration = self._backoff_duration(qpi)
+        expiry = self._align_to_window(qpi.timestamp + duration)
+        if duration > 0 and expiry > now:
             qpi.backoff_expiry = expiry
             self._backoff.add(qpi)
         else:

