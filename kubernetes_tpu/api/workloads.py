@@ -79,6 +79,57 @@ class Deployment:
     kind = "Deployment"
 
 
+@dataclass
+class StatefulSetSpec:
+    """apps/v1 StatefulSetSpec (scheduling/controller-relevant subset)."""
+
+    replicas: int = 1
+    selector: LabelSelector | None = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    service_name: str = ""
+    # OrderedReady: ordinal i+1 waits for ordinal i to be running;
+    # Parallel: all at once (apps/v1 PodManagementPolicyType)
+    pod_management_policy: str = "OrderedReady"
+
+
+@dataclass
+class StatefulSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class StatefulSet:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: StatefulSetSpec = field(default_factory=StatefulSetSpec)
+    status: StatefulSetStatus = field(default_factory=StatefulSetStatus)
+
+    kind = "StatefulSet"
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: LabelSelector | None = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class DaemonSetStatus:
+    desired_number_scheduled: int = 0
+    current_number_scheduled: int = 0
+    number_ready: int = 0
+
+
+@dataclass
+class DaemonSet:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+    kind = "DaemonSet"
+
+
 # --- batch/v1 ---------------------------------------------------------------
 
 

@@ -8,7 +8,13 @@ from .lifecycle import (
     NodeLifecycleController,
     ResourceClaimController,
 )
-from .workloads import DeploymentController, JobController, ReplicaSetController
+from .workloads import (
+    DaemonSetController,
+    DeploymentController,
+    JobController,
+    ReplicaSetController,
+    StatefulSetController,
+)
 
 
 def default_controllers(store, clock=None) -> list[Controller]:
@@ -27,12 +33,16 @@ def default_controllers(store, clock=None) -> list[Controller]:
         ResourceClaimController(store, informers),
         EndpointSliceController(store, informers),
         DisruptionController(store, informers),
+        StatefulSetController(store, informers),
+        DaemonSetController(store, informers),
     ]
 
 
 __all__ = [
-    "Controller", "ControllerManager", "DeploymentController",
-    "DisruptionController", "EndpointSliceController", "GarbageCollector",
-    "JobController", "NodeLifecycleController", "ReplicaSetController",
-    "ResourceClaimController", "default_controllers",
+    "Controller", "ControllerManager", "DaemonSetController",
+    "DeploymentController", "DisruptionController",
+    "EndpointSliceController", "GarbageCollector", "JobController",
+    "NodeLifecycleController", "ReplicaSetController",
+    "ResourceClaimController", "StatefulSetController",
+    "default_controllers",
 ]

@@ -252,3 +252,214 @@ class JobController(Controller):
             self.store.create(pod)
         if job.status != old_status:
             self.store.update(job, check_version=False)
+
+
+class StatefulSetController(Controller):
+    """pkg/controller/statefulset — stable pod identity with ordered
+    rollout: pods are named <set>-0 .. <set>-(replicas-1); under the
+    default OrderedReady policy ordinal i+1 is only created once ordinal i
+    is scheduled-and-running, scale-down removes the highest ordinal first,
+    and a deleted ordinal is recreated under the SAME name (the stable
+    network identity the reference guarantees via the headless service)."""
+
+    name = "statefulset"
+    watches = ("StatefulSet", "Pod")
+
+    def key_of(self, kind: str, obj) -> str | None:
+        if kind == "StatefulSet":
+            return obj.meta.key
+        for ref in obj.meta.owner_references:
+            if ref.kind == "StatefulSet" and ref.controller:
+                return f"{obj.meta.namespace}/{ref.name}"
+        return None
+
+    @staticmethod
+    def _ordinal(set_name: str, pod_name: str) -> int | None:
+        prefix = f"{set_name}-"
+        if not pod_name.startswith(prefix):
+            return None
+        tail = pod_name[len(prefix):]
+        return int(tail) if tail.isdigit() else None
+
+    def _pod_running(self, pod: Pod) -> bool:
+        # no kubelet in-process: scheduled == as-running-as-it-gets (the
+        # hollow kubelet flips phase when present)
+        return bool(pod.spec.node_name) and not pod.is_terminating
+
+    def reconcile(self, key: str) -> None:
+        try:
+            st = self.store.get("StatefulSet", key)
+        except NotFoundError:
+            return
+        from ..api.workloads import StatefulSetStatus
+
+        owned: dict[int, Pod] = {}
+        for p in self.store.pods():
+            if p.meta.namespace != st.meta.namespace or not _owned_by(p, st.meta.uid):
+                continue
+            if p.is_terminating:
+                continue
+            o = self._ordinal(st.meta.name, p.meta.name)
+            if o is not None:
+                owned[o] = p
+
+        ordered = st.spec.pod_management_policy != "Parallel"
+        # scale down highest-ordinal-first (the reference deletes one at a
+        # time under OrderedReady; one per reconcile converges the same way)
+        excess = sorted((o for o in owned if o >= st.spec.replicas), reverse=True)
+        for o in excess:
+            self.store.delete("Pod", owned[o].meta.key)
+            del owned[o]
+            if ordered:
+                break
+
+        # create missing ordinals in order; OrderedReady waits for the
+        # predecessor to be running before minting the successor
+        for o in range(st.spec.replicas):
+            if o in owned:
+                if ordered and not self._pod_running(owned[o]):
+                    break
+                continue
+            labels = dict(st.spec.template.labels)
+            labels["statefulset.kubernetes.io/pod-name"] = f"{st.meta.name}-{o}"
+            pod = Pod(
+                meta=ObjectMeta(
+                    name=f"{st.meta.name}-{o}",
+                    namespace=st.meta.namespace,
+                    labels=labels,
+                    owner_references=[_controller_ref(st)],
+                ),
+                spec=_clone_pod_spec(st.spec.template),
+            )
+            self.store.create(pod)
+            if ordered:
+                break  # next ordinal waits for this one to run
+
+        new_status = StatefulSetStatus(
+            replicas=len(owned),
+            ready_replicas=sum(1 for p in owned.values() if self._pod_running(p)),
+            observed_generation=st.meta.generation,
+        )
+        if new_status != st.status:
+            st.status = new_status
+            self.store.update(st, check_version=False)
+
+
+class DaemonSetController(Controller):
+    """pkg/controller/daemon — one pod per eligible node. Pods are pinned
+    to their node with required node affinity on metadata.name (the modern
+    daemon controller delegates placement to the SCHEDULER instead of
+    setting spec.nodeName, daemon/daemon_controller.go) and get the
+    controller's node.kubernetes.io/unschedulable toleration so cordoned
+    nodes keep their daemons."""
+
+    name = "daemonset"
+    watches = ("DaemonSet", "Pod", "Node")
+
+    def key_of(self, kind: str, obj) -> str | None:
+        if kind == "DaemonSet":
+            return obj.meta.key
+        if kind == "Node":
+            # node churn re-reconciles every daemonset
+            for ds in self.store.iter_kind("DaemonSet"):
+                self.queue.add(ds.meta.key)
+            return None
+        for ref in obj.meta.owner_references:
+            if ref.kind == "DaemonSet" and ref.controller:
+                return f"{obj.meta.namespace}/{ref.name}"
+        return None
+
+    @staticmethod
+    def _daemon_pod_spec(ds, node_name: str) -> PodSpec:
+        from ..api.types import (
+            Affinity,
+            NodeAffinity,
+            NodeSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            Toleration,
+        )
+
+        spec = _clone_pod_spec(ds.spec.template)
+        # ReplaceDaemonSetPodNodeNameNodeAffinity: pin via required node
+        # affinity on the node FIELD, scheduled by the scheduler
+        spec.affinity = Affinity(node_affinity=NodeAffinity(
+            required=NodeSelector(terms=(NodeSelectorTerm(
+                match_fields=(NodeSelectorRequirement(
+                    key="metadata.name", operator="In", values=(node_name,)
+                ),),
+            ),)),
+        ))
+        spec.tolerations = tuple(spec.tolerations) + (
+            Toleration(key="node.kubernetes.io/unschedulable",
+                       operator="Exists", effect="NoSchedule"),
+        )
+        return spec
+
+    def _eligible(self, ds, node) -> bool:
+        # template-level node selection: honor the template's nodeSelector
+        # (spec.selector is pod OWNERSHIP, handled via owner references)
+        tpl_sel = ds.spec.template.spec.node_selector
+        if tpl_sel and any(node.meta.labels.get(k) != v for k, v in tpl_sel.items()):
+            return False
+        return True
+
+    def reconcile(self, key: str) -> None:
+        try:
+            ds = self.store.get("DaemonSet", key)
+        except NotFoundError:
+            return
+        from ..api.workloads import DaemonSetStatus
+
+        nodes = {n.meta.name: n for n in self.store.nodes()}
+        eligible = {name for name, n in nodes.items() if self._eligible(ds, n)}
+        by_node: dict[str, list[Pod]] = {}
+        floating: list[Pod] = []
+        for p in self.store.pods():
+            if p.meta.namespace != ds.meta.namespace or not _owned_by(p, ds.meta.uid):
+                continue
+            target = p.meta.annotations.get("daemonset.kubernetes.io/node", "")
+            if target:
+                by_node.setdefault(target, []).append(p)
+            else:
+                floating.append(p)
+        for p in floating:
+            self.store.delete("Pod", p.meta.key)
+        from ..api.meta import new_uid
+
+        for name in sorted(eligible):
+            pods = by_node.get(name, [])
+            if not pods:
+                pod = Pod(
+                    meta=ObjectMeta(
+                        name=f"{ds.meta.name}-{new_uid().rsplit('-', 1)[-1]}",
+                        namespace=ds.meta.namespace,
+                        labels=dict(ds.spec.template.labels),
+                        annotations={"daemonset.kubernetes.io/node": name},
+                        owner_references=[_controller_ref(ds)],
+                    ),
+                    spec=self._daemon_pod_spec(ds, name),
+                )
+                self.store.create(pod)
+            else:
+                # at most one daemon per node; extra copies die
+                for dup in pods[1:]:
+                    self.store.delete("Pod", dup.meta.key)
+        # pods for gone/ineligible nodes are removed
+        for name, pods in by_node.items():
+            if name not in eligible:
+                for p in pods:
+                    self.store.delete("Pod", p.meta.key)
+
+        scheduled = sum(
+            1 for name in eligible for p in by_node.get(name, [])[:1]
+            if p.spec.node_name
+        )
+        new_status = DaemonSetStatus(
+            desired_number_scheduled=len(eligible),
+            current_number_scheduled=sum(1 for n in eligible if by_node.get(n)),
+            number_ready=scheduled,
+        )
+        if new_status != ds.status:
+            ds.status = new_status
+            self.store.update(ds, check_version=False)

@@ -422,6 +422,42 @@ class WorkloadExecutor:
                 self.store.create(pod)
         self._barrier()
 
+    def _op_createDaemonSetPods(self, op: dict) -> None:
+        """SchedulingDaemonset shape (misc/performance-config.yaml:146-160):
+        one pod per existing node, pinned by required node affinity on
+        metadata.name — the scheduler places them (daemon controller
+        delegation), exercising the NodeAffinity single-node fast path."""
+        from ..api.types import (
+            Affinity,
+            NodeAffinity,
+            NodeSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+
+        template = op.get("podTemplate", self.pod_template)
+        collect = bool(op.get("collectMetrics"))
+        if collect and not self._collecting:
+            self._start_collecting()
+        n = 0
+        for node in self.store.nodes():
+            i = self._pod_seq
+            self._pod_seq += 1
+            pod = pod_from_manifest(template, f"ds-pod-{i}", "default")
+            pod.spec.affinity = Affinity(node_affinity=NodeAffinity(
+                required=NodeSelector(terms=(NodeSelectorTerm(
+                    match_fields=(NodeSelectorRequirement(
+                        key="metadata.name", operator="In",
+                        values=(node.meta.name,),
+                    ),),
+                ),)),
+            ))
+            self.store.create(pod)
+            n += 1
+        if collect:
+            self._measured += n
+        self._barrier()
+
     def _op_churn(self, op: dict) -> None:
         """churn op: delete + recreate pods to stress event handling."""
         n = self._count(op) or 10

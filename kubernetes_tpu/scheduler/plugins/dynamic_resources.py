@@ -268,6 +268,8 @@ class DynamicResources(Plugin):
             return None, Status.unschedulable(
                 "no claims to deallocate", plugin=self.name
             )
+        from ...store.store import ConflictError
+
         freed = 0
         for claim in s.claims:
             cur = self.store.try_get("ResourceClaim", claim.meta.key)
@@ -277,8 +279,14 @@ class DynamicResources(Plugin):
                 continue  # another pod holds it; not ours to free
             cur.status.allocation = None
             try:
-                self.store.update(cur, check_version=False)
+                # optimistic-concurrency write: if a concurrent PreBind
+                # reserved the claim since our snapshot, the deallocation is
+                # stale and MUST lose (a forced write would erase a live
+                # reservation and double-allocate the device)
+                self.store.update(cur)
                 freed += 1
+            except ConflictError:
+                pass
             except Exception:  # noqa: BLE001
                 pass
         return None, Status.unschedulable(

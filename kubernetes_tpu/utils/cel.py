@@ -54,8 +54,12 @@ def _tokenize(src: str) -> list[tuple[str, str]]:
         if m.group("num") is not None:
             out.append(("num", m.group("num")))
         elif m.group("str") is not None:
-            raw = m.group("str")
-            out.append(("str", raw[1:-1].encode().decode("unicode_escape")))
+            s = m.group("str")[1:-1]
+            if "\\" in s:
+                # resolve backslash escapes only when present — the UTF-8
+                # round trip through unicode_escape mangles non-ASCII text
+                s = s.encode("latin-1", "backslashreplace").decode("unicode_escape")
+            out.append(("str", s))
         elif m.group("ident") is not None:
             out.append(("ident", m.group("ident")))
         else:
@@ -226,11 +230,14 @@ def evaluate_device(src: str, *, driver: str = "", name: str = "",
     """Evaluate an expression against one device; mis-typed comparisons and
     missing attributes evaluate False (the reference treats runtime CEL
     errors as non-matching devices)."""
+    # no copies: this runs per candidate device inside the Filter hot loop,
+    # and the compiled closures only ever .get() from these mappings
+    _empty: dict = {}
     ctx = {
         "driver": driver,
         "name": name,
-        "attributes": dict(attributes or {}),
-        "capacity": dict(capacity or {}),
+        "attributes": attributes if attributes is not None else _empty,
+        "capacity": capacity if capacity is not None else _empty,
     }
     try:
         return bool(compile_expression(src)(ctx))

@@ -1,0 +1,211 @@
+"""StatefulSet + DaemonSet controller tests.
+
+Reference: pkg/controller/statefulset/ (ordered rollout, stable identity,
+reverse-ordinal scale-down) and pkg/controller/daemon/ (one pod per
+eligible node, scheduler-delegated placement via node affinity, cleanup on
+node removal)."""
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import PodSpec, Container
+from kubernetes_tpu.api.workloads import (
+    DaemonSet,
+    DaemonSetSpec,
+    PodTemplateSpec,
+    StatefulSet,
+    StatefulSetSpec,
+)
+from kubernetes_tpu.controllers import (
+    DaemonSetController,
+    StatefulSetController,
+)
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.store.store import Store
+from tests.wrappers import make_node
+
+
+def _template(labels=None, cpu="100m"):
+    return PodTemplateSpec(
+        labels=dict(labels or {"app": "db"}),
+        spec=PodSpec(containers=[Container(name="c", image="db:1",
+                                           requests={"cpu": cpu,
+                                                     "memory": "64Mi"})]),
+    )
+
+
+def _converge(ctrl, sched, rounds=30):
+    """Alternate controller reconciles and scheduling until quiescent."""
+    for _ in range(rounds):
+        n = ctrl.sync_once()
+        n += sched.schedule_pending()
+        if n == 0:
+            break
+
+
+class TestStatefulSet:
+    def _setup(self):
+        store = Store()
+        for i in range(3):
+            store.create(make_node(f"n{i}", cpu="4", mem="8Gi"))
+        sched = Scheduler(store, profiles=[Profile()])
+        sched.start()
+        ctrl = StatefulSetController(store)
+        return store, sched, ctrl
+
+    def test_ordered_creation_with_stable_names(self):
+        store, sched, ctrl = self._setup()
+        store.create(StatefulSet(
+            meta=ObjectMeta(name="db"),
+            spec=StatefulSetSpec(replicas=3, template=_template()),
+        ))
+        # first reconcile mints ONLY ordinal 0 (OrderedReady)
+        ctrl.sync_once()
+        names = sorted(p.meta.name for p in store.pods())
+        assert names == ["db-0"]
+        _converge(ctrl, sched)
+        names = sorted(p.meta.name for p in store.pods())
+        assert names == ["db-0", "db-1", "db-2"]
+        assert all(p.spec.node_name for p in store.pods())
+        st = store.get("StatefulSet", "default/db")
+        assert st.status.replicas == 3
+        assert st.status.ready_replicas == 3
+
+    def test_deleted_ordinal_recreated_same_name(self):
+        store, sched, ctrl = self._setup()
+        store.create(StatefulSet(
+            meta=ObjectMeta(name="db"),
+            spec=StatefulSetSpec(replicas=2, template=_template()),
+        ))
+        _converge(ctrl, sched)
+        store.delete("Pod", "default/db-0")
+        _converge(ctrl, sched)
+        names = sorted(p.meta.name for p in store.pods())
+        assert names == ["db-0", "db-1"], "stable identity must be restored"
+
+    def test_scale_down_removes_highest_ordinal_first(self):
+        store, sched, ctrl = self._setup()
+        store.create(StatefulSet(
+            meta=ObjectMeta(name="db"),
+            spec=StatefulSetSpec(replicas=3, template=_template()),
+        ))
+        _converge(ctrl, sched)
+        st = store.get("StatefulSet", "default/db")
+        st.spec.replicas = 1
+        store.update(st, check_version=False)
+        _converge(ctrl, sched)
+        names = sorted(p.meta.name for p in store.pods())
+        assert names == ["db-0"]
+
+    def test_parallel_policy_mints_all_at_once(self):
+        store, sched, ctrl = self._setup()
+        store.create(StatefulSet(
+            meta=ObjectMeta(name="db"),
+            spec=StatefulSetSpec(replicas=3, template=_template(),
+                                 pod_management_policy="Parallel"),
+        ))
+        ctrl.sync_once()
+        assert len(store.pods()) == 3
+
+
+class TestDaemonSet:
+    def _setup(self, n_nodes=4):
+        store = Store()
+        for i in range(n_nodes):
+            store.create(make_node(f"n{i}", cpu="4", mem="8Gi"))
+        sched = Scheduler(store, profiles=[Profile()])
+        sched.start()
+        ctrl = DaemonSetController(store)
+        return store, sched, ctrl
+
+    def test_one_pod_per_node_scheduled_to_its_node(self):
+        store, sched, ctrl = self._setup()
+        store.create(DaemonSet(
+            meta=ObjectMeta(name="agent"),
+            spec=DaemonSetSpec(template=_template({"app": "agent"})),
+        ))
+        _converge(ctrl, sched)
+        pods = store.pods()
+        assert len(pods) == 4
+        targets = {p.meta.annotations["daemonset.kubernetes.io/node"]
+                   for p in pods}
+        assert targets == {f"n{i}" for i in range(4)}
+        # the SCHEDULER placed each daemon on exactly its pinned node
+        for p in pods:
+            assert p.spec.node_name == p.meta.annotations[
+                "daemonset.kubernetes.io/node"
+            ]
+        ds = store.get("DaemonSet", "default/agent")
+        assert ds.status.desired_number_scheduled == 4
+        assert ds.status.current_number_scheduled == 4
+
+    def test_new_node_gets_a_daemon(self):
+        store, sched, ctrl = self._setup()
+        store.create(DaemonSet(
+            meta=ObjectMeta(name="agent"),
+            spec=DaemonSetSpec(template=_template({"app": "agent"})),
+        ))
+        _converge(ctrl, sched)
+        store.create(make_node("n9", cpu="4", mem="8Gi"))
+        _converge(ctrl, sched)
+        assert any(
+            p.meta.annotations.get("daemonset.kubernetes.io/node") == "n9"
+            and p.spec.node_name == "n9"
+            for p in store.pods()
+        )
+
+    def test_node_removal_cleans_up_daemon(self):
+        store, sched, ctrl = self._setup()
+        store.create(DaemonSet(
+            meta=ObjectMeta(name="agent"),
+            spec=DaemonSetSpec(template=_template({"app": "agent"})),
+        ))
+        _converge(ctrl, sched)
+        store.delete("Node", "n3")
+        _converge(ctrl, sched)
+        assert not any(
+            p.meta.annotations.get("daemonset.kubernetes.io/node") == "n3"
+            for p in store.pods()
+        )
+
+    def test_cordoned_node_keeps_daemon(self):
+        """Daemons tolerate the unschedulable taint (controller-added)."""
+        store, sched, ctrl = self._setup(n_nodes=2)
+        node = store.get("Node", "n1")
+        node.spec.unschedulable = True
+        store.update(node, check_version=False)
+        store.create(DaemonSet(
+            meta=ObjectMeta(name="agent"),
+            spec=DaemonSetSpec(template=_template({"app": "agent"})),
+        ))
+        _converge(ctrl, sched)
+        bound = {p.meta.annotations["daemonset.kubernetes.io/node"]:
+                 p.spec.node_name for p in store.pods()}
+        assert bound.get("n1") == "n1", "cordoned node must keep its daemon"
+
+    def test_node_selector_limits_eligibility(self):
+        store, sched, ctrl = self._setup(n_nodes=3)
+        node = store.get("Node", "n1")
+        node.meta.labels = dict(node.meta.labels, gpu="true")
+        store.update(node, check_version=False)
+        tpl = _template({"app": "gpu-agent"})
+        tpl.spec.node_selector = {"gpu": "true"}
+        store.create(DaemonSet(
+            meta=ObjectMeta(name="gpu-agent"),
+            spec=DaemonSetSpec(template=tpl),
+        ))
+        _converge(ctrl, sched)
+        pods = store.pods()
+        assert len(pods) == 1
+        assert pods[0].spec.node_name == "n1"
+
+
+def test_daemonset_perf_workload_runs():
+    """The SchedulingDaemonset short workload schedules one pod per node."""
+    from kubernetes_tpu.perf import run_workloads
+    from pathlib import Path
+
+    cfg = (Path(__file__).parent.parent / "kubernetes_tpu" / "perf" /
+           "configs" / "misc.yaml")
+    results = run_workloads(cfg, labels={"short"},
+                            name_filter="SchedulingDaemonset")
+    (r,) = results
+    assert r.scheduled == 50
