@@ -31,10 +31,10 @@ def main() -> None:
     sys.path.insert(0, base)
     # persistent XLA compilation cache: the big wave programs compile once
     # per machine; repeat runs measure steady-state scheduling, not compiles
-    os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "true")
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                          os.path.join(base, ".jax_cache"))
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    # (env vars don't engage the cache on this JAX build — see jaxcache.py)
+    from kubernetes_tpu.utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache(os.path.join(base, ".jax_cache"))
 
     from kubernetes_tpu.perf.harness import WorkloadExecutor, load_config
 

@@ -390,9 +390,17 @@ class DaemonSetController(Controller):
                 ),),
             ),)),
         ))
+        # AddOrUpdateDaemonPodTolerations: daemons ride out node pressure —
+        # unschedulable spec, and the lifecycle controller's unreachable/
+        # not-ready NoExecute taints (otherwise a daemon evicted from a
+        # flapping node mints a replacement that can never schedule there)
         spec.tolerations = tuple(spec.tolerations) + (
             Toleration(key="node.kubernetes.io/unschedulable",
                        operator="Exists", effect="NoSchedule"),
+            Toleration(key="node.kubernetes.io/unreachable",
+                       operator="Exists", effect="NoExecute"),
+            Toleration(key="node.kubernetes.io/not-ready",
+                       operator="Exists", effect="NoExecute"),
         )
         return spec
 

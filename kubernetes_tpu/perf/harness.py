@@ -436,6 +436,10 @@ class WorkloadExecutor:
         )
 
         template = op.get("podTemplate", self.pod_template)
+        if isinstance(template, str):
+            template = self._load_template(
+                template, self.test_case.get("_base_dir", "."), DEFAULT_POD_TEMPLATE
+            )
         collect = bool(op.get("collectMetrics"))
         if collect and not self._collecting:
             self._start_collecting()
@@ -535,6 +539,10 @@ def run_workloads(
 
 def main(argv: list[str] | None = None) -> int:
     import argparse
+
+    from ..utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     parser = argparse.ArgumentParser(description="scheduler_perf harness")
     parser.add_argument("configs", nargs="+", help="performance-config YAMLs")

@@ -1,0 +1,39 @@
+"""Persistent XLA compilation cache setup.
+
+The wave kernel (ops/kernels.py batched_assign) is one big scanned program;
+compiling it for a 512-pod wave over a 5k-node cluster costs tens of seconds
+on TPU, while steady-state execution is ~0.1s. The reference amortizes its
+equivalent cost (Go compile) at build time; we amortize XLA compiles across
+processes with JAX's persistent compilation cache.
+
+This JAX build does NOT honor the JAX_COMPILATION_CACHE_DIR /
+JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS environment variables (the config
+values stay None/default when they are set), so the cache silently never
+engages — it must be enabled via jax.config.update before the first compile.
+Call enable_persistent_cache() from every entry point that compiles kernels
+(bench, perf harness, tests, graft entry).
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache",
+)
+
+def enable_persistent_cache(path: str | None = None,
+                            min_compile_secs: float = 1.0) -> str:
+    """Point JAX's persistent compilation cache at `path` (default:
+    $KUBERNETES_TPU_JAX_CACHE or <repo>/.jax_cache). Idempotent — repeat
+    calls just re-apply the config, so the latest explicit path wins; safe
+    before or after the first device use, but only compiles issued
+    afterwards are cached."""
+    cache_dir = path or os.environ.get("KUBERNETES_TPU_JAX_CACHE", _DEFAULT_DIR)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    return cache_dir

@@ -19,3 +19,9 @@ _ensure_devices(8)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# persistent XLA compilation cache: kernel compiles dominate suite wall time
+# otherwise (env-var route doesn't engage the cache on this JAX build)
+from kubernetes_tpu.utils.jaxcache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
