@@ -34,7 +34,7 @@ def main() -> None:
     # (env vars don't engage the cache on this JAX build — see jaxcache.py)
     from kubernetes_tpu.utils.jaxcache import enable_persistent_cache
 
-    enable_persistent_cache(os.path.join(base, ".jax_cache"))
+    enable_persistent_cache()  # defaults near the repo; env knob still wins
 
     from kubernetes_tpu.perf.harness import WorkloadExecutor, load_config
 

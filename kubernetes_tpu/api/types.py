@@ -231,6 +231,7 @@ class PodStatus:
     conditions: list[PodCondition] = field(default_factory=list)
     nominated_node_name: str = ""
     start_time: float | None = None
+    pod_ip: str = ""  # set by the kubelet once the sandbox has a network
 
 
 @dataclass

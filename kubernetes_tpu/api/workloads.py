@@ -168,6 +168,7 @@ class ServicePort:
     target_port: int = 0
     protocol: str = "TCP"
     name: str = ""
+    node_port: int = 0
 
 
 @dataclass
@@ -176,6 +177,11 @@ class ServiceSpec:
     ports: tuple[ServicePort, ...] = ()
     cluster_ip: str = ""
     type: str = "ClusterIP"
+    # core/v1 ServiceSpec traffic-routing knobs consumed by the proxy layer
+    session_affinity: str = "None"  # "None" | "ClientIP"
+    session_affinity_timeout_s: int = 10800
+    internal_traffic_policy: str = "Cluster"  # "Cluster" | "Local"
+    external_traffic_policy: str = "Cluster"  # "Cluster" | "Local"
 
 
 @dataclass
@@ -192,6 +198,11 @@ class Endpoint:
     node_name: str = ""
     ready: bool = True
     target_pod: str = ""  # pod key
+    # discovery/v1 EndpointConditions: serving mirrors readiness but stays
+    # true for terminating pods; the proxy falls back to serving-terminating
+    # endpoints when a service has no ready ones (pkg/proxy/topology.go)
+    serving: bool = True
+    terminating: bool = False
 
 
 @dataclass

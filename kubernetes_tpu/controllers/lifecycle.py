@@ -208,20 +208,28 @@ class EndpointSliceController(Controller):
             return
         from ..api.types import RUNNING
 
-        import zlib
-
         def pod_ip(p) -> str:
-            # stable per-pod address derived from its uid (crc32: stable
-            # across processes, unlike salted hash()) — churn elsewhere in
-            # the cluster must not rewrite this slice's endpoints
-            h = zlib.crc32((p.meta.uid or p.meta.key).encode()) & 0xFFFF
-            return f"10.0.{h >> 8}.{h & 0xFF}"
+            # prefer the kubelet-reported address; otherwise a stable
+            # per-pod address derived from its uid (stable across
+            # processes, unlike salted hash()) — churn elsewhere in the
+            # cluster must not rewrite this slice's endpoints
+            if p.status.pod_ip:
+                return p.status.pod_ip
+            from ..utils.net import stable_pod_ip
+
+            return stable_pod_ip(p.meta.uid or p.meta.key)
 
         endpoints = tuple(
             Endpoint(
                 addresses=(pod_ip(p),),
                 node_name=p.spec.node_name,
-                ready=p.status.phase == RUNNING,
+                # discovery/v1 conditions: a deleting pod stops being
+                # "ready" but keeps "serving" while it still runs, so the
+                # proxy's terminating fallback has real producers
+                ready=(p.status.phase == RUNNING
+                       and p.meta.deletion_timestamp is None),
+                serving=p.status.phase == RUNNING,
+                terminating=p.meta.deletion_timestamp is not None,
                 target_pod=p.meta.key,
             )
             for p in self.store.pods()

@@ -134,6 +134,15 @@ class HollowKubelet:
                 self.runtime.start_pod(pod)
                 pod.status.phase = RUNNING
                 pod.status.start_time = self.clock.now()
+                if not pod.status.pod_ip:
+                    # sandbox networking: stable per-pod address (crc32 of
+                    # uid — same scheme the endpointslice controller falls
+                    # back to for pods that never report one)
+                    from ..utils.net import stable_pod_ip
+
+                    pod.status.pod_ip = stable_pod_ip(
+                        pod.meta.uid or pod.meta.key
+                    )
                 ready = PodCondition(type="Ready", status="True")
                 pod.status.conditions = [
                     c for c in pod.status.conditions if c.type != "Ready"
