@@ -1,0 +1,136 @@
+"""Authentication + RBAC authorization for the API server.
+
+Reference: the generic server's handler chain runs authentication (bearer
+tokens among others — staging/src/k8s.io/apiserver/pkg/authentication),
+then authorization (RBAC evaluator —
+plugin/pkg/auth/authorizer/rbac/rbac.go) before any handler. This module
+provides both stages: a static-token authenticator (the token-file
+authenticator's model) and an RBAC authorizer that evaluates store-resident
+Role/ClusterRole bindings per request attribute tuple
+(user, verb, resource, namespace).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..api.rbac import ClusterRole, Role
+
+SYSTEM_MASTERS = "system:masters"
+AUTHENTICATED = "system:authenticated"
+UNAUTHENTICATED = "system:unauthenticated"
+ANONYMOUS = "system:anonymous"
+
+
+@dataclass(frozen=True)
+class User:
+    """authentication.k8s.io UserInfo subset."""
+
+    name: str
+    groups: tuple[str, ...] = ()
+
+
+class AuthenticationError(Exception):
+    """Invalid credentials (401; distinct from no credentials)."""
+
+
+class TokenAuthenticator:
+    """Static bearer-token table (the --token-auth-file model).
+
+    authenticate() returns the token's user, the anonymous user when no
+    credentials are presented (anonymous-auth=true semantics), and raises
+    AuthenticationError for a credential that doesn't resolve — presenting a
+    bad token must not silently degrade to anonymous."""
+
+    def __init__(self, tokens: dict[str, User] | None = None):
+        self._tokens = dict(tokens or {})
+
+    def add_token(self, token: str, user: User) -> None:
+        self._tokens[token] = user
+
+    def authenticate(self, authorization_header: str | None) -> User:
+        if not authorization_header:
+            return User(ANONYMOUS, (UNAUTHENTICATED,))
+        scheme, _, credential = authorization_header.partition(" ")
+        if scheme.lower() != "bearer" or not credential:
+            raise AuthenticationError("unsupported authorization scheme")
+        user = self._tokens.get(credential.strip())
+        if user is None:
+            raise AuthenticationError("unknown bearer token")
+        if AUTHENTICATED not in user.groups:
+            user = User(user.name, user.groups + (AUTHENTICATED,))
+        return user
+
+
+@dataclass(frozen=True)
+class Attributes:
+    """The authorization request tuple (authorizer.AttributesRecord)."""
+
+    user: User
+    verb: str  # get|list|watch|create|update|delete
+    resource: str  # kind name
+    namespace: str = ""
+
+
+class RBACAuthorizer:
+    """Evaluates RBAC objects from the store per request.
+
+    Walk order mirrors rbac.go VisitRulesFor: cluster-role bindings grant
+    cluster-wide; role bindings grant within their namespace (the referenced
+    role may be a Role in that namespace or a ClusterRole scoped down).
+    system:masters short-circuits (the superuser group the reference
+    hard-codes in bootstrap policy)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def authorize(self, attrs: Attributes) -> bool:
+        if SYSTEM_MASTERS in attrs.user.groups:
+            return True
+        for crb in self.store.iter_kind("ClusterRoleBinding"):
+            if not any(s.matches(attrs.user) for s in crb.subjects):
+                continue
+            role = self.store.try_get("ClusterRole", crb.role_ref.name)
+            if role and self._rules_allow(role, attrs):
+                return True
+        if attrs.namespace:
+            for rb in self.store.iter_kind("RoleBinding"):
+                if rb.meta.namespace != attrs.namespace:
+                    continue
+                if not any(s.matches(attrs.user) for s in rb.subjects):
+                    continue
+                role = self._resolve_role(rb)
+                if role and self._rules_allow(role, attrs):
+                    return True
+        return False
+
+    def _resolve_role(self, rb) -> Role | ClusterRole | None:
+        if rb.role_ref.kind == "ClusterRole":
+            return self.store.try_get("ClusterRole", rb.role_ref.name)
+        return self.store.try_get(
+            "Role", f"{rb.meta.namespace}/{rb.role_ref.name}"
+        )
+
+    @staticmethod
+    def _rules_allow(role, attrs: Attributes) -> bool:
+        return any(r.matches(attrs.verb, attrs.resource) for r in role.rules)
+
+
+def bootstrap_policy() -> list:
+    """The default cluster roles the reference installs at startup
+    (plugin/pkg/auth/authorizer/rbac/bootstrappolicy): admin/edit/view here
+    reduced to the roles our components use."""
+    from ..api.meta import ObjectMeta
+    from ..api.rbac import ClusterRoleBinding, PolicyRule, RoleRef, Subject
+
+    return [
+        ClusterRole(meta=ObjectMeta(name="cluster-admin", namespace=""),
+                    rules=(PolicyRule(("*",), ("*",)),)),
+        ClusterRole(meta=ObjectMeta(name="view", namespace=""),
+                    rules=(PolicyRule(("get", "list", "watch"), ("*",)),)),
+        ClusterRoleBinding(
+            meta=ObjectMeta(name="system:authenticated-view", namespace=""),
+            subjects=(Subject("Group", AUTHENTICATED),),
+            role_ref=RoleRef("ClusterRole", "view"),
+        ),
+    ]

@@ -34,6 +34,7 @@ from urllib.parse import parse_qs, urlparse
 from ..api.serialization import decode, encode, kind_class
 from ..store.store import (
     AlreadyExistsError,
+    CompactedError,
     ConflictError,
     NotFoundError,
     Store,
@@ -50,9 +51,16 @@ class AdmissionError(Exception):
 
 
 class APIServer:
-    def __init__(self, store: Store, admission: list[AdmissionFn] | None = None):
+    def __init__(self, store: Store, admission: list[AdmissionFn] | None = None,
+                 authenticator=None, authorizer=None):
+        """authenticator/authorizer None = the chain stage is skipped
+        (insecure localhost serving, the in-tree trust model); passing a
+        TokenAuthenticator + RBACAuthorizer (apiserver/auth.py) turns on
+        the generic server's authn→authz handler-chain stages."""
         self.store = store
         self.admission = list(admission or [])
+        self.authenticator = authenticator
+        self.authorizer = authorizer
         self._http: ThreadingHTTPServer | None = None
         self.port = 0
 
@@ -100,6 +108,38 @@ class APIServer:
                 raw = self.rfile.read(length) if length else b"{}"
                 return json.loads(raw or b"{}")
 
+            def _authorized(self, verb: str, kind: str, key: str,
+                            namespace: str | None = None) -> bool:
+                """authn → authz chain stages (generic server handler
+                chain); sends the 401/403 itself when the request fails.
+                namespace overrides the key-derived one (creates carry the
+                namespace in the body, not the flat URL)."""
+                from .auth import Attributes, AuthenticationError
+
+                if server.authenticator is None:
+                    return True
+                try:
+                    user = server.authenticator.authenticate(
+                        self.headers.get("Authorization")
+                    )
+                except AuthenticationError as e:
+                    self._error(401, "Unauthorized", str(e))
+                    return False
+                if server.authorizer is None:
+                    return True
+                if namespace is None:
+                    namespace = key.split("/", 1)[0] if "/" in key else ""
+                ok = server.authorizer.authorize(
+                    Attributes(user=user, verb=verb, resource=kind,
+                               namespace=namespace)
+                )
+                if not ok:
+                    self._error(
+                        403, "Forbidden",
+                        f'user "{user.name}" cannot {verb} resource "{kind}"',
+                    )
+                return ok
+
             def do_GET(self):
                 if self.path == "/healthz" or self.path == "/readyz":
                     self._send_json(200, {"status": "ok"})
@@ -113,6 +153,9 @@ class APIServer:
                     self._error(404, "NotFound", "unknown path")
                     return
                 kind, key, _, query = route
+                verb = "get" if key else ("watch" if query.get("watch") else "list")
+                if not self._authorized(verb, kind, key):
+                    return
                 try:
                     if key:
                         obj = server.store.get(kind, key)
@@ -128,6 +171,9 @@ class APIServer:
                         })
                 except NotFoundError as e:
                     self._error(404, "NotFound", str(e))
+                except CompactedError as e:
+                    # etcd compaction → 410 Gone ("Expired"): client relists
+                    self._error(410, "Expired", str(e))
 
             def _serve_watch(self, kind: str, from_revision: int) -> None:
                 watch = server.store.watch(kind, from_revision=from_revision)
@@ -167,6 +213,10 @@ class APIServer:
                     return
                 kind, key, sub, _ = route
                 body = self._read_body()
+                ns = (key.split("/", 1)[0] if "/" in key
+                      else (body.get("meta") or {}).get("namespace", ""))
+                if not self._authorized("create", kind, key, namespace=ns):
+                    return
                 try:
                     if sub == "binding":
                         # pods/binding subresource (registry/core/pod BindingREST)
@@ -179,6 +229,12 @@ class APIServer:
                         return
                     cls = kind_class(kind)
                     obj = decode(body, cls)
+                    if key and obj.meta.key != key:
+                        self._error(
+                            400, "BadRequest",
+                            f"body key {obj.meta.key!r} != URL key {key!r}",
+                        )
+                        return
                     server._admit("CREATE", obj)
                     created = server.store.create(obj)
                     self._send_json(201, encode(created))
@@ -197,10 +253,21 @@ class APIServer:
                     self._error(404, "NotFound", "unknown path")
                     return
                 kind, key, sub, query = route
+                if not self._authorized("update", kind, key):
+                    return
                 body = self._read_body()
                 try:
                     cls = kind_class(kind)
                     obj = decode(body, cls)
+                    if obj.meta.key != key:
+                        # the authz decision above was made against the URL
+                        # key; a body naming a different object would bypass
+                        # it (the reference rejects URL/body mismatches)
+                        self._error(
+                            400, "BadRequest",
+                            f"body key {obj.meta.key!r} != URL key {key!r}",
+                        )
+                        return
                     server._admit("UPDATE", obj)
                     check = query.get("force") != "true"
                     updated = server.store.update(obj, check_version=check)
@@ -220,6 +287,8 @@ class APIServer:
                     self._error(404, "NotFound", "unknown path")
                     return
                 kind, key, _, _ = route
+                if not self._authorized("delete", kind, key):
+                    return
                 try:
                     deleted = server.store.delete(kind, key)
                     self._send_json(200, encode(deleted))

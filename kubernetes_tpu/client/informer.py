@@ -42,9 +42,19 @@ class SharedInformer:
                 handler(ADDED, None, obj)
 
     def start(self) -> None:
-        """List + open watch. Emits ADDED for the initial list."""
-        objs, rev = self._store.list(self.kind)
-        self._watch = self._store.watch(self.kind, from_revision=rev)
+        """List + open watch. Emits ADDED for the initial list. A relist
+        covers the (heavy-churn) case where the list revision is compacted
+        out of the watch window before the watch opens — the reflector's
+        "too old resource version" retry."""
+        from ..store.store import CompactedError
+
+        while True:
+            objs, rev = self._store.list(self.kind)
+            try:
+                self._watch = self._store.watch(self.kind, from_revision=rev)
+                break
+            except CompactedError:
+                continue
         for obj in objs:
             self._cache[obj.meta.key] = obj
             for h in self._handlers:

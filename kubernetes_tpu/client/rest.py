@@ -47,11 +47,12 @@ class RESTWatch:
     """A streaming watch connection (client-go watch.Interface shape,
     drop-in for store.Watch)."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, headers: dict[str, str] | None = None):
         self._events: deque[Event] = deque()
         self._cond = threading.Condition()
         self._stopped = False
-        self._resp = urllib.request.urlopen(url)  # noqa: S310 - loopback
+        req = urllib.request.Request(url, headers=headers or {})
+        self._resp = urllib.request.urlopen(req)  # noqa: S310 - loopback
         self._thread = threading.Thread(target=self._reader, daemon=True)
         self._thread.start()
 
@@ -112,17 +113,25 @@ class RESTWatch:
 class RESTStore:
     """Typed client over the API server; same surface as store.Store."""
 
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 token: str = ""):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token  # bearer credential (rest.Config.BearerToken)
 
     # -- plumbing ------------------------------------------------------------
+
+    def _headers(self) -> dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return headers
 
     def _request(self, method: str, path: str, body: dict | None = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             f"{self.base_url}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"},
+            headers=self._headers(),
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
@@ -169,9 +178,17 @@ class RESTStore:
         return items, out.get("metadata", {}).get("resourceVersion", 0)
 
     def watch(self, kind: str, from_revision: int = 0) -> RESTWatch:
-        return RESTWatch(
-            f"{self.base_url}/api/v1/{kind}?watch=1&resourceVersion={from_revision}"
-        )
+        from ..store.store import CompactedError
+
+        try:
+            return RESTWatch(
+                f"{self.base_url}/api/v1/{kind}?watch=1&resourceVersion={from_revision}",
+                headers=self._headers(),
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                raise CompactedError(from_revision, -1) from e
+            raise
 
     def bind(self, pod_key: str, node_name: str) -> None:
         self._request(

@@ -49,6 +49,7 @@ class DataplaneTable:
         # (rule key, client ip) → (backend, stamp)
         self._affinity: dict[tuple, tuple[Backend, float]] = {}
         self._clock = clock
+        self._last_sweep = 0.0
         self.generation = 0
 
     def program(self, rules: dict[tuple[str, int, str], Rule]) -> None:
@@ -82,6 +83,16 @@ class DataplaneTable:
             if rule is None or not rule.backends:
                 return None
             now = self._clock()
+            if now - self._last_sweep > 60.0:
+                # periodic sweep: one-shot clients of a stable ruleset
+                # would otherwise grow the map forever (program() reaps,
+                # but the no-change sync fast path never calls it)
+                self._last_sweep = now
+                self._affinity = {
+                    k: (b, stamp) for k, (b, stamp) in self._affinity.items()
+                    if (r := self._rules.get(k[0])) is not None
+                    and now - stamp <= r.affinity_timeout_s
+                }
             if rule.session_affinity and client_ip:
                 hit = self._affinity.get((key, client_ip))
                 if hit is not None:

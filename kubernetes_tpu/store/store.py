@@ -42,6 +42,18 @@ class AlreadyExistsError(Exception):
     pass
 
 
+class CompactedError(Exception):
+    """The requested watch start revision precedes the compacted log window
+    (etcd's ErrCompacted → apiserver 410 Gone). The client must relist."""
+
+    def __init__(self, requested: int, oldest: int):
+        super().__init__(
+            f"revision {requested} compacted (oldest retained: {oldest})"
+        )
+        self.requested = requested
+        self.oldest = oldest
+
+
 @dataclass
 class Event:
     type: str  # ADDED | MODIFIED | DELETED
@@ -109,6 +121,9 @@ class Store:
         self._watches: dict[str, list[Watch]] = {}
         self._clock = clock
         self._log_cap = 100_000  # bounded watch cache; older events compacted
+        # kind → revision of the first retained event after compaction:
+        # watches older than this get CompactedError (etcd compaction rev)
+        self._compacted_before: dict[str, int] = {}
 
     # -- helpers -----------------------------------------------------------
 
@@ -124,6 +139,7 @@ class Store:
         log.append(ev)
         if len(log) > self._log_cap:
             del log[: self._log_cap // 2]
+            self._compacted_before[kind] = log[0].revision
         for w in self._watches.get(kind, []):
             w._push(ev)
 
@@ -315,12 +331,24 @@ class Store:
         """Open a watch; replays logged events with revision > from_revision.
 
         list() + watch(rev) gives the reflector's gap-free ListAndWatch.
+        Replay binary-searches the sorted per-kind log (the cacher's ring-
+        buffer lookup, staging/.../storage/cacher) instead of scanning it.
+        Raises CompactedError when from_revision predates the retained
+        window — events would be silently missing otherwise; the caller
+        must relist (410 Gone semantics). from_revision=0 = "from the
+        beginning of history", valid only while kind history is uncompacted.
         """
+        import bisect
+
         with self._mu:
+            log = self._log.get(kind, [])
+            compacted_before = self._compacted_before.get(kind, 0)
+            if from_revision < compacted_before - 1:
+                raise CompactedError(from_revision, compacted_before)
             w = Watch(self, kind)
-            for ev in self._log.get(kind, []):
-                if ev.revision > from_revision:
-                    w._push(ev)
+            i = bisect.bisect_right(log, from_revision, key=lambda e: e.revision)
+            for ev in log[i:]:
+                w._push(ev)
             self._watches.setdefault(kind, []).append(w)
             return w
 
