@@ -213,9 +213,23 @@ class APIServer:
                     return
                 kind, key, sub, _ = route
                 body = self._read_body()
-                ns = (key.split("/", 1)[0] if "/" in key
-                      else (body.get("meta") or {}).get("namespace", ""))
-                if not self._authorized("create", kind, key, namespace=ns):
+                if sub == "binding":
+                    # the reference gates binding writes behind the separate
+                    # pods/binding resource, NOT plain pod create — a
+                    # create-only grant must not mutate existing pods
+                    resource = f"{kind}/binding"
+                else:
+                    # authorize against where the object will actually land:
+                    # decode applies the namespace default, the raw body may
+                    # omit it
+                    resource = kind
+                if key and "/" in key:
+                    ns = key.split("/", 1)[0]
+                else:
+                    # mirror decode's ObjectMeta default ("default") so an
+                    # omitted namespace is authorized where the object lands
+                    ns = (body.get("meta") or {}).get("namespace", "default")
+                if not self._authorized("create", resource, key, namespace=ns):
                     return
                 try:
                     if sub == "binding":
@@ -253,9 +267,12 @@ class APIServer:
                     self._error(404, "NotFound", "unknown path")
                     return
                 kind, key, sub, query = route
+                # body FIRST: an unauthorized PUT must still drain its
+                # Content-Length bytes or the next request on this
+                # keep-alive connection parses them as a request line
+                body = self._read_body()
                 if not self._authorized("update", kind, key):
                     return
-                body = self._read_body()
                 try:
                     cls = kind_class(kind)
                     obj = decode(body, cls)

@@ -105,20 +105,14 @@ def main(argv: list[str] | None = None) -> int:
 
     parser = argparse.ArgumentParser(description="node service proxy")
     parser.add_argument("--node", default="")
-    parser.add_argument("--server", default="",
-                        help="API server URL (required outside tests: a "
-                             "fresh in-process Store sees no cluster)")
+    parser.add_argument("--server", required=True, help="API server URL")
     parser.add_argument("--token", default="", help="bearer token")
     parser.add_argument("--port", type=int, default=10256)
     parser.add_argument("--sync-period", type=float, default=1.0)
     args = parser.parse_args(argv)
-    if args.server:
-        from ..client.rest import RESTStore
+    from ..client.rest import RESTStore
 
-        store = RESTStore(args.server, token=args.token)
-    else:
-        parser.error("--server is required (an empty local store has "
-                     "no services to program)")
+    store = RESTStore(args.server, token=args.token)
     server = ProxyServer(store, node_name=args.node,
                          sync_period_s=args.sync_period)
     server.serve(args.port)

@@ -1,5 +1,20 @@
-"""Node agent layer (pkg/kubelet in its kubemark hollow form)."""
+"""Node agent layer.
 
+Two forms, as in the reference: the full-shaped agent (kubelet.Kubelet —
+CRI runtime boundary, PLEG, eviction manager, per-pod workers; pkg/kubelet)
+and the kubemark hollow form (HollowKubelet — fake runtime, batch sync;
+pkg/kubemark) used for scale simulation.
+"""
+
+from .cri import InMemoryRuntime
+from .eviction import EvictionManager, PodStats, Threshold
 from .hollow import FakeRuntime, HollowKubelet, start_hollow_nodes
+from .kubelet import Kubelet
+from .pleg import GenericPLEG, PodLifecycleEvent
+from .pod_workers import PodWorkers
 
-__all__ = ["FakeRuntime", "HollowKubelet", "start_hollow_nodes"]
+__all__ = [
+    "FakeRuntime", "HollowKubelet", "start_hollow_nodes",
+    "Kubelet", "InMemoryRuntime", "GenericPLEG", "PodLifecycleEvent",
+    "PodWorkers", "EvictionManager", "PodStats", "Threshold",
+]

@@ -1,0 +1,188 @@
+"""CRI: the container-runtime boundary of the node agent.
+
+Reference: the kubelet drives its runtime exclusively through the CRI gRPC
+services (staging/src/k8s.io/cri-api RuntimeService/ImageService, client in
+staging/src/k8s.io/cri-client); pkg/kubelet/kuberuntime translates pod specs
+into sandbox + container calls against that boundary. This module defines
+the same boundary as a Python protocol with the CRI state machines
+(sandbox: READY/NOTREADY; container: CREATED→RUNNING→EXITED) and an
+in-memory runtime implementing it — the seam where containerd/crun would
+attach on a real node, and what kubemark fakes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Protocol
+
+# sandbox states
+SANDBOX_READY = "SANDBOX_READY"
+SANDBOX_NOTREADY = "SANDBOX_NOTREADY"
+
+# container states
+CREATED = "CONTAINER_CREATED"
+CONTAINER_RUNNING = "CONTAINER_RUNNING"
+EXITED = "CONTAINER_EXITED"
+
+
+@dataclass
+class PodSandbox:
+    id: str
+    pod_key: str
+    state: str = SANDBOX_READY
+    ip: str = ""
+    created_at: float = 0.0
+
+
+@dataclass
+class CRIContainer:
+    id: str
+    sandbox_id: str
+    name: str
+    image: str
+    state: str = CREATED
+    exit_code: int = 0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    # fake-runtime knob: seconds after start when the container exits on
+    # its own (None = runs until stopped), driving Job completion
+    run_seconds: float | None = None
+
+
+@dataclass
+class Image:
+    ref: str
+    size_bytes: int = 0
+
+
+class RuntimeService(Protocol):
+    """The RuntimeService RPC surface the kubelet consumes."""
+
+    def run_pod_sandbox(self, pod_key: str, ip: str = "") -> str: ...
+    def stop_pod_sandbox(self, sandbox_id: str) -> None: ...
+    def remove_pod_sandbox(self, sandbox_id: str) -> None: ...
+    def create_container(self, sandbox_id: str, name: str, image: str,
+                         run_seconds: float | None = None) -> str: ...
+    def start_container(self, container_id: str) -> None: ...
+    def stop_container(self, container_id: str, timeout_s: float = 0) -> None: ...
+    def remove_container(self, container_id: str) -> None: ...
+    def list_pod_sandboxes(self) -> list[PodSandbox]: ...
+    def list_containers(self) -> list[CRIContainer]: ...
+    def container_status(self, container_id: str) -> CRIContainer: ...
+
+
+class ImageService(Protocol):
+    def pull_image(self, ref: str) -> str: ...
+    def list_images(self) -> list[Image]: ...
+    def remove_image(self, ref: str) -> None: ...
+
+
+class InMemoryRuntime:
+    """A CRI runtime with real state machines and no kernel underneath.
+
+    Containers with run_seconds transition RUNNING→EXITED as the clock
+    passes their deadline (observed lazily at list/status time — the same
+    way a remote runtime's state is only as fresh as the last poll)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._ids = itertools.count(1)
+        self.sandboxes: dict[str, PodSandbox] = {}
+        self.containers: dict[str, CRIContainer] = {}
+        self.images: dict[str, Image] = {}
+
+    # -- RuntimeService ------------------------------------------------------
+
+    def run_pod_sandbox(self, pod_key: str, ip: str = "") -> str:
+        sid = f"sb-{next(self._ids)}"
+        self.sandboxes[sid] = PodSandbox(
+            id=sid, pod_key=pod_key, ip=ip, created_at=self._clock()
+        )
+        return sid
+
+    def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        sb = self.sandboxes.get(sandbox_id)
+        if sb is not None:
+            sb.state = SANDBOX_NOTREADY
+            for c in self.containers.values():
+                if c.sandbox_id == sandbox_id and c.state == CONTAINER_RUNNING:
+                    self._exit(c, code=137)
+
+    def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        sb = self.sandboxes.get(sandbox_id)
+        if sb is not None and sb.state == SANDBOX_READY:
+            raise RuntimeError(f"sandbox {sandbox_id} not stopped")
+        self.sandboxes.pop(sandbox_id, None)
+        for cid in [c.id for c in self.containers.values()
+                    if c.sandbox_id == sandbox_id]:
+            self.containers.pop(cid, None)
+
+    def create_container(self, sandbox_id: str, name: str, image: str,
+                         run_seconds: float | None = None) -> str:
+        if sandbox_id not in self.sandboxes:
+            raise RuntimeError(f"no sandbox {sandbox_id}")
+        cid = f"c-{next(self._ids)}"
+        self.containers[cid] = CRIContainer(
+            id=cid, sandbox_id=sandbox_id, name=name, image=image,
+            run_seconds=run_seconds,
+        )
+        return cid
+
+    def start_container(self, container_id: str) -> None:
+        c = self.containers[container_id]
+        if c.state != CREATED:
+            raise RuntimeError(f"container {container_id} is {c.state}")
+        c.state = CONTAINER_RUNNING
+        c.started_at = self._clock()
+
+    def stop_container(self, container_id: str, timeout_s: float = 0) -> None:
+        c = self.containers.get(container_id)
+        if c is not None and c.state == CONTAINER_RUNNING:
+            self._exit(c, code=137)
+
+    def remove_container(self, container_id: str) -> None:
+        c = self.containers.get(container_id)
+        if c is not None and c.state == CONTAINER_RUNNING:
+            raise RuntimeError(f"container {container_id} still running")
+        self.containers.pop(container_id, None)
+
+    def list_pod_sandboxes(self) -> list[PodSandbox]:
+        return list(self.sandboxes.values())
+
+    def list_containers(self) -> list[CRIContainer]:
+        self._tick()
+        return list(self.containers.values())
+
+    def container_status(self, container_id: str) -> CRIContainer:
+        self._tick()
+        return self.containers[container_id]
+
+    # -- ImageService --------------------------------------------------------
+
+    def pull_image(self, ref: str) -> str:
+        self.images.setdefault(ref, Image(ref=ref, size_bytes=64 << 20))
+        return ref
+
+    def list_images(self) -> list[Image]:
+        return list(self.images.values())
+
+    def remove_image(self, ref: str) -> None:
+        self.images.pop(ref, None)
+
+    # -- internals -----------------------------------------------------------
+
+    def _exit(self, c: CRIContainer, code: int) -> None:
+        c.state = EXITED
+        c.exit_code = code
+        c.finished_at = self._clock()
+
+    def _tick(self) -> None:
+        now = self._clock()
+        for c in self.containers.values():
+            if (c.state == CONTAINER_RUNNING and c.run_seconds is not None
+                    and now - c.started_at >= c.run_seconds):
+                c.state = EXITED
+                c.exit_code = 0
+                c.finished_at = now

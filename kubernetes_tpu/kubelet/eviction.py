@@ -1,0 +1,115 @@
+"""Eviction manager: node-pressure pod eviction.
+
+Reference: pkg/kubelet/eviction/eviction_manager.go — the manager observes
+node resource signals (memory.available, nodefs.available), compares them
+against configured thresholds, sets the matching node condition
+(MemoryPressure/DiskPressure), and evicts pods one per sync until the
+signal clears. Victim ranking mirrors the reference's quality-of-service
+ordering (helpers.go rankMemoryPressure): pods exceeding their requests
+first, then by priority, then by usage — so a guaranteed high-priority pod
+is the last thing a leaky neighbor can take down.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from ..api.types import FAILED, Taint
+
+MEMORY_AVAILABLE = "memory.available"
+NODEFS_AVAILABLE = "nodefs.available"
+
+_SIGNAL_CONDITION = {
+    MEMORY_AVAILABLE: ("MemoryPressure", "node.kubernetes.io/memory-pressure"),
+    NODEFS_AVAILABLE: ("DiskPressure", "node.kubernetes.io/disk-pressure"),
+}
+
+
+@dataclass(frozen=True)
+class Threshold:
+    signal: str  # MEMORY_AVAILABLE | NODEFS_AVAILABLE
+    min_available: int  # evict when observed available < this
+
+
+@dataclass
+class PodStats:
+    """Per-pod usage of the pressured resource (stats provider sample)."""
+
+    memory_bytes: int = 0
+    disk_bytes: int = 0
+
+
+class EvictionManager:
+    """One node's eviction loop.
+
+    stats_fn() returns (node available by signal, usage by pod key) — the
+    summary-API role. evict_fn(pod, reason) performs the API eviction; the
+    kubelet wires it to a status-Failed + delete write."""
+
+    def __init__(self, thresholds: list[Threshold],
+                 stats_fn: Callable[[], tuple[dict[str, int], dict[str, PodStats]]],
+                 evict_fn: Callable[[object, str], None]):
+        self.thresholds = thresholds
+        self.stats_fn = stats_fn
+        self.evict_fn = evict_fn
+        self.pressure: set[str] = set()  # active condition types
+
+    def synchronize(self, pods: list) -> list:
+        """One manager sync (eviction_manager.go synchronize): returns the
+        pods evicted this pass (at most one per pressured signal)."""
+        available, usage = self.stats_fn()
+        evicted = []
+        self.pressure = set()
+        for th in self.thresholds:
+            cond, _taint = _SIGNAL_CONDITION[th.signal]
+            obs = available.get(th.signal)
+            if obs is None or obs >= th.min_available:
+                continue
+            self.pressure.add(cond)
+            victims = self._rank(pods, usage, th.signal)
+            if victims:
+                pod = victims[0]
+                self.evict_fn(pod, f"node had {cond}: {th.signal} "
+                                   f"{obs} < {th.min_available}")
+                evicted.append(pod)
+        return evicted
+
+    def node_conditions(self) -> set[str]:
+        return set(self.pressure)
+
+    def node_taints(self) -> list[Taint]:
+        return [
+            Taint(key=taint, value="", effect="NoSchedule")
+            for cond, taint in _SIGNAL_CONDITION.values()
+            if cond in self.pressure
+        ]
+
+    def _rank(self, pods: list, usage: dict[str, PodStats],
+              signal: str) -> list:
+        def pod_usage(p) -> int:
+            st = usage.get(p.meta.key)
+            if st is None:
+                return 0
+            return st.memory_bytes if signal == MEMORY_AVAILABLE else st.disk_bytes
+
+        def pod_request(p) -> int:
+            if signal != MEMORY_AVAILABLE:
+                return 0
+            total = 0
+            for c in p.spec.containers:
+                req = c.requests.get("memory")
+                if req is not None:
+                    from ..api.quantity import parse_quantity
+
+                    total += int(parse_quantity(req))
+            return total
+
+        candidates = [p for p in pods if pod_usage(p) > 0]
+        # (exceeds requests first) then (lowest priority) then (most usage)
+        candidates.sort(key=lambda p: (
+            0 if pod_usage(p) > pod_request(p) else 1,
+            p.spec.priority,
+            -pod_usage(p),
+        ))
+        return candidates

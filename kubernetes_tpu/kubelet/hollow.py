@@ -82,7 +82,12 @@ class HollowKubelet:
             self.store.update(existing, check_version=False)
             self.node = existing
         self.heartbeat()
-        self._watch = self.store.watch("Pod")
+        # from the CURRENT revision: the watch is only drained as a wakeup
+        # signal (state is re-listed each sync), and a node started mid-run
+        # must not demand compacted history (watch(0) raises CompactedError
+        # once >log_cap Pod events have ever happened)
+        _, rev = self.store.list("Pod")
+        self._watch = self.store.watch("Pod", from_revision=rev)
 
     def heartbeat(self) -> None:
         """NodeLease heartbeat (kubelet.go:1122-1128 fast path)."""

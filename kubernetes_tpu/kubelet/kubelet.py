@@ -1,0 +1,285 @@
+"""The node agent: sync loop over CRI + PLEG + eviction + pod workers.
+
+Reference: pkg/kubelet/kubelet.go — Run:1833 starts the managers and enters
+syncLoop:2602; syncLoopIteration:2677 selects over config changes (API pod
+assignments), PLEG events, and housekeeping ticks, dispatching each affected
+pod to its worker whose SyncPod:2002 converges the runtime (sandbox up,
+containers created/started via CRI) and reports status. The HollowKubelet
+(hollow.py) remains the kubemark form; this Kubelet is the full-shaped agent
+that a real CRI runtime would slot into.
+"""
+
+from __future__ import annotations
+
+from ..api.types import (
+    FAILED,
+    PENDING,
+    RUNNING,
+    SUCCEEDED,
+    Node,
+    NodeCondition,
+    PodCondition,
+)
+from ..store.store import ConflictError, NotFoundError
+from .cri import CONTAINER_RUNNING, CREATED, EXITED, InMemoryRuntime
+from .eviction import EvictionManager, PodStats, Threshold
+from .hollow import LEASE_NAMESPACE
+from .pleg import GenericPLEG
+from .pod_workers import PodWorkers
+
+
+class Kubelet:
+    def __init__(self, store, node: Node, runtime=None, clock=None,
+                 eviction_thresholds: list[Threshold] | None = None,
+                 workers: int = 4):
+        from ..utils.clock import Clock
+
+        self.store = store
+        self.node = node
+        self.node_name = node.meta.name
+        self.clock = clock or Clock()
+        self.runtime = runtime or InMemoryRuntime(clock=self.clock.now)
+        self.pleg = GenericPLEG(self.runtime)
+        self.workers = PodWorkers(self._sync_pod, workers=workers)
+        self.eviction = EvictionManager(
+            eviction_thresholds or [], self._stats, self._evict
+        )
+        # pod key → sandbox id (the runtime cache of kuberuntime manager)
+        self._sandboxes: dict[str, str] = {}
+        # configCh change detection: key → (resource_version, terminating)
+        # as of the last dispatch — only changed pods are re-dispatched
+        self._seen: dict[str, tuple[int, bool]] = {}
+        # injected usage for tests / simulations (summary-API stand-in)
+        self.pod_stats: dict[str, PodStats] = {}
+        self.node_available: dict[str, int] = {}
+
+    # -- registration / heartbeat (same contract as HollowKubelet) -----------
+
+    def register(self) -> None:
+        from ..api.coordination import Lease, LeaseSpec
+        from ..api.meta import ObjectMeta
+
+        existing = self.store.try_get("Node", self.node_name)
+        ready = NodeCondition(type="Ready", status="True")
+        self.node.status.conditions = [
+            c for c in self.node.status.conditions if c.type != "Ready"
+        ] + [ready]
+        if existing is None:
+            self.store.create(self.node)
+        else:
+            existing.status = self.node.status
+            self.store.update(existing, check_version=False)
+            self.node = existing
+        key = f"{LEASE_NAMESPACE}/{self.node_name}"
+        if self.store.try_get("Lease", key) is None:
+            now = self.clock.now()
+            self.store.create(Lease(
+                meta=ObjectMeta(name=self.node_name,
+                                namespace=LEASE_NAMESPACE),
+                spec=LeaseSpec(holder_identity=self.node_name,
+                               lease_duration_seconds=40.0,
+                               acquire_time=now, renew_time=now),
+            ))
+
+    def heartbeat(self) -> None:
+        key = f"{LEASE_NAMESPACE}/{self.node_name}"
+        lease = self.store.try_get("Lease", key)
+        if lease is not None:
+            lease.spec.renew_time = self.clock.now()
+            try:
+                self.store.update(lease, check_version=False)
+            except (ConflictError, NotFoundError):
+                pass
+
+    # -- the sync loop -------------------------------------------------------
+
+    def sync_loop_iteration(self) -> int:
+        """One syncLoopIteration: config changes + PLEG events +
+        housekeeping. Returns pods dispatched to workers."""
+        self.heartbeat()
+        dispatched = set()
+        # configCh: only pods whose API object CHANGED since the last
+        # dispatch (new assignment, spec update, deletion mark) — steady-
+        # state pods are the PLEG's job, which is the whole point of a PLEG
+        current: dict[str, tuple[int, bool]] = {}
+        for pod in self._my_pods():
+            key = pod.meta.key
+            state = (pod.meta.resource_version, pod.is_terminating)
+            current[key] = state
+            if self._seen.get(key) != state:
+                self.workers.update_pod(key)
+                dispatched.add(key)
+        for key in self._seen:
+            if key not in current and key not in dispatched:
+                # vanished from the API: one teardown dispatch
+                self.workers.update_pod(key)
+                dispatched.add(key)
+        self._seen = current
+        # plegCh: runtime-observed transitions (covers pods whose API object
+        # is already gone but whose containers still exist)
+        self.pleg.relist()
+        for ev in self.pleg.drain():
+            if ev.pod_key not in dispatched:
+                self.workers.update_pod(ev.pod_key)
+                dispatched.add(ev.pod_key)
+        # housekeeping: eviction + orphaned-sandbox cleanup
+        self._housekeeping()
+        return len(dispatched)
+
+    def _my_pods(self):
+        return [p for p in self.store.pods()
+                if p.spec.node_name == self.node_name]
+
+    # -- SyncPod (per-pod, serialized by PodWorkers) -------------------------
+
+    def _sync_pod(self, key: str) -> None:
+        pod = self.store.try_get("Pod", key)
+        if pod is None or pod.is_terminating:
+            self._teardown(key)
+            if pod is not None:
+                try:
+                    self.store.delete("Pod", key)
+                except NotFoundError:
+                    pass
+            return
+        sid = self._sandboxes.get(key)
+        if sid is None or all(
+            s.id != sid for s in self.runtime.list_pod_sandboxes()
+        ):
+            from ..utils.net import stable_pod_ip
+
+            ip = pod.status.pod_ip or stable_pod_ip(pod.meta.uid or key)
+            sid = self.runtime.run_pod_sandbox(key, ip=ip)
+            self._sandboxes[key] = sid
+            pod.status.pod_ip = ip
+        # converge containers: one CRI container per spec container; EXITED
+        # containers are restarted per restartPolicy (kuberuntime's
+        # computePodActions: Always restarts any exit, OnFailure restarts
+        # non-zero exits, Never leaves the corpse for status reporting)
+        existing = {c.name: c for c in self.runtime.list_containers()
+                    if c.sandbox_id == sid}
+        run_s = pod.meta.annotations.get("kubemark.io/run-seconds")
+        policy = pod.spec.restart_policy
+        for spec_c in pod.spec.containers:
+            c = existing.get(spec_c.name)
+            if c is not None and c.state == EXITED and (
+                policy == "Always"
+                or (policy == "OnFailure" and c.exit_code != 0)
+            ):
+                self.runtime.remove_container(c.id)
+                c = None
+            if c is None:
+                if spec_c.image:
+                    self.runtime.pull_image(spec_c.image)
+                cid = self.runtime.create_container(
+                    sid, spec_c.name, spec_c.image,
+                    run_seconds=float(run_s) if run_s is not None else None,
+                )
+                self.runtime.start_container(cid)
+            elif c.state == CREATED:
+                self.runtime.start_container(c.id)
+        self._report_status(pod, sid)
+
+    def _report_status(self, pod, sid: str) -> None:
+        """Container states → pod phase (kubelet's status manager)."""
+        states = [c for c in self.runtime.list_containers()
+                  if c.sandbox_id == sid]
+        if not states:
+            phase = PENDING
+        elif all(c.state == EXITED for c in states):
+            failed = any(c.exit_code != 0 for c in states)
+            if pod.spec.restart_policy == "Always":
+                phase = RUNNING  # restarts pending next sync
+            else:
+                phase = FAILED if failed else SUCCEEDED
+        else:
+            phase = RUNNING
+        changed = phase != pod.status.phase
+        pod.status.phase = phase
+        if phase == RUNNING and pod.status.start_time is None:
+            pod.status.start_time = self.clock.now()
+            changed = True
+        ready = "True" if phase == RUNNING else "False"
+        cond = next((c for c in pod.status.conditions if c.type == "Ready"),
+                    None)
+        if cond is None or cond.status != ready:
+            pod.status.conditions = [
+                c for c in pod.status.conditions if c.type != "Ready"
+            ] + [PodCondition(type="Ready", status=ready)]
+            changed = True
+        if changed:
+            try:
+                self.store.update(pod, check_version=False)
+            except (ConflictError, NotFoundError):
+                pass
+
+    def _teardown(self, key: str) -> None:
+        sid = self._sandboxes.pop(key, None)
+        if sid is None:
+            return
+        self.runtime.stop_pod_sandbox(sid)
+        self.runtime.remove_pod_sandbox(sid)
+
+    # -- housekeeping --------------------------------------------------------
+
+    def _housekeeping(self) -> None:
+        # orphaned sandboxes: runtime pods whose API object vanished
+        my = {p.meta.key for p in self._my_pods()}
+        for key, sid in list(self._sandboxes.items()):
+            if key not in my:
+                self._teardown(key)
+        # node-pressure eviction + condition/taint reporting
+        if self.eviction.thresholds:
+            self.eviction.synchronize(self._my_pods())
+            self._report_pressure()
+
+    def _report_pressure(self) -> None:
+        node = self.store.try_get("Node", self.node_name)
+        if node is None:
+            return
+        conds = self.eviction.node_conditions()
+        changed = False
+        for cond_type in ("MemoryPressure", "DiskPressure"):
+            want = "True" if cond_type in conds else "False"
+            cur = next((c for c in node.status.conditions
+                        if c.type == cond_type), None)
+            if cur is None or cur.status != want:
+                node.status.conditions = [
+                    c for c in node.status.conditions if c.type != cond_type
+                ] + [NodeCondition(type=cond_type, status=want)]
+                changed = True
+        taints = {(t.key, t.effect) for t in self.eviction.node_taints()}
+        keep = [t for t in node.spec.taints
+                if not t.key.endswith("-pressure") or (t.key, t.effect) in taints]
+        add = [t for t in self.eviction.node_taints()
+               if (t.key, t.effect) not in {(x.key, x.effect) for x in keep}]
+        if add or len(keep) != len(node.spec.taints):
+            node.spec.taints = tuple(keep) + tuple(add)
+            changed = True
+        if changed:
+            try:
+                self.store.update(node, check_version=False)
+            except (ConflictError, NotFoundError):
+                pass
+
+    # -- eviction plumbing ---------------------------------------------------
+
+    def _stats(self):
+        return dict(self.node_available), dict(self.pod_stats)
+
+    def _evict(self, pod, reason: str) -> None:
+        """Status-Failed + delete (the eviction API write path)."""
+        pod.status.phase = FAILED
+        pod.status.conditions = [
+            c for c in pod.status.conditions if c.type != "Ready"
+        ] + [PodCondition(type="DisruptionTarget", status="True",
+                          reason="TerminationByKubelet", message=reason)]
+        try:
+            self.store.update(pod, check_version=False)
+            self.store.delete("Pod", pod.meta.key)
+        except (ConflictError, NotFoundError):
+            pass
+        self._teardown(pod.meta.key)
+
+    def shutdown(self) -> None:
+        self.workers.stop()

@@ -1,0 +1,83 @@
+"""PLEG: pod lifecycle event generator.
+
+Reference: pkg/kubelet/pleg/generic.go — the kubelet doesn't poll every pod
+every loop; a single relist() compares the runtime's current container
+states against the previous relist and emits per-pod lifecycle events
+(ContainerStarted/ContainerDied/ContainerRemoved) into the channel the sync
+loop selects on (syncLoopIteration's plegCh). Only pods with events get
+synced, which is what keeps a 100-pod node's sync loop cheap.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+from .cri import CONTAINER_RUNNING, EXITED, RuntimeService
+
+CONTAINER_STARTED = "ContainerStarted"
+CONTAINER_DIED = "ContainerDied"
+CONTAINER_REMOVED = "ContainerRemoved"
+
+
+@dataclass(frozen=True)
+class PodLifecycleEvent:
+    pod_key: str
+    type: str
+    container_id: str
+
+
+class GenericPLEG:
+    def __init__(self, runtime: RuntimeService):
+        self.runtime = runtime
+        # container id → (pod_key, state) as of the last relist
+        self._last: dict[str, tuple[str, str]] = {}
+        self.events: deque[PodLifecycleEvent] = deque()
+
+    def relist(self) -> int:
+        """One relist pass; queues events for every observed transition.
+        Returns the number of events generated."""
+        sandboxes = {s.id: s.pod_key for s in self.runtime.list_pod_sandboxes()}
+        current: dict[str, tuple[str, str]] = {}
+        for c in self.runtime.list_containers():
+            pod_key = sandboxes.get(c.sandbox_id, "")
+            current[c.id] = (pod_key, c.state)
+        n = 0
+        for cid, (pod_key, state) in current.items():
+            old = self._last.get(cid)
+            if old is None:
+                if state == CONTAINER_RUNNING:
+                    self.events.append(
+                        PodLifecycleEvent(pod_key, CONTAINER_STARTED, cid)
+                    )
+                    n += 1
+                elif state == EXITED:
+                    # created-and-died between relists
+                    self.events.append(
+                        PodLifecycleEvent(pod_key, CONTAINER_DIED, cid)
+                    )
+                    n += 1
+            elif old[1] != state:
+                if state == CONTAINER_RUNNING:
+                    self.events.append(
+                        PodLifecycleEvent(pod_key, CONTAINER_STARTED, cid)
+                    )
+                    n += 1
+                elif state == EXITED:
+                    self.events.append(
+                        PodLifecycleEvent(pod_key, CONTAINER_DIED, cid)
+                    )
+                    n += 1
+        for cid, (pod_key, _state) in self._last.items():
+            if cid not in current:
+                self.events.append(
+                    PodLifecycleEvent(pod_key, CONTAINER_REMOVED, cid)
+                )
+                n += 1
+        self._last = current
+        return n
+
+    def drain(self) -> list[PodLifecycleEvent]:
+        out = list(self.events)
+        self.events.clear()
+        return out

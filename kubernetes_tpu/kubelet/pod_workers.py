@@ -1,0 +1,87 @@
+"""Pod workers: per-pod serialized sync dispatch.
+
+Reference: pkg/kubelet/pod_workers.go — every pod gets its own goroutine
+processing that pod's sync requests strictly in order; new requests for a
+pod already syncing coalesce into one pending request (the kubelet never
+queues more than the latest state per pod). Here a fixed worker pool plays
+the goroutine-per-pod role with the same two invariants: per-key
+serialization and latest-wins coalescing.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable
+
+
+class PodWorkers:
+    def __init__(self, sync_fn: Callable[[str], None], workers: int = 4):
+        self.sync_fn = sync_fn
+        self._lock = threading.Lock()
+        self._queue: deque[str] = deque()
+        self._queued: set[str] = set()   # keys in _queue
+        self._active: set[str] = set()   # keys being synced right now
+        self._repeat: set[str] = set()   # re-request arrived mid-sync
+        self._cv = threading.Condition(self._lock)
+        self._stop = False
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True)
+            for _ in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def update_pod(self, key: str) -> None:
+        """Request a sync for this pod (UpdatePod). Coalesces: a pod already
+        queued stays queued once; a pod mid-sync gets exactly one follow-up."""
+        with self._cv:
+            if self._stop:
+                return
+            if key in self._active:
+                self._repeat.add(key)
+            elif key not in self._queued:
+                self._queued.add(key)
+                self._queue.append(key)
+                self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and not self._queue:
+                    self._cv.wait()
+                if self._stop:
+                    return
+                key = self._queue.popleft()
+                self._queued.discard(key)
+                self._active.add(key)
+            try:
+                self.sync_fn(key)
+            except Exception:  # noqa: BLE001 - a pod's sync error is its own
+                pass
+            with self._cv:
+                self._active.discard(key)
+                if key in self._repeat:
+                    self._repeat.discard(key)
+                    self._queued.add(key)
+                    self._queue.append(key)
+                    self._cv.notify()
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Test helper: wait until no work is queued or active."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue and not self._active and not self._repeat:
+                    return True
+            time.sleep(0.002)
+        return False
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2)

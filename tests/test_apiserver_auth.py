@@ -272,3 +272,78 @@ class TestBodyKeyValidation:
             assert store.get("Pod", "team-b/x").spec.node_name == ""
         finally:
             server.shutdown()
+
+
+class TestBindingSubresource:
+    def test_create_grant_does_not_cover_binding(self):
+        store, server = secure_server()
+        try:
+            victim = make_pod("victim")
+            store.create(victim)
+            store.create(Role(
+                meta=ObjectMeta(name="creator", namespace="default"),
+                rules=(PolicyRule(("create",), ("Pod",)),),
+            ))
+            store.create(RoleBinding(
+                meta=ObjectMeta(name="devs", namespace="default"),
+                subjects=(Subject("User", "dev"),),
+                role_ref=RoleRef("Role", "creator"),
+            ))
+            client = RESTStore(server.url, token="dev-token")
+            client.create(make_pod("own-pod"))  # create works
+            with pytest.raises(RESTError) as exc:
+                client.bind("default/victim", "attacker-node")
+            assert exc.value.code == 403
+            assert store.get("Pod", "default/victim").spec.node_name == ""
+        finally:
+            server.shutdown()
+
+    def test_binding_grant_allows_bind(self):
+        store, server = secure_server()
+        try:
+            store.create(make_pod("p"))
+            store.create(Role(
+                meta=ObjectMeta(name="binder", namespace="default"),
+                rules=(PolicyRule(("create",), ("Pod/binding",)),),
+            ))
+            store.create(RoleBinding(
+                meta=ObjectMeta(name="scheds", namespace="default"),
+                subjects=(Subject("User", "dev"),),
+                role_ref=RoleRef("Role", "binder"),
+            ))
+            client = RESTStore(server.url, token="dev-token")
+            client.bind("default/p", "n1")
+            assert store.get("Pod", "default/p").spec.node_name == "n1"
+        finally:
+            server.shutdown()
+
+    def test_create_without_namespace_uses_decode_default(self):
+        store, server = secure_server()
+        try:
+            store.create(Role(
+                meta=ObjectMeta(name="creator", namespace="default"),
+                rules=(PolicyRule(("create",), ("Pod",)),),
+            ))
+            store.create(RoleBinding(
+                meta=ObjectMeta(name="devs", namespace="default"),
+                subjects=(Subject("User", "dev"),),
+                role_ref=RoleRef("Role", "creator"),
+            ))
+            import json as _json
+            import urllib.request
+
+            # body omits meta.namespace entirely: decode defaults it to
+            # "default", where dev IS granted — must succeed
+            req = urllib.request.Request(
+                f"{server.url}/api/v1/Pod",
+                data=_json.dumps({"kind": "Pod",
+                                  "meta": {"name": "nons"}}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json",
+                         "Authorization": "Bearer dev-token"},
+            )
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 201
+            assert store.get("Pod", "default/nons") is not None
+        finally:
+            server.shutdown()

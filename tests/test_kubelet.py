@@ -1,0 +1,318 @@
+"""Full-shaped kubelet tests: CRI state machines, PLEG diffing, eviction
+ranking, pod-worker serialization, and the sync loop end to end.
+
+Modeled on pkg/kubelet/kuberuntime + pleg/generic_test.go +
+eviction/eviction_manager_test.go + pod_workers_test.go.
+"""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.api.types import FAILED, RUNNING, SUCCEEDED
+from kubernetes_tpu.kubelet import (
+    EvictionManager,
+    GenericPLEG,
+    InMemoryRuntime,
+    Kubelet,
+    PodStats,
+    PodWorkers,
+    Threshold,
+)
+from kubernetes_tpu.kubelet.cri import (
+    CONTAINER_RUNNING,
+    CREATED,
+    EXITED,
+    SANDBOX_NOTREADY,
+)
+from kubernetes_tpu.kubelet.eviction import MEMORY_AVAILABLE
+from kubernetes_tpu.kubelet.pleg import (
+    CONTAINER_DIED,
+    CONTAINER_REMOVED,
+    CONTAINER_STARTED,
+)
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.utils.clock import FakeClock
+from tests.wrappers import make_node, make_pod
+
+
+class TestCRIRuntime:
+    def test_sandbox_and_container_lifecycle(self):
+        rt = InMemoryRuntime()
+        sid = rt.run_pod_sandbox("default/p1", ip="10.128.0.1")
+        cid = rt.create_container(sid, "main", "img:v1")
+        assert rt.container_status(cid).state == CREATED
+        rt.start_container(cid)
+        assert rt.container_status(cid).state == CONTAINER_RUNNING
+        # can't remove a running container or a ready sandbox
+        with pytest.raises(RuntimeError):
+            rt.remove_container(cid)
+        with pytest.raises(RuntimeError):
+            rt.remove_pod_sandbox(sid)
+        rt.stop_container(cid)
+        assert rt.container_status(cid).state == EXITED
+        assert rt.container_status(cid).exit_code == 137
+        rt.stop_pod_sandbox(sid)
+        assert rt.sandboxes[sid].state == SANDBOX_NOTREADY
+        rt.remove_pod_sandbox(sid)
+        assert not rt.sandboxes and not rt.containers
+
+    def test_run_seconds_self_exit(self):
+        t = [0.0]
+        rt = InMemoryRuntime(clock=lambda: t[0])
+        sid = rt.run_pod_sandbox("default/job")
+        cid = rt.create_container(sid, "main", "img", run_seconds=5.0)
+        rt.start_container(cid)
+        assert rt.container_status(cid).state == CONTAINER_RUNNING
+        t[0] = 6.0
+        assert rt.container_status(cid).state == EXITED
+        assert rt.container_status(cid).exit_code == 0
+
+    def test_double_start_rejected(self):
+        rt = InMemoryRuntime()
+        sid = rt.run_pod_sandbox("default/p")
+        cid = rt.create_container(sid, "c", "img")
+        rt.start_container(cid)
+        with pytest.raises(RuntimeError):
+            rt.start_container(cid)
+
+
+class TestPLEG:
+    def test_detects_transitions(self):
+        t = [0.0]
+        rt = InMemoryRuntime(clock=lambda: t[0])
+        pleg = GenericPLEG(rt)
+        sid = rt.run_pod_sandbox("default/p1")
+        cid = rt.create_container(sid, "c", "img", run_seconds=3.0)
+        assert pleg.relist() == 0  # created, not started: no event
+        rt.start_container(cid)
+        assert pleg.relist() == 1
+        (ev,) = pleg.drain()
+        assert ev.type == CONTAINER_STARTED and ev.pod_key == "default/p1"
+        assert pleg.relist() == 0  # steady state: no events
+        t[0] = 4.0  # container self-exits
+        assert pleg.relist() == 1
+        (ev,) = pleg.drain()
+        assert ev.type == CONTAINER_DIED
+        rt.stop_pod_sandbox(sid)
+        rt.remove_pod_sandbox(sid)
+        assert pleg.relist() == 1
+        (ev,) = pleg.drain()
+        assert ev.type == CONTAINER_REMOVED
+
+    def test_created_and_died_between_relists(self):
+        rt = InMemoryRuntime()
+        pleg = GenericPLEG(rt)
+        sid = rt.run_pod_sandbox("default/p1")
+        cid = rt.create_container(sid, "c", "img")
+        rt.start_container(cid)
+        rt.stop_container(cid)
+        assert pleg.relist() == 1
+        (ev,) = pleg.drain()
+        assert ev.type == CONTAINER_DIED
+
+
+class TestEvictionManager:
+    def test_ranks_bursting_low_priority_heavy_first(self):
+        evicted = []
+        burster = make_pod("burster", mem="1Gi")
+        burster.spec.priority = 100
+        hog = make_pod("hog", mem="1Gi")  # within requests, low priority
+        vip = make_pod("vip", mem="1Gi")
+        vip.spec.priority = 1000
+        usage = {
+            "default/burster": PodStats(memory_bytes=3 << 30),  # > request
+            "default/hog": PodStats(memory_bytes=1 << 29),
+            "default/vip": PodStats(memory_bytes=1 << 29),
+        }
+        mgr = EvictionManager(
+            [Threshold(MEMORY_AVAILABLE, min_available=1 << 30)],
+            stats_fn=lambda: ({MEMORY_AVAILABLE: 1 << 20}, usage),
+            evict_fn=lambda p, reason: evicted.append(p.meta.name),
+        )
+        out = mgr.synchronize([vip, hog, burster])
+        assert [p.meta.name for p in out] == ["burster"]
+        assert "MemoryPressure" in mgr.node_conditions()
+        assert any(t.key == "node.kubernetes.io/memory-pressure"
+                   for t in mgr.node_taints())
+
+    def test_no_pressure_no_eviction(self):
+        mgr = EvictionManager(
+            [Threshold(MEMORY_AVAILABLE, min_available=1 << 20)],
+            stats_fn=lambda: ({MEMORY_AVAILABLE: 1 << 30}, {}),
+            evict_fn=lambda p, r: (_ for _ in ()).throw(AssertionError),
+        )
+        assert mgr.synchronize([make_pod("p")]) == []
+        assert mgr.node_conditions() == set()
+
+
+class TestPodWorkers:
+    def test_serializes_per_key_and_coalesces(self):
+        order = []
+        lock = threading.Lock()
+        gate = threading.Event()
+
+        def sync(key):
+            if key == "slow":
+                gate.wait(2)
+            with lock:
+                order.append(key)
+
+        w = PodWorkers(sync, workers=2)
+        try:
+            w.update_pod("slow")
+            import time
+
+            time.sleep(0.05)  # let "slow" enter its sync
+            for _ in range(5):
+                w.update_pod("slow")  # coalesce into ONE follow-up
+            w.update_pod("fast")
+            gate.set()
+            assert w.drain()
+            with lock:
+                assert order.count("slow") == 2  # original + one coalesced
+                assert order.count("fast") == 1
+        finally:
+            w.stop()
+
+
+class TestKubeletSyncLoop:
+    def make(self, thresholds=None):
+        store = Store()
+        clock = FakeClock()
+        node = make_node("n1", cpu="8", mem="16Gi")
+        k = Kubelet(store, node, clock=clock,
+                    eviction_thresholds=thresholds or [])
+        k.register()
+        return store, clock, k
+
+    def test_pod_runs_through_cri(self):
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("web", image="registry/app:v1")
+            pod.spec.node_name = "n1"
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            got = store.get("Pod", "default/web")
+            assert got.status.phase == RUNNING
+            assert got.status.pod_ip.startswith("10.")
+            assert any(c.state == CONTAINER_RUNNING
+                       for c in k.runtime.list_containers())
+            assert k.runtime.images  # image was pulled
+        finally:
+            k.shutdown()
+
+    def test_job_pod_completes(self):
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("job")
+            pod.spec.node_name = "n1"
+            pod.spec.restart_policy = "Never"
+            pod.meta.annotations["kubemark.io/run-seconds"] = "5"
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            assert store.get("Pod", "default/job").status.phase == RUNNING
+            clock.step(6)
+            k.sync_loop_iteration()  # PLEG sees the exit, resyncs the pod
+            assert k.workers.drain()
+            assert store.get("Pod", "default/job").status.phase == SUCCEEDED
+        finally:
+            k.shutdown()
+
+    def test_deleted_pod_tears_down_sandbox(self):
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("gone")
+            pod.spec.node_name = "n1"
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            assert k.runtime.sandboxes
+            pod = store.get("Pod", "default/gone")
+            pod.meta.deletion_timestamp = clock.now()
+            store.update(pod, check_version=False)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            assert not k.runtime.sandboxes
+            assert store.try_get("Pod", "default/gone") is None
+        finally:
+            k.shutdown()
+
+    def test_eviction_end_to_end(self):
+        store, clock, k = self.make(
+            thresholds=[Threshold(MEMORY_AVAILABLE, min_available=1 << 30)]
+        )
+        try:
+            pod = make_pod("leaky", mem="1Gi")
+            pod.spec.node_name = "n1"
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            k.node_available = {MEMORY_AVAILABLE: 1 << 20}  # pressure!
+            k.pod_stats = {"default/leaky": PodStats(memory_bytes=2 << 30)}
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            assert store.try_get("Pod", "default/leaky") is None
+            node = store.get("Node", "n1")
+            assert any(c.type == "MemoryPressure" and c.status == "True"
+                       for c in node.status.conditions)
+            assert any(t.key == "node.kubernetes.io/memory-pressure"
+                       for t in node.spec.taints)
+            # pressure clears → condition goes False, taint removed
+            k.node_available = {MEMORY_AVAILABLE: 4 << 30}
+            k.pod_stats = {}
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            node = store.get("Node", "n1")
+            assert any(c.type == "MemoryPressure" and c.status == "False"
+                       for c in node.status.conditions)
+            assert not any(t.key == "node.kubernetes.io/memory-pressure"
+                           for t in node.spec.taints)
+        finally:
+            k.shutdown()
+
+
+class TestRestartPolicy:
+    def test_always_restarts_exited_container(self):
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        try:
+            pod = make_pod("svc")
+            pod.spec.node_name = "n1"
+            pod.spec.restart_policy = "Always"
+            pod.meta.annotations["kubemark.io/run-seconds"] = "5"
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            first = {c.id for c in k.runtime.list_containers()}
+            clock.step(6)  # container exits on its own
+            k.sync_loop_iteration()  # PLEG sees the death → resync restarts
+            assert k.workers.drain()
+            live = [c for c in k.runtime.list_containers()
+                    if c.state == CONTAINER_RUNNING]
+            assert live and {c.id for c in live}.isdisjoint(first)
+            assert store.get("Pod", "default/svc").status.phase == RUNNING
+        finally:
+            k.shutdown()
+
+    def test_steady_state_pods_not_redispatched(self):
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        try:
+            for i in range(5):
+                pod = make_pod(f"p{i}")
+                pod.spec.node_name = "n1"
+                store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            k.sync_loop_iteration()  # status writes bumped RVs: once more
+            assert k.workers.drain()
+            assert k.sync_loop_iteration() == 0  # steady state: no dispatch
+        finally:
+            k.shutdown()
