@@ -1,0 +1,143 @@
+"""CBOR (RFC 8949) codec: the binary wire format.
+
+Reference: apimachinery ships three serializers — JSON, protobuf, and CBOR
+(staging/src/k8s.io/apimachinery/pkg/runtime/serializer/cbor, the KEP-4222
+format Kubernetes is moving to for the protobuf role on CRDs). JSON is the
+debuggable format; the binary format is what components negotiate for bulk
+traffic (lists, watches) because it cuts encode time and bytes. This is a
+self-contained RFC 8949 subset covering the JSON data model the object
+codec (serialization.py) produces: None/bool/int/float/str/bytes/list/dict.
+
+Deterministic encoding: definite lengths, shortest-form integers — the
+"core deterministic encoding" RFC 8949 §4.2 requires, which makes encoded
+objects byte-comparable.
+"""
+
+from __future__ import annotations
+
+import struct
+
+_MAJOR_UINT = 0
+_MAJOR_NEGINT = 1
+_MAJOR_BYTES = 2
+_MAJOR_TEXT = 3
+_MAJOR_ARRAY = 4
+_MAJOR_MAP = 5
+_SIMPLE_FALSE = b"\xf4"
+_SIMPLE_TRUE = b"\xf5"
+_SIMPLE_NULL = b"\xf6"
+_FLOAT64 = b"\xfb"
+
+
+def _head(major: int, n: int) -> bytes:
+    mb = major << 5
+    if n < 24:
+        return bytes([mb | n])
+    if n < 0x100:
+        return bytes([mb | 24, n])
+    if n < 0x10000:
+        return bytes([mb | 25]) + n.to_bytes(2, "big")
+    if n < 0x100000000:
+        return bytes([mb | 26]) + n.to_bytes(4, "big")
+    return bytes([mb | 27]) + n.to_bytes(8, "big")
+
+
+def dumps(obj) -> bytes:
+    out = bytearray()
+    _encode(obj, out)
+    return bytes(out)
+
+
+def _encode(obj, out: bytearray) -> None:
+    if obj is None:
+        out += _SIMPLE_NULL
+    elif obj is True:
+        out += _SIMPLE_TRUE
+    elif obj is False:
+        out += _SIMPLE_FALSE
+    elif isinstance(obj, int):
+        if obj >= 0:
+            out += _head(_MAJOR_UINT, obj)
+        else:
+            out += _head(_MAJOR_NEGINT, -1 - obj)
+    elif isinstance(obj, float):
+        out += _FLOAT64 + struct.pack(">d", obj)
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out += _head(_MAJOR_TEXT, len(b))
+        out += b
+    elif isinstance(obj, (bytes, bytearray)):
+        out += _head(_MAJOR_BYTES, len(obj))
+        out += obj
+    elif isinstance(obj, (list, tuple)):
+        out += _head(_MAJOR_ARRAY, len(obj))
+        for item in obj:
+            _encode(item, out)
+    elif isinstance(obj, dict):
+        out += _head(_MAJOR_MAP, len(obj))
+        for k, v in obj.items():
+            _encode(k, out)
+            _encode(v, out)
+    else:
+        raise TypeError(f"cbor: unsupported type {type(obj).__name__}")
+
+
+class _Decoder:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise ValueError("cbor: truncated input")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def _length(self, info: int) -> int:
+        if info < 24:
+            return info
+        if info == 24:
+            return self._take(1)[0]
+        if info == 25:
+            return int.from_bytes(self._take(2), "big")
+        if info == 26:
+            return int.from_bytes(self._take(4), "big")
+        if info == 27:
+            return int.from_bytes(self._take(8), "big")
+        raise ValueError(f"cbor: indefinite/reserved length {info}")
+
+    def decode(self):
+        ib = self._take(1)[0]
+        major, info = ib >> 5, ib & 0x1F
+        if major == _MAJOR_UINT:
+            return self._length(info)
+        if major == _MAJOR_NEGINT:
+            return -1 - self._length(info)
+        if major == _MAJOR_BYTES:
+            return bytes(self._take(self._length(info)))
+        if major == _MAJOR_TEXT:
+            return self._take(self._length(info)).decode("utf-8")
+        if major == _MAJOR_ARRAY:
+            return [self.decode() for _ in range(self._length(info))]
+        if major == _MAJOR_MAP:
+            return {self.decode(): self.decode()
+                    for _ in range(self._length(info))}
+        if major == 7:
+            if ib == _SIMPLE_NULL[0]:
+                return None
+            if ib == _SIMPLE_TRUE[0]:
+                return True
+            if ib == _SIMPLE_FALSE[0]:
+                return False
+            if ib == _FLOAT64[0]:
+                return struct.unpack(">d", self._take(8))[0]
+        raise ValueError(f"cbor: unsupported item 0x{ib:02x}")
+
+
+def loads(data: bytes):
+    dec = _Decoder(data)
+    obj = dec.decode()
+    if dec.pos != len(data):
+        raise ValueError("cbor: trailing bytes")
+    return obj

@@ -140,6 +140,9 @@ class JobSpec:
     backoff_limit: int = 6
     selector: LabelSelector | None = None
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    # batch/v1 ttlSecondsAfterFinished: the TTL-after-finished controller
+    # deletes the Job this long after it completes (None = keep forever)
+    ttl_seconds_after_finished: int | None = None
 
 
 @dataclass
@@ -148,6 +151,7 @@ class JobStatus:
     succeeded: int = 0
     failed: int = 0
     completed: bool = False
+    completion_time: float | None = None
 
 
 @dataclass

@@ -72,10 +72,23 @@ class APIServer:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
+            def _wants_cbor(self) -> bool:
+                return "application/cbor" in (self.headers.get("Accept") or "")
+
             def _send_json(self, code: int, payload) -> None:
-                data = json.dumps(payload).encode()
+                """Content-negotiated object response: CBOR when the client
+                Accepts it (the binary serializer role of apimachinery's
+                protobuf/CBOR formats), JSON otherwise."""
+                if self._wants_cbor():
+                    from ..api import cbor
+
+                    data = cbor.dumps(payload)
+                    ctype = "application/cbor"
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json"
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -105,8 +118,15 @@ class APIServer:
 
             def _read_body(self):
                 length = int(self.headers.get("Content-Length") or 0)
-                raw = self.rfile.read(length) if length else b"{}"
-                return json.loads(raw or b"{}")
+                raw = self.rfile.read(length) if length else b""
+                if not raw:
+                    return {}
+                ctype = self.headers.get("Content-Type") or ""
+                if "application/cbor" in ctype:
+                    from ..api import cbor
+
+                    return cbor.loads(raw)
+                return json.loads(raw)
 
             def _authorized(self, verb: str, kind: str, key: str,
                             namespace: str | None = None) -> bool:
@@ -148,6 +168,15 @@ class APIServer:
                     self._send_json(200, {"gitVersion": "v1.36.0-tpu",
                                           "platform": "tpu"})
                     return
+                if self.path in ("/api", "/api/v1", "/openapi/v2"):
+                    from . import discovery
+
+                    doc = (discovery.api_versions() if self.path == "/api"
+                           else discovery.api_resource_list()
+                           if self.path == "/api/v1"
+                           else discovery.openapi_v2())
+                    self._send_json(200, doc)
+                    return
                 route = self._route()
                 if route is None:
                     self._error(404, "NotFound", "unknown path")
@@ -177,9 +206,15 @@ class APIServer:
 
             def _serve_watch(self, kind: str, from_revision: int) -> None:
                 watch = server.store.watch(kind, from_revision=from_revision)
+                use_cbor = self._wants_cbor()
+                if use_cbor:
+                    from ..api import cbor
                 try:
                     self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
+                    self.send_header(
+                        "Content-Type",
+                        "application/cbor-seq" if use_cbor else "application/json",
+                    )
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
 
@@ -194,13 +229,17 @@ class APIServer:
                             # heartbeat chunk: a dead client surfaces as a
                             # broken pipe here instead of leaking the handler
                             # thread + store watch forever on quiet kinds
-                            write_chunk(b"\n")
+                            write_chunk(b"\x00\x00\x00\x00" if use_cbor else b"\n")
                             continue
-                        frame = json.dumps(
-                            {"type": ev.type, "object": encode(ev.obj),
-                             "revision": ev.revision}
-                        ).encode()
-                        write_chunk(frame + b"\n")
+                        payload = {"type": ev.type, "object": encode(ev.obj),
+                                   "revision": ev.revision}
+                        if use_cbor:
+                            # length-prefixed CBOR frames: binary bodies
+                            # aren't newline-delimitable
+                            frame = cbor.dumps(payload)
+                            write_chunk(len(frame).to_bytes(4, "big") + frame)
+                        else:
+                            write_chunk(json.dumps(payload).encode() + b"\n")
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
                 finally:

@@ -21,6 +21,22 @@ from ..store.store import Store, ADDED, MODIFIED, DELETED
 Handler = Callable[[str, Any, Any], None]  # (event_type, old_obj, new_obj)
 
 
+class CacheMutationDetected(Exception):
+    """An informer-cache object was mutated in place. Informer caches are
+    shared read-only state (client-go's contract); a consumer that edits a
+    cached object corrupts every other consumer's view. The reference's
+    detector (client-go/tools/cache/mutation_detector.go, enabled by
+    KUBE_CACHE_MUTATION_DETECTOR) panics the process; we raise."""
+
+
+def _mutation_detector_enabled() -> bool:
+    import os
+
+    return os.environ.get("KUBERNETES_TPU_CACHE_MUTATION_DETECTOR", "") not in (
+        "", "0", "false",
+    )
+
+
 class SharedInformer:
     def __init__(self, store: Store, kind: str):
         self._store = store
@@ -32,6 +48,9 @@ class SharedInformer:
         self._mu = threading.Lock()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # mutation detector: pristine deepcopies to compare against
+        self._detect = _mutation_detector_enabled()
+        self._pristine: dict[str, Any] = {}
 
     def add_handler(self, handler: Handler) -> None:
         """Register a handler. If already synced, replays Adds for the current
@@ -57,6 +76,10 @@ class SharedInformer:
                 continue
         for obj in objs:
             self._cache[obj.meta.key] = obj
+            if self._detect:
+                import copy as _copy
+
+                self._pristine[obj.meta.key] = _copy.deepcopy(obj)
             for h in self._handlers:
                 h(ADDED, None, obj)
         self._synced = True
@@ -68,25 +91,45 @@ class SharedInformer:
         """Drain all currently queued watch events; returns count processed."""
         if self._watch is None:
             return 0
+        if self._detect:
+            self.check_mutations()
         n = 0
         for ev in self._watch.drain():
             self._dispatch(ev)
             n += 1
         return n
 
+    def check_mutations(self) -> None:
+        """Compare every cached object against its pristine copy; raises
+        CacheMutationDetected on any in-place edit. Called automatically
+        per pump when the detector env is set; tests may call directly."""
+        for key, obj in self._cache.items():
+            pristine = self._pristine.get(key)
+            if pristine is not None and obj != pristine:
+                raise CacheMutationDetected(
+                    f"{self.kind} {key} was mutated in the informer cache"
+                )
+
     def _dispatch(self, ev) -> None:
+        import copy as _copy
+
         key = ev.obj.meta.key
         if ev.type == DELETED:
             old = self._cache.pop(key, None)
+            self._pristine.pop(key, None)
             for h in self._handlers:
                 h(DELETED, old if old is not None else ev.obj, ev.obj)
         elif key in self._cache:
             old = self._cache[key]
             self._cache[key] = ev.obj
+            if self._detect:
+                self._pristine[key] = _copy.deepcopy(ev.obj)
             for h in self._handlers:
                 h(MODIFIED, old, ev.obj)
         else:
             self._cache[key] = ev.obj
+            if self._detect:
+                self._pristine[key] = _copy.deepcopy(ev.obj)
             for h in self._handlers:
                 h(ADDED, None, ev.obj)
 

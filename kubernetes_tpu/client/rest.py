@@ -47,10 +47,12 @@ class RESTWatch:
     """A streaming watch connection (client-go watch.Interface shape,
     drop-in for store.Watch)."""
 
-    def __init__(self, url: str, headers: dict[str, str] | None = None):
+    def __init__(self, url: str, headers: dict[str, str] | None = None,
+                 binary: bool = False):
         self._events: deque[Event] = deque()
         self._cond = threading.Condition()
         self._stopped = False
+        self._binary = binary
         req = urllib.request.Request(url, headers=headers or {})
         self._resp = urllib.request.urlopen(req)  # noqa: S310 - loopback
         self._thread = threading.Thread(target=self._reader, daemon=True)
@@ -58,20 +60,44 @@ class RESTWatch:
 
     def _reader(self) -> None:
         try:
-            for line in self._resp:
-                line = line.strip()
-                if not line:
-                    continue
-                frame = json.loads(line)
-                ev = Event(frame["type"], decode(frame["object"]),
-                           frame.get("revision", 0))
-                with self._cond:
-                    self._events.append(ev)
-                    self._cond.notify_all()
+            if self._binary:
+                self._read_cbor_frames()
+            else:
+                for line in self._resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    self._push_frame(json.loads(line))
         except Exception:  # noqa: BLE001 - connection torn down
             pass
         with self._cond:
             self._stopped = True
+            self._cond.notify_all()
+
+    def _read_cbor_frames(self) -> None:
+        from ..api import cbor
+
+        read = self._resp.read
+        while True:
+            head = read(4)
+            if len(head) < 4:
+                return
+            n = int.from_bytes(head, "big")
+            if n == 0:
+                continue  # heartbeat
+            payload = b""
+            while len(payload) < n:
+                chunk = read(n - len(payload))
+                if not chunk:
+                    return
+                payload += chunk
+            self._push_frame(cbor.loads(payload))
+
+    def _push_frame(self, frame: dict) -> None:
+        ev = Event(frame["type"], decode(frame["object"]),
+                   frame.get("revision", 0))
+        with self._cond:
+            self._events.append(ev)
             self._cond.notify_all()
 
     def next(self, timeout: float | None = None) -> Event | None:
@@ -114,37 +140,65 @@ class RESTStore:
     """Typed client over the API server; same surface as store.Store."""
 
     def __init__(self, base_url: str, timeout: float = 10.0,
-                 token: str = ""):
+                 token: str = "", wire_format: str = "json"):
+        """wire_format="cbor" negotiates the binary serializer both ways
+        (request bodies, responses, and watch frames) — the protobuf role
+        in the reference's content-type negotiation."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token  # bearer credential (rest.Config.BearerToken)
+        self.wire_format = wire_format
 
     # -- plumbing ------------------------------------------------------------
 
     def _headers(self) -> dict[str, str]:
-        headers = {"Content-Type": "application/json"}
+        if self.wire_format == "cbor":
+            headers = {"Content-Type": "application/cbor",
+                       "Accept": "application/cbor"}
+        else:
+            headers = {"Content-Type": "application/json"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         return headers
 
+    def _encode_body(self, body: dict) -> bytes:
+        if self.wire_format == "cbor":
+            from ..api import cbor
+
+            return cbor.dumps(body)
+        return json.dumps(body).encode()
+
+    def _decode_body(self, raw: bytes, ctype: str) -> dict:
+        if not raw:
+            return {}
+        if "application/cbor" in ctype:
+            from ..api import cbor
+
+            return cbor.loads(raw)
+        return json.loads(raw.decode())
+
     def _request(self, method: str, path: str, body: dict | None = None) -> dict:
-        data = json.dumps(body).encode() if body is not None else None
+        data = self._encode_body(body) if body is not None else None
         req = urllib.request.Request(
             f"{self.base_url}{path}", data=data, method=method,
             headers=self._headers(),
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read().decode() or "{}")
+                return self._decode_body(
+                    resp.read(), resp.headers.get("Content-Type") or ""
+                )
         except urllib.error.HTTPError as e:
-            payload = e.read().decode()
+            raw = e.read()
             reason = ""
             try:
-                status = json.loads(payload)
-                message = status.get("message", payload)
+                status = self._decode_body(
+                    raw, e.headers.get("Content-Type") or ""
+                )
+                message = status.get("message", "")
                 reason = status.get("reason", "")
-            except json.JSONDecodeError:
-                message = payload
+            except (json.JSONDecodeError, ValueError):
+                message = raw.decode(errors="replace")
             _raise_for(e.code, message, reason)
 
     # -- store surface -------------------------------------------------------
@@ -184,6 +238,7 @@ class RESTStore:
             return RESTWatch(
                 f"{self.base_url}/api/v1/{kind}?watch=1&resourceVersion={from_revision}",
                 headers=self._headers(),
+                binary=self.wire_format == "cbor",
             )
         except urllib.error.HTTPError as e:
             if e.code == 410:

@@ -1,0 +1,188 @@
+"""Cluster bootstrap: the kubeadm-init equivalent.
+
+Reference: cmd/kubeadm brings up a control plane (apiserver, controller
+manager, scheduler), mints credentials, and joins nodes. Here the whole
+cluster is process-local: `ClusterBootstrap.init()` starts the API server
+(optionally with bearer-token authn + RBAC bootstrap policy), the scheduler
+loop, the controller manager, per-node hollow kubelets, and a node proxy —
+and returns a kubeconfig-shaped dict (server URL + admin token) a client
+can use immediately. `kubeadm join` is `add_node()`.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+
+from ..apiserver.auth import RBACAuthorizer, TokenAuthenticator, User, bootstrap_policy
+from ..apiserver.server import APIServer
+from ..controllers import ControllerManager, default_controllers
+from ..kubelet import HollowKubelet
+from ..proxy import Proxier
+from ..scheduler import Scheduler
+from ..store.store import Store
+
+
+class ClusterBootstrap:
+    def __init__(self, nodes: int = 3, secure: bool = False, clock=None,
+                 store: Store | None = None, backend: str = "host"):
+        from ..utils.clock import Clock
+
+        self.clock = clock or Clock()
+        self.store = store or Store()
+        self.nodes = nodes
+        self.secure = secure
+        self.backend = backend
+        self.admin_token = ""
+        self.apiserver: APIServer | None = None
+        self.scheduler: Scheduler | None = None
+        self.controller_manager: ControllerManager | None = None
+        self.kubelets: list[HollowKubelet] = []
+        self.proxiers: list[Proxier] = []
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- phases (kubeadm's init workflow) ------------------------------------
+
+    def init(self, serve_port: int = 0) -> dict:
+        """Run all init phases; returns the admin kubeconfig dict."""
+        self._phase_certs_and_auth()
+        self._phase_control_plane(serve_port)
+        self._phase_bootstrap_policy()
+        self._phase_join_nodes()
+        return self.kubeconfig()
+
+    def _phase_certs_and_auth(self) -> None:
+        if self.secure:
+            self.admin_token = secrets.token_urlsafe(16)
+
+    def _phase_control_plane(self, serve_port: int) -> None:
+        authn = authz = None
+        if self.secure:
+            authn = TokenAuthenticator({
+                self.admin_token: User("kubernetes-admin",
+                                       ("system:masters",)),
+            })
+            authz = RBACAuthorizer(self.store)
+        self.apiserver = APIServer(self.store, authenticator=authn,
+                                   authorizer=authz)
+        self.apiserver.serve(serve_port)
+        from ..scheduler import Profile
+
+        profiles = [Profile(backend=self.backend,
+                            wave_size=256 if self.backend == "tpu" else 0)]
+        self.scheduler = Scheduler(self.store, profiles=profiles,
+                                   clock=self.clock)
+        self.scheduler.start()  # sync informers before any pods arrive
+        self.controller_manager = ControllerManager(
+            self.store, default_controllers(self.store, clock=self.clock)
+        )
+
+    def _phase_bootstrap_policy(self) -> None:
+        if not self.secure:
+            return
+        for obj in bootstrap_policy():
+            if self.store.try_get(obj.kind, obj.meta.key) is None:
+                self.store.create(obj)
+
+    def _phase_join_nodes(self) -> None:
+        for i in range(self.nodes):
+            self.add_node(f"node-{i}", zone=f"zone-{i % 8}")
+
+    def add_node(self, name: str, cpu: str = "8", mem: str = "32Gi",
+                 zone: str = "zone-0") -> HollowKubelet:
+        """kubeadm join: register a kubelet + per-node proxy."""
+        from ..testing.wrappers import make_node
+
+        kubelet = HollowKubelet(self.store, make_node(name, cpu=cpu, mem=mem,
+                                                      zone=zone),
+                                clock=self.clock)
+        kubelet.register()
+        self.kubelets.append(kubelet)
+        self.proxiers.append(Proxier(self.store, node_name=name))
+        return kubelet
+
+    # -- convergence ---------------------------------------------------------
+
+    def converge(self, rounds: int = 10) -> None:
+        """Deterministic single-threaded convergence (tests): controllers →
+        scheduler → kubelets → proxies until a fixed point."""
+        assert self.scheduler is not None and self.controller_manager is not None
+        for _ in range(rounds):
+            n = self.controller_manager.sync_once()
+            n += self.scheduler.schedule_pending()
+            for k in self.kubelets:
+                n += k.sync_once()
+            if n == 0:
+                break
+        for p in self.proxiers:
+            p.sync()
+
+    def run(self) -> None:
+        """Threaded mode: every component loops until shutdown()."""
+        assert self.controller_manager is not None
+        self.controller_manager.run(self._stop)
+        for k in self.kubelets:
+            self._threads.append(k.run(self._stop))
+
+        def sched_loop():
+            while not self._stop.is_set():
+                if self.scheduler.schedule_pending() == 0:
+                    self._stop.wait(0.01)
+
+        def proxy_loop():
+            while not self._stop.is_set():
+                for p in self.proxiers:
+                    p.sync()
+                self._stop.wait(0.05)
+
+        for fn in (sched_loop, proxy_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- client access -------------------------------------------------------
+
+    def kubeconfig(self) -> dict:
+        assert self.apiserver is not None
+        return {
+            "server": self.apiserver.url,
+            "token": self.admin_token,
+        }
+
+    def client(self):
+        from ..client.rest import RESTStore
+
+        cfg = self.kubeconfig()
+        return RESTStore(cfg["server"], token=cfg["token"])
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        if self.apiserver is not None:
+            self.apiserver.shutdown()
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description="cluster bootstrap (kubeadm init)")
+    parser.add_argument("--nodes", type=int, default=3)
+    parser.add_argument("--secure", action="store_true")
+    parser.add_argument("--port", type=int, default=6443)
+    args = parser.parse_args(argv)
+    boot = ClusterBootstrap(nodes=args.nodes, secure=args.secure)
+    cfg = boot.init(serve_port=args.port)
+    boot.run()
+    print(json.dumps(cfg))
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        boot.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

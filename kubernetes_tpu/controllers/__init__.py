@@ -5,8 +5,10 @@ from .disruption import DisruptionController
 from .lifecycle import (
     EndpointSliceController,
     GarbageCollector,
+    NamespaceController,
     NodeLifecycleController,
     ResourceClaimController,
+    TTLAfterFinishedController,
 )
 from .workloads import (
     DaemonSetController,
@@ -27,7 +29,7 @@ def default_controllers(store, clock=None) -> list[Controller]:
     return [
         DeploymentController(store, informers),
         ReplicaSetController(store, informers),
-        JobController(store, informers),
+        JobController(store, informers, clock=clock),
         GarbageCollector(store, informers),
         NodeLifecycleController(store, informers, clock=clock),
         ResourceClaimController(store, informers),
@@ -35,6 +37,8 @@ def default_controllers(store, clock=None) -> list[Controller]:
         DisruptionController(store, informers),
         StatefulSetController(store, informers),
         DaemonSetController(store, informers),
+        NamespaceController(store, informers),
+        TTLAfterFinishedController(store, informers, clock=clock),
     ]
 
 
@@ -42,7 +46,8 @@ __all__ = [
     "Controller", "ControllerManager", "DaemonSetController",
     "DeploymentController", "DisruptionController",
     "EndpointSliceController", "GarbageCollector", "JobController",
-    "NodeLifecycleController", "ReplicaSetController",
-    "ResourceClaimController", "StatefulSetController",
+    "NamespaceController", "NodeLifecycleController",
+    "ReplicaSetController", "ResourceClaimController",
+    "StatefulSetController", "TTLAfterFinishedController",
     "default_controllers",
 ]

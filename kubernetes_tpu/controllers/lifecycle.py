@@ -251,3 +251,92 @@ class EndpointSliceController(Controller):
             existing.endpoints = endpoints
             existing.ports = svc.spec.ports
             self.store.update(existing, check_version=False)
+
+
+class NamespaceController(Controller):
+    """namespace lifecycle controller — pkg/controller/namespace: a
+    Namespace marked for deletion drains every namespaced object it holds
+    (the "content deleter" walking discovered resources), then the
+    namespace object itself goes away. Phase mirrors the reference:
+    Active → Terminating (deletion_timestamp set) → gone."""
+
+    name = "namespace"
+    watches = ("Namespace",)
+
+    # namespaced kinds the deleter drains, in dependency-ish order (pods
+    # last so controllers don't resurrect them mid-drain)
+    DRAIN_KINDS = ("Deployment", "StatefulSet", "DaemonSet", "ReplicaSet",
+                   "Job", "Service", "EndpointSlice", "RoleBinding", "Role",
+                   "PersistentVolumeClaim", "ResourceClaim",
+                   "PodDisruptionBudget", "Pod")
+
+    def reconcile(self, key: str) -> None:
+        ns = self.store.try_get("Namespace", key)
+        if ns is None:
+            return
+        if ns.meta.deletion_timestamp is None:
+            return
+        if ns.phase != "Terminating":
+            ns.phase = "Terminating"
+            self.store.update(ns, check_version=False)
+        name = ns.meta.name
+        remaining = 0
+        for kind in self.DRAIN_KINDS:
+            for obj in self.store.iter_kind(kind):
+                if obj.meta.namespace != name:
+                    continue
+                remaining += 1
+                try:
+                    self.store.delete(kind, obj.meta.key)
+                except NotFoundError:
+                    pass
+        if remaining:
+            # deletes cascade through other controllers/kubelets; re-check
+            self.queue.add(key)
+            return
+        try:
+            self.store.delete("Namespace", key)
+        except NotFoundError:
+            pass
+
+
+class TTLAfterFinishedController(Controller):
+    """ttl-after-finished controller — pkg/controller/ttlafterfinished:
+    deletes finished Jobs ttlSecondsAfterFinished after completion. Jobs
+    whose TTL hasn't elapsed yet are requeued (the reference enqueues with
+    a delay; our workqueue re-add plays that role via periodic syncs)."""
+
+    name = "ttlafterfinished"
+    watches = ("Job",)
+
+    def __init__(self, store, informers=None, clock=None):
+        from ..client.workqueue import WorkQueue
+        from ..utils.clock import Clock
+
+        super().__init__(store, informers)
+        self.clock = clock or Clock()
+        # the queue's delay timer must tick on the SAME clock the TTL math
+        # uses, or injected-clock tests (and any future frozen-clock sim)
+        # would wait on wall time
+        self.queue = WorkQueue(clock=self.clock.now)
+
+    def reconcile(self, key: str) -> None:
+        job = self.store.try_get("Job", key)
+        if job is None:
+            return
+        ttl = job.spec.ttl_seconds_after_finished
+        if ttl is None or not job.status.completed:
+            return
+        done_at = job.status.completion_time
+        if done_at is None:
+            return
+        remaining = ttl - (self.clock.now() - done_at)
+        if remaining <= 0:
+            try:
+                self.store.delete("Job", key)
+            except NotFoundError:
+                pass
+        else:
+            # delayed requeue (the reference enqueueAfter) — a plain add()
+            # would busy-spin the worker for the whole TTL window
+            self.queue.add_after(key, remaining)

@@ -189,6 +189,12 @@ class JobController(Controller):
     name = "job"
     watches = ("Job", "Pod")
 
+    def __init__(self, store, informers=None, clock=None):
+        from ..utils.clock import Clock
+
+        super().__init__(store, informers)
+        self.clock = clock or Clock()
+
     def key_of(self, kind: str, obj) -> str | None:
         if kind == "Job":
             return obj.meta.key
@@ -220,6 +226,8 @@ class JobController(Controller):
         job.status.failed = failed
         if succeeded >= job.spec.completions:
             job.status.completed = True
+            if job.status.completion_time is None:
+                job.status.completion_time = self.clock.now()
             for p in active:
                 self.store.delete("Pod", p.meta.key)
             if job.status != old_status:

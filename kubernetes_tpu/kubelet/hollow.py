@@ -12,20 +12,16 @@ from __future__ import annotations
 
 import threading
 
-from ..api.coordination import Lease, LeaseSpec
-from ..api.meta import ObjectMeta
 from ..api.types import (
     FAILED,
     Node,
-    NodeCondition,
     PENDING,
     RUNNING,
     SUCCEEDED,
     PodCondition,
 )
 from ..store.store import ConflictError, NotFoundError
-
-LEASE_NAMESPACE = "kube-node-lease"
+from .agent import LEASE_NAMESPACE, NodeAgentBase
 
 
 class FakeRuntime:
@@ -51,7 +47,7 @@ class FakeRuntime:
         self.containers.pop(key, None)
 
 
-class HollowKubelet:
+class HollowKubelet(NodeAgentBase):
     """One hollow node agent (cmd/kubemark hollow-node)."""
 
     def __init__(self, store, node: Node, clock=None,
@@ -66,49 +62,16 @@ class HollowKubelet:
         self.runtime = FakeRuntime(self.clock)
         self._watch = None
 
-    # -- registration + heartbeat -------------------------------------------
+    # registration + heartbeat come from NodeAgentBase
 
     def register(self) -> None:
-        """kubelet registerWithAPIServer: create/refresh Node + first lease."""
-        existing = self.store.try_get("Node", self.node_name)
-        ready = NodeCondition(type="Ready", status="True")
-        self.node.status.conditions = [
-            c for c in self.node.status.conditions if c.type != "Ready"
-        ] + [ready]
-        if existing is None:
-            self.store.create(self.node)
-        else:
-            existing.status = self.node.status
-            self.store.update(existing, check_version=False)
-            self.node = existing
-        self.heartbeat()
+        super().register()
         # from the CURRENT revision: the watch is only drained as a wakeup
         # signal (state is re-listed each sync), and a node started mid-run
         # must not demand compacted history (watch(0) raises CompactedError
         # once >log_cap Pod events have ever happened)
         _, rev = self.store.list("Pod")
         self._watch = self.store.watch("Pod", from_revision=rev)
-
-    def heartbeat(self) -> None:
-        """NodeLease heartbeat (kubelet.go:1122-1128 fast path)."""
-        key = f"{LEASE_NAMESPACE}/{self.node_name}"
-        now = self.clock.now()
-        lease = self.store.try_get("Lease", key)
-        if lease is None:
-            self.store.create(Lease(
-                meta=ObjectMeta(name=self.node_name, namespace=LEASE_NAMESPACE),
-                spec=LeaseSpec(
-                    holder_identity=self.node_name,
-                    lease_duration_seconds=self.lease_duration,
-                    acquire_time=now, renew_time=now,
-                ),
-            ))
-            return
-        lease.spec.renew_time = now
-        try:
-            self.store.update(lease, check_version=False)
-        except (ConflictError, NotFoundError):
-            pass
 
     # -- pod sync loop -------------------------------------------------------
 

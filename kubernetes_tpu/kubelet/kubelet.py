@@ -21,14 +21,14 @@ from ..api.types import (
     PodCondition,
 )
 from ..store.store import ConflictError, NotFoundError
-from .cri import CONTAINER_RUNNING, CREATED, EXITED, InMemoryRuntime
+from .agent import NodeAgentBase
+from .cri import CREATED, EXITED, InMemoryRuntime
 from .eviction import EvictionManager, PodStats, Threshold
-from .hollow import LEASE_NAMESPACE
 from .pleg import GenericPLEG
 from .pod_workers import PodWorkers
 
 
-class Kubelet:
+class Kubelet(NodeAgentBase):
     def __init__(self, store, node: Node, runtime=None, clock=None,
                  eviction_thresholds: list[Threshold] | None = None,
                  workers: int = 4):
@@ -53,43 +53,8 @@ class Kubelet:
         self.pod_stats: dict[str, PodStats] = {}
         self.node_available: dict[str, int] = {}
 
-    # -- registration / heartbeat (same contract as HollowKubelet) -----------
-
-    def register(self) -> None:
-        from ..api.coordination import Lease, LeaseSpec
-        from ..api.meta import ObjectMeta
-
-        existing = self.store.try_get("Node", self.node_name)
-        ready = NodeCondition(type="Ready", status="True")
-        self.node.status.conditions = [
-            c for c in self.node.status.conditions if c.type != "Ready"
-        ] + [ready]
-        if existing is None:
-            self.store.create(self.node)
-        else:
-            existing.status = self.node.status
-            self.store.update(existing, check_version=False)
-            self.node = existing
-        key = f"{LEASE_NAMESPACE}/{self.node_name}"
-        if self.store.try_get("Lease", key) is None:
-            now = self.clock.now()
-            self.store.create(Lease(
-                meta=ObjectMeta(name=self.node_name,
-                                namespace=LEASE_NAMESPACE),
-                spec=LeaseSpec(holder_identity=self.node_name,
-                               lease_duration_seconds=40.0,
-                               acquire_time=now, renew_time=now),
-            ))
-
-    def heartbeat(self) -> None:
-        key = f"{LEASE_NAMESPACE}/{self.node_name}"
-        lease = self.store.try_get("Lease", key)
-        if lease is not None:
-            lease.spec.renew_time = self.clock.now()
-            try:
-                self.store.update(lease, check_version=False)
-            except (ConflictError, NotFoundError):
-                pass
+    # registration/heartbeat shared via NodeAgentBase (lease recreated on
+    # heartbeat — a renew-only agent would stay NotReady after a lease GC)
 
     # -- the sync loop -------------------------------------------------------
 
@@ -223,11 +188,15 @@ class Kubelet:
     # -- housekeeping --------------------------------------------------------
 
     def _housekeeping(self) -> None:
-        # orphaned sandboxes: runtime pods whose API object vanished
+        # orphaned sandboxes: runtime pods whose API object vanished.
+        # Dispatch through the workers — _sync_pod observes the missing API
+        # object and tears down — so teardown serializes with any in-flight
+        # sync of the same pod (direct _teardown here would race a worker
+        # into re-creating the sandbox)
         my = {p.meta.key for p in self._my_pods()}
-        for key, sid in list(self._sandboxes.items()):
+        for key in list(self._sandboxes):
             if key not in my:
-                self._teardown(key)
+                self.workers.update_pod(key)
         # node-pressure eviction + condition/taint reporting
         if self.eviction.thresholds:
             self.eviction.synchronize(self._my_pods())
@@ -268,7 +237,9 @@ class Kubelet:
         return dict(self.node_available), dict(self.pod_stats)
 
     def _evict(self, pod, reason: str) -> None:
-        """Status-Failed + delete (the eviction API write path)."""
+        """Status-Failed + delete (the eviction API write path). Runtime
+        teardown goes through the pod's worker, not inline — _sync_pod sees
+        the deleted object and tears down under per-key serialization."""
         pod.status.phase = FAILED
         pod.status.conditions = [
             c for c in pod.status.conditions if c.type != "Ready"
@@ -279,7 +250,7 @@ class Kubelet:
             self.store.delete("Pod", pod.meta.key)
         except (ConflictError, NotFoundError):
             pass
-        self._teardown(pod.meta.key)
+        self.workers.update_pod(pod.meta.key)
 
     def shutdown(self) -> None:
         self.workers.stop()

@@ -186,3 +186,34 @@ class TestKubectl:
         assert not store.get("Node", "n1").spec.unschedulable
         assert kubectl(["-s", url, "delete", "rs", "web"]) == 0
         assert kubectl(["-s", url, "get", "rs", "web"]) == 1
+
+
+class TestDiscovery:
+    """Discovery + OpenAPI surface (reflected from the kind registry)."""
+
+    def test_api_and_resource_list(self):
+        import json
+        import urllib.request
+
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.store import Store
+
+        server = APIServer(Store())
+        server.serve(0)
+        try:
+            with urllib.request.urlopen(f"{server.url}/api") as r:
+                assert json.loads(r.read())["versions"] == ["v1"]
+            with urllib.request.urlopen(f"{server.url}/api/v1") as r:
+                doc = json.loads(r.read())
+            by_name = {res["name"]: res for res in doc["resources"]}
+            assert by_name["Pod"]["namespaced"] is True
+            assert by_name["Node"]["namespaced"] is False
+            assert "watch" in by_name["Pod"]["verbs"]
+            with urllib.request.urlopen(f"{server.url}/openapi/v2") as r:
+                spec = json.loads(r.read())
+            assert "/api/v1/Pod/{name}" in spec["paths"]
+            pod_def = spec["definitions"]["Pod"]
+            assert "spec" in pod_def["properties"]
+            assert "PodSpec" in spec["definitions"]
+        finally:
+            server.shutdown()

@@ -1,0 +1,145 @@
+"""CBOR wire-format tests: codec determinism + negotiated client/server.
+
+Modeled on apimachinery's serializer round-trip tests
+(runtime/serializer/cbor): every API object must survive
+object → dict → CBOR → dict → object, and a cbor-negotiated client must
+interoperate with a json one against the same server.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import cbor
+from kubernetes_tpu.api.serialization import decode, encode
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTStore
+from kubernetes_tpu.store.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+class TestCodec:
+    CASES = [
+        None, True, False, 0, 1, 23, 24, 255, 256, 65535, 65536, 2**32,
+        -1, -24, -25, -256, 3.14159, -0.0, "", "hello", "ünïcødé",
+        b"", b"\x00\xff\n", [], [1, [2, [3]]], {}, {"a": 1, "b": [True]},
+        {"nested": {"deep": {"x": None}}},
+    ]
+
+    def test_roundtrip(self):
+        for case in self.CASES:
+            assert cbor.loads(cbor.dumps(case)) == case
+
+    def test_tuple_encodes_as_array(self):
+        assert cbor.loads(cbor.dumps((1, 2))) == [1, 2]
+
+    def test_shortest_form_integers(self):
+        # RFC 8949 §4.2.1 deterministic heads
+        assert cbor.dumps(0) == b"\x00"
+        assert cbor.dumps(23) == b"\x17"
+        assert cbor.dumps(24) == b"\x18\x18"
+        assert cbor.dumps(256) == b"\x19\x01\x00"
+        assert cbor.dumps(-1) == b"\x20"
+
+    def test_smaller_than_json_for_api_objects(self):
+        import json
+
+        pod = encode(make_pod("p", cpu="500m", mem="1Gi",
+                              labels={"app": "web", "tier": "backend"}))
+        assert len(cbor.dumps(pod)) < len(json.dumps(pod).encode())
+
+    def test_truncated_and_trailing_rejected(self):
+        data = cbor.dumps({"a": 1})
+        with pytest.raises(ValueError):
+            cbor.loads(data[:-1])
+        with pytest.raises(ValueError):
+            cbor.loads(data + b"\x00")
+
+    def test_api_object_roundtrip(self):
+        for obj in (make_pod("p", cpu="1", mem="2Gi"),
+                    make_node("n", cpu="8", mem="16Gi", zone="z1")):
+            wire = cbor.dumps(encode(obj))
+            assert decode(cbor.loads(wire)) == obj
+
+
+class TestNegotiatedWire:
+    def test_cbor_client_full_cycle(self):
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        try:
+            client = RESTStore(server.url, wire_format="cbor")
+            pod = client.create(make_pod("p1", cpu="1"))
+            assert pod.meta.name == "p1"
+            got = client.get("Pod", pod.meta.key)
+            assert got == pod
+            got.spec.node_name = "n1"
+            client.update(got, check_version=False)
+            pods, rev = client.list("Pod")
+            assert len(pods) == 1 and pods[0].spec.node_name == "n1"
+            # error payloads decode too
+            from kubernetes_tpu.store.store import NotFoundError
+
+            with pytest.raises(NotFoundError):
+                client.get("Pod", "default/missing")
+            client.delete("Pod", pod.meta.key)
+        finally:
+            server.shutdown()
+
+    def test_cbor_watch_stream(self):
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        try:
+            client = RESTStore(server.url, wire_format="cbor")
+            _, rev = client.list("Pod")
+            w = client.watch("Pod", from_revision=rev)
+            store.create(make_pod("streamed"))
+            ev = w.next(timeout=5)
+            assert ev is not None and ev.obj.meta.name == "streamed"
+            w.stop()
+        finally:
+            server.shutdown()
+
+    def test_json_and_cbor_clients_interoperate(self):
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        try:
+            jc = RESTStore(server.url)
+            cc = RESTStore(server.url, wire_format="cbor")
+            created = cc.create(make_pod("x", labels={"a": "b"}))
+            assert jc.get("Pod", created.meta.key) == created
+        finally:
+            server.shutdown()
+
+
+class TestCacheMutationDetector:
+    """client-go mutation_detector.go equivalent (SURVEY §5.2): informer
+    caches are shared read-only; in-place edits must be caught."""
+
+    def test_detects_in_place_mutation(self, monkeypatch):
+        monkeypatch.setenv("KUBERNETES_TPU_CACHE_MUTATION_DETECTOR", "1")
+        from kubernetes_tpu.client.informer import (
+            CacheMutationDetected,
+            SharedInformer,
+        )
+
+        store = Store()
+        store.create(make_pod("p1"))
+        inf = SharedInformer(store, "Pod")
+        inf.start()
+        cached = inf.get("default/p1")
+        cached.meta.labels["oops"] = "mutated"  # the forbidden edit
+        with pytest.raises(CacheMutationDetected):
+            inf.pump()
+
+    def test_clean_consumers_pass(self, monkeypatch):
+        monkeypatch.setenv("KUBERNETES_TPU_CACHE_MUTATION_DETECTOR", "1")
+        from kubernetes_tpu.client.informer import SharedInformer
+
+        store = Store()
+        store.create(make_pod("p1"))
+        inf = SharedInformer(store, "Pod")
+        inf.start()
+        store.create(make_pod("p2"))
+        assert inf.pump() == 1
+        inf.check_mutations()  # no raise

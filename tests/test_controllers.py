@@ -253,3 +253,75 @@ class TestResourceClaimCleanup:
         claim = store.get("ResourceClaim", "default/c")
         assert claim.status.reserved_for == ()
         assert claim.status.allocation is None  # deallocated for reuse
+
+
+class TestNamespaceController:
+    def test_terminating_namespace_drains_contents(self):
+        from kubernetes_tpu.api.workloads import Namespace
+        from kubernetes_tpu.controllers import NamespaceController
+
+        store = Store()
+        ns = store.create(Namespace(meta=ObjectMeta(name="team-a", namespace="")))
+        pod = make_pod("p1")
+        pod.meta.namespace = "team-a"
+        store.create(pod)
+        svc = Service(meta=ObjectMeta(name="svc", namespace="team-a"),
+                      spec=ServiceSpec(selector={"app": "x"}))
+        store.create(svc)
+        other = make_pod("keep")  # different namespace: untouched
+        store.create(other)
+        ctl = NamespaceController(store)
+        ctl.sync_once()
+        assert store.try_get("Namespace", "team-a") is not None  # still Active
+        ns = store.get("Namespace", "team-a")
+        ns.meta.deletion_timestamp = 1.0
+        store.update(ns, check_version=False)
+        for _ in range(4):
+            ctl.sync_once()
+        assert store.try_get("Pod", "team-a/p1") is None
+        assert store.try_get("Service", "team-a/svc") is None
+        assert store.try_get("Namespace", "team-a") is None
+        assert store.try_get("Pod", "default/keep") is not None
+
+
+class TestTTLAfterFinished:
+    def test_finished_job_deleted_after_ttl(self):
+        from kubernetes_tpu.controllers import (
+            JobController,
+            TTLAfterFinishedController,
+        )
+
+        store = Store()
+        clock = FakeClock()
+        job = Job(
+            meta=ObjectMeta(name="once"),
+            spec=JobSpec(completions=0, ttl_seconds_after_finished=30,
+                         template=template()),
+        )
+        store.create(job)
+        jc = JobController(store, clock=clock)
+        jc.sync_once()  # completions=0 → immediately complete
+        got = store.get("Job", "default/once")
+        assert got.status.completed and got.status.completion_time is not None
+        ttl = TTLAfterFinishedController(store, clock=clock)
+        ttl.sync_once()
+        assert store.try_get("Job", "default/once") is not None  # ttl not up
+        clock.step(31)
+        ttl.sync_once()
+        assert store.try_get("Job", "default/once") is None
+
+    def test_no_ttl_keeps_job(self):
+        from kubernetes_tpu.controllers import (
+            JobController,
+            TTLAfterFinishedController,
+        )
+
+        store = Store()
+        clock = FakeClock()
+        job = Job(meta=ObjectMeta(name="keep"),
+                  spec=JobSpec(completions=0, template=template()))
+        store.create(job)
+        JobController(store, clock=clock).sync_once()
+        clock.step(10_000)
+        TTLAfterFinishedController(store, clock=clock).sync_once()
+        assert store.try_get("Job", "default/keep") is not None
