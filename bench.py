@@ -79,6 +79,11 @@ def main() -> None:
             k: (round(v, 2) if isinstance(v, float) else v)
             for k, v in prof.items()
         },
+        # where the "kernel" phase actually goes: host prep (sync/features/
+        # tie), dispatch, device wait, full re-uploads
+        "wave_profile_s": {
+            k: round(v, 2) for k, v in algo.backend.perf.items()
+        },
     }))
 
 

@@ -345,7 +345,7 @@ class PodDisruptionBudgetSpec:
     the disruption controller before the scheduler ever reads them, so the
     scheduler-side contract is identical)."""
 
-    selector: object | None = None  # labels.LabelSelector; None matches nothing
+    selector: LabelSelector | None = None  # None matches nothing
     min_available: int | None = None
     max_unavailable: int | None = None
 

@@ -169,6 +169,23 @@ class APIServer:
                                           "platform": "tpu"})
                     return
                 if self.path in ("/api", "/api/v1", "/openapi/v2"):
+                    # discovery requires authentication (the reference
+                    # grants system:discovery to authenticated users, not
+                    # anonymous); authenticated users are always allowed
+                    if server.authenticator is not None:
+                        from .auth import ANONYMOUS, AuthenticationError
+
+                        try:
+                            user = server.authenticator.authenticate(
+                                self.headers.get("Authorization")
+                            )
+                        except AuthenticationError as e:
+                            self._error(401, "Unauthorized", str(e))
+                            return
+                        if user.name == ANONYMOUS:
+                            self._error(403, "Forbidden",
+                                        "discovery requires authentication")
+                            return
                     from . import discovery
 
                     doc = (discovery.api_versions() if self.path == "/api"

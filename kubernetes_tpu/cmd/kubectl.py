@@ -163,6 +163,85 @@ def cmd_cordon(client: RESTStore, args, unschedulable: bool = True) -> int:
     return 0
 
 
+def cmd_drain(client: RESTStore, args) -> int:
+    """kubectl drain: cordon, then evict every pod on the node, honoring
+    PodDisruptionBudgets (staging/.../kubectl/pkg/drain): an eviction that
+    would take a PDB below its budget is refused and retried; --force
+    overrides for pods with no budget room after the grace rounds."""
+    cmd_cordon(client, args, True)
+    import time as _time
+
+    deadline = _time.monotonic() + args.timeout
+    warned_ds = False
+    while True:
+        pods = [p for p in client.pods() if p.spec.node_name == args.name]
+        # DaemonSet pods tolerate the cordon taint and would be re-minted
+        # onto this node forever — real kubectl ignores them for the same
+        # reason (--ignore-daemonsets is effectively mandatory)
+        ds_pods = [p for p in pods if any(
+            r.kind == "DaemonSet" and r.controller
+            for r in p.meta.owner_references
+        )]
+        if ds_pods and not warned_ds:
+            warned_ds = True
+            for p in ds_pods:
+                print(f"ignoring DaemonSet-managed pod {p.meta.key}")
+        pods = [p for p in pods if p not in ds_pods]
+        if not pods:
+            print(f"node/{args.name} drained")
+            return 0
+        blocked = []
+        for pod in pods:
+            pdb = _pdb_for(client, pod)
+            if pdb is not None and pdb.status.disruptions_allowed <= 0:
+                blocked.append(pod.meta.key)
+                continue
+            if pdb is not None:
+                pdb.status.disruptions_allowed -= 1
+                pdb.status.disrupted_pods[pod.meta.name] = _time.time()
+                client.update(pdb, check_version=False)
+            client.delete("Pod", pod.meta.key)
+            print(f"evicting pod {pod.meta.key}")
+        if _time.monotonic() >= deadline:
+            if blocked and args.force:
+                for key in blocked:
+                    client.delete("Pod", key)
+                    print(f"evicting pod {key} (forced)")
+                continue
+            if blocked:
+                print(f"error: cannot evict {len(blocked)} pod(s) "
+                      f"(PodDisruptionBudget), use --force to override")
+                return 1
+            print(f"error: node/{args.name} still has pods after "
+                  f"{args.timeout}s")
+            return 1
+        _time.sleep(args.poll)
+
+
+def _pdb_for(client: RESTStore, pod):
+    from ..api.labels import matches_selector
+
+    for pdb in client.iter_kind("PodDisruptionBudget"):
+        if pdb.meta.namespace != pod.meta.namespace:
+            continue
+        sel = pdb.spec.selector
+        if sel is not None and matches_selector(sel, pod.meta.labels):
+            return pdb
+    return None
+
+
+def cmd_events(client: RESTStore, args) -> int:
+    """kubectl get events — the Scheduled/FailedScheduling stream."""
+    events = sorted(client.iter_kind("Event"),
+                    key=lambda e: getattr(e, "last_timestamp", 0))
+    for ev in events:
+        if not args.all_namespaces and ev.meta.namespace != args.namespace:
+            continue
+        print(f"{ev.type}\t{ev.reason}\t{ev.involved_object}\t"
+              f"{ev.message}\t{getattr(ev, 'count', 1)}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="kubectl-tpu")
     parser.add_argument("--server", "-s", default=DEFAULT_SERVER)
@@ -195,6 +274,15 @@ def build_parser() -> argparse.ArgumentParser:
     for verb in ("cordon", "uncordon"):
         c = sub.add_parser(verb)
         c.add_argument("name")
+
+    dr = sub.add_parser("drain")
+    dr.add_argument("name")
+    dr.add_argument("--force", action="store_true")
+    dr.add_argument("--timeout", type=float, default=5.0)
+    dr.add_argument("--poll", type=float, default=0.1)
+
+    ev = sub.add_parser("events")
+    ev.add_argument("-A", "--all-namespaces", action="store_true")
     return parser
 
 
@@ -210,6 +298,8 @@ def main(argv: list[str] | None = None) -> int:
         "scale": cmd_scale,
         "cordon": lambda c, a: cmd_cordon(c, a, True),
         "uncordon": lambda c, a: cmd_cordon(c, a, False),
+        "drain": cmd_drain,
+        "events": cmd_events,
     }
     return verbs[args.verb](client, args)
 

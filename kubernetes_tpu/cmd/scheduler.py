@@ -191,7 +191,15 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--port", type=int, default=10259,
                         help="health/metrics port")
     parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--v", type=int, default=0,
+                        help="log verbosity (klog levels)")
+    parser.add_argument("--log-format", choices=["text", "json"],
+                        default="text")
     args = parser.parse_args(argv)
+
+    from ..utils.logging import configure as configure_logging
+
+    configure_logging(fmt=args.log_format, verbosity_level=args.v)
 
     config = (
         load_config_file(args.config) if args.config else SchedulerConfiguration()

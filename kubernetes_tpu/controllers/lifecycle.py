@@ -263,12 +263,21 @@ class NamespaceController(Controller):
     name = "namespace"
     watches = ("Namespace",)
 
-    # namespaced kinds the deleter drains, in dependency-ish order (pods
-    # last so controllers don't resurrect them mid-drain)
-    DRAIN_KINDS = ("Deployment", "StatefulSet", "DaemonSet", "ReplicaSet",
-                   "Job", "Service", "EndpointSlice", "RoleBinding", "Role",
-                   "PersistentVolumeClaim", "ResourceClaim",
-                   "PodDisruptionBudget", "Pod")
+    @staticmethod
+    def drain_kinds() -> list[str]:
+        """Every namespaced kind from the registry (the reference's
+        discovery-driven content deleter), workload owners first and pods
+        last so controllers don't resurrect pods mid-drain. Derived, not
+        hand-listed: a new namespaced kind is drained automatically."""
+        from ..apiserver.discovery import CLUSTER_SCOPED, all_kinds
+
+        first = ["Deployment", "StatefulSet", "DaemonSet", "ReplicaSet",
+                 "Job"]
+        last = ["Pod"]
+        rest = sorted(k for k in all_kinds()
+                      if k not in CLUSTER_SCOPED
+                      and k not in first and k not in last)
+        return first + rest + last
 
     def reconcile(self, key: str) -> None:
         ns = self.store.try_get("Namespace", key)
@@ -281,7 +290,7 @@ class NamespaceController(Controller):
             self.store.update(ns, check_version=False)
         name = ns.meta.name
         remaining = 0
-        for kind in self.DRAIN_KINDS:
+        for kind in self.drain_kinds():
             for obj in self.store.iter_kind(kind):
                 if obj.meta.namespace != name:
                     continue

@@ -107,6 +107,12 @@ class Kubelet(NodeAgentBase):
                 except NotFoundError:
                     pass
             return
+        if pod.spec.node_name != self.node_name:
+            # same-named pod reassigned elsewhere (StatefulSet identity
+            # reuse): OUR sandbox is an orphan — tear down, never resurrect
+            # another node's pod here
+            self._teardown(key)
+            return
         sid = self._sandboxes.get(key)
         if sid is None or all(
             s.id != sid for s in self.runtime.list_pod_sandboxes()

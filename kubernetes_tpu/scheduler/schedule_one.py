@@ -32,6 +32,9 @@ from .framework.interface import (
 from .framework.runtime import Framework
 from .nodeinfo import NodeInfo, PodInfo
 from .queue.scheduling_queue import QueuedPodInfo
+from ..utils.logging import get_logger
+
+_log = get_logger("scheduler")
 
 MIN_FEASIBLE_NODES_TO_FIND = 100  # schedule_one.go:56
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # schedule_one.go:62
@@ -1038,6 +1041,8 @@ class ScheduleOneLoop:
             self.metrics.pod_scheduled(qpi)
         if self.event_recorder is not None:
             self.event_recorder.event(pod, "Normal", "Scheduled", f"bound to {host}")
+        _log.v2("Successfully bound pod to node", pod=qpi.key, node=host,
+                evaluatedNodes=getattr(qpi, "evaluated_nodes", None))
         gk = self._group_key(pod)
         if gk is not None:
             self.cache.pod_group_states.pod_scheduled(gk, pod.meta.key)
@@ -1103,6 +1108,8 @@ class ScheduleOneLoop:
             self.event_recorder.event(
                 pod, "Warning", "FailedScheduling", status.message()
             )
+        _log.v2("Unable to schedule pod; waiting", pod=qpi.key,
+                reason=status.message())
         if self.metrics is not None:
             self.metrics.pod_unschedulable(qpi)
 

@@ -174,6 +174,11 @@ class TPUBackend:
         self._carry_external = False  # an external event touched the planes
         self._inflight: InflightWave | None = None  # last launched wave
         self._advanced_since_launch = 0  # rng words collected since then
+        # fine-grained wave-path timing (seconds), surfaced by the perf
+        # harness next to the coarse phase profile: where does "kernel"
+        # wall time actually go — host feature prep, dispatch, device wait?
+        self.perf = {"sync": 0.0, "features": 0.0, "tie": 0.0,
+                     "dispatch": 0.0, "upload": 0.0, "wait": 0.0}
         # (carry dict, allowed dirty rows) of the wave being processed RIGHT
         # NOW: single-pod re-runs inside that window must see state as of
         # THAT wave — the live carry already contains the uncollected
@@ -433,19 +438,25 @@ class TPUBackend:
         Raises NeedResync when the carry can't absorb host-side changes
         (external dirty rows / bucket reshape) — caller drains the pipeline
         and retries — and FallbackNeeded for non-kernelizable pods."""
+        import time as _time
+
         from ...ops import pad_features
         from ...ops.kernels import MAX_TIE_DRAWS
 
         self._rerun_carry = None  # a new launch closes any re-run window
+        t0 = _time.perf_counter()
         for pod in pods:
             self.extractor.register(pod)
         planes = self.sync(snapshot)
+        t1 = _time.perf_counter()
+        self.perf["sync"] += t1 - t0
         feats = stack_features(
             [self.extractor.features_cached(p, planes) for p in pods]
         )
         if pad_to > len(pods):
             feats = pad_features(feats, pad_to)
         pad = max(pad_to, len(pods))
+        self.perf["features"] += _time.perf_counter() - t1
 
         prev = self._inflight
         if prev is not None and self._carry is None:
@@ -471,7 +482,9 @@ class TPUBackend:
             self._refresh_tables(planes)
             dev = {**self._device_planes, **self._carry, **self._device_tables}
         else:
+            t_up = _time.perf_counter()
             dev = self.device_inputs(planes)
+            self.perf["upload"] += _time.perf_counter() - t_up
 
         cfg = self.kernel_config(planes, feats)
         tie_words = None
@@ -480,6 +493,7 @@ class TPUBackend:
         # cursor rides in as a device array) — one full recompile
         cursor_init: object = np.int32(0)
         frame_shift = self._advanced_since_launch
+        t_tie = _time.perf_counter()
         if rng is not None:
             # frame covers a full predecessor + this wave (static shape per
             # pad): the predecessor may consume up to pad*MAX words first
@@ -488,10 +502,13 @@ class TPUBackend:
                 # predecessor's final cursor, shifted into this frame inside
                 # the next kernel's trace — no host sync, no eager op
                 cursor_init = prev.info["tie_consumed"]
+        t_disp = _time.perf_counter()
+        self.perf["tie"] += t_disp - t_tie
         _winners_dev, info = batched_assign(
             cfg, dev, feats, tie_words, cursor_init,
             frame_shift if prev is not None else 0,
         )
+        self.perf["dispatch"] += _time.perf_counter() - t_disp
         # next launch chains on these outputs
         self._carry = {k: info[k] for k in
                        ("used", "nonzero_used", "sel_counts")}
@@ -515,7 +532,11 @@ class TPUBackend:
         Raises FallbackNeeded on tie-draw overflow (results discarded, rng
         untouched, carry invalidated — the successor launch, if any, must be
         poisoned by the caller)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         packed = np.asarray(fl.info["packed"])
+        self.perf["wait"] += _time.perf_counter() - t0
         winners = packed[: len(fl.pods)]
         final_abs, overflow = int(packed[-2]), bool(packed[-1])
         if self._inflight is fl:

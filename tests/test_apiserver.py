@@ -217,3 +217,86 @@ class TestDiscovery:
             assert "PodSpec" in spec["definitions"]
         finally:
             server.shutdown()
+
+
+class TestKubectlDrain:
+    def test_drain_respects_pdb_and_force(self, capsys):
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.types import (
+            PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+            PodDisruptionBudgetStatus,
+        )
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+        from kubernetes_tpu.store import Store
+        from tests.wrappers import make_node, make_pod
+
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        try:
+            url = server.url
+            store.create(make_node("n1", cpu="8", mem="16Gi"))
+            free = make_pod("free")
+            free.spec.node_name = "n1"
+            store.create(free)
+            guarded = make_pod("guarded", labels={"app": "db"})
+            guarded.spec.node_name = "n1"
+            store.create(guarded)
+            store.create(PodDisruptionBudget(
+                meta=ObjectMeta(name="db-pdb"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector.of({"app": "db"})
+                ),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+            ))
+            # PDB blocks: drain fails without --force, free pod evicted
+            rc = kubectl(["-s", url, "drain", "n1", "--timeout", "0.3"])
+            assert rc == 1
+            assert store.try_get("Pod", "default/free") is None
+            assert store.try_get("Pod", "default/guarded") is not None
+            assert store.get("Node", "n1").spec.unschedulable
+            # forced drain evicts the guarded pod too
+            rc = kubectl(["-s", url, "drain", "n1", "--timeout", "0.2",
+                          "--force"])
+            assert rc == 0
+            assert store.try_get("Pod", "default/guarded") is None
+        finally:
+            server.shutdown()
+
+    def test_drain_with_budget_decrements(self):
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.types import (
+            PodDisruptionBudget,
+            PodDisruptionBudgetSpec,
+            PodDisruptionBudgetStatus,
+        )
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+        from kubernetes_tpu.store import Store
+        from tests.wrappers import make_node, make_pod
+
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        try:
+            store.create(make_node("n1", cpu="8", mem="16Gi"))
+            pod = make_pod("db-0", labels={"app": "db"})
+            pod.spec.node_name = "n1"
+            store.create(pod)
+            store.create(PodDisruptionBudget(
+                meta=ObjectMeta(name="db-pdb"),
+                spec=PodDisruptionBudgetSpec(
+                    selector=LabelSelector.of({"app": "db"})
+                ),
+                status=PodDisruptionBudgetStatus(disruptions_allowed=1),
+            ))
+            assert kubectl(["-s", server.url, "drain", "n1"]) == 0
+            assert store.try_get("Pod", "default/db-0") is None
+            pdb = store.get("PodDisruptionBudget", "default/db-pdb")
+            assert pdb.status.disruptions_allowed == 0
+        finally:
+            server.shutdown()

@@ -347,3 +347,33 @@ class TestBindingSubresource:
             assert store.get("Pod", "default/nons") is not None
         finally:
             server.shutdown()
+
+
+class TestDiscoveryAuth:
+    def test_discovery_requires_authentication(self):
+        import urllib.error
+        import urllib.request
+
+        _, server = secure_server()
+        try:
+            # anonymous: denied
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{server.url}/api/v1")
+            assert exc.value.code == 403
+            # bad token: 401
+            req = urllib.request.Request(
+                f"{server.url}/openapi/v2",
+                headers={"Authorization": "Bearer nope"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 401
+            # any authenticated user: allowed
+            req = urllib.request.Request(
+                f"{server.url}/api/v1",
+                headers={"Authorization": "Bearer viewer-token"},
+            )
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 200
+        finally:
+            server.shutdown()

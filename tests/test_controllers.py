@@ -325,3 +325,23 @@ class TestTTLAfterFinished:
         clock.step(10_000)
         TTLAfterFinishedController(store, clock=clock).sync_once()
         assert store.try_get("Job", "default/keep") is not None
+
+
+class TestNamespaceDrainDerived:
+    def test_drains_registry_kinds_including_lease(self):
+        from kubernetes_tpu.api.coordination import Lease, LeaseSpec
+        from kubernetes_tpu.api.workloads import Namespace
+        from kubernetes_tpu.controllers import NamespaceController
+
+        store = Store()
+        store.create(Namespace(meta=ObjectMeta(name="team-a", namespace="")))
+        store.create(Lease(meta=ObjectMeta(name="lock", namespace="team-a"),
+                           spec=LeaseSpec(holder_identity="x")))
+        ctl = NamespaceController(store)
+        ns = store.get("Namespace", "team-a")
+        ns.meta.deletion_timestamp = 1.0
+        store.update(ns, check_version=False)
+        for _ in range(4):
+            ctl.sync_once()
+        assert store.try_get("Lease", "team-a/lock") is None
+        assert store.try_get("Namespace", "team-a") is None

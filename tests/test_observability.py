@@ -166,3 +166,42 @@ class TestNodeDeclaredFeatures:
         sched.schedule_pending()
         # gate off: requirement not enforced
         assert store.get("Pod", "default/any").spec.node_name == "plain"
+
+
+class TestStructuredLogging:
+    """klog v2 role: structured key-value logging, V-gating, JSON backend."""
+
+    def test_json_backend_and_v_gating(self):
+        import io
+        import json as _json
+
+        from kubernetes_tpu.utils import logging as klog
+
+        buf = io.StringIO()
+        klog.configure(fmt="json", stream=buf, verbosity_level=2)
+        try:
+            log = klog.get_logger("testcomp").with_values(node="n1")
+            log.info("hello", pod="default/p")
+            log.v2("verbose-on", x=1)
+            log.v4("verbose-off", huge="never")  # gated out at v=2
+            lines = [_json.loads(l) for l in buf.getvalue().splitlines()]
+            assert [l["msg"] for l in lines] == ["hello", "verbose-on"]
+            assert lines[0]["pod"] == "default/p"
+            assert lines[0]["node"] == "n1"  # WithValues context rides along
+            assert lines[1]["v"] == 2
+        finally:
+            klog.configure(fmt="text", verbosity_level=0)
+
+    def test_text_backend(self):
+        import io
+
+        from kubernetes_tpu.utils import logging as klog
+
+        buf = io.StringIO()
+        klog.configure(fmt="text", stream=buf, verbosity_level=0)
+        try:
+            klog.get_logger("sched").info("Scheduled", pod="a/b", node="n9")
+            out = buf.getvalue()
+            assert "Scheduled" in out and 'pod="a/b"' in out and 'node="n9"' in out
+        finally:
+            klog.configure(fmt="text", verbosity_level=0)
