@@ -337,6 +337,21 @@ class PodGroup:
 
 
 @dataclass
+class PriorityClass:
+    """scheduling.k8s.io/v1 PriorityClass: a named priority value resolved
+    onto pods at admission (the reference's priority admission plugin,
+    plugin/pkg/admission/priority). Cluster-scoped."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = "PreemptLowerPriority"
+    description: str = ""
+
+    kind = "PriorityClass"
+
+
+@dataclass
 class PodDisruptionBudgetSpec:
     """policy/v1 PodDisruptionBudgetSpec (scheduling-relevant subset).
 

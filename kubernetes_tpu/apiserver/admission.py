@@ -1,0 +1,90 @@
+"""In-tree admission plugins.
+
+Reference: the apiserver's admission chain (mutating then validating,
+staging/src/k8s.io/apiserver/pkg/admission) runs compiled-in plugins per
+request. The two that matter for scheduling parity:
+
+- priority (plugin/pkg/admission/priority): resolve a pod's
+  priorityClassName to its numeric priority at create time (or apply the
+  global-default class); reject unknown class names.
+- namespace lifecycle (plugin/pkg/admission/namespace/lifecycle): refuse
+  creates into a terminating or missing namespace.
+
+Admission functions follow the server's AdmissionFn contract:
+fn(operation, obj) raising AdmissionError to reject.
+"""
+
+from __future__ import annotations
+
+from .server import AdmissionError
+
+
+def cluster_scope_admission():
+    """Mutating: cluster-scoped kinds carry no namespace. The ObjectMeta
+    default ("default") would otherwise key a PriorityClass at
+    "default/critical" where by-name lookups never find it — the apiserver
+    strips namespace from cluster-scoped resources."""
+    from .discovery import CLUSTER_SCOPED
+
+    def admit(operation: str, obj) -> None:
+        if operation == "CREATE" and getattr(obj, "kind", "") in CLUSTER_SCOPED:
+            obj.meta.namespace = ""
+
+    return admit
+
+
+def priority_admission(store):
+    """Mutating: pod.spec.priority from PriorityClass (admission.go)."""
+
+    def admit(operation: str, obj) -> None:
+        if operation != "CREATE" or getattr(obj, "kind", "") != "Pod":
+            return
+        name = obj.spec.priority_class_name
+        if name:
+            pc = store.try_get("PriorityClass", name)
+            if pc is None:
+                raise AdmissionError(
+                    f"no PriorityClass with name {name} was found", code=422
+                )
+            obj.spec.priority = pc.value
+            obj.spec.preemption_policy = pc.preemption_policy
+            return
+        if obj.spec.priority == 0:
+            for pc in store.iter_kind("PriorityClass"):
+                if pc.global_default:
+                    obj.spec.priority = pc.value
+                    obj.spec.priority_class_name = pc.meta.name
+                    obj.spec.preemption_policy = pc.preemption_policy
+                    return
+
+    return admit
+
+
+def namespace_lifecycle_admission(store):
+    """Validating: no creates into terminating/missing namespaces. A
+    namespace that was never created as an object is treated as implicit
+    (tests and single-tenant flows create pods without namespace objects);
+    only an EXISTING namespace in Terminating phase rejects."""
+
+    def admit(operation: str, obj) -> None:
+        if operation != "CREATE":
+            return
+        ns_name = getattr(obj.meta, "namespace", "")
+        if not ns_name:
+            return
+        ns = store.try_get("Namespace", ns_name)
+        if ns is not None and (ns.phase == "Terminating"
+                               or ns.meta.deletion_timestamp is not None):
+            raise AdmissionError(
+                f"namespace {ns_name} is terminating: no new objects",
+                code=403,
+            )
+
+    return admit
+
+
+def default_admission_chain(store) -> list:
+    """The plugins every control plane enables (mutating before
+    validating, as the reference orders its chain)."""
+    return [cluster_scope_admission(), priority_admission(store),
+            namespace_lifecycle_admission(store)]

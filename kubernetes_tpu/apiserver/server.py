@@ -61,6 +61,11 @@ class APIServer:
         self.admission = list(admission or [])
         self.authenticator = authenticator
         self.authorizer = authorizer
+        # versioned-conversion scheme: wire objects carrying an apiVersion
+        # other than v1 are converted at the codec boundary (runtime.Scheme)
+        from ..api.versioning import default_scheme
+
+        self.scheme = default_scheme()
         self._http: ThreadingHTTPServer | None = None
         self.port = 0
 
@@ -205,6 +210,15 @@ class APIServer:
                 try:
                     if key:
                         obj = server.store.get(kind, key)
+                        want_version = query.get("apiVersion", "")
+                        if want_version not in ("", "v1"):
+                            self._send_json(
+                                200,
+                                server.scheme.encode_versioned(
+                                    obj, want_version
+                                ),
+                            )
+                            return
                         self._send_json(200, encode(obj))
                     elif query.get("watch"):
                         self._serve_watch(kind, int(query.get("resourceVersion", 0)))
@@ -220,6 +234,8 @@ class APIServer:
                 except CompactedError as e:
                     # etcd compaction → 410 Gone ("Expired"): client relists
                     self._error(410, "Expired", str(e))
+                except ValueError as e:
+                    self._error(400, "BadRequest", str(e))
 
             def _serve_watch(self, kind: str, from_revision: int) -> None:
                 watch = server.store.watch(kind, from_revision=from_revision)
@@ -297,8 +313,17 @@ class APIServer:
                         server.store.update(pod, check_version=False)
                         self._send_json(201, {"status": "Success"})
                         return
-                    cls = kind_class(kind)
-                    obj = decode(body, cls)
+                    if body.get("apiVersion", "") not in ("", "v1"):
+                        obj = server.scheme.decode_versioned(body)
+                        if obj.kind != kind:
+                            # authz ran against the URL kind; a body of a
+                            # different kind would bypass it
+                            self._error(400, "BadRequest",
+                                        f"body kind {obj.kind!r} != URL "
+                                        f"kind {kind!r}")
+                            return
+                    else:
+                        obj = decode(body, kind_class(kind))
                     if key and obj.meta.key != key:
                         self._error(
                             400, "BadRequest",
@@ -330,8 +355,15 @@ class APIServer:
                 if not self._authorized("update", kind, key):
                     return
                 try:
-                    cls = kind_class(kind)
-                    obj = decode(body, cls)
+                    if body.get("apiVersion", "") not in ("", "v1"):
+                        obj = server.scheme.decode_versioned(body)
+                        if obj.kind != kind:
+                            self._error(400, "BadRequest",
+                                        f"body kind {obj.kind!r} != URL "
+                                        f"kind {kind!r}")
+                            return
+                    else:
+                        obj = decode(body, kind_class(kind))
                     if obj.meta.key != key:
                         # the authz decision above was made against the URL
                         # key; a body naming a different object would bypass

@@ -64,8 +64,11 @@ class ClusterBootstrap:
                                        ("system:masters",)),
             })
             authz = RBACAuthorizer(self.store)
-        self.apiserver = APIServer(self.store, authenticator=authn,
-                                   authorizer=authz)
+        from ..apiserver.admission import default_admission_chain
+
+        self.apiserver = APIServer(self.store,
+                                   admission=default_admission_chain(self.store),
+                                   authenticator=authn, authorizer=authz)
         self.apiserver.serve(serve_port)
         from ..scheduler import Profile
 

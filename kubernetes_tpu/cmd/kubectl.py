@@ -43,10 +43,10 @@ def _kind(resource: str) -> str:
 
 
 def _key(kind: str, name: str, namespace: str) -> str:
-    cluster_scoped = kind in ("Node", "PersistentVolume", "StorageClass",
-                              "CSINode", "ResourceSlice", "DeviceClass",
-                              "Namespace")
-    return name if cluster_scoped else f"{namespace}/{name}"
+    # one source of truth for scoping (discovery.CLUSTER_SCOPED)
+    from ..apiserver.discovery import CLUSTER_SCOPED
+
+    return name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
 
 
 def _status_of(obj) -> str:
@@ -190,16 +190,13 @@ def cmd_drain(client: RESTStore, args) -> int:
         if not pods:
             print(f"node/{args.name} drained")
             return 0
+        pdbs = list(client.iter_kind("PodDisruptionBudget"))  # once per round
         blocked = []
         for pod in pods:
-            pdb = _pdb_for(client, pod)
-            if pdb is not None and pdb.status.disruptions_allowed <= 0:
+            pdb = _pdb_for(pdbs, pod)
+            if pdb is not None and not _consume_disruption(client, pdb, pod):
                 blocked.append(pod.meta.key)
                 continue
-            if pdb is not None:
-                pdb.status.disruptions_allowed -= 1
-                pdb.status.disrupted_pods[pod.meta.name] = _time.time()
-                client.update(pdb, check_version=False)
             client.delete("Pod", pod.meta.key)
             print(f"evicting pod {pod.meta.key}")
         if _time.monotonic() >= deadline:
@@ -218,16 +215,38 @@ def cmd_drain(client: RESTStore, args) -> int:
         _time.sleep(args.poll)
 
 
-def _pdb_for(client: RESTStore, pod):
+def _pdb_for(pdbs, pod):
     from ..api.labels import matches_selector
 
-    for pdb in client.iter_kind("PodDisruptionBudget"):
+    for pdb in pdbs:
         if pdb.meta.namespace != pod.meta.namespace:
             continue
         sel = pdb.spec.selector
         if sel is not None and matches_selector(sel, pod.meta.labels):
             return pdb
     return None
+
+
+def _consume_disruption(client: RESTStore, pdb, pod, retries: int = 3) -> bool:
+    """Atomically take one disruption from the budget: versioned
+    compare-and-swap with retry, so concurrent drains (or the disruption
+    controller) can't both spend the last allowed disruption — the
+    client-side analogue of the server-side Eviction subresource."""
+    import time as _time
+
+    from ..store.store import ConflictError
+
+    for _ in range(retries):
+        if pdb.status.disruptions_allowed <= 0:
+            return False
+        pdb.status.disruptions_allowed -= 1
+        pdb.status.disrupted_pods[pod.meta.name] = _time.time()
+        try:
+            client.update(pdb)  # CAS on resourceVersion
+            return True
+        except ConflictError:
+            pdb = client.get("PodDisruptionBudget", pdb.meta.key)
+    return False
 
 
 def cmd_events(client: RESTStore, args) -> int:

@@ -72,6 +72,11 @@ class SchedulerServer:
         self._http: ThreadingHTTPServer | None = None
         self._threads: list[threading.Thread] = []
         self.started = False
+        import time as _time
+
+        self.start_time = _time.time()
+        self.start_mono = _time.monotonic()
+        self.flags: dict = {}  # effective flags, filled by main()
 
     # -- serving mux (server.go:367-390) -------------------------------------
 
@@ -106,6 +111,23 @@ class SchedulerServer:
                         "featureGates": server.feature_gates.as_map(),
                         "profiles": [p.scheduler_name for p in server.config.profiles],
                     }), "application/json")
+                elif self.path == "/statusz":
+                    # component-base/zpages/statusz: liveness + identity
+                    import time as _time
+
+                    self._send(200, json.dumps({
+                        "component": "tpu-scheduler",
+                        "startTime": server.start_time,
+                        "uptimeSeconds": round(
+                            _time.monotonic() - server.start_mono, 1
+                        ),
+                        "leader": (server.elector is None
+                                   or server.elector.is_leader()),
+                    }), "application/json")
+                elif self.path == "/flagz":
+                    # component-base/zpages/flagz: effective flag values
+                    self._send(200, json.dumps(server.flags),
+                               "application/json")
                 else:
                     self._send(404, "not found")
 
@@ -211,6 +233,7 @@ def main(argv: list[str] | None = None) -> int:
         config.leader_election.leader_elect = True
     config.health_bind_port = args.port
     server = SchedulerServer(Store(), config)
+    server.flags = {k: v for k, v in vars(args).items()}
     server.run(block=True)
     return 0
 

@@ -78,6 +78,9 @@ def configure(fmt: str = "text", stream=None, verbosity_level: int = 0) -> None:
         )
         root.addHandler(handler)
         root.setLevel(logging.INFO)
+        # never double-emit through root-logger handlers (basicConfig,
+        # pytest's capture handler, ...) — the backend owns the format
+        root.propagate = False
 
 
 class StructuredLogger:

@@ -102,3 +102,93 @@ class TestClusterBootstrap:
             assert boot.client().get("Node", "late-joiner") is not None
         finally:
             boot.shutdown()
+
+
+class TestAdmissionChain:
+    def test_priority_class_resolution(self):
+        import pytest
+
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.types import PriorityClass
+        from kubernetes_tpu.client.rest import RESTError
+        from tests.wrappers import make_pod
+
+        boot = ClusterBootstrap(nodes=1, clock=FakeClock())
+        boot.init()
+        try:
+            client = boot.client()
+            client.create(PriorityClass(
+                meta=ObjectMeta(name="critical", namespace=""), value=10000,
+            ))
+            client.create(PriorityClass(
+                meta=ObjectMeta(name="bulk", namespace=""), value=-10,
+                global_default=True,
+            ))
+            pod = make_pod("vip")
+            pod.spec.priority_class_name = "critical"
+            created = client.create(pod)
+            assert created.spec.priority == 10000
+            # global default applies when no class is named
+            anon = client.create(make_pod("anon"))
+            assert anon.spec.priority == -10
+            assert anon.spec.priority_class_name == "bulk"
+            # unknown class rejected
+            bad = make_pod("bad")
+            bad.spec.priority_class_name = "nope"
+            with pytest.raises(RESTError) as exc:
+                client.create(bad)
+            assert exc.value.code == 422
+        finally:
+            boot.shutdown()
+
+    def test_terminating_namespace_rejects_creates(self):
+        import pytest
+
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.workloads import Namespace
+        from kubernetes_tpu.client.rest import RESTError
+        from tests.wrappers import make_pod
+
+        boot = ClusterBootstrap(nodes=1, clock=FakeClock())
+        boot.init()
+        try:
+            client = boot.client()
+            boot.store.create(Namespace(
+                meta=ObjectMeta(name="doomed", namespace="")))
+            ns = boot.store.get("Namespace", "doomed")
+            ns.meta.deletion_timestamp = 1.0
+            boot.store.update(ns, check_version=False)
+            pod = make_pod("late")
+            pod.meta.namespace = "doomed"
+            with pytest.raises(RESTError) as exc:
+                client.create(pod)
+            assert exc.value.code == 403
+        finally:
+            boot.shutdown()
+
+
+class TestZPages:
+    def test_statusz_and_flagz(self):
+        import json
+        import urllib.request
+
+        from kubernetes_tpu.cmd.scheduler import SchedulerServer
+        from kubernetes_tpu.config.types import SchedulerConfiguration
+        from kubernetes_tpu.store import Store
+
+        server = SchedulerServer(Store(), SchedulerConfiguration())
+        server.flags = {"v": 2, "backend": "tpu"}
+        port = server.serve(0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz"
+            ) as r:
+                st = json.loads(r.read())
+            assert st["component"] == "tpu-scheduler"
+            assert st["uptimeSeconds"] >= 0
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/flagz"
+            ) as r:
+                assert json.loads(r.read())["backend"] == "tpu"
+        finally:
+            server.shutdown()
