@@ -476,6 +476,26 @@ class WorkloadExecutor:
             self.store.create(pod_from_manifest(template, f"churn-pod-{i}"))
         self._barrier()
 
+    def _op_deletePods(self, op: dict) -> None:
+        """deletePods op (scheduler_perf.go): delete pods matching a label
+        selector (or the oldest N scheduled pods), driving the queueing-hint
+        requeue path — deletes free resources, AssignedPodDelete events
+        must un-block pending pods."""
+        n = self._count(op) or 0
+        selector = op.get("labelSelector") or {}
+        # scheduled pods only (churn-op filter): deleting pending pods
+        # frees nothing and silently shrinks the measured set
+        pods = [
+            p for p in self.store.pods()
+            if p.spec.node_name
+            and all(p.meta.labels.get(k) == v for k, v in selector.items())
+        ]
+        if n:
+            pods = pods[:n]
+        for p in pods:
+            self.store.delete("Pod", p.meta.key)
+        self.scheduler.pump()
+
     def _op_barrier(self, op: dict) -> None:
         self._barrier()
 
@@ -490,11 +510,29 @@ class WorkloadExecutor:
 
     # -- helpers -------------------------------------------------------------
 
-    def _barrier(self, wait_all: bool = True) -> None:
+    def _barrier(self, wait_all: bool = True, timeout: float = 30.0) -> None:
         """operations.go barrier:498-537 — wait until every pending pod got a
-        scheduling attempt and bindings landed."""
-        self.scheduler.schedule_pending()
-        self.collector.pump()
+        scheduling attempt and bindings landed. Pods parked in the backoffQ
+        still count as pending (their expiry is wall-clock): the barrier
+        rides through backoff windows instead of declaring the queue drained
+        the moment activeQ goes empty."""
+        deadline = time.monotonic() + timeout
+        while True:
+            self.scheduler.schedule_pending()
+            self.collector.pump()
+            if not wait_all:
+                return  # skipWaitToCompletion: one pass, no drain
+            active, backoff, _unsched = self.scheduler.queue.pending_pods()
+            if active == 0 and backoff == 0:
+                return
+            if time.monotonic() >= deadline:
+                # the reference barrier FAILS the run on timeout
+                # (operations.go); returning quietly would ship hangs
+                raise TimeoutError(
+                    f"barrier: {active} active + {backoff} backoff pods "
+                    f"still pending after {timeout}s"
+                )
+            time.sleep(0.02)
 
     def _start_collecting(self) -> None:
         self._collecting = True

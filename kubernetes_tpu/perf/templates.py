@@ -149,7 +149,9 @@ def pod_from_manifest(manifest: dict, name: str, namespace: str = "default") -> 
     )
     return Pod(
         meta=ObjectMeta(
-            name=name, namespace=namespace, labels=dict(meta_m.get("labels", {}))
+            name=name, namespace=namespace,
+            labels=dict(meta_m.get("labels", {})),
+            annotations=dict(meta_m.get("annotations", {})),
         ),
         spec=PodSpec(
             containers=containers,
@@ -182,5 +184,8 @@ def node_from_manifest(manifest: dict, name: str, zone: str | None = None) -> No
     return Node(
         meta=ObjectMeta(name=name, namespace="", labels=labels),
         spec=NodeSpec(unschedulable=spec_m.get("unschedulable", False), taints=taints),
-        status=NodeStatus(capacity=dict(alloc), allocatable=alloc),
+        status=NodeStatus(
+            capacity=dict(alloc), allocatable=alloc,
+            declared_features=tuple(status_m.get("declaredFeatures", ())),
+        ),
     )
