@@ -67,7 +67,11 @@ class WorkQueue:
                     self._queue.append(item)
 
     def get(self, timeout: float | None = None) -> Hashable | None:
-        deadline = None if timeout is None else self._clock() + timeout
+        # the timeout is a LIVENESS bound for the calling worker loop: it
+        # must tick on wall clock even when the queue's own clock is an
+        # injected fake (a frozen clock would otherwise trap the caller in
+        # here forever, deaf to its stop event)
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._mu:
             while True:
                 self._flush_delayed_locked()
@@ -82,11 +86,13 @@ class WorkQueue:
                 if self._delayed:
                     wait = max(0.0, self._delayed[0][0] - self._clock())
                 if deadline is not None:
-                    remaining = deadline - self._clock()
+                    remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None
                     wait = remaining if wait is None else min(wait, remaining)
-                self._mu.wait(wait if wait is not None else 0.05)
+                # cap: delayed-expiry waits computed on a fake clock are
+                # not real durations — stay responsive regardless
+                self._mu.wait(min(wait, 0.05) if wait is not None else 0.05)
 
     def done(self, item: Hashable) -> None:
         with self._mu:

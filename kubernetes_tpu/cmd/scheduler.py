@@ -124,6 +124,19 @@ class SchedulerServer:
                         "leader": (server.elector is None
                                    or server.elector.is_leader()),
                     }), "application/json")
+                elif self.path.startswith("/debug/pprof/profile"):
+                    # sampling CPU profile (routes.Profiling, server.go:390)
+                    from urllib.parse import parse_qs, urlparse
+
+                    from ..utils.pprof import take_profile
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        secs = min(float(q.get("seconds", ["1"])[0]), 30.0)
+                    except ValueError:
+                        self._send(400, "seconds must be a number")
+                        return
+                    self._send(200, take_profile(seconds=secs))
                 elif self.path == "/flagz":
                     # component-base/zpages/flagz: effective flag values
                     self._send(200, json.dumps(server.flags),

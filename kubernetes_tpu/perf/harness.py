@@ -465,36 +465,38 @@ class WorkloadExecutor:
     def _op_churn(self, op: dict) -> None:
         """churn op: delete + recreate pods to stress event handling."""
         n = self._count(op) or 10
-        pods = [p for p in self.store.pods() if p.spec.node_name][:n]
-        for p in pods:
-            self.store.delete("Pod", p.meta.key)
-        self.scheduler.pump()
+        deleted = self._delete_scheduled(n)
         template = op.get("podTemplate", self.pod_template)
-        for _ in range(len(pods)):
+        for _ in range(deleted):
             i = self._pod_seq
             self._pod_seq += 1
             self.store.create(pod_from_manifest(template, f"churn-pod-{i}"))
         self._barrier()
 
-    def _op_deletePods(self, op: dict) -> None:
-        """deletePods op (scheduler_perf.go): delete pods matching a label
-        selector (or the oldest N scheduled pods), driving the queueing-hint
-        requeue path — deletes free resources, AssignedPodDelete events
-        must un-block pending pods."""
-        n = self._count(op) or 0
-        selector = op.get("labelSelector") or {}
-        # scheduled pods only (churn-op filter): deleting pending pods
-        # frees nothing and silently shrinks the measured set
+    def _delete_scheduled(self, n: int, selector: dict | None = None) -> int:
+        """Delete up to n SCHEDULED pods matching selector; returns count.
+        Shared by churn and deletePods — deleting pending pods frees
+        nothing and shrinks the measured set."""
         pods = [
             p for p in self.store.pods()
             if p.spec.node_name
-            and all(p.meta.labels.get(k) == v for k, v in selector.items())
+            and all(p.meta.labels.get(k) == v
+                    for k, v in (selector or {}).items())
         ]
         if n:
             pods = pods[:n]
         for p in pods:
             self.store.delete("Pod", p.meta.key)
         self.scheduler.pump()
+        return len(pods)
+
+    def _op_deletePods(self, op: dict) -> None:
+        """deletePods op (scheduler_perf.go): delete pods matching a label
+        selector (or the oldest N scheduled pods), driving the queueing-hint
+        requeue path — deletes free resources, AssignedPodDelete events
+        must un-block pending pods."""
+        self._delete_scheduled(self._count(op) or 0,
+                               op.get("labelSelector") or {})
 
     def _op_barrier(self, op: dict) -> None:
         self._barrier()

@@ -1,0 +1,38 @@
+"""Thread-leak detection for tests: the goleak role.
+
+Reference: test/integration/framework/goleak.go wraps goleak.VerifyNone so
+integration suites fail when a component leaks goroutines past shutdown
+(used at scheduler_perf.go:693). Threads are our goroutines: the context
+manager snapshots live threads on entry and asserts every thread started
+inside the block terminated by exit (after a grace period for daemon
+threads still winding down).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+@contextmanager
+def assert_no_thread_leaks(grace_s: float = 2.0, allow: tuple[str, ...] = ()):
+    """Fail if threads created inside the block outlive it. `allow` names
+    thread-name prefixes to ignore (goleak's IgnoreTopFunction)."""
+    before = set(threading.enumerate())
+    yield
+    deadline = time.monotonic() + grace_s
+    while True:
+        leaked = [
+            t for t in threading.enumerate()
+            if t not in before and t.is_alive()
+            and not any(t.name.startswith(p) for p in allow)
+        ]
+        if not leaked:
+            return
+        if time.monotonic() >= deadline:
+            names = ", ".join(f"{t.name} (daemon={t.daemon})" for t in leaked)
+            raise AssertionError(
+                f"{len(leaked)} thread(s) leaked past shutdown: {names}"
+            )
+        time.sleep(0.01)

@@ -205,3 +205,69 @@ class TestStructuredLogging:
             assert "Scheduled" in out and 'pod="a/b"' in out and 'node="n9"' in out
         finally:
             klog.configure(fmt="text", verbosity_level=0)
+
+
+class TestPprofProfile:
+    def test_sampling_profile_endpoint(self):
+        import threading
+        import urllib.request
+
+        from kubernetes_tpu.cmd.scheduler import SchedulerServer
+        from kubernetes_tpu.config.types import SchedulerConfiguration
+        from kubernetes_tpu.store import Store
+
+        server = SchedulerServer(Store(), SchedulerConfiguration())
+        port = server.serve(0)
+        stop = threading.Event()
+
+        def burn():  # a busy thread the sampler should catch
+            while not stop.is_set():
+                sum(i * i for i in range(1000))
+
+        t = threading.Thread(target=burn, daemon=True, name="burner")
+        t.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/pprof/profile?seconds=0.3"
+            ) as r:
+                body = r.read().decode()
+            assert "sampling profile:" in body
+            assert "burn" in body  # the hot function shows up
+        finally:
+            stop.set()
+            t.join(timeout=2)
+            server.shutdown()
+
+
+class TestGoleak:
+    def test_detects_leak_and_passes_clean(self):
+        import threading
+        import time
+
+        import pytest
+
+        from kubernetes_tpu.testing.goleak import assert_no_thread_leaks
+
+        # clean case: thread ends inside the block
+        with assert_no_thread_leaks():
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join()
+        # leak case: long-lived thread survives the block
+        stop = threading.Event()
+        with pytest.raises(AssertionError, match="leaked"):
+            with assert_no_thread_leaks(grace_s=0.2):
+                threading.Thread(target=stop.wait, daemon=True,
+                                 name="leaker").start()
+        stop.set()
+
+    def test_bootstrap_shuts_down_clean(self):
+        from kubernetes_tpu.cmd.bootstrap import ClusterBootstrap
+        from kubernetes_tpu.testing.goleak import assert_no_thread_leaks
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        with assert_no_thread_leaks(grace_s=3.0):
+            boot = ClusterBootstrap(nodes=2, clock=FakeClock())
+            boot.init()
+            boot.run()
+            boot.shutdown()
