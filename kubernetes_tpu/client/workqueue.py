@@ -90,9 +90,12 @@ class WorkQueue:
                     if remaining <= 0:
                         return None
                     wait = remaining if wait is None else min(wait, remaining)
-                # cap: delayed-expiry waits computed on a fake clock are
-                # not real durations — stay responsive regardless
-                self._mu.wait(min(wait, 0.05) if wait is not None else 0.05)
+                # fake-clock intervals aren't real durations — cap so the
+                # caller stays responsive; with the real clock the wait is
+                # event-driven (woken by add/notify), no polling
+                if self._clock is not time.monotonic and wait is not None:
+                    wait = min(wait, 0.05)
+                self._mu.wait(wait)
 
     def done(self, item: Hashable) -> None:
         with self._mu:

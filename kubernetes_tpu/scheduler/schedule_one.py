@@ -449,7 +449,7 @@ class ScheduleOneLoop:
             eligible = (
                 isinstance(algo, TPUSchedulingAlgorithm)
                 and pod.spec.scheduling_group is None
-                and not algo._must_fall_back(pod)
+                and algo.wave_eligible(pod)
                 and (wave_algo is None or algo is wave_algo)
             )
             if not eligible:

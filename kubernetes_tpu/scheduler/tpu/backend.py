@@ -87,6 +87,22 @@ def advance_rng(rng, n_words: int) -> None:
     rng.setstate((version, tuple(int(x) for x in s[1]) + (int(s[2]),), gauss))
 
 
+# Plugins the dense kernel fully models. In the HYBRID path these are
+# skipped host-side (their work already happened on device) while the
+# long-tail plugins (VolumeBinding/Zone/Restrictions/Limits,
+# DynamicResources, NodeDeclaredFeatures) run on the kernel-pruned node
+# set — the "framework composes host + device plugins in one cycle" design
+# (SURVEY §7), mirroring how the reference composes in-tree plugins with
+# out-of-process extenders.
+KERNEL_FILTER_PLUGINS = frozenset({
+    "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
+    "NodePorts", "NodeResourcesFit", "PodTopologySpread", "InterPodAffinity",
+})
+KERNEL_SCORE_PLUGINS = frozenset({
+    "NodeResourcesFit", "NodeResourcesBalancedAllocation", "TaintToleration",
+    "NodeAffinity", "PodTopologySpread", "InterPodAffinity", "ImageLocality",
+})
+
 # Reconstructed host-path messages + codes per filter mask row.
 _ROW_STATUS = {
     "NodeUnschedulable": ("unresolvable", "node(s) were unschedulable"),
@@ -693,12 +709,15 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         if self._must_fall_back(pod):
             self.fallback_count += 1
             return super().schedule_pod(state, pod, snapshot)
+        hybrid = self._needs_host_compose(pod)
         try:
             planes, out = self.backend.run(pod, snapshot)
         except FallbackNeeded:
             self.fallback_count += 1
             return super().schedule_pod(state, pod, snapshot)
         self.kernel_count += 1
+        if hybrid:
+            return self._schedule_hybrid(state, pod, snapshot, planes, out)
 
         feasible_idx = np.flatnonzero(out["feasible"][: planes.n])
         if feasible_idx.size == 0:
@@ -730,18 +749,109 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
             feasible_nodes=int(feasible_idx.size),
         )
 
-    def _must_fall_back(self, pod: Pod) -> bool:
-        # long-tail volume plugins (VolumeBinding/Zone/Restrictions/Limits)
-        # run host-side only — a claim-backed pod needs the full host chain
+    def _needs_host_compose(self, pod: Pod) -> bool:
+        """Pods whose long-tail plugins (volumes, DRA, declared features)
+        must run host-side ON TOP of the kernel's dense feasibility/scores —
+        the hybrid path, not a full fallback."""
         from ...api.storage import pod_claim_names
-
-        if pod_claim_names(pod) or pod.spec.resource_claims:
-            return True
-        # NodeDeclaredFeatures isn't modeled in the kernel's filter planes
         from ..plugins.node_declared_features import infer_required_features
 
-        if infer_required_features(pod):
-            return True
+        return bool(pod_claim_names(pod) or pod.spec.resource_claims
+                    or infer_required_features(pod))
+
+    def wave_eligible(self, pod: Pod) -> bool:
+        """Only fully-kernel pods ride the batched wave (hybrid pods need
+        per-node host plugin calls the scan can't carry)."""
+        return not self._must_fall_back(pod) and not self._needs_host_compose(pod)
+
+    def _schedule_hybrid(self, state, pod: Pod, snapshot, planes,
+                         out) -> ScheduleResult:
+        """Kernel feasibility/scores ∩ host long-tail plugins.
+
+        The kernel already filtered+scored the dense plugins over every
+        node; the host chain runs ONLY the remaining plugins (skip sets) on
+        the kernel-feasible nodes, and their weighted scores add onto the
+        kernel totals. Decisions match the pure host path bit-for-bit: the
+        kernel's per-plugin math is golden-tested equal to the host
+        plugins', node order is snapshot order in both, and selection goes
+        through the same select_host rng draw."""
+        fw = self.fw
+        nodes = snapshot.list_nodes()
+        by_name = {ni.name: ni for ni in nodes}
+        pre_result, st = fw.run_pre_filter_plugins(state, pod, nodes)
+        if not st.is_success:
+            if st.is_rejected:
+                d = Diagnosis()
+                d.pre_filter_msg = st.message()
+                if st.plugin:
+                    d.unschedulable_plugins.add(st.plugin)
+                raise FitError(pod, snapshot.num_nodes(), d)
+            raise RuntimeError(f"prefilter failed: {st.reasons}")
+        allowed = None
+        if pre_result is not None and pre_result.node_names is not None:
+            allowed = set(pre_result.node_names)
+        # dense plugins already ran on device: skip their host Filter. Keep
+        # the UNPOLLUTED PreFilter skip set aside — preemption's victim
+        # dry-run re-runs the FULL host filter chain against this state
+        # (default_preemption SelectVictimsOnNode), and must not inherit
+        # kernel skips or it would evict victims for a pod that can never
+        # fit (resources/taints unchecked).
+        prefilter_skips = set(state.skip_filter_plugins)
+        state.skip_filter_plugins = prefilter_skips | set(
+            KERNEL_FILTER_PLUGINS
+        )
+        diagnosis = self.backend.build_diagnosis(pod, planes, out)
+        feasible_idx = np.flatnonzero(out["feasible"][: planes.n])
+        survivors: list[tuple[int, object]] = []
+        for i in feasible_idx:
+            name = planes.node_names[int(i)]
+            ni = by_name.get(name)
+            if ni is None:
+                continue
+            if allowed is not None and name not in allowed:
+                diagnosis.node_to_status.set(name, Status.unresolvable(
+                    "node(s) didn't satisfy plugin prefilter result"
+                ))
+                continue
+            host_st = fw.run_filter_plugins(state, pod, ni)
+            if host_st.is_success:
+                survivors.append((int(i), ni))
+            else:
+                diagnosis.node_to_status.set(name, host_st)
+                if host_st.plugin:
+                    diagnosis.unschedulable_plugins.add(host_st.plugin)
+        if not survivors:
+            state.skip_filter_plugins = prefilter_skips  # see above
+            raise FitError(pod, snapshot.num_nodes(), diagnosis)
+        node_infos = [ni for _, ni in survivors]
+        st = fw.run_pre_score_plugins(state, pod, node_infos)
+        if not st.is_success:
+            raise RuntimeError(f"prescore failed: {st.reasons}")
+        # AFTER PreScore: run_pre_score_plugins REPLACES the skip set with
+        # its own Skip returns — union the kernel-covered plugins back in
+        # or their weighted scores would be counted twice (once in the
+        # kernel total, once host-side)
+        state.skip_score_plugins = set(state.skip_score_plugins) | set(
+            KERNEL_SCORE_PLUGINS
+        )
+        host_scores, st = fw.run_score_plugins(state, pod, node_infos)
+        if not st.is_success:
+            raise RuntimeError(f"score failed: {st.reasons}")
+        from ..framework.interface import NodePluginScores
+
+        combined = []
+        for (i, ni), host in zip(survivors, host_scores):
+            total = int(out["total"][i]) + host.total_score
+            combined.append(NodePluginScores(name=ni.name, scores=host.scores,
+                                             total_score=total))
+        host_name, _ = self.select_host(combined)
+        return ScheduleResult(
+            suggested_host=host_name,
+            evaluated_nodes=planes.n,
+            feasible_nodes=len(survivors),
+        )
+
+    def _must_fall_back(self, pod: Pod) -> bool:
         # configured HTTP extenders veto/score out-of-process — host path only
         if self.extenders and any(e.is_interested(pod) for e in self.extenders):
             return True

@@ -1,0 +1,231 @@
+"""Hybrid kernel+host composition tests.
+
+Claim-backed and declared-features pods no longer fall back to the full
+host path: the kernel filters+scores the dense plugins over every node,
+and the host chain runs only the long-tail plugins (volumes, DRA,
+NodeDeclaredFeatures) on the kernel-pruned set. The contract: decisions
+are bit-identical to the pure host path, and kernel_count — not
+fallback_count — grows.
+"""
+
+import random
+
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testing.wrappers import (
+    make_node,
+    make_pod,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+    with_pvc,
+)
+
+
+def new_scheduler(store, backend):
+    s = Scheduler(store, profiles=[Profile(backend=backend)],
+                  seed=7)
+    s.start()
+    return s
+
+
+def run_both(setup):
+    """Run the same cluster+pods through host and tpu schedulers; return
+    ({pod: node} host, {pod: node} tpu, tpu scheduler)."""
+    out = []
+    scheds = []
+    for backend in ("host", "tpu"):
+        store = Store()
+        setup(store)
+        s = new_scheduler(store, backend)
+        s.schedule_pending()
+        out.append({p.meta.name: p.spec.node_name for p in store.pods()})
+        scheds.append(s)
+    return out[0], out[1], scheds[1]
+
+
+class TestHybridVolumes:
+    def test_claim_pod_uses_kernel_not_fallback(self):
+        def setup(store):
+            for i in range(6):
+                store.create(make_node(f"n{i}", cpu="8", mem="16Gi",
+                                       zone=f"z{i % 3}"))
+            store.create(make_storage_class("local",
+                                            wait_for_first_consumer=True))
+            store.create(make_pv("pv-n4", storage="10Gi",
+                                 storage_class="local", node_names=("n4",)))
+            store.create(make_pvc("data", storage="5Gi",
+                                  storage_class="local"))
+            store.create(with_pvc(make_pod("claimed", cpu="1"), "data"))
+            # plus plain pods to prove mixed workloads stay kernel-side
+            for i in range(4):
+                store.create(make_pod(f"plain-{i}", cpu="1", mem="1Gi"))
+
+        host_nodes, tpu_nodes, s = run_both(setup)
+        assert tpu_nodes == host_nodes  # bit-identical decisions
+        assert tpu_nodes["claimed"] == "n4"  # PV pinning honored
+        algo = s.algorithms["default-scheduler"]
+        assert algo.fallback_count == 0
+        assert algo.kernel_count == 5
+
+    def test_zone_conflict_composes_with_kernel_filters(self):
+        """VolumeZone (host) prunes what the kernel allowed; NodeResources
+        (kernel) prunes what VolumeZone allowed — intersection semantics."""
+        def setup(store):
+            # n0: right zone, but too small (kernel rejects)
+            n0 = make_node("n0", cpu="1", mem="1Gi", zone="z1")
+            store.create(n0)
+            # n1: big enough, wrong zone (host VolumeZone rejects)
+            store.create(make_node("n1", cpu="8", mem="16Gi", zone="z2"))
+            # n2: big enough, right zone — the only survivor
+            store.create(make_node("n2", cpu="8", mem="16Gi", zone="z1"))
+            store.create(make_storage_class("std"))
+            pv = make_pv("pv-z1", storage="10Gi", storage_class="std",
+                         zone="z1")
+            store.create(pv)
+            pvc = make_pvc("data", storage="5Gi", storage_class="std",
+                           volume_name="pv-z1")
+            store.create(pvc)
+            store.create(with_pvc(make_pod("p", cpu="4", mem="8Gi"), "data"))
+
+        host_nodes, tpu_nodes, s = run_both(setup)
+        assert tpu_nodes == host_nodes
+        assert tpu_nodes["p"] == "n2"
+        algo = s.algorithms["default-scheduler"]
+        assert algo.fallback_count == 0 and algo.kernel_count == 1
+
+
+class TestHybridDeclaredFeatures:
+    def test_ndf_pod_composes(self):
+        from kubernetes_tpu.scheduler.plugins.node_declared_features import (
+            REQUIRED_FEATURES_ANNOTATION,
+        )
+
+        def setup(store):
+            plain = make_node("plain", cpu="8", mem="16Gi")
+            store.create(plain)
+            featured = make_node("featured", cpu="8", mem="16Gi")
+            featured.status.declared_features = ("NUMAAlignment",)
+            store.create(featured)
+            pod = make_pod("needy", cpu="1")
+            pod.meta.annotations[REQUIRED_FEATURES_ANNOTATION] = "NUMAAlignment"
+            store.create(pod)
+
+        host_nodes, tpu_nodes, s = run_both(setup)
+        assert tpu_nodes == host_nodes
+        assert tpu_nodes["needy"] == "featured"
+        algo = s.algorithms["default-scheduler"]
+        assert algo.fallback_count == 0 and algo.kernel_count == 1
+
+    def test_unsatisfiable_ndf_pod_gets_fit_error_diagnosis(self):
+        from kubernetes_tpu.scheduler.plugins.node_declared_features import (
+            REQUIRED_FEATURES_ANNOTATION,
+        )
+
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        pod = make_pod("needy", cpu="1")
+        pod.meta.annotations[REQUIRED_FEATURES_ANNOTATION] = "Quantum"
+        store.create(pod)
+        s = new_scheduler(store, "tpu")
+        s.schedule_pending()
+        got = store.get("Pod", "default/needy")
+        assert not got.spec.node_name
+        conds = [c for c in got.status.conditions if c.type == "PodScheduled"]
+        assert conds and conds[0].reason == "Unschedulable"
+
+
+class TestWaveSkipsHybridPods:
+    def test_mixed_wave_keeps_order_and_schedules_all(self):
+        """A hybrid pod inside a wave run must not be batched; everything
+        still schedules and plain pods still ride the kernel."""
+        store = Store()
+        for i in range(8):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi",
+                                   zone=f"z{i % 2}"))
+        store.create(make_storage_class("std"))
+        store.create(make_pv("pv0", storage="10Gi", storage_class="std"))
+        store.create(make_pvc("data", storage="5Gi", storage_class="std",
+                              volume_name="pv0"))
+        for i in range(5):
+            store.create(make_pod(f"a{i}", cpu="1"))
+        store.create(with_pvc(make_pod("mid-claim", cpu="1"), "data"))
+        for i in range(5):
+            store.create(make_pod(f"b{i}", cpu="1"))
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=4)],
+                      seed=7)
+        s.start()
+        s.schedule_pending()
+        pods = {p.meta.name: p.spec.node_name for p in store.pods()}
+        assert all(pods.values()), pods
+        algo = s.algorithms["default-scheduler"]
+        assert algo.fallback_count == 0
+
+
+class TestHybridScoreIsolation:
+    def test_host_score_pass_excludes_kernel_plugins(self, monkeypatch):
+        """The dense plugins' scores live in the kernel total; the host
+        score pass must not re-run them (double-count regression)."""
+        from kubernetes_tpu.scheduler.framework.runtime import Framework
+        from kubernetes_tpu.scheduler.tpu.backend import KERNEL_SCORE_PLUGINS
+
+        captured = []
+        orig = Framework.run_score_plugins
+
+        def spy(self, state, pod, nodes):
+            scores, st = orig(self, state, pod, nodes)
+            captured.append(scores)
+            return scores, st
+
+        monkeypatch.setattr(Framework, "run_score_plugins", spy)
+        store = Store()
+        # asymmetric utilization so kernel scores genuinely differ per node
+        for i in range(4):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        filler = make_pod("filler", cpu="6", mem="12Gi")
+        filler.spec.node_name = "n0"
+        store.create(filler)
+        store.create(make_storage_class("std"))
+        store.create(make_pv("pv0", storage="10Gi", storage_class="std"))
+        store.create(make_pvc("data", storage="5Gi", storage_class="std",
+                              volume_name="pv0"))
+        store.create(with_pvc(make_pod("claimed", cpu="1"), "data"))
+        s = new_scheduler(store, "tpu")
+        s.schedule_pending()
+        assert store.get("Pod", "default/claimed").spec.node_name
+        assert captured, "hybrid path did not run host scoring"
+        for scores in captured:
+            for nps in scores:
+                for plugin, _ in nps.scores:
+                    assert plugin not in KERNEL_SCORE_PLUGINS, (
+                        f"{plugin} double-counted host-side"
+                    )
+
+
+class TestHybridPreemptionState:
+    def test_unsatisfiable_hybrid_pod_does_not_evict(self):
+        """FitError from the hybrid path must leave the cycle state fit for
+        preemption's dry-run: a pod too big for EVERY node gains nothing
+        from eviction, so no victim may be deleted and nothing nominated
+        (skip-set pollution would make the dry-run ignore resources)."""
+        store = Store()
+        store.create(make_node("n0", cpu="4", mem="8Gi"))
+        victim = make_pod("victim", cpu="1", mem="1Gi")
+        victim.spec.node_name = "n0"
+        victim.spec.priority = 0
+        store.create(victim)
+        store.create(make_storage_class("std"))
+        store.create(make_pv("pv0", storage="10Gi", storage_class="std"))
+        store.create(make_pvc("data", storage="5Gi", storage_class="std",
+                              volume_name="pv0"))
+        giant = with_pvc(make_pod("giant", cpu="32", mem="64Gi"), "data")
+        giant.spec.priority = 1000
+        store.create(giant)
+        s = new_scheduler(store, "tpu")
+        s.schedule_pending()
+        assert store.try_get("Pod", "default/victim") is not None, (
+            "victim evicted for a pod that can never fit"
+        )
+        giant = store.get("Pod", "default/giant")
+        assert not giant.spec.node_name
+        assert not giant.status.nominated_node_name
