@@ -750,14 +750,20 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         )
 
     def _needs_host_compose(self, pod: Pod) -> bool:
-        """Pods whose long-tail plugins (volumes, DRA, declared features)
-        must run host-side ON TOP of the kernel's dense feasibility/scores —
-        the hybrid path, not a full fallback."""
+        """Pods whose long-tail stages (volume plugins, DRA, declared
+        features, HTTP extenders) must run host-side ON TOP of the kernel's
+        dense feasibility/scores — the hybrid path, not a full fallback."""
         from ...api.storage import pod_claim_names
         from ..plugins.node_declared_features import infer_required_features
 
-        return bool(pod_claim_names(pod) or pod.spec.resource_claims
-                    or infer_required_features(pod))
+        if pod_claim_names(pod) or pod.spec.resource_claims:
+            return True
+        if infer_required_features(pod):
+            return True
+        # extenders ride on the feasible set exactly as in the host path
+        # (filter after in-tree, prioritize added to totals)
+        return bool(self.extenders
+                    and any(e.is_interested(pod) for e in self.extenders))
 
     def wave_eligible(self, pod: Pod) -> bool:
         """Only fully-kernel pods ride the batched wave (hybrid pods need
@@ -820,6 +826,20 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
                 diagnosis.node_to_status.set(name, host_st)
                 if host_st.plugin:
                     diagnosis.unschedulable_plugins.add(host_st.plugin)
+        if survivors and self.extenders:
+            # extenders prune AFTER in-tree filters (findNodesThatPass-
+            # Extenders, schedule_one.go:890) — same position here, on the
+            # kernel∩host-feasible set
+            from ..extender import find_nodes_that_pass_extenders
+
+            interested = [e for e in self.extenders if e.is_interested(pod)]
+            if interested:
+                kept = find_nodes_that_pass_extenders(
+                    interested, pod, [ni for _, ni in survivors], diagnosis
+                )
+                kept_names = {ni.name for ni in kept}
+                survivors = [(i, ni) for i, ni in survivors
+                             if ni.name in kept_names]
         if not survivors:
             state.skip_filter_plugins = prefilter_skips  # see above
             raise FitError(pod, snapshot.num_nodes(), diagnosis)
@@ -839,9 +859,15 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
             raise RuntimeError(f"score failed: {st.reasons}")
         from ..framework.interface import NodePluginScores
 
+        ext_bonus: dict[str, int] = {}
+        if self.extenders:
+            from ..extender import extender_scores
+
+            ext_bonus = extender_scores(self.extenders, pod, node_infos) or {}
         combined = []
         for (i, ni), host in zip(survivors, host_scores):
-            total = int(out["total"][i]) + host.total_score
+            total = (int(out["total"][i]) + host.total_score
+                     + ext_bonus.get(ni.name, 0))
             combined.append(NodePluginScores(name=ni.name, scores=host.scores,
                                              total_score=total))
         host_name, _ = self.select_host(combined)
@@ -852,9 +878,6 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         )
 
     def _must_fall_back(self, pod: Pod) -> bool:
-        # configured HTTP extenders veto/score out-of-process — host path only
-        if self.extenders and any(e.is_interested(pod) for e in self.extenders):
-            return True
         # preemption aftermath: nominated pods must be simulated onto nodes
         # during filtering — but ONLY nominated pods with priority >= the
         # incoming pod's matter (schedule_one.go:1190 addNominatedPods), so

@@ -10,7 +10,7 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import pytest
 
-from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler import Profile, Scheduler
 from kubernetes_tpu.scheduler.extender import ExtenderConfig
 from kubernetes_tpu.store import Store
 from tests.wrappers import make_node, make_pod
@@ -151,3 +151,40 @@ def test_managed_resources_interest(extender_server):
     s.schedule_pending()
     assert node_of(store, "plain") == "n1"  # extender not interested
     assert node_of(store, "special") == ""  # extender rejected every node
+
+
+def test_extender_composes_with_tpu_backend(extender_server):
+    """Extender-interested pods ride the HYBRID path: kernel feasibility,
+    extender filter/prioritize on top — same decisions as the host path."""
+    url, handler = extender_server
+    handler.behavior["filter"] = lambda args: {
+        "nodenames": [n for n in args.get("nodenames", []) if n != "n1"]
+    }
+    handler.behavior["prioritize"] = lambda args: [
+        {"host": n, "score": 10 if n == "n3" else 0}
+        for n in args.get("nodenames", [])
+    ]
+    results = {}
+    for backend in ("host", "tpu"):
+        handler.calls.clear()
+        store = Store()
+        for i in range(1, 4):
+            store.create(make_node(f"n{i}"))
+        store.create(make_pod("p1", cpu="1"))
+        s = new_scheduler(
+            store,
+            profiles=[Profile(backend=backend)],
+            extenders=[ExtenderConfig(
+                url_prefix=url, filter_verb="filter",
+                prioritize_verb="prioritize", weight=5,
+                node_cache_capable=True)],
+        )
+        assert s.schedule_pending() == 1
+        results[backend] = node_of(store, "p1")
+        if backend == "tpu":
+            algo = s.algorithms["default-scheduler"]
+            assert algo.fallback_count == 0  # hybrid, not fallback
+            assert algo.kernel_count == 1
+            assert any(v == "filter" for v, _ in handler.calls)
+            assert any(v == "prioritize" for v, _ in handler.calls)
+    assert results["tpu"] == results["host"] == "n3"
