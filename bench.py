@@ -36,6 +36,36 @@ def main() -> None:
 
     enable_persistent_cache()  # defaults near the repo; env knob still wins
 
+    # device watchdog: a wedged accelerator tunnel hangs jax backend init
+    # forever — surface an error line instead of a silent hang
+    import threading
+
+    probe_done = threading.Event()
+    probe_err: list[str] = []
+
+    def probe():
+        try:
+            import jax
+
+            jax.devices()
+        except Exception as e:  # noqa: BLE001 - reported, not swallowed
+            probe_err.append(f"{type(e).__name__}: {e}")
+        finally:
+            probe_done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    timed_out = not probe_done.wait(timeout=float(os.environ.get(
+        "BENCH_DEVICE_TIMEOUT_S", "300")))
+    if timed_out or probe_err:
+        print(json.dumps({
+            "metric": "full_pipeline_scheduling_throughput_5k_nodes",
+            "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+            "error": ("accelerator unreachable (device init timed out)"
+                      if timed_out else probe_err[0]),
+        }))
+        sys.exit(1)
+
     from kubernetes_tpu.perf.harness import WorkloadExecutor, load_config
 
     cases = load_config(os.path.join(base, "kubernetes_tpu/perf/configs/misc.yaml"))

@@ -163,6 +163,35 @@ class Job:
     kind = "Job"
 
 
+@dataclass
+class CronJobSpec:
+    """batch/v1 CronJobSpec subset: 5-field cron schedule + concurrency
+    policy + history limits."""
+
+    schedule: str = "* * * * *"
+    job_template: JobSpec = field(default_factory=JobSpec)
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    suspend: bool = False
+    successful_jobs_history_limit: int = 3
+    failed_jobs_history_limit: int = 1
+    starting_deadline_seconds: int | None = None
+
+
+@dataclass
+class CronJobStatus:
+    last_schedule_time: float | None = None
+    active: tuple[str, ...] = ()  # job keys
+
+
+@dataclass
+class CronJob:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CronJobSpec = field(default_factory=CronJobSpec)
+    status: CronJobStatus = field(default_factory=CronJobStatus)
+
+    kind = "CronJob"
+
+
 # --- core/v1 Service + discovery/v1 EndpointSlice ---------------------------
 
 

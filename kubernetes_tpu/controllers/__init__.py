@@ -1,6 +1,7 @@
 """Controller manager layer (cmd/kube-controller-manager + pkg/controller)."""
 
 from .base import Controller, ControllerManager
+from .cronjob import CronJobController
 from .disruption import DisruptionController
 from .lifecycle import (
     EndpointSliceController,
@@ -39,11 +40,13 @@ def default_controllers(store, clock=None) -> list[Controller]:
         DaemonSetController(store, informers),
         NamespaceController(store, informers),
         TTLAfterFinishedController(store, informers, clock=clock),
+        CronJobController(store, informers, clock=clock),
     ]
 
 
 __all__ = [
-    "Controller", "ControllerManager", "DaemonSetController",
+    "Controller", "ControllerManager", "CronJobController",
+    "DaemonSetController",
     "DeploymentController", "DisruptionController",
     "EndpointSliceController", "GarbageCollector", "JobController",
     "NamespaceController", "NodeLifecycleController",
