@@ -48,7 +48,9 @@ def _parse_field(spec: str, lo: int, hi: int) -> frozenset[int]:
                 raise ValueError(f"bad cron range {part!r}")
             start, end = int(a), int(b)
         elif part.isdigit():
-            start = end = int(part)
+            start = int(part)
+            # Vixie cron: "N/step" means N..max/step, a bare "N" just N
+            end = hi if step > 1 else start
         else:
             raise ValueError(f"unsupported cron field part {part!r}")
         if not (lo <= start <= end <= hi):
@@ -60,42 +62,67 @@ def _parse_field(spec: str, lo: int, hi: int) -> frozenset[int]:
 
 
 @functools.lru_cache(maxsize=1024)
-def _parse_schedule(schedule: str) -> tuple[frozenset[int], ...]:
+def _parse_schedule(schedule: str):
+    """→ (minute, hour, dom, month, dow sets, dom_star, dow_star)."""
     fields = schedule.split()
     if len(fields) != 5:
         raise ValueError(f"bad cron schedule {schedule!r}")
-    parsed = tuple(
+    parsed = [
         _parse_field(f, lo, hi) for f, (lo, hi) in zip(fields, _FIELD_RANGES)
-    )
+    ]
     # day-of-week: both 0 and 7 mean Sunday
     dow = set(parsed[4])
     if 7 in dow:
         dow.discard(7)
         dow.add(0)
-    return parsed[:4] + (frozenset(dow),)
+    parsed[4] = frozenset(dow)
+    # standard cron: when BOTH dom and dow are restricted, a day matches if
+    # EITHER does (Vixie + robfig/cron, which the reference controller uses)
+    dom_star = fields[2] == "*"
+    dow_star = fields[4] == "*"
+    return (*parsed, dom_star, dow_star)
+
+
+def _day_matches(dom_set, dow_set, dom_star, dow_star, tm) -> bool:
+    cron_dow = (tm.tm_wday + 1) % 7  # cron: 0=Sunday; tm_wday: 0=Monday
+    dom_ok = tm.tm_mday in dom_set
+    dow_ok = cron_dow in dow_set
+    if not dom_star and not dow_star:
+        return dom_ok or dow_ok
+    return dom_ok and dow_ok
 
 
 def cron_due(schedule: str, t: float) -> bool:
     """True when wall-clock minute `t` matches the 5-field schedule."""
-    minute, hour, dom, month, dow = _parse_schedule(schedule)
+    minute, hour, dom, month, dow, dom_star, dow_star = _parse_schedule(schedule)
     tm = _time.gmtime(t)
-    # cron day-of-week: 0=Sunday..6=Saturday; tm_wday: 0=Monday..6=Sunday
-    cron_dow = (tm.tm_wday + 1) % 7
     return (tm.tm_min in minute and tm.tm_hour in hour
-            and tm.tm_mday in dom and tm.tm_mon in month
-            and cron_dow in dow)
+            and tm.tm_mon in month
+            and _day_matches(dom, dow, dom_star, dow_star, tm))
 
 
 def next_due(schedule: str, after: float,
-             horizon_s: int = 366 * 24 * 3600) -> float | None:
-    """First minute boundary strictly after `after` matching the schedule."""
-    _parse_schedule(schedule)  # raise early on bad syntax
+             horizon_s: int = 5 * 366 * 24 * 3600) -> float | None:
+    """First minute boundary strictly after `after` matching the schedule.
+
+    Walks DAYS for the date fields and picks from the minute/hour sets
+    directly, so even a once-every-4-years schedule (Feb 29) costs a few
+    thousand iterations, not millions of per-minute gmtime calls."""
+    minute, hour, dom, month, dow, dom_star, dow_star = _parse_schedule(schedule)
+    minutes = sorted(minute)
+    hours = sorted(hour)
     t = (int(after) // 60 + 1) * 60
     end = after + horizon_s
-    while t <= end:
-        if cron_due(schedule, t):
-            return float(t)
-        t += 60
+    day_start = t - (t % 86400)
+    while day_start <= end:
+        tm = _time.gmtime(day_start)
+        if tm.tm_mon in month and _day_matches(dom, dow, dom_star, dow_star, tm):
+            for h in hours:
+                for m in minutes:
+                    cand = day_start + h * 3600 + m * 60
+                    if cand >= t:
+                        return float(cand)
+        day_start += 86400
     return None
 
 

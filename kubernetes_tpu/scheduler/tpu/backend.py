@@ -709,7 +709,8 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         if self._must_fall_back(pod):
             self.fallback_count += 1
             return super().schedule_pod(state, pod, snapshot)
-        hybrid = self._needs_host_compose(pod)
+        hybrid = (self._needs_host_compose(pod)
+                  or self._has_relevant_nominations(pod))
         try:
             planes, out = self.backend.run(pod, snapshot)
         except FallbackNeeded:
@@ -768,7 +769,20 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
     def wave_eligible(self, pod: Pod) -> bool:
         """Only fully-kernel pods ride the batched wave (hybrid pods need
         per-node host plugin calls the scan can't carry)."""
-        return not self._must_fall_back(pod) and not self._needs_host_compose(pod)
+        return (not self._must_fall_back(pod)
+                and not self._needs_host_compose(pod)
+                and not self._has_relevant_nominations(pod))
+
+    def _has_relevant_nominations(self, pod: Pod) -> bool:
+        """Any nominated pod (≥ priority) that must be simulated during
+        this pod's filtering (schedule_one.go:1190)?"""
+        if self.nominator is None:
+            return False
+        fn = getattr(self.nominator, "max_nominated_priority", None)
+        if fn is not None:
+            top = fn(exclude_key=pod.meta.key)
+            return top is not None and top >= pod.spec.priority
+        return getattr(self.nominator, "has_nominated_pods", lambda: False)()
 
     def _schedule_hybrid(self, state, pod: Pod, snapshot, planes,
                          out) -> ScheduleResult:
@@ -819,7 +833,31 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
                     "node(s) didn't satisfy plugin prefilter result"
                 ))
                 continue
-            host_st = fw.run_filter_plugins(state, pod, ni)
+            npis = self._nominated_pod_infos(pod, ni)
+            if npis:
+                # two-pass nominated treatment (schedule_one.go:1190).
+                # Pass 1 — WITH nominated pods assumed — needs the FULL
+                # chain on an unpolluted state clone: the kernel verdict
+                # didn't model the nominated pods. Pass 2 — the bare node —
+                # keeps the kernel skips: the kernel's out["feasible"]
+                # already IS the bare-node dense verdict, so only the long
+                # tail runs again.
+                state.skip_filter_plugins = prefilter_skips
+                state_clone = state.clone()
+                state.skip_filter_plugins = prefilter_skips | set(
+                    KERNEL_FILTER_PLUGINS
+                )
+                ni_with = ni.clone()
+                for npi in npis:
+                    ni_with.add_pod(npi)
+                    fw.run_pre_filter_extension_add_pod(
+                        state_clone, pod, npi, ni_with
+                    )
+                host_st = fw.run_filter_plugins(state_clone, pod, ni_with)
+                if host_st.is_success:
+                    host_st = fw.run_filter_plugins(state, pod, ni)
+            else:
+                host_st = fw.run_filter_plugins(state, pod, ni)
             if host_st.is_success:
                 survivors.append((int(i), ni))
             else:
@@ -878,20 +916,9 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         )
 
     def _must_fall_back(self, pod: Pod) -> bool:
-        # preemption aftermath: nominated pods must be simulated onto nodes
-        # during filtering — but ONLY nominated pods with priority >= the
-        # incoming pod's matter (schedule_one.go:1190 addNominatedPods), so
-        # a pod that outranks every nomination stays on the kernel path.
-        # One preemption event no longer pushes the whole queue to the
-        # sequential host path.
-        if pod.status.nominated_node_name:
-            return True
-        if self.nominator is not None:
-            fn = getattr(self.nominator, "max_nominated_priority", None)
-            if fn is not None:
-                top = fn(exclude_key=pod.meta.key)
-                if top is not None and top >= pod.spec.priority:
-                    return True
-            elif getattr(self.nominator, "has_nominated_pods", lambda: False)():
-                return True
-        return False
+        # a preemptor revisiting its own nomination takes the host path:
+        # evaluateNominatedNode's nominee-first fast path (schedule_one.go:
+        # 718) is host logic. Everything else — including OTHER pods while
+        # nominations exist — runs kernel or hybrid (nominated nodes get
+        # the host two-pass treatment inside the hybrid survivor loop).
+        return bool(pod.status.nominated_node_name)

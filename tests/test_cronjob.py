@@ -210,3 +210,36 @@ class TestSelfRequeue:
         clock.step(301)  # the delayed self-requeue is now due
         ctl.sync_once()
         assert len(list(store.iter_kind("Job"))) == 1
+
+
+class TestVixieSemantics:
+    def test_dom_dow_or_when_both_restricted(self):
+        # "0 0 1 * 1": 1st of month OR every Monday (standard cron OR rule)
+        mon = T0 + 4 * 86400  # Jan 5 2026 is a Monday, not the 1st
+        assert cron_due("0 0 1 * 1", T0)    # the 1st (a Thursday)
+        assert cron_due("0 0 1 * 1", mon)   # a Monday (not the 1st)
+        tue = T0 + 5 * 86400  # Jan 6: neither the 1st nor Monday
+        assert not cron_due("0 0 1 * 1", tue)
+        # one side star: AND semantics as usual
+        assert not cron_due("0 0 1 * *", mon)
+
+    def test_value_slash_step_runs_to_max(self):
+        # Vixie "30/10" == "30-59/10"
+        for m in (30, 40, 50):
+            assert cron_due("30/10 * * * *", T0 + m * 60)
+        assert not cron_due("30/10 * * * *", T0 + 35 * 60)
+        assert not cron_due("30/10 * * * *", T0)
+
+    def test_feb29_schedule_found_within_horizon(self):
+        # next Feb 29 after 2026-01-01 is 2028-02-29; the day-walking scan
+        # must find it (and fast)
+        import time as _t
+
+        t0 = _t.perf_counter()
+        nd = next_due("0 0 29 2 *", T0)
+        assert nd is not None
+        tm = _t.gmtime(nd) if hasattr(_t, "gmtime") else None
+        import time
+        tm = time.gmtime(nd)
+        assert (tm.tm_year, tm.tm_mon, tm.tm_mday) == (2028, 2, 29)
+        assert _t.perf_counter() - t0 < 1.0

@@ -55,20 +55,35 @@ class TestNarrowedFallback:
         # during this window — only IT may fall back, never the VIP pods
         assert algo.fallback_count - f0 <= 1
 
-    def test_lower_priority_pods_fall_back(self):
+    def test_lower_priority_pods_use_hybrid_with_protection(self):
+        """Pods a nomination outranks still ride the kernel (hybrid): the
+        nominated NODE gets the host two-pass simulation, so the
+        preemptor's freed resources are protected without pushing the pod
+        to the sequential host path."""
         store, sched = _setup()
-        _fill_and_nominate(store, sched)
+        pre = _fill_and_nominate(store, sched)
+        nominee = (pre.status.nominated_node_name
+                   or store.get("Pod", "default/preemptor")
+                   .status.nominated_node_name)
+        assert nominee
         algo = sched.algorithms["default-scheduler"]
-        f0 = algo.fallback_count
+        k0, f0 = algo.kernel_count, algo.fallback_count
         for i in range(4):
-            p = make_pod(f"low-{i}", cpu="100m", mem="64Mi")
+            # sized to fit ONLY in the preemptor's freed slot: nominated-pod
+            # protection must keep them off the nominee
+            p = make_pod(f"low-{i}", cpu="3", mem="1Gi")
             p.spec.priority = 0  # the nomination (100) outranks it
             store.create(p)
         sched.schedule_pending()
-        assert algo.fallback_count - f0 >= 4, (
-            "pods a nomination outranks must take the host path "
-            "(nominated-pod protection)"
+        assert algo.kernel_count - k0 >= 4, (
+            "outranked pods now ride the hybrid kernel path"
         )
+        assert algo.fallback_count - f0 <= 1  # only the preemptor may retry
+        for i in range(4):
+            low = store.get("Pod", f"default/low-{i}")
+            assert low.spec.node_name != nominee or not low.spec.node_name, (
+                "a low-priority pod stole the preemptor's freed node"
+            )
 
     def test_mixed_workload_kernel_ratio(self):
         """Preemption + default spread + node-affinity mix: kernel coverage
