@@ -43,6 +43,97 @@ from ..store.store import (
 # admission: fn(operation, obj) -> None | raises AdmissionError
 AdmissionFn = Callable[[str, object], None]
 
+# field-selector paths the reference supports per resource, generalized:
+# dotted attribute walk over the object (metadata.* maps to meta.*)
+_FIELD_ALIASES = {
+    "metadata.name": ("meta", "name"),
+    "metadata.namespace": ("meta", "namespace"),
+    "spec.nodeName": ("spec", "node_name"),
+    "spec.schedulerName": ("spec", "scheduler_name"),
+    "status.phase": ("status", "phase"),
+}
+
+
+_LABEL_TOKEN = r"[A-Za-z0-9]([-A-Za-z0-9_./]*[A-Za-z0-9])?"
+
+
+def parse_label_selector(expr: str) -> list[tuple[str, str, str]]:
+    """'k=v,k2!=v2,k3' → [(key, op, value)]; op ∈ {'=', '!=', 'exists'}.
+
+    Strict on syntax: the set-based forms ('k in (a,b)', gt/lt) the
+    reference ALSO accepts are not implemented here — they raise
+    ValueError (→ 400) rather than silently matching nothing."""
+    import re
+
+    token = re.compile(f"^{_LABEL_TOKEN}$")
+    out = []
+    for part in expr.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            k, _, v = part.partition("!=")
+            op = "!="
+        elif "=" in part:
+            k, _, v = part.partition("==" if "==" in part else "=")
+            v = v.lstrip("=")
+            op = "="
+        else:
+            k, v, op = part, "", "exists"
+        k, v = k.strip(), v.strip()
+        if not token.match(k) or (v and not token.match(v)):
+            raise ValueError(f"unsupported label selector part {part!r}")
+        out.append((k, op, v))
+    return out
+
+
+def matches_label_selector(obj, sel: list[tuple[str, str, str]]) -> bool:
+    labels = getattr(obj.meta, "labels", {}) or {}
+    for k, op, v in sel:
+        if op == "exists":
+            if k not in labels:
+                return False
+        elif op == "=":
+            if labels.get(k) != v:
+                return False
+        elif labels.get(k) == v:  # !=
+            return False
+    return True
+
+
+def parse_field_selector(expr: str) -> list[tuple[tuple[str, ...], bool, str]]:
+    """'spec.nodeName=n1,metadata.name!=x' → [(attr path, negated, value)].
+    Unknown fields raise ValueError (the reference 400s them). Parsed ONCE
+    per request; matching is pure attribute walks."""
+    out = []
+    for part in expr.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        neg = "!=" in part
+        k, _, v = part.partition("!=" if neg else "=")
+        path = _FIELD_ALIASES.get(k.strip())
+        if path is None:
+            raise ValueError(f"unsupported field selector {k.strip()!r}")
+        out.append((path, neg, v.strip()))
+    return out
+
+
+def matches_field_selector(obj, sel: list[tuple[tuple[str, ...], bool, str]]) -> bool:
+    for path, neg, v in sel:
+        cur = obj
+        for attr in path:
+            cur = getattr(cur, attr, None)
+            if cur is None:
+                break
+        got = "" if cur is None else str(cur)
+        if neg:
+            if got == v:
+                return False
+        elif got != v:
+            return False
+    return True
+
 
 class AdmissionError(Exception):
     def __init__(self, message: str, code: int = 422):
@@ -208,6 +299,19 @@ class APIServer:
                 if not self._authorized(verb, kind, key):
                     return
                 try:
+                    # both selectors parse (and thus validate) BEFORE any
+                    # stream headers go out: bad syntax must 400, not kill
+                    # a live watch mid-stream
+                    lsel = (parse_label_selector(query["labelSelector"])
+                            if "labelSelector" in query else None)
+                    fsel = (parse_field_selector(query["fieldSelector"])
+                            if "fieldSelector" in query else None)
+
+                    def selected(obj) -> bool:
+                        if lsel is not None and not matches_label_selector(obj, lsel):
+                            return False
+                        return fsel is None or matches_field_selector(obj, fsel)
+
                     if key:
                         obj = server.store.get(kind, key)
                         want_version = query.get("apiVersion", "")
@@ -221,13 +325,17 @@ class APIServer:
                             return
                         self._send_json(200, encode(obj))
                     elif query.get("watch"):
-                        self._serve_watch(kind, int(query.get("resourceVersion", 0)))
+                        self._serve_watch(
+                            kind, int(query.get("resourceVersion", 0)),
+                            selected if (lsel is not None
+                                         or fsel is not None) else None,
+                        )
                     else:
                         items, rev = server.store.list(kind)
                         self._send_json(200, {
                             "kind": f"{kind}List",
                             "metadata": {"resourceVersion": rev},
-                            "items": [encode(o) for o in items],
+                            "items": [encode(o) for o in items if selected(o)],
                         })
                 except NotFoundError as e:
                     self._error(404, "NotFound", str(e))
@@ -237,7 +345,14 @@ class APIServer:
                 except ValueError as e:
                     self._error(400, "BadRequest", str(e))
 
-            def _serve_watch(self, kind: str, from_revision: int) -> None:
+            def _serve_watch(self, kind: str, from_revision: int,
+                             selected=None) -> None:
+                """selected: optional predicate — events whose object
+                doesn't match are dropped server-side (the watch-cache
+                selector filtering of staging/.../storage/cacher). DELETED
+                events for matching objects still flow; an object UPDATED
+                out of the selector emits nothing further (the reference
+                synthesizes DELETED there — documented simplification)."""
                 watch = server.store.watch(kind, from_revision=from_revision)
                 use_cbor = self._wants_cbor()
                 if use_cbor:
@@ -263,6 +378,8 @@ class APIServer:
                             # broken pipe here instead of leaking the handler
                             # thread + store watch forever on quiet kinds
                             write_chunk(b"\x00\x00\x00\x00" if use_cbor else b"\n")
+                            continue
+                        if selected is not None and not selected(ev.obj):
                             continue
                         payload = {"type": ev.type, "object": encode(ev.obj),
                                    "revision": ev.revision}

@@ -226,17 +226,34 @@ class RESTStore:
     def delete(self, kind: str, key: str):
         return decode(self._request("DELETE", f"/api/v1/{kind}/{key}"))
 
-    def list(self, kind: str):
-        out = self._request("GET", f"/api/v1/{kind}")
+    @staticmethod
+    def _selector_query(label_selector: str, field_selector: str) -> str:
+        from urllib.parse import quote
+
+        q = ""
+        if label_selector:
+            q += f"&labelSelector={quote(label_selector)}"
+        if field_selector:
+            q += f"&fieldSelector={quote(field_selector)}"
+        return q
+
+    def list(self, kind: str, label_selector: str = "",
+             field_selector: str = ""):
+        sel = self._selector_query(label_selector, field_selector)
+        out = self._request("GET", f"/api/v1/{kind}?{sel.lstrip('&')}"
+                            if sel else f"/api/v1/{kind}")
         items = [decode(item) for item in out.get("items", [])]
         return items, out.get("metadata", {}).get("resourceVersion", 0)
 
-    def watch(self, kind: str, from_revision: int = 0) -> RESTWatch:
+    def watch(self, kind: str, from_revision: int = 0,
+              label_selector: str = "", field_selector: str = "") -> RESTWatch:
         from ..store.store import CompactedError
 
+        sel = self._selector_query(label_selector, field_selector)
         try:
             return RESTWatch(
-                f"{self.base_url}/api/v1/{kind}?watch=1&resourceVersion={from_revision}",
+                f"{self.base_url}/api/v1/{kind}"
+                f"?watch=1&resourceVersion={from_revision}{sel}",
                 headers=self._headers(),
                 binary=self.wire_format == "cbor",
             )

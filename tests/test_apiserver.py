@@ -300,3 +300,92 @@ class TestKubectlDrain:
             assert pdb.status.disruptions_allowed == 0
         finally:
             server.shutdown()
+
+
+class TestSelectors:
+    """Server-side label/field selector filtering on list + watch (the
+    watch cache's selector role; kubelets watch spec.nodeName=<node>)."""
+
+    def setup_cluster(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.store import Store
+        from tests.wrappers import make_pod
+
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        a = make_pod("a", labels={"app": "web", "tier": "fe"})
+        a.spec.node_name = "n1"
+        b = make_pod("b", labels={"app": "web", "tier": "be"})
+        b.spec.node_name = "n2"
+        c = make_pod("c", labels={"app": "db"})
+        for p in (a, b, c):
+            store.create(p)
+        return store, server
+
+    def test_label_selector_list(self):
+        from kubernetes_tpu.client.rest import RESTStore
+
+        store, server = self.setup_cluster()
+        try:
+            client = RESTStore(server.url)
+            pods, _ = client.list("Pod", label_selector="app=web")
+            assert {p.meta.name for p in pods} == {"a", "b"}
+            pods, _ = client.list("Pod", label_selector="app=web,tier!=be")
+            assert {p.meta.name for p in pods} == {"a"}
+            pods, _ = client.list("Pod", label_selector="tier")
+            assert {p.meta.name for p in pods} == {"a", "b"}
+        finally:
+            server.shutdown()
+
+    def test_field_selector_list_and_watch(self):
+        from kubernetes_tpu.client.rest import RESTStore
+        from tests.wrappers import make_pod
+
+        store, server = self.setup_cluster()
+        try:
+            client = RESTStore(server.url)
+            pods, rev = client.list("Pod", field_selector="spec.nodeName=n1")
+            assert {p.meta.name for p in pods} == {"a"}
+            w = client.watch("Pod", from_revision=rev,
+                             field_selector="spec.nodeName=n1")
+            d = make_pod("d")
+            d.spec.node_name = "n1"
+            store.create(d)
+            e = make_pod("e")
+            e.spec.node_name = "n9"  # filtered out
+            store.create(e)
+            ev = w.next(timeout=5)
+            assert ev is not None and ev.obj.meta.name == "d"
+            assert w.next(timeout=0.3) is None  # n9 event never arrives
+            w.stop()
+        finally:
+            server.shutdown()
+
+    def test_unknown_field_selector_400(self):
+        import pytest
+
+        from kubernetes_tpu.client.rest import RESTError, RESTStore
+
+        _, server = self.setup_cluster()
+        try:
+            client = RESTStore(server.url)
+            with pytest.raises(RESTError) as exc:
+                client.list("Pod", field_selector="spec.bogus=1")
+            assert exc.value.code == 400
+        finally:
+            server.shutdown()
+
+    def test_set_based_label_selector_400(self):
+        import pytest
+
+        from kubernetes_tpu.client.rest import RESTError, RESTStore
+
+        _, server = self.setup_cluster()
+        try:
+            client = RESTStore(server.url)
+            with pytest.raises(RESTError) as exc:
+                client.list("Pod", label_selector="tier in (fe,be)")
+            assert exc.value.code == 400
+        finally:
+            server.shutdown()

@@ -238,8 +238,6 @@ class TestVixieSemantics:
         t0 = _t.perf_counter()
         nd = next_due("0 0 29 2 *", T0)
         assert nd is not None
-        tm = _t.gmtime(nd) if hasattr(_t, "gmtime") else None
-        import time
-        tm = time.gmtime(nd)
+        tm = _t.gmtime(nd)
         assert (tm.tm_year, tm.tm_mon, tm.tm_mday) == (2028, 2, 29)
         assert _t.perf_counter() - t0 < 1.0
