@@ -164,6 +164,50 @@ class Job:
 
 
 @dataclass
+class PodMetrics:
+    """metrics.k8s.io/v1beta1 PodMetrics subset: per-pod usage published by
+    the node agent (the metrics-server role) and consumed by the HPA."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    cpu_usage_milli: int = 0
+    memory_usage_bytes: int = 0
+    window_s: float = 15.0
+
+    kind = "PodMetrics"
+
+
+@dataclass
+class HPASpec:
+    """autoscaling/v2 subset: one CPU-utilization metric target."""
+
+    scale_target_kind: str = "Deployment"
+    scale_target_name: str = ""
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_cpu_utilization_percent: int = 80
+    # scale-down stabilization (autoscaling/v2 behavior.scaleDown default
+    # 300s): the controller applies the HIGHEST recommendation in the window
+    scale_down_stabilization_s: float = 300.0
+
+
+@dataclass
+class HPAStatus:
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percent: int | None = None
+    last_scale_time: float | None = None
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: HPASpec = field(default_factory=HPASpec)
+    status: HPAStatus = field(default_factory=HPAStatus)
+
+    kind = "HorizontalPodAutoscaler"
+
+
+@dataclass
 class CronJobSpec:
     """batch/v1 CronJobSpec subset: 5-field cron schedule + concurrency
     policy + history limits."""

@@ -3,6 +3,7 @@
 from .base import Controller, ControllerManager
 from .cronjob import CronJobController
 from .disruption import DisruptionController
+from .hpa import HPAController
 from .lifecycle import (
     EndpointSliceController,
     GarbageCollector,
@@ -41,6 +42,7 @@ def default_controllers(store, clock=None) -> list[Controller]:
         NamespaceController(store, informers),
         TTLAfterFinishedController(store, informers, clock=clock),
         CronJobController(store, informers, clock=clock),
+        HPAController(store, informers, clock=clock),
     ]
 
 
@@ -48,7 +50,8 @@ __all__ = [
     "Controller", "ControllerManager", "CronJobController",
     "DaemonSetController",
     "DeploymentController", "DisruptionController",
-    "EndpointSliceController", "GarbageCollector", "JobController",
+    "EndpointSliceController", "GarbageCollector", "HPAController",
+    "JobController",
     "NamespaceController", "NodeLifecycleController",
     "ReplicaSetController", "ResourceClaimController",
     "StatefulSetController", "TTLAfterFinishedController",

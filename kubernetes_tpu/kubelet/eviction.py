@@ -34,10 +34,13 @@ class Threshold:
 
 @dataclass
 class PodStats:
-    """Per-pod usage of the pressured resource (stats provider sample)."""
+    """Per-pod usage sample (the summary-API role): feeds both the
+    eviction manager (memory/disk pressure) and the published PodMetrics
+    objects the HPA consumes (cpu)."""
 
     memory_bytes: int = 0
     disk_bytes: int = 0
+    cpu_milli: int = 0
 
 
 class EvictionManager:

@@ -185,6 +185,14 @@ class Kubelet(NodeAgentBase):
                 pass
 
     def _teardown(self, key: str) -> None:
+        # the pod's published metrics die with it: a same-named successor
+        # (StatefulSet identity reuse) must not inherit stale usage and
+        # churn must not leak PodMetrics objects
+        self.pod_stats.pop(key, None)
+        try:
+            self.store.delete("PodMetrics", key)
+        except NotFoundError:
+            pass
         sid = self._sandboxes.pop(key, None)
         if sid is None:
             return
@@ -207,6 +215,32 @@ class Kubelet(NodeAgentBase):
         if self.eviction.thresholds:
             self.eviction.synchronize(self._my_pods())
             self._report_pressure()
+        # publish per-pod usage as PodMetrics (the metrics-server role the
+        # HPA controller consumes)
+        if self.pod_stats:
+            self._publish_metrics()
+
+    def _publish_metrics(self) -> None:
+        from ..api.meta import ObjectMeta
+        from ..api.workloads import PodMetrics
+
+        for key, st in self.pod_stats.items():
+            ns, _, name = key.partition("/")
+            existing = self.store.try_get("PodMetrics", key)
+            if existing is None:
+                self.store.create(PodMetrics(
+                    meta=ObjectMeta(name=name, namespace=ns),
+                    cpu_usage_milli=st.cpu_milli,
+                    memory_usage_bytes=st.memory_bytes,
+                ))
+            elif (existing.cpu_usage_milli != st.cpu_milli
+                  or existing.memory_usage_bytes != st.memory_bytes):
+                existing.cpu_usage_milli = st.cpu_milli
+                existing.memory_usage_bytes = st.memory_bytes
+                try:
+                    self.store.update(existing, check_version=False)
+                except (ConflictError, NotFoundError):
+                    pass
 
     def _report_pressure(self) -> None:
         node = self.store.try_get("Node", self.node_name)

@@ -1,0 +1,190 @@
+"""HPA controller tests (pkg/controller/podautoscaler horizontal.go).
+
+Scale-up on high utilization, tolerance band, min/max clamps, scale-down
+stabilization window, missing-metrics conservatism, and the kubelet →
+PodMetrics → HPA pipeline end to end.
+"""
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import Container, PodSpec, RUNNING
+from kubernetes_tpu.api.workloads import (
+    Deployment,
+    DeploymentSpec,
+    HorizontalPodAutoscaler,
+    HPASpec,
+    PodMetrics,
+    PodTemplateSpec,
+)
+from kubernetes_tpu.controllers import HPAController
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.utils.clock import FakeClock
+from tests.wrappers import make_pod
+
+
+def template(labels):
+    return PodTemplateSpec(
+        labels=dict(labels),
+        spec=PodSpec(containers=[Container(requests={"cpu": "1"})]),
+    )
+
+
+def mk_cluster(replicas=3, target=80, min_r=1, max_r=10):
+    store = Store()
+    clock = FakeClock()
+    store.create(Deployment(
+        meta=ObjectMeta(name="web"),
+        spec=DeploymentSpec(replicas=replicas,
+                            template=template({"app": "web"})),
+    ))
+    for i in range(replicas):
+        p = make_pod(f"web-{i}", cpu="1", labels={"app": "web"})
+        p.spec.node_name = "n1"
+        p.status.phase = RUNNING
+        store.create(p)
+    store.create(HorizontalPodAutoscaler(
+        meta=ObjectMeta(name="web"),
+        spec=HPASpec(scale_target_name="web", min_replicas=min_r,
+                     max_replicas=max_r,
+                     target_cpu_utilization_percent=target),
+    ))
+    ctl = HPAController(store, clock=clock)
+    return store, clock, ctl
+
+
+def set_usage(store, name, milli):
+    m = store.try_get("PodMetrics", f"default/{name}")
+    if m is None:
+        store.create(PodMetrics(meta=ObjectMeta(name=name),
+                                cpu_usage_milli=milli))
+    else:
+        m.cpu_usage_milli = milli
+        store.update(m, check_version=False)
+
+
+class TestHPA:
+    def test_scales_up_on_high_utilization(self):
+        store, clock, ctl = mk_cluster(replicas=3, target=50)
+        for i in range(3):
+            set_usage(store, f"web-{i}", 1000)  # 100% of the 1-cpu request
+        ctl.sync_once()
+        dep = store.get("Deployment", "default/web")
+        assert dep.spec.replicas == 6  # ceil(3 * 100/50)
+        hpa = store.get("HorizontalPodAutoscaler", "default/web")
+        assert hpa.status.current_cpu_utilization_percent == 100
+        assert hpa.status.desired_replicas == 6
+
+    def test_tolerance_band_no_flap(self):
+        store, clock, ctl = mk_cluster(replicas=4, target=80)
+        for i in range(4):
+            set_usage(store, f"web-{i}", 850)  # 85% ≈ within 10% of 80
+        ctl.sync_once()
+        assert store.get("Deployment", "default/web").spec.replicas == 4
+
+    def test_max_clamp(self):
+        store, clock, ctl = mk_cluster(replicas=3, target=10, max_r=5)
+        for i in range(3):
+            set_usage(store, f"web-{i}", 1000)
+        ctl.sync_once()
+        assert store.get("Deployment", "default/web").spec.replicas == 5
+
+    def test_scale_down_stabilized(self):
+        store, clock, ctl = mk_cluster(replicas=6, target=50)
+        # phase 1: utilization at target → recommendation 6 recorded
+        for i in range(6):
+            set_usage(store, f"web-{i}", 500)  # 50% = on target
+        ctl.sync_once()
+        assert store.get("Deployment", "default/web").spec.replicas == 6
+        # phase 2: usage collapses INSIDE the stabilization window — the
+        # high past recommendation pins the deployment
+        clock.step(60)
+        for i in range(6):
+            set_usage(store, f"web-{i}", 100)  # 10% → wants 2 replicas
+        ctl.sync_once()
+        assert store.get("Deployment", "default/web").spec.replicas == 6
+        # phase 3: past the window, the low recommendation applies
+        clock.step(301)
+        ctl.sweep()
+        ctl.sync_once()
+        assert store.get("Deployment", "default/web").spec.replicas == 2
+
+    def test_missing_metrics_never_scales(self):
+        store, clock, ctl = mk_cluster(replicas=3, target=50)
+        ctl.sync_once()  # no PodMetrics at all
+        assert store.get("Deployment", "default/web").spec.replicas == 3
+
+    def test_kubelet_publishes_metrics_end_to_end(self):
+        from kubernetes_tpu.kubelet import Kubelet, PodStats
+        from tests.wrappers import make_node
+
+        store, clock, ctl = mk_cluster(replicas=3, target=50)
+        k = Kubelet(store, make_node("n1", cpu="32", mem="64Gi"), clock=clock)
+        k.register()
+        try:
+            k.pod_stats = {
+                f"default/web-{i}": PodStats(cpu_milli=1000) for i in range(3)
+            }
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            assert store.try_get("PodMetrics", "default/web-0") is not None
+            ctl.sync_once()
+            assert store.get("Deployment", "default/web").spec.replicas == 6
+        finally:
+            k.shutdown()
+
+    def test_missing_metric_pods_damp_scale_up(self):
+        """After a scale-up, fresh metric-less replicas count as 0% usage —
+        the next reconcile must NOT compound toward max_replicas."""
+        store, clock, ctl = mk_cluster(replicas=3, target=50, max_r=10)
+        for i in range(3):
+            set_usage(store, f"web-{i}", 1000)
+        ctl.sync_once()
+        assert store.get("Deployment", "default/web").spec.replicas == 6
+        # deployment controller catches up: 3 new pods, NO metrics yet
+        for i in range(3, 6):
+            p = make_pod(f"web-{i}", cpu="1", labels={"app": "web"})
+            p.spec.node_name = "n1"
+            store.create(p)
+        set_usage(store, "web-0", 1001)  # any fluctuation retriggers
+        ctl.sync_once()
+        # damped ratio: 100% over 3 of 6 pods = 50% of target → no change
+        assert store.get("Deployment", "default/web").spec.replicas == 6
+
+    def test_stabilization_expiry_self_requeues(self):
+        """Scale-down must eventually happen WITHOUT any metric event or
+        manual sweep: the controller wakes itself when the window expires."""
+        store, clock, ctl = mk_cluster(replicas=6, target=50)
+        for i in range(6):
+            set_usage(store, f"web-{i}", 500)
+        ctl.sync_once()
+        for i in range(6):
+            set_usage(store, f"web-{i}", 100)
+        ctl.sync_once()
+        assert store.get("Deployment", "default/web").spec.replicas == 6
+        clock.step(302)
+        ctl.sync_once()  # NO sweep: the delayed self-requeue fires
+        assert store.get("Deployment", "default/web").spec.replicas == 2
+
+    def test_metrics_cleaned_up_on_pod_teardown(self):
+        from kubernetes_tpu.kubelet import Kubelet, PodStats
+        from tests.wrappers import make_node
+
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        try:
+            pod = make_pod("web-0", labels={"app": "web"})
+            pod.spec.node_name = "n1"
+            store.create(pod)
+            k.pod_stats = {"default/web-0": PodStats(cpu_milli=900)}
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            assert store.try_get("PodMetrics", "default/web-0") is not None
+            pod = store.get("Pod", "default/web-0")
+            pod.meta.deletion_timestamp = clock.now()
+            store.update(pod, check_version=False)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            assert store.try_get("PodMetrics", "default/web-0") is None
+        finally:
+            k.shutdown()
