@@ -174,6 +174,18 @@ class ContainerPort:
     host_ip: str = ""
 
 
+@dataclass(frozen=True)
+class Probe:
+    """core/v1 Probe subset: cadence + thresholds. The probe ACTION
+    (exec/http/tcp) is the node agent's prober hook — spec carries only
+    the policy, as the scheduler/controllers never look inside actions."""
+
+    period_s: float = 10.0
+    initial_delay_s: float = 0.0
+    failure_threshold: int = 3
+    success_threshold: int = 1
+
+
 @dataclass
 class Container:
     name: str = "c"
@@ -181,6 +193,8 @@ class Container:
     requests: dict[str, object] = field(default_factory=dict)
     limits: dict[str, object] = field(default_factory=dict)
     ports: tuple[ContainerPort, ...] = ()
+    liveness_probe: Probe | None = None
+    readiness_probe: Probe | None = None
 
 
 @dataclass(frozen=True)
@@ -425,14 +439,18 @@ for _frozen in (
     WeightedPodAffinityTerm, PodAffinity, PodAntiAffinity, Affinity,
     Taint, Toleration, TopologySpreadConstraint, ContainerPort,
     SchedulingGroup, ContainerImage, GangPolicy, TopologyConstraint,
-    SchedulingConstraints,
+    SchedulingConstraints, Probe,
 ):
     _frozen.__deepcopy__ = _identity_deepcopy  # type: ignore[attr-defined]
 
 
 def _container_deepcopy(self: Container, memo) -> Container:
+    # probes are frozen → shareable; keep this hook in sync with the
+    # Container field list (a dropped field silently truncates every
+    # object that passes through the store)
     return Container(self.name, self.image, dict(self.requests),
-                     dict(self.limits), self.ports)
+                     dict(self.limits), self.ports,
+                     self.liveness_probe, self.readiness_probe)
 
 
 def _podspec_deepcopy(self: PodSpec, memo) -> PodSpec:

@@ -219,6 +219,15 @@ class EndpointSliceController(Controller):
 
             return stable_pod_ip(p.meta.uid or p.meta.key)
 
+        def pod_ready(p) -> bool:
+            # pod readiness = Running AND the kubelet-reported Ready
+            # condition isn't False (readiness probes gate it)
+            if p.status.phase != RUNNING:
+                return False
+            cond = next((c for c in p.status.conditions
+                         if c.type == "Ready"), None)
+            return cond is None or cond.status != "False"
+
         endpoints = tuple(
             Endpoint(
                 addresses=(pod_ip(p),),
@@ -226,9 +235,9 @@ class EndpointSliceController(Controller):
                 # discovery/v1 conditions: a deleting pod stops being
                 # "ready" but keeps "serving" while it still runs, so the
                 # proxy's terminating fallback has real producers
-                ready=(p.status.phase == RUNNING
+                ready=(pod_ready(p)
                        and p.meta.deletion_timestamp is None),
-                serving=p.status.phase == RUNNING,
+                serving=pod_ready(p),
                 terminating=p.meta.deletion_timestamp is not None,
                 target_pod=p.meta.key,
             )

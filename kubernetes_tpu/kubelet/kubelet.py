@@ -31,8 +31,9 @@ from .pod_workers import PodWorkers
 class Kubelet(NodeAgentBase):
     def __init__(self, store, node: Node, runtime=None, clock=None,
                  eviction_thresholds: list[Threshold] | None = None,
-                 workers: int = 4):
+                 workers: int = 4, prober=None):
         from ..utils.clock import Clock
+        from .prober import ProbeManager
 
         self.store = store
         self.node = node
@@ -40,6 +41,7 @@ class Kubelet(NodeAgentBase):
         self.clock = clock or Clock()
         self.runtime = runtime or InMemoryRuntime(clock=self.clock.now)
         self.pleg = GenericPLEG(self.runtime)
+        self.prober = ProbeManager(self.clock, prober=prober)
         self.workers = PodWorkers(self._sync_pod, workers=workers)
         self.eviction = EvictionManager(
             eviction_thresholds or [], self._stats, self._evict
@@ -49,9 +51,17 @@ class Kubelet(NodeAgentBase):
         # configCh change detection: key → (resource_version, terminating)
         # as of the last dispatch — only changed pods are re-dispatched
         self._seen: dict[str, tuple[int, bool]] = {}
+        # CrashLoopBackOff state (kuberuntime's backOff): (pod, container) →
+        # (restart count, no-restart-before); pod key → earliest wakeup
+        self._restart_backoff: dict[tuple[str, str], tuple[int, float]] = {}
+        self._backoff_wakeup: dict[str, float] = {}
         # injected usage for tests / simulations (summary-API stand-in)
         self.pod_stats: dict[str, PodStats] = {}
         self.node_available: dict[str, int] = {}
+
+    RESTART_BACKOFF_BASE_S = 10.0   # kubelet.go MaxContainerBackOff family
+    RESTART_BACKOFF_MAX_S = 300.0
+    RESTART_BACKOFF_RESET_S = 600.0  # ran this long → loop considered over
 
     # registration/heartbeat shared via NodeAgentBase (lease recreated on
     # heartbeat — a renew-only agent would stay NotReady after a lease GC)
@@ -87,6 +97,19 @@ class Kubelet(NodeAgentBase):
             if ev.pod_key not in dispatched:
                 self.workers.update_pod(ev.pod_key)
                 dispatched.add(ev.pod_key)
+        # probe ticks: pods with a due liveness/readiness probe re-sync
+        now = self.clock.now()
+        for key in self.prober.pods_due(now):
+            if key not in dispatched:
+                self.workers.update_pod(key)
+                dispatched.add(key)
+        # expired restart backoffs: retry the parked container
+        for key, until in list(self._backoff_wakeup.items()):
+            if now >= until:
+                del self._backoff_wakeup[key]
+                if key not in dispatched:
+                    self.workers.update_pod(key)
+                    dispatched.add(key)
         # housekeeping: eviction + orphaned-sandbox cleanup
         self._housekeeping()
         return len(dispatched)
@@ -137,6 +160,8 @@ class Kubelet(NodeAgentBase):
                 policy == "Always"
                 or (policy == "OnFailure" and c.exit_code != 0)
             ):
+                if not self._may_restart(key, spec_c.name, c):
+                    continue  # CrashLoopBackOff: leave the corpse for now
                 self.runtime.remove_container(c.id)
                 c = None
             if c is None:
@@ -151,10 +176,45 @@ class Kubelet(NodeAgentBase):
                 self.runtime.start_container(c.id)
         self._report_status(pod, sid)
 
+    def _may_restart(self, key: str, cname: str, c) -> bool:
+        """CrashLoopBackOff: exponential delay between restarts of the same
+        container; a long successful run resets the loop."""
+        now = self.clock.now()
+        bk = (key, cname)
+        count, until = self._restart_backoff.get(bk, (0, 0.0))
+        if c.finished_at and c.started_at and (
+            c.finished_at - c.started_at >= self.RESTART_BACKOFF_RESET_S
+        ):
+            count, until = 0, 0.0
+        if now < until:
+            # parked: remember when to wake this pod for the retry
+            cur = self._backoff_wakeup.get(key)
+            if cur is None or until < cur:
+                self._backoff_wakeup[key] = until
+            return False
+        delay = min(self.RESTART_BACKOFF_BASE_S * (2 ** count),
+                    self.RESTART_BACKOFF_MAX_S)
+        self._restart_backoff[bk] = (count + 1, now + delay)
+        return True
+
     def _report_status(self, pod, sid: str) -> None:
-        """Container states → pod phase (kubelet's status manager)."""
+        """Container states → pod phase (kubelet's status manager), with
+        probe results folded in: liveness failures kill the container
+        (restart policy then applies next sync), readiness gates Ready."""
         states = [c for c in self.runtime.list_containers()
                   if c.sandbox_id == sid]
+        running = {c.name for c in states
+                   if c.state not in (EXITED,)}
+        probes_ready, kill = self.prober.sync_pod(pod, running)
+        for c in states:
+            if c.name in kill:
+                self.runtime.stop_container(c.id)
+        if kill:
+            states = [c for c in self.runtime.list_containers()
+                      if c.sandbox_id == sid]
+            # a liveness kill needs a follow-up sync to restart the
+            # container per restartPolicy
+            self.workers.update_pod(pod.meta.key)
         if not states:
             phase = PENDING
         elif all(c.state == EXITED for c in states):
@@ -170,7 +230,7 @@ class Kubelet(NodeAgentBase):
         if phase == RUNNING and pod.status.start_time is None:
             pod.status.start_time = self.clock.now()
             changed = True
-        ready = "True" if phase == RUNNING else "False"
+        ready = "True" if phase == RUNNING and probes_ready else "False"
         cond = next((c for c in pod.status.conditions if c.type == "Ready"),
                     None)
         if cond is None or cond.status != ready:
@@ -189,6 +249,10 @@ class Kubelet(NodeAgentBase):
         # (StatefulSet identity reuse) must not inherit stale usage and
         # churn must not leak PodMetrics objects
         self.pod_stats.pop(key, None)
+        self.prober.forget_pod(key)
+        self._backoff_wakeup.pop(key, None)
+        for bk in [b for b in self._restart_backoff if b[0] == key]:
+            del self._restart_backoff[bk]
         try:
             self.store.delete("PodMetrics", key)
         except NotFoundError:

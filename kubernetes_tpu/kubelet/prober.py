@@ -1,0 +1,139 @@
+"""Probe manager: liveness + readiness worker state machines.
+
+Reference: pkg/kubelet/prober (prober_manager.go + worker.go) — each
+probed container gets a worker ticking at the probe period, counting
+consecutive successes/failures against the thresholds; readiness results
+feed the pod Ready condition (and thence EndpointSlices → proxy
+backends), liveness failures kill the container so the restart policy
+takes over. The probe ACTION is pluggable (`prober(pod, container) ->
+bool`): real kubelets exec/http/tcp into the sandbox; the default prober
+reports success while the container runs, and tests/simulations inject
+outcomes (e.g. by pod annotation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..api.types import Pod, Probe
+
+LIVENESS = "liveness"
+READINESS = "readiness"
+
+# simulation hook: a pod annotated with this ("false") fails readiness;
+# the default prober honors it so hollow clusters can flip readiness
+READY_ANNOTATION = "probe.k8s.io/ready"
+LIVE_ANNOTATION = "probe.k8s.io/live"
+
+
+def default_prober(pod: Pod, container) -> dict[str, bool]:
+    """{probe kind: success}. Honors the simulation annotations."""
+    return {
+        READINESS: pod.meta.annotations.get(READY_ANNOTATION, "true") != "false",
+        LIVENESS: pod.meta.annotations.get(LIVE_ANNOTATION, "true") != "false",
+    }
+
+
+@dataclass
+class _WorkerState:
+    probe: Probe
+    kind: str
+    started_at: float
+    last_probe: float | None = None
+    successes: int = 0
+    failures: int = 0
+    # readiness starts False until the first success (worker.go initial
+    # value), liveness starts True
+    result: bool = field(default=False)
+
+
+class ProbeManager:
+    def __init__(self, clock, prober: Callable | None = None):
+        self.clock = clock
+        self.prober = prober or default_prober
+        # (pod key, container name, kind) → worker state
+        self._workers: dict[tuple[str, str, str], _WorkerState] = {}
+
+    def sync_pod(self, pod: Pod, running_containers: set[str]) -> tuple[bool, list[str]]:
+        """Tick every due probe for this pod.
+
+        Returns (pod_ready, containers_to_kill): pod_ready ANDs the
+        readiness results of probed running containers (unprobed
+        containers are ready by definition); containers_to_kill lists
+        containers whose liveness crossed the failure threshold."""
+        now = self.clock.now()
+        key = pod.meta.key
+        ready = True
+        kill: list[str] = []
+        for c in pod.spec.containers:
+            if c.name not in running_containers:
+                # container died: drop its workers so a restarted container
+                # starts FRESH (readiness False until first success, full
+                # initial delay) instead of inheriting stale results — and
+                # so a permanently-dead container stops showing up as "due"
+                self._workers.pop((key, c.name, READINESS), None)
+                self._workers.pop((key, c.name, LIVENESS), None)
+                if c.readiness_probe is not None:
+                    # a dead readiness-probed container gates the pod:
+                    # nothing is serving behind that probe
+                    ready = False
+                continue
+            for kind, probe in ((READINESS, c.readiness_probe),
+                                (LIVENESS, c.liveness_probe)):
+                if probe is None:
+                    continue
+                wk = (key, c.name, kind)
+                st = self._workers.get(wk)
+                if st is None:
+                    st = _WorkerState(probe=probe, kind=kind, started_at=now,
+                                      result=(kind == LIVENESS))
+                    self._workers[wk] = st
+                self._tick(st, pod, c, now)
+                if kind == READINESS:
+                    ready = ready and st.result
+                elif not st.result:
+                    kill.append(c.name)
+                    # the container will restart: reset the worker so the
+                    # replacement gets a fresh start (manager removes the
+                    # worker when the container dies)
+                    del self._workers[wk]
+        return ready, kill
+
+    def _tick(self, st: _WorkerState, pod: Pod, container, now: float) -> None:
+        if now - st.started_at < st.probe.initial_delay_s:
+            return
+        if st.last_probe is not None and now - st.last_probe < st.probe.period_s:
+            return
+        st.last_probe = now
+        ok = bool(self.prober(pod, container).get(st.kind, True))
+        if ok:
+            st.successes += 1
+            st.failures = 0
+            if st.successes >= st.probe.success_threshold:
+                st.result = True
+        else:
+            st.failures += 1
+            st.successes = 0
+            if st.failures >= st.probe.failure_threshold:
+                st.result = False
+
+    def pods_due(self, now: float) -> set[str]:
+        """Pod keys with at least one probe whose next tick is ≤ now — the
+        sync loop re-dispatches these (probe workers are self-ticking
+        goroutines in the reference; here the loop provides the ticks)."""
+        out: set[str] = set()
+        # snapshot: worker threads mutate the dict concurrently via
+        # sync_pod/forget_pod (same pattern as _housekeeping's sandbox scan)
+        for (key, _c, _kind), st in list(self._workers.items()):
+            if st.last_probe is None:
+                nxt = st.started_at + st.probe.initial_delay_s
+            else:
+                nxt = st.last_probe + st.probe.period_s
+            if now >= nxt:
+                out.add(key)
+        return out
+
+    def forget_pod(self, pod_key: str) -> None:
+        for wk in [w for w in self._workers if w[0] == pod_key]:
+            del self._workers[wk]

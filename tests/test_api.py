@@ -174,3 +174,23 @@ class TestTaints:
         t = Taint("k", "v", "NoExecute")
         assert not Toleration(key="k", operator="Exists", effect="NoSchedule").tolerates(t)
         assert Toleration(key="k", operator="Exists", effect="NoExecute").tolerates(t)
+
+
+def test_fast_deepcopy_preserves_every_container_field():
+    """The hand-written _container_deepcopy hook must stay in sync with the
+    Container field list — a dropped field silently truncates every object
+    that passes through the store (regression: probes vanished)."""
+    import copy
+
+    from kubernetes_tpu.api.types import Container, ContainerPort, Pod, PodSpec, Probe
+
+    c = Container(
+        name="main", image="img:v1", requests={"cpu": "1"},
+        limits={"memory": "1Gi"},
+        ports=(ContainerPort(container_port=80),),
+        liveness_probe=Probe(period_s=3),
+        readiness_probe=Probe(period_s=7, failure_threshold=5),
+    )
+    pod = Pod(spec=PodSpec(containers=[c]))
+    clone = copy.deepcopy(pod)
+    assert clone.spec.containers[0] == c

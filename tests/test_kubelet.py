@@ -316,3 +316,239 @@ class TestRestartPolicy:
             assert k.sync_loop_iteration() == 0  # steady state: no dispatch
         finally:
             k.shutdown()
+
+
+class TestProbes:
+    def make(self):
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        return store, clock, k
+
+    def probed_pod(self, name, readiness=None, liveness=None):
+        from kubernetes_tpu.api.types import Container, Probe
+
+        pod = make_pod(name)
+        pod.spec.node_name = "n1"
+        pod.spec.containers = [Container(
+            name="main",
+            requests={"cpu": "100m"},
+            readiness_probe=Probe(period_s=5) if readiness else None,
+            liveness_probe=(Probe(period_s=5, failure_threshold=2)
+                            if liveness else None),
+        )]
+        return pod
+
+    def sync(self, k):
+        k.sync_loop_iteration()
+        assert k.workers.drain()
+
+    def test_readiness_gates_ready_condition(self):
+        from kubernetes_tpu.kubelet.prober import READY_ANNOTATION
+
+        store, clock, k = self.make()
+        try:
+            store.create(self.probed_pod("web", readiness=True))
+            self.sync(k)
+            got = store.get("Pod", "default/web")
+            assert got.status.phase == RUNNING
+
+            def ready_of(p):
+                return next(c.status for c in p.status.conditions
+                            if c.type == "Ready")
+
+            assert ready_of(got) == "True"  # first probe succeeded
+            # flip the simulated probe to failing: after failure_threshold
+            # (3) ticks the pod goes NotReady while still Running
+            got.meta.annotations[READY_ANNOTATION] = "false"
+            store.update(got, check_version=False)
+            for _ in range(3):
+                clock.step(6)
+                self.sync(k)
+            got = store.get("Pod", "default/web")
+            assert got.status.phase == RUNNING
+            assert ready_of(got) == "False"
+            # recovery: one success (success_threshold=1) restores Ready
+            got.meta.annotations[READY_ANNOTATION] = "true"
+            store.update(got, check_version=False)
+            clock.step(6)
+            self.sync(k)
+            assert ready_of(store.get("Pod", "default/web")) == "True"
+        finally:
+            k.shutdown()
+
+    def test_liveness_failure_restarts_container(self):
+        from kubernetes_tpu.kubelet.prober import LIVE_ANNOTATION
+
+        store, clock, k = self.make()
+        try:
+            store.create(self.probed_pod("svc", liveness=True))
+            self.sync(k)
+            first = {c.id for c in k.runtime.list_containers()}
+            pod = store.get("Pod", "default/svc")
+            pod.meta.annotations[LIVE_ANNOTATION] = "false"
+            store.update(pod, check_version=False)
+            for _ in range(2):  # cross failure_threshold=2 → kill + restart
+                clock.step(6)
+                self.sync(k)
+            # probe recovers: the restarted container must stay alive
+            pod = store.get("Pod", "default/svc")
+            pod.meta.annotations[LIVE_ANNOTATION] = "true"
+            store.update(pod, check_version=False)
+            clock.step(6)
+            self.sync(k)
+            live = [c for c in k.runtime.list_containers()
+                    if c.state == CONTAINER_RUNNING]
+            assert live, "container was not restarted after liveness kill"
+            assert {c.id for c in live}.isdisjoint(first)
+        finally:
+            k.shutdown()
+
+    def test_readiness_drops_proxy_backend_end_to_end(self):
+        """NotReady pod → endpointslice ready=False → proxy drops it."""
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.workloads import Service, ServicePort, ServiceSpec
+        from kubernetes_tpu.controllers.lifecycle import EndpointSliceController
+        from kubernetes_tpu.kubelet.prober import READY_ANNOTATION
+        from kubernetes_tpu.proxy import Proxier
+
+        store, clock, k = self.make()
+        try:
+            store.create(Service(
+                meta=ObjectMeta(name="api", namespace="default"),
+                spec=ServiceSpec(selector={"app": "api"},
+                                 ports=(ServicePort(port=80, target_port=8080),),
+                                 cluster_ip="10.0.0.50"),
+            ))
+            pod = self.probed_pod("api-0", readiness=True)
+            pod.meta.labels["app"] = "api"
+            store.create(pod)
+            self.sync(k)
+            esc = EndpointSliceController(store)
+            esc.sync_once()
+            proxy = Proxier(store, node_name="n1")
+            proxy.sync()
+            assert proxy.dataplane.resolve("10.0.0.50", 80) is not None
+            pod = store.get("Pod", "default/api-0")
+            pod.meta.annotations[READY_ANNOTATION] = "false"
+            store.update(pod, check_version=False)
+            for _ in range(3):
+                clock.step(6)
+                self.sync(k)
+            esc.sync_once()
+            proxy.sync()
+            # not ready, not terminating → no serving fallback → dropped
+            assert proxy.dataplane.resolve("10.0.0.50", 80) is None
+        finally:
+            k.shutdown()
+
+    def test_dead_probed_container_gates_readiness(self):
+        """Multi-container pod: the probed container dying must flip the
+        pod NotReady even while an unprobed sibling keeps running."""
+        from kubernetes_tpu.api.types import Container, Probe
+
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("multi")
+            pod.spec.node_name = "n1"
+            pod.spec.restart_policy = "OnFailure"  # exit 0 → no restart
+            pod.spec.containers = [
+                Container(name="probed", requests={"cpu": "100m"},
+                          readiness_probe=Probe(period_s=5)),
+                Container(name="plain", requests={"cpu": "100m"}),
+            ]
+            store.create(pod)
+            self.sync(k)
+
+            def ready_of():
+                p = store.get("Pod", "default/multi")
+                return next(c.status for c in p.status.conditions
+                            if c.type == "Ready")
+
+            assert ready_of() == "True"
+            # the probed container exits cleanly; OnFailure won't restart it
+            probed = next(c for c in k.runtime.list_containers()
+                          if c.name == "probed")
+            k.runtime.stop_container(probed.id)
+            probed.exit_code = 0
+            clock.step(6)
+            self.sync(k)
+            p = store.get("Pod", "default/multi")
+            assert p.status.phase == RUNNING  # sibling still runs
+            assert ready_of() == "False"
+            # steady state: the dead container's workers are pruned, so the
+            # loop is quiet again (no forever-due busy dispatch)
+            clock.step(6)
+            k.sync_loop_iteration()
+            k.workers.drain()
+            assert k.sync_loop_iteration() == 0
+        finally:
+            k.shutdown()
+
+    def test_restarted_container_starts_not_ready(self):
+        """After a liveness kill+restart the readiness worker must start
+        fresh (False until its first success), not inherit Ready=True."""
+        from kubernetes_tpu.api.types import Container, Probe
+        from kubernetes_tpu.kubelet.prober import LIVE_ANNOTATION, READINESS
+
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("svc")
+            pod.spec.node_name = "n1"
+            pod.spec.containers = [Container(
+                name="main", requests={"cpu": "100m"},
+                readiness_probe=Probe(period_s=5, initial_delay_s=20),
+                liveness_probe=Probe(period_s=5, failure_threshold=1),
+            )]
+            store.create(pod)
+            self.sync(k)   # creates the workers (initial delay starts now)
+            clock.step(25)  # past the initial delay
+            self.sync(k)
+            st = k.prober._workers[("default/svc", "main", READINESS)]
+            assert st.result is True
+            pod = store.get("Pod", "default/svc")
+            pod.meta.annotations[LIVE_ANNOTATION] = "false"
+            store.update(pod, check_version=False)
+            clock.step(6)
+            self.sync(k)   # liveness kill
+            clock.step(1)
+            self.sync(k)   # restart + fresh workers
+            st = k.prober._workers.get(("default/svc", "main", READINESS))
+            # fresh worker: inside the new initial delay, result False
+            assert st is None or st.result is False
+        finally:
+            k.shutdown()
+
+    def test_crashloop_backoff_parks_and_retries(self):
+        """A persistently failing liveness probe must NOT kill/restart at
+        full speed: the second restart waits out the backoff, then the
+        expiry wakeup retries it."""
+        from kubernetes_tpu.api.types import Container, Probe
+        from kubernetes_tpu.kubelet.prober import LIVE_ANNOTATION
+
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("loopy")
+            pod.spec.node_name = "n1"
+            pod.spec.containers = [Container(
+                name="main", requests={"cpu": "100m"},
+                liveness_probe=Probe(period_s=5, failure_threshold=1),
+            )]
+            pod.meta.annotations[LIVE_ANNOTATION] = "false"
+            store.create(pod)
+            self.sync(k)   # start + immediate liveness kill + restart#1
+            clock.step(6)
+            self.sync(k)   # kill#2 → restart PARKED (backoff 10s)
+            assert not [c for c in k.runtime.list_containers()
+                        if c.state == CONTAINER_RUNNING]
+            # probe recovers; backoff expiry wakeup retries the restart
+            pod = store.get("Pod", "default/loopy")
+            pod.meta.annotations[LIVE_ANNOTATION] = "true"
+            store.update(pod, check_version=False)
+            clock.step(20)
+            self.sync(k)
+            assert [c for c in k.runtime.list_containers()
+                    if c.state == CONTAINER_RUNNING]
+        finally:
+            k.shutdown()
