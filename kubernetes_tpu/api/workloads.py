@@ -298,3 +298,17 @@ class Namespace:
     phase: str = "Active"
 
     kind = "Namespace"
+
+
+@dataclass
+class ResourceQuota:
+    """core/v1 ResourceQuota subset: hard caps per namespace over
+    requests.cpu / requests.memory (milli / MiB) and object counts
+    ("pods", "count/<kind>"). `used` is maintained by the quota controller
+    and enforced at admission."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    hard: dict[str, int] = field(default_factory=dict)
+    used: dict[str, int] = field(default_factory=dict)
+
+    kind = "ResourceQuota"

@@ -86,5 +86,7 @@ def namespace_lifecycle_admission(store):
 def default_admission_chain(store) -> list:
     """The plugins every control plane enables (mutating before
     validating, as the reference orders its chain)."""
+    from ..controllers.quota import quota_admission
+
     return [cluster_scope_admission(), priority_admission(store),
-            namespace_lifecycle_admission(store)]
+            namespace_lifecycle_admission(store), quota_admission(store)]

@@ -4,6 +4,7 @@ from .base import Controller, ControllerManager
 from .cronjob import CronJobController
 from .disruption import DisruptionController
 from .hpa import HPAController
+from .quota import QuotaController, quota_admission
 from .lifecycle import (
     EndpointSliceController,
     GarbageCollector,
@@ -43,6 +44,7 @@ def default_controllers(store, clock=None) -> list[Controller]:
         TTLAfterFinishedController(store, informers, clock=clock),
         CronJobController(store, informers, clock=clock),
         HPAController(store, informers, clock=clock),
+        QuotaController(store, informers),
     ]
 
 
@@ -53,7 +55,7 @@ __all__ = [
     "EndpointSliceController", "GarbageCollector", "HPAController",
     "JobController",
     "NamespaceController", "NodeLifecycleController",
-    "ReplicaSetController", "ResourceClaimController",
+    "QuotaController", "ReplicaSetController", "ResourceClaimController",
     "StatefulSetController", "TTLAfterFinishedController",
-    "default_controllers",
+    "default_controllers", "quota_admission",
 ]

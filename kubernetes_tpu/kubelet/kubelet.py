@@ -22,7 +22,7 @@ from ..api.types import (
 )
 from ..store.store import ConflictError, NotFoundError
 from .agent import NodeAgentBase
-from .cri import CREATED, EXITED, InMemoryRuntime
+from .cri import CONTAINER_RUNNING, CREATED, EXITED, InMemoryRuntime
 from .eviction import EvictionManager, PodStats, Threshold
 from .pleg import GenericPLEG
 from .pod_workers import PodWorkers
@@ -103,10 +103,12 @@ class Kubelet(NodeAgentBase):
             if key not in dispatched:
                 self.workers.update_pod(key)
                 dispatched.add(key)
-        # expired restart backoffs: retry the parked container
+        # expired restart backoffs: retry the parked container (pop, not
+        # del: a concurrent _teardown on a worker thread may already have
+        # removed the entry)
         for key, until in list(self._backoff_wakeup.items()):
             if now >= until:
-                del self._backoff_wakeup[key]
+                self._backoff_wakeup.pop(key, None)
                 if key not in dispatched:
                     self.workers.update_pod(key)
                     dispatched.add(key)
@@ -230,7 +232,12 @@ class Kubelet(NodeAgentBase):
         if phase == RUNNING and pod.status.start_time is None:
             pod.status.start_time = self.clock.now()
             changed = True
-        ready = "True" if phase == RUNNING and probes_ready else "False"
+        # Ready needs probes AND at least one actually-running container:
+        # a CrashLoopBackOff-parked pod reports phase=Running (restart
+        # pending) but must not keep receiving service traffic
+        any_running = any(c.state == CONTAINER_RUNNING for c in states)
+        ready = ("True" if phase == RUNNING and probes_ready and any_running
+                 else "False")
         cond = next((c for c in pod.status.conditions if c.type == "Ready"),
                     None)
         if cond is None or cond.status != ready:

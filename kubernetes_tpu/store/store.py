@@ -314,10 +314,16 @@ class Store:
             self._emit(kind, Event(DELETED, copy.deepcopy(cur), rev, time.perf_counter()))
             return cur
 
-    def list(self, kind: str) -> tuple[list[Any], int]:
-        """Returns (objects, revision) — the revision to start a watch from."""
+    def list(self, kind: str, namespace: str | None = None) -> tuple[list[Any], int]:
+        """Returns (objects, revision) — the revision to start a watch from.
+        namespace filters BEFORE the deepcopy: a namespace-scoped consumer
+        (quota admission) must not pay for copying the whole cluster."""
         with self._mu:
-            objs = [copy.deepcopy(o) for o in self._objects.get(kind, {}).values()]
+            objs = [
+                copy.deepcopy(o)
+                for o in self._objects.get(kind, {}).values()
+                if namespace is None or o.meta.namespace == namespace
+            ]
             return objs, self._revision
 
     @property

@@ -552,3 +552,33 @@ class TestProbes:
                     if c.state == CONTAINER_RUNNING]
         finally:
             k.shutdown()
+
+    def test_backoff_parked_pod_goes_not_ready(self):
+        """A crash-looping Always pod parked in backoff must drop Ready —
+        zero running containers may not keep receiving service traffic."""
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("crashy")
+            pod.spec.node_name = "n1"
+            pod.meta.annotations["kubemark.io/run-seconds"] = "1"
+            store.create(pod)  # restart_policy defaults to Always
+            self.sync(k)
+
+            def ready_of():
+                p = store.get("Pod", "default/crashy")
+                return next((c.status for c in p.status.conditions
+                             if c.type == "Ready"), None)
+
+            assert ready_of() == "True"
+            # crash → restart#1 (immediate) → crash again → parked
+            for _ in range(3):
+                clock.step(2)
+                self.sync(k)
+            live = [c for c in k.runtime.list_containers()
+                    if c.state == CONTAINER_RUNNING]
+            if not live:  # parked in backoff
+                assert ready_of() == "False"
+            p = store.get("Pod", "default/crashy")
+            assert p.status.phase == RUNNING  # restart still pending
+        finally:
+            k.shutdown()

@@ -1,0 +1,133 @@
+"""ResourceQuota tests: admission enforcement + controller accounting.
+
+Modeled on plugin/pkg/admission/resourcequota and
+pkg/controller/resourcequota tests.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.workloads import ResourceQuota
+from kubernetes_tpu.controllers import QuotaController
+from kubernetes_tpu.controllers.quota import quota_admission
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_pod
+
+
+def mk_quota(hard, namespace="default", name="rq"):
+    return ResourceQuota(
+        meta=ObjectMeta(name=name, namespace=namespace), hard=dict(hard)
+    )
+
+
+class TestQuotaAdmission:
+    def admit(self, store):
+        return quota_admission(store)
+
+    def test_cpu_cap_enforced(self):
+        store = Store()
+        store.create(mk_quota({"requests.cpu": 2000}))  # 2 cores
+        admit = self.admit(store)
+        p1 = make_pod("a", cpu="1500m")
+        admit("CREATE", p1)
+        store.create(p1)
+        with pytest.raises(Exception) as exc:
+            admit("CREATE", make_pod("b", cpu="600m"))
+        assert "exceeded quota" in str(exc.value)
+        admit("CREATE", make_pod("c", cpu="500m"))  # exactly fills: allowed
+
+    def test_pod_count_cap(self):
+        store = Store()
+        store.create(mk_quota({"pods": 2}))
+        admit = self.admit(store)
+        for n in ("a", "b"):
+            pod = make_pod(n, cpu="100m")
+            admit("CREATE", pod)
+            store.create(pod)
+        with pytest.raises(Exception):
+            admit("CREATE", make_pod("c", cpu="100m"))
+
+    def test_object_count_cap(self):
+        from kubernetes_tpu.api.workloads import Service, ServiceSpec
+
+        store = Store()
+        store.create(mk_quota({"count/Service": 1}))
+        admit = self.admit(store)
+        svc = Service(meta=ObjectMeta(name="s1"),
+                      spec=ServiceSpec(cluster_ip="10.0.0.1"))
+        admit("CREATE", svc)
+        store.create(svc)
+        with pytest.raises(Exception):
+            admit("CREATE", Service(meta=ObjectMeta(name="s2"),
+                                    spec=ServiceSpec(cluster_ip="10.0.0.2")))
+
+    def test_other_namespace_unaffected(self):
+        store = Store()
+        store.create(mk_quota({"pods": 0}, namespace="team-a"))
+        admit = self.admit(store)
+        admit("CREATE", make_pod("free"))  # default ns: no quota
+
+    def test_terminal_pods_release_quota(self):
+        from kubernetes_tpu.api.types import SUCCEEDED
+
+        store = Store()
+        store.create(mk_quota({"pods": 1}))
+        admit = self.admit(store)
+        done = make_pod("done", cpu="100m")
+        done.status.phase = SUCCEEDED
+        store.create(done)
+        admit("CREATE", make_pod("next", cpu="100m"))  # slot freed
+
+
+class TestQuotaController:
+    def test_used_tracks_live_objects(self):
+        store = Store()
+        store.create(mk_quota({"requests.cpu": 4000, "pods": 10}))
+        ctl = QuotaController(store)
+        ctl.sync_once()
+        rq = store.get("ResourceQuota", "default/rq")
+        assert rq.used == {"requests.cpu": 0, "pods": 0}
+        store.create(make_pod("a", cpu="1500m"))
+        store.create(make_pod("b", cpu="500m"))
+        ctl.sync_once()
+        rq = store.get("ResourceQuota", "default/rq")
+        assert rq.used == {"requests.cpu": 2000, "pods": 2}
+        store.delete("Pod", "default/a")
+        ctl.sync_once()
+        rq = store.get("ResourceQuota", "default/rq")
+        assert rq.used == {"requests.cpu": 500, "pods": 1}
+
+
+class TestQuotaEndToEnd:
+    def test_bootstrap_cluster_enforces_quota(self):
+        from kubernetes_tpu.client.rest import RESTError
+        from kubernetes_tpu.cmd.bootstrap import ClusterBootstrap
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        boot = ClusterBootstrap(nodes=2, clock=FakeClock())
+        boot.init()
+        try:
+            client = boot.client()
+            client.create(mk_quota({"pods": 1}))
+            client.create(make_pod("one", cpu="100m"))
+            with pytest.raises(RESTError) as exc:
+                client.create(make_pod("two", cpu="100m"))
+            assert exc.value.code == 403
+        finally:
+            boot.shutdown()
+
+
+class TestQuotaControllerNonPodKinds:
+    def test_service_count_stays_fresh(self):
+        from kubernetes_tpu.api.workloads import Service, ServiceSpec
+
+        store = Store()
+        store.create(mk_quota({"count/Service": 5}))
+        ctl = QuotaController(store)
+        ctl.sync_once()
+        for i in range(3):
+            store.create(Service(meta=ObjectMeta(name=f"s{i}"),
+                                 spec=ServiceSpec(cluster_ip=f"10.0.0.{i}")))
+        ctl.sync_once()  # Service events alone must refresh accounting
+        rq = store.get("ResourceQuota", "default/rq")
+        assert rq.used == {"count/Service": 3}
