@@ -88,6 +88,13 @@ class LabelSelector:
         return ",".join(parts)
 
 
+def labels_subset(selector: Mapping[str, str],
+                  labels: Mapping[str, str]) -> bool:
+    """match_labels semantics: every selector pair present in labels
+    (shared by the controllers that select pods by a plain label dict)."""
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
 def matches_selector(sel: LabelSelector | None, labels: Mapping[str, str]) -> bool:
     if sel is None:
         return False

@@ -26,6 +26,7 @@ insecure serving was).
 from __future__ import annotations
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
@@ -57,15 +58,20 @@ _FIELD_ALIASES = {
 _LABEL_TOKEN = r"[A-Za-z0-9]([-A-Za-z0-9_./]*[A-Za-z0-9])?"
 
 
+_LABEL_TOKEN_RE = re.compile(f"^{_LABEL_TOKEN}$")
+
+# sentinel user for insecure serving (no authenticator configured): the
+# whole authn/authz chain is off, every request is trusted
+_TRUSTED = object()
+
+
 def parse_label_selector(expr: str) -> list[tuple[str, str, str]]:
     """'k=v,k2!=v2,k3' → [(key, op, value)]; op ∈ {'=', '!=', 'exists'}.
 
     Strict on syntax: the set-based forms ('k in (a,b)', gt/lt) the
     reference ALSO accepts are not implemented here — they raise
     ValueError (→ 400) rather than silently matching nothing."""
-    import re
-
-    token = re.compile(f"^{_LABEL_TOKEN}$")
+    token = _LABEL_TOKEN_RE
     out = []
     for part in expr.split(","):
         part = part.strip()
@@ -224,25 +230,35 @@ class APIServer:
                     return cbor.loads(raw)
                 return json.loads(raw)
 
+            def _authenticate(self):
+                """Run the authn stage; returns the user, or None after
+                having sent the 401. A None authenticator means the chain
+                is off (insecure serving) — returns the trusted marker."""
+                from .auth import AuthenticationError
+
+                if server.authenticator is None:
+                    return _TRUSTED
+                try:
+                    return server.authenticator.authenticate(
+                        self.headers.get("Authorization")
+                    )
+                except AuthenticationError as e:
+                    self._error(401, "Unauthorized", str(e))
+                    return None
+
             def _authorized(self, verb: str, kind: str, key: str,
                             namespace: str | None = None) -> bool:
                 """authn → authz chain stages (generic server handler
                 chain); sends the 401/403 itself when the request fails.
                 namespace overrides the key-derived one (creates carry the
                 namespace in the body, not the flat URL)."""
-                from .auth import Attributes, AuthenticationError
-
-                if server.authenticator is None:
-                    return True
-                try:
-                    user = server.authenticator.authenticate(
-                        self.headers.get("Authorization")
-                    )
-                except AuthenticationError as e:
-                    self._error(401, "Unauthorized", str(e))
+                user = self._authenticate()
+                if user is None:
                     return False
-                if server.authorizer is None:
+                if user is _TRUSTED or server.authorizer is None:
                     return True
+                from .auth import Attributes
+
                 if namespace is None:
                     namespace = key.split("/", 1)[0] if "/" in key else ""
                 ok = server.authorizer.authorize(
@@ -267,21 +283,16 @@ class APIServer:
                 if self.path in ("/api", "/api/v1", "/openapi/v2"):
                     # discovery requires authentication (the reference
                     # grants system:discovery to authenticated users, not
-                    # anonymous); authenticated users are always allowed
-                    if server.authenticator is not None:
-                        from .auth import ANONYMOUS, AuthenticationError
+                    # anonymous); any authenticated user is allowed
+                    from .auth import ANONYMOUS
 
-                        try:
-                            user = server.authenticator.authenticate(
-                                self.headers.get("Authorization")
-                            )
-                        except AuthenticationError as e:
-                            self._error(401, "Unauthorized", str(e))
-                            return
-                        if user.name == ANONYMOUS:
-                            self._error(403, "Forbidden",
-                                        "discovery requires authentication")
-                            return
+                    user = self._authenticate()
+                    if user is None:
+                        return
+                    if user is not _TRUSTED and user.name == ANONYMOUS:
+                        self._error(403, "Forbidden",
+                                    "discovery requires authentication")
+                        return
                     from . import discovery
 
                     doc = (discovery.api_versions() if self.path == "/api"

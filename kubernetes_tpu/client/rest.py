@@ -226,6 +226,13 @@ class RESTStore:
     def delete(self, kind: str, key: str):
         return decode(self._request("DELETE", f"/api/v1/{kind}/{key}"))
 
+    def try_delete(self, kind: str, key: str):
+        """delete() tolerant of already-gone objects (Store.try_delete)."""
+        try:
+            return self.delete(kind, key)
+        except NotFoundError:
+            return None
+
     @staticmethod
     def _selector_query(label_selector: str, field_selector: str) -> str:
         from urllib.parse import quote

@@ -17,15 +17,26 @@ from ..client.workqueue import WorkQueue
 class Controller:
     """Subclasses set `watches` (kinds whose events enqueue keys) and
     implement `reconcile(key) -> None` (raise to retry with backoff) and
-    `key_of(kind, obj) -> str | None` (None = ignore event)."""
+    `key_of(kind, obj) -> str | None` (None = ignore event).
+
+    Time-driven controllers set `clocked_queue = True`: they get a `clock`
+    (injectable) and a workqueue whose delayed-add timers tick on that same
+    clock — the shared pattern for schedule-time/TTL/stabilization
+    self-requeues."""
 
     name = "controller"
     watches: tuple[str, ...] = ()
+    clocked_queue = False
 
-    def __init__(self, store, informers: InformerFactory | None = None):
+    def __init__(self, store, informers: InformerFactory | None = None,
+                 clock=None):
+        from ..utils.clock import Clock
+
         self.store = store
         self.informers = informers or InformerFactory(store)
-        self.queue = WorkQueue()
+        self.clock = clock or Clock()
+        self.queue = (WorkQueue(clock=self.clock.now) if self.clocked_queue
+                      else WorkQueue())
         self._started = False
         for kind in self.watches:
             self.informers.informer(kind).add_handler(

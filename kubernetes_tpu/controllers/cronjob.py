@@ -22,7 +22,6 @@ import time as _time
 
 from ..api.meta import ObjectMeta, OwnerReference, new_uid
 from ..api.workloads import CronJob, Job
-from ..store.store import NotFoundError
 from .base import Controller
 
 # (lo, hi) per field: minute, hour, day-of-month, month, day-of-week
@@ -130,15 +129,7 @@ class CronJobController(Controller):
     name = "cronjob"
     watches = ("CronJob", "Job")
 
-    def __init__(self, store, informers=None, clock=None):
-        from ..client.workqueue import WorkQueue
-        from ..utils.clock import Clock
-
-        super().__init__(store, informers)
-        self.clock = clock or Clock()
-        # delayed self-requeues at the next schedule time must tick on the
-        # SAME clock the due-time math uses (see TTLAfterFinishedController)
-        self.queue = WorkQueue(clock=self.clock.now)
+    clocked_queue = True  # schedule-time self-requeues ride the clock
 
     def key_of(self, kind: str, obj) -> str | None:
         if kind == "CronJob":
@@ -195,10 +186,7 @@ class CronJobController(Controller):
             return
         if cj.spec.concurrency_policy == "Replace":
             for j in active:
-                try:
-                    self.store.delete("Job", j.meta.key)
-                except NotFoundError:
-                    pass
+                self.store.try_delete("Job", j.meta.key)
             active = []
         job = self._mint_job(cj, fired)
         self.store.create(job)
@@ -282,12 +270,6 @@ class CronJobController(Controller):
             key=lambda j: j.meta.creation_timestamp,
         )
         for j in done[: max(0, len(done) - cj.spec.successful_jobs_history_limit)]:
-            try:
-                self.store.delete("Job", j.meta.key)
-            except NotFoundError:
-                pass
+            self.store.try_delete("Job", j.meta.key)
         for j in failed[: max(0, len(failed) - cj.spec.failed_jobs_history_limit)]:
-            try:
-                self.store.delete("Job", j.meta.key)
-            except NotFoundError:
-                pass
+            self.store.try_delete("Job", j.meta.key)

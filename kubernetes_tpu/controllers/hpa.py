@@ -25,15 +25,10 @@ class HPAController(Controller):
     # tolerance around target before acting (horizontal.go: 0.1)
     TOLERANCE = 0.1
 
-    def __init__(self, store, informers=None, clock=None):
-        from ..client.workqueue import WorkQueue
-        from ..utils.clock import Clock
+    clocked_queue = True  # stabilization-expiry self-requeues
 
-        super().__init__(store, informers)
-        self.clock = clock or Clock()
-        # stabilization-expiry self-requeues ride a clocked delayed queue
-        # (same pattern as CronJob/TTLAfterFinished)
-        self.queue = WorkQueue(clock=self.clock.now)
+    def __init__(self, store, informers=None, clock=None):
+        super().__init__(store, informers, clock=clock)
         # hpa key → [(time, desired)] recommendations inside the window
         self._recommendations: dict[str, list[tuple[float, int]]] = {}
 
@@ -148,10 +143,12 @@ class HPAController(Controller):
             labels = dict(target.spec.template.labels)
         if not labels:
             return []
+        from ..api.labels import labels_subset
+
         return [
             p for p in self.store.pods()
             if p.meta.namespace == hpa.meta.namespace
-            and all(p.meta.labels.get(k) == v for k, v in labels.items())
+            and labels_subset(labels, p.meta.labels)
             and not p.is_terminating
         ]
 

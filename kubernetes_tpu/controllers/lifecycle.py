@@ -9,10 +9,10 @@ pkg/controller/resourceclaim/, pkg/controller/endpointslice/.
 
 from __future__ import annotations
 
+from ..api.labels import labels_subset
 from ..api.types import NO_EXECUTE, NodeCondition, Taint
 from ..api.workloads import Endpoint, EndpointSlice
 from ..api.meta import ObjectMeta
-from ..store.store import NotFoundError
 from .base import Controller
 
 UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
@@ -50,10 +50,7 @@ class GarbageCollector(Controller):
             return
         refs = obj.meta.owner_references
         if refs and not any(self._owner_exists(obj.meta.namespace, r) for r in refs):
-            try:
-                self.store.delete(kind, obj_key)
-            except NotFoundError:
-                pass
+            self.store.try_delete(kind, obj_key)
 
     def sweep(self) -> int:
         """Full-resync mark pass (the reference's graph rebuild on sync)."""
@@ -75,12 +72,6 @@ class NodeLifecycleController(Controller):
     name = "node-lifecycle"
     watches = ("Node", "Lease")
     grace_period = 40.0  # node-monitor-grace-period default
-
-    def __init__(self, store, informers=None, clock=None):
-        super().__init__(store, informers)
-        from ..utils.clock import Clock
-
-        self.clock = clock or Clock()
 
     def key_of(self, kind: str, obj) -> str | None:
         if kind == "Lease":
@@ -137,10 +128,7 @@ class NodeLifecycleController(Controller):
                 continue
             if any(tol.tolerates(taint) for tol in pod.spec.tolerations):
                 continue
-            try:
-                self.store.delete("Pod", pod.meta.key)
-            except NotFoundError:
-                pass
+            self.store.try_delete("Pod", pod.meta.key)
 
     def sweep(self) -> None:
         for node in self.store.nodes():
@@ -192,9 +180,9 @@ class EndpointSliceController(Controller):
             return obj.meta.key
         # pods map back to services by label match
         for svc in self.store.iter_kind("Service"):
-            if svc.meta.namespace == obj.meta.namespace and svc.spec.selector and all(
-                obj.meta.labels.get(k) == v for k, v in svc.spec.selector.items()
-            ):
+            if (svc.meta.namespace == obj.meta.namespace
+                    and svc.spec.selector
+                    and labels_subset(svc.spec.selector, obj.meta.labels)):
                 self.queue.add(svc.meta.key)
         return None
 
@@ -245,7 +233,7 @@ class EndpointSliceController(Controller):
             if p.meta.namespace == svc.meta.namespace
             and p.spec.node_name
             and svc.spec.selector
-            and all(p.meta.labels.get(k) == v for k, v in svc.spec.selector.items())
+            and labels_subset(svc.spec.selector, p.meta.labels)
         )
         name = f"{svc.meta.name}-endpoints"
         existing = self.store.try_get("EndpointSlice", f"{svc.meta.namespace}/{name}")
@@ -304,18 +292,12 @@ class NamespaceController(Controller):
                 if obj.meta.namespace != name:
                     continue
                 remaining += 1
-                try:
-                    self.store.delete(kind, obj.meta.key)
-                except NotFoundError:
-                    pass
+                self.store.try_delete(kind, obj.meta.key)
         if remaining:
             # deletes cascade through other controllers/kubelets; re-check
             self.queue.add(key)
             return
-        try:
-            self.store.delete("Namespace", key)
-        except NotFoundError:
-            pass
+        self.store.try_delete("Namespace", key)
 
 
 class TTLAfterFinishedController(Controller):
@@ -327,16 +309,7 @@ class TTLAfterFinishedController(Controller):
     name = "ttlafterfinished"
     watches = ("Job",)
 
-    def __init__(self, store, informers=None, clock=None):
-        from ..client.workqueue import WorkQueue
-        from ..utils.clock import Clock
-
-        super().__init__(store, informers)
-        self.clock = clock or Clock()
-        # the queue's delay timer must tick on the SAME clock the TTL math
-        # uses, or injected-clock tests (and any future frozen-clock sim)
-        # would wait on wall time
-        self.queue = WorkQueue(clock=self.clock.now)
+    clocked_queue = True  # TTL-expiry self-requeues ride the clock
 
     def reconcile(self, key: str) -> None:
         job = self.store.try_get("Job", key)
@@ -350,10 +323,7 @@ class TTLAfterFinishedController(Controller):
             return
         remaining = ttl - (self.clock.now() - done_at)
         if remaining <= 0:
-            try:
-                self.store.delete("Job", key)
-            except NotFoundError:
-                pass
+            self.store.try_delete("Job", key)
         else:
             # delayed requeue (the reference enqueueAfter) — a plain add()
             # would busy-spin the worker for the whole TTL window

@@ -189,12 +189,6 @@ class JobController(Controller):
     name = "job"
     watches = ("Job", "Pod")
 
-    def __init__(self, store, informers=None, clock=None):
-        from ..utils.clock import Clock
-
-        super().__init__(store, informers)
-        self.clock = clock or Clock()
-
     def key_of(self, kind: str, obj) -> str | None:
         if kind == "Job":
             return obj.meta.key

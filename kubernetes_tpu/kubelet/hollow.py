@@ -92,10 +92,7 @@ class HollowKubelet(NodeAgentBase):
                 # finalize: the runtime stops containers, then the API object
                 # goes away (kubelet's graceful deletion handshake)
                 self.runtime.kill_pod(pod.meta.key)
-                try:
-                    self.store.delete("Pod", pod.meta.key)
-                except NotFoundError:
-                    pass
+                self.store.try_delete("Pod", pod.meta.key)
                 changed += 1
                 continue
             if pod.status.phase == PENDING:

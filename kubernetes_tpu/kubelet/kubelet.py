@@ -127,10 +127,7 @@ class Kubelet(NodeAgentBase):
         if pod is None or pod.is_terminating:
             self._teardown(key)
             if pod is not None:
-                try:
-                    self.store.delete("Pod", key)
-                except NotFoundError:
-                    pass
+                self.store.try_delete("Pod", key)
             return
         if pod.spec.node_name != self.node_name:
             # same-named pod reassigned elsewhere (StatefulSet identity
@@ -260,10 +257,7 @@ class Kubelet(NodeAgentBase):
         self._backoff_wakeup.pop(key, None)
         for bk in [b for b in self._restart_backoff if b[0] == key]:
             del self._restart_backoff[bk]
-        try:
-            self.store.delete("PodMetrics", key)
-        except NotFoundError:
-            pass
+        self.store.try_delete("PodMetrics", key)
         sid = self._sandboxes.pop(key, None)
         if sid is None:
             return

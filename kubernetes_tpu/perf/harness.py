@@ -477,11 +477,11 @@ class WorkloadExecutor:
         """Delete up to n SCHEDULED pods matching selector; returns count.
         Shared by churn and deletePods — deleting pending pods frees
         nothing and shrinks the measured set."""
+        from ..api.labels import labels_subset
+
         pods = [
             p for p in self.store.pods()
-            if p.spec.node_name
-            and all(p.meta.labels.get(k) == v
-                    for k, v in (selector or {}).items())
+            if p.spec.node_name and labels_subset(selector or {}, p.meta.labels)
         ]
         if n:
             pods = pods[:n]

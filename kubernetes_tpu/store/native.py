@@ -221,6 +221,13 @@ class NativeStore:
             self._emit(kind, Event(MODIFIED, obj, rev, ts))
             return copy.deepcopy(obj)
 
+    def try_delete(self, kind: str, key: str):
+        """delete() tolerant of already-gone objects (Store.try_delete)."""
+        try:
+            return self.delete(kind, key)
+        except NotFoundError:
+            return None
+
     def delete(self, kind: str, key: str):
         with self._mu:
             ts = time.perf_counter()

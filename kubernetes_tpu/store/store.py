@@ -314,6 +314,14 @@ class Store:
             self._emit(kind, Event(DELETED, copy.deepcopy(cur), rev, time.perf_counter()))
             return cur
 
+    def try_delete(self, kind: str, key: str) -> Any | None:
+        """delete() for already-might-be-gone objects (controller GC paths
+        are full of benign delete races); returns None instead of raising."""
+        try:
+            return self.delete(kind, key)
+        except NotFoundError:
+            return None
+
     def list(self, kind: str, namespace: str | None = None) -> tuple[list[Any], int]:
         """Returns (objects, revision) — the revision to start a watch from.
         namespace filters BEFORE the deepcopy: a namespace-scoped consumer
