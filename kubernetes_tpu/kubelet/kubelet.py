@@ -284,6 +284,26 @@ class Kubelet(NodeAgentBase):
         # HPA controller consumes)
         if self.pod_stats:
             self._publish_metrics()
+        self._report_images()
+
+    def _report_images(self) -> None:
+        """NodeStatus.images from the CRI image store (kubelet_node_status
+        nodestatus.Images) — what the scheduler's ImageLocality scores."""
+        from ..api.types import ContainerImage
+
+        images = sorted(
+            (ContainerImage(names=(img.ref,), size_bytes=img.size_bytes)
+             for img in self.runtime.list_images()),
+            key=lambda i: i.names,
+        )
+        node = self.store.try_get("Node", self.node_name)
+        if node is None or node.status.images == images:
+            return
+        node.status.images = images
+        try:
+            self.store.update(node, check_version=False)
+        except (ConflictError, NotFoundError):
+            pass
 
     def _publish_metrics(self) -> None:
         from ..api.meta import ObjectMeta

@@ -582,3 +582,24 @@ class TestProbes:
             assert p.status.phase == RUNNING  # restart still pending
         finally:
             k.shutdown()
+
+
+class TestNodeStatusImages:
+    def test_pulled_images_reported_for_image_locality(self):
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        try:
+            pod = make_pod("web", image="registry/app:v1")
+            pod.spec.node_name = "n1"
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            k.sync_loop_iteration()  # housekeeping reports images
+            node = store.get("Node", "n1")
+            assert any("registry/app:v1" in img.names
+                       for img in node.status.images)
+            assert all(img.size_bytes > 0 for img in node.status.images)
+        finally:
+            k.shutdown()
