@@ -175,6 +175,27 @@ class ContainerPort:
 
 
 @dataclass(frozen=True)
+class KeyRef:
+    """configMapKeyRef / secretKeyRef: one key of a named config object."""
+
+    name: str
+    key: str
+    optional: bool = False
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """core/v1 EnvVar: literal value, or a reference resolved by the
+    kubelet at container start (missing non-optional refs block the start
+    with CreateContainerConfigError)."""
+
+    name: str
+    value: str = ""
+    config_map_key_ref: KeyRef | None = None
+    secret_key_ref: KeyRef | None = None
+
+
+@dataclass(frozen=True)
 class Probe:
     """core/v1 Probe subset: cadence + thresholds. The probe ACTION
     (exec/http/tcp) is the node agent's prober hook — spec carries only
@@ -195,6 +216,7 @@ class Container:
     ports: tuple[ContainerPort, ...] = ()
     liveness_probe: Probe | None = None
     readiness_probe: Probe | None = None
+    env: tuple[EnvVar, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -439,18 +461,18 @@ for _frozen in (
     WeightedPodAffinityTerm, PodAffinity, PodAntiAffinity, Affinity,
     Taint, Toleration, TopologySpreadConstraint, ContainerPort,
     SchedulingGroup, ContainerImage, GangPolicy, TopologyConstraint,
-    SchedulingConstraints, Probe,
+    SchedulingConstraints, Probe, EnvVar, KeyRef,
 ):
     _frozen.__deepcopy__ = _identity_deepcopy  # type: ignore[attr-defined]
 
 
 def _container_deepcopy(self: Container, memo) -> Container:
-    # probes are frozen → shareable; keep this hook in sync with the
+    # probes/env are frozen → shareable; keep this hook in sync with the
     # Container field list (a dropped field silently truncates every
     # object that passes through the store)
     return Container(self.name, self.image, dict(self.requests),
                      dict(self.limits), self.ports,
-                     self.liveness_probe, self.readiness_probe)
+                     self.liveness_probe, self.readiness_probe, self.env)
 
 
 def _podspec_deepcopy(self: PodSpec, memo) -> PodSpec:

@@ -301,6 +301,28 @@ class Namespace:
 
 
 @dataclass
+class ConfigMap:
+    """core/v1 ConfigMap: plain key→value configuration data."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, str] = field(default_factory=dict)
+
+    kind = "ConfigMap"
+
+
+@dataclass
+class Secret:
+    """core/v1 Secret subset: stringData semantics (values handled as
+    strings; at-rest encoding is the store's concern, not the type's)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, str] = field(default_factory=dict)
+    type: str = "Opaque"
+
+    kind = "Secret"
+
+
+@dataclass
 class ResourceQuota:
     """core/v1 ResourceQuota subset: hard caps per namespace over
     requests.cpu / requests.memory (milli / MiB) and object counts

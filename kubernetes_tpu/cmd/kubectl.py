@@ -33,6 +33,15 @@ ALIASES = {
     "storageclass": "StorageClass", "sc": "StorageClass",
     "podgroup": "PodGroup", "podgroups": "PodGroup", "pg": "PodGroup",
     "resourceclaim": "ResourceClaim", "resourceclaims": "ResourceClaim",
+    "configmap": "ConfigMap", "configmaps": "ConfigMap", "cm": "ConfigMap",
+    "secret": "Secret", "secrets": "Secret",
+    "cronjob": "CronJob", "cronjobs": "CronJob", "cj": "CronJob",
+    "hpa": "HorizontalPodAutoscaler",
+    "horizontalpodautoscaler": "HorizontalPodAutoscaler",
+    "resourcequota": "ResourceQuota", "quota": "ResourceQuota",
+    "statefulset": "StatefulSet", "statefulsets": "StatefulSet",
+    "sts": "StatefulSet",
+    "daemonset": "DaemonSet", "daemonsets": "DaemonSet", "ds": "DaemonSet",
     "resourceslice": "ResourceSlice", "resourceslices": "ResourceSlice",
     "lease": "Lease", "leases": "Lease",
 }

@@ -49,6 +49,8 @@ class CRIContainer:
     # fake-runtime knob: seconds after start when the container exits on
     # its own (None = runs until stopped), driving Job completion
     run_seconds: float | None = None
+    # resolved environment handed over at create (CRI ContainerConfig.envs)
+    env: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -120,13 +122,14 @@ class InMemoryRuntime:
             self.containers.pop(cid, None)
 
     def create_container(self, sandbox_id: str, name: str, image: str,
-                         run_seconds: float | None = None) -> str:
+                         run_seconds: float | None = None,
+                         env: dict | None = None) -> str:
         if sandbox_id not in self.sandboxes:
             raise RuntimeError(f"no sandbox {sandbox_id}")
         cid = f"c-{next(self._ids)}"
         self.containers[cid] = CRIContainer(
             id=cid, sandbox_id=sandbox_id, name=name, image=image,
-            run_seconds=run_seconds,
+            run_seconds=run_seconds, env=dict(env or {}),
         )
         return cid
 

@@ -55,6 +55,9 @@ class Kubelet(NodeAgentBase):
         # (restart count, no-restart-before); pod key → earliest wakeup
         self._restart_backoff: dict[tuple[str, str], tuple[int, float]] = {}
         self._backoff_wakeup: dict[str, float] = {}
+        # pods blocked on missing ConfigMap/Secret refs: retried each
+        # housekeeping pass until the reference appears
+        self._config_errors: set[str] = set()
         # injected usage for tests / simulations (summary-API stand-in)
         self.pod_stats: dict[str, PodStats] = {}
         self.node_available: dict[str, int] = {}
@@ -100,6 +103,11 @@ class Kubelet(NodeAgentBase):
         # probe ticks: pods with a due liveness/readiness probe re-sync
         now = self.clock.now()
         for key in self.prober.pods_due(now):
+            if key not in dispatched:
+                self.workers.update_pod(key)
+                dispatched.add(key)
+        # config-blocked pods: retry (their ConfigMap/Secret may exist now)
+        for key in list(self._config_errors):
             if key not in dispatched:
                 self.workers.update_pod(key)
                 dispatched.add(key)
@@ -153,6 +161,7 @@ class Kubelet(NodeAgentBase):
                     if c.sandbox_id == sid}
         run_s = pod.meta.annotations.get("kubemark.io/run-seconds")
         policy = pod.spec.restart_policy
+        config_blocked = False  # pod-level: ANY container missing its refs
         for spec_c in pod.spec.containers:
             c = existing.get(spec_c.name)
             if c is not None and c.state == EXITED and (
@@ -164,16 +173,55 @@ class Kubelet(NodeAgentBase):
                 self.runtime.remove_container(c.id)
                 c = None
             if c is None:
+                env = self._resolve_env(pod, spec_c)
+                if env is None:
+                    # CreateContainerConfigError: a referenced ConfigMap/
+                    # Secret key is missing — the container cannot start;
+                    # housekeeping retries until the reference appears
+                    config_blocked = True
+                    continue
                 if spec_c.image:
                     self.runtime.pull_image(spec_c.image)
                 cid = self.runtime.create_container(
                     sid, spec_c.name, spec_c.image,
                     run_seconds=float(run_s) if run_s is not None else None,
+                    env=env,
                 )
                 self.runtime.start_container(cid)
             elif c.state == CREATED:
                 self.runtime.start_container(c.id)
-        self._report_status(pod, sid)
+        # ONE pod-level set update after the loop: per-container updates
+        # would make retry bookkeeping depend on container order
+        if config_blocked:
+            self._config_errors.add(key)
+        else:
+            self._config_errors.discard(key)
+        self._report_status(pod, sid, config_blocked=config_blocked)
+
+    def _resolve_env(self, pod, spec_c) -> dict | None:
+        """EnvVar refs → concrete values (kubelet_pods makeEnvironment-
+        Variables); None = a non-optional reference is missing."""
+        env: dict[str, str] = {}
+        for ev in spec_c.env:
+            if ev.config_map_key_ref is not None:
+                ref = ev.config_map_key_ref
+                src = self.store.try_get(
+                    "ConfigMap", f"{pod.meta.namespace}/{ref.name}"
+                )
+            elif ev.secret_key_ref is not None:
+                ref = ev.secret_key_ref
+                src = self.store.try_get(
+                    "Secret", f"{pod.meta.namespace}/{ref.name}"
+                )
+            else:
+                env[ev.name] = ev.value
+                continue
+            if src is None or ref.key not in src.data:
+                if ref.optional:
+                    continue
+                return None
+            env[ev.name] = src.data[ref.key]
+        return env
 
     def _may_restart(self, key: str, cname: str, c) -> bool:
         """CrashLoopBackOff: exponential delay between restarts of the same
@@ -196,10 +244,13 @@ class Kubelet(NodeAgentBase):
         self._restart_backoff[bk] = (count + 1, now + delay)
         return True
 
-    def _report_status(self, pod, sid: str) -> None:
+    def _report_status(self, pod, sid: str, config_blocked: bool = False) -> None:
         """Container states → pod phase (kubelet's status manager), with
         probe results folded in: liveness failures kill the container
-        (restart policy then applies next sync), readiness gates Ready."""
+        (restart policy then applies next sync), readiness gates Ready.
+        config_blocked (CreateContainerConfigError on any container) pins
+        the pod Pending and NotReady — a pod missing one of its containers
+        must not serve traffic."""
         states = [c for c in self.runtime.list_containers()
                   if c.sandbox_id == sid]
         running = {c.name for c in states
@@ -214,7 +265,10 @@ class Kubelet(NodeAgentBase):
             # a liveness kill needs a follow-up sync to restart the
             # container per restartPolicy
             self.workers.update_pod(pod.meta.key)
-        if not states:
+        if not states or config_blocked:
+            # a container that never got created keeps the POD Pending
+            # (real phase semantics: Running requires every container
+            # started at least once)
             phase = PENDING
         elif all(c.state == EXITED for c in states):
             failed = any(c.exit_code != 0 for c in states)
@@ -254,6 +308,7 @@ class Kubelet(NodeAgentBase):
         # churn must not leak PodMetrics objects
         self.pod_stats.pop(key, None)
         self.prober.forget_pod(key)
+        self._config_errors.discard(key)
         self._backoff_wakeup.pop(key, None)
         for bk in [b for b in self._restart_backoff if b[0] == key]:
             del self._restart_backoff[bk]

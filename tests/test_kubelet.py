@@ -603,3 +603,114 @@ class TestNodeStatusImages:
             assert all(img.size_bytes > 0 for img in node.status.images)
         finally:
             k.shutdown()
+
+
+class TestEnvResolution:
+    def test_configmap_and_secret_env_end_to_end(self):
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.types import Container, EnvVar, KeyRef
+        from kubernetes_tpu.api.workloads import ConfigMap, Secret
+
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        try:
+            store.create(ConfigMap(meta=ObjectMeta(name="app-cfg"),
+                                   data={"LOG_LEVEL": "debug"}))
+            store.create(Secret(meta=ObjectMeta(name="db"),
+                                data={"password": "hunter2"}))
+            pod = make_pod("web")
+            pod.spec.node_name = "n1"
+            pod.spec.containers = [Container(
+                name="main", requests={"cpu": "100m"},
+                env=(
+                    EnvVar("PLAIN", value="1"),
+                    EnvVar("LOG_LEVEL",
+                           config_map_key_ref=KeyRef("app-cfg", "LOG_LEVEL")),
+                    EnvVar("DB_PASS", secret_key_ref=KeyRef("db", "password")),
+                    EnvVar("MISSING_OK", config_map_key_ref=KeyRef(
+                        "nope", "x", optional=True)),
+                ),
+            )]
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            (c,) = k.runtime.list_containers()
+            assert c.env == {"PLAIN": "1", "LOG_LEVEL": "debug",
+                             "DB_PASS": "hunter2"}
+            assert store.get("Pod", "default/web").status.phase == RUNNING
+        finally:
+            k.shutdown()
+
+    def test_missing_ref_blocks_until_created(self):
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.types import Container, EnvVar, KeyRef, PENDING
+        from kubernetes_tpu.api.workloads import ConfigMap
+
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        try:
+            pod = make_pod("blocked")
+            pod.spec.node_name = "n1"
+            pod.spec.containers = [Container(
+                name="main", requests={"cpu": "100m"},
+                env=(EnvVar("X", config_map_key_ref=KeyRef("later", "k")),),
+            )]
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            assert not k.runtime.list_containers()  # config error: no start
+            assert store.get("Pod", "default/blocked").status.phase == PENDING
+            # the reference appears → housekeeping retry starts the pod
+            store.create(ConfigMap(meta=ObjectMeta(name="later"),
+                                   data={"k": "v"}))
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            (c,) = k.runtime.list_containers()
+            assert c.env == {"X": "v"}
+            assert store.get("Pod", "default/blocked").status.phase == RUNNING
+        finally:
+            k.shutdown()
+
+    def test_partially_blocked_multi_container_pod_stays_pending(self):
+        """One config-blocked container keeps the POD Pending and NotReady
+        even while a sibling container runs — and the retry set survives
+        the sibling's successful start (container-order independence)."""
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.types import Container, EnvVar, KeyRef, PENDING
+        from kubernetes_tpu.api.workloads import ConfigMap
+
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        try:
+            pod = make_pod("half")
+            pod.spec.node_name = "n1"
+            pod.spec.containers = [
+                Container(name="a", requests={"cpu": "100m"},
+                          env=(EnvVar("X",
+                                      config_map_key_ref=KeyRef("later", "k")),)),
+                Container(name="b", requests={"cpu": "100m"}),
+            ]
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            got = store.get("Pod", "default/half")
+            assert got.status.phase == PENDING  # b runs, but a never started
+            ready = next((c.status for c in got.status.conditions
+                          if c.type == "Ready"), None)
+            assert ready == "False"
+            assert "default/half" in k._config_errors  # retry survives b
+            store.create(ConfigMap(meta=ObjectMeta(name="later"),
+                                   data={"k": "v"}))
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            got = store.get("Pod", "default/half")
+            assert got.status.phase == RUNNING
+            assert "default/half" not in k._config_errors
+        finally:
+            k.shutdown()
