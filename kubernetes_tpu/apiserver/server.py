@@ -149,12 +149,15 @@ class AdmissionError(Exception):
 
 class APIServer:
     def __init__(self, store: Store, admission: list[AdmissionFn] | None = None,
-                 authenticator=None, authorizer=None):
+                 authenticator=None, authorizer=None, tracer=None):
         """authenticator/authorizer None = the chain stage is skipped
         (insecure localhost serving, the in-tree trust model); passing a
         TokenAuthenticator + RBACAuthorizer (apiserver/auth.py) turns on
-        the generic server's authn→authz handler-chain stages."""
+        the generic server's authn→authz handler-chain stages. tracer (a
+        utils.tracing.Tracer) emits one span per request — the request-
+        filter spans of component-base/tracing."""
         self.store = store
+        self.tracer = tracer
         self.admission = list(admission or [])
         self.authenticator = authenticator
         self.authorizer = authorizer
@@ -531,6 +534,25 @@ class APIServer:
             def log_message(self, *a):
                 pass
 
+        def traced(method_fn):
+            # request-filter span wrapper (component-base/tracing): one
+            # root span per request, named like the reference's
+            # "{method} {path}" server spans
+            import functools
+
+            @functools.wraps(method_fn)
+            def wrapper(handler_self):
+                tracer = server.tracer
+                if tracer is None or tracer.exporter is None:
+                    return method_fn(handler_self)
+                path = handler_self.path.split("?")[0]
+                with tracer.span(f"HTTP {handler_self.command} {path}"):
+                    return method_fn(handler_self)
+
+            return wrapper
+
+        for verb in ("do_GET", "do_POST", "do_PUT", "do_DELETE"):
+            setattr(Handler, verb, traced(getattr(Handler, verb)))
         return Handler
 
     def _admit(self, operation: str, obj) -> None:
