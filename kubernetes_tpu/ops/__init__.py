@@ -11,8 +11,10 @@ from .planes import (
     Planes,
     PlaneBuilder,
     PodFeatureExtractor,
+    pack_features,
     pad_features,
     stack_features,
+    unpack_features,
 )
 from .kernels import (
     FILTER_NAMES,

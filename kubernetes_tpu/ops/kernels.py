@@ -820,9 +820,14 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, carry, inp
             overflow), winner
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _batched_assign_jit(cfg: KernelConfig, planes: dict, batched_f: dict,
-                        tie_words, cursor_init, frame_shift):
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _batched_assign_jit(cfg: KernelConfig, planes: dict, packed_f,
+                        layout, tie_words, cursor_init, frame_shift):
+    from .planes import unpack_features
+
+    # ONE host→device transfer carries the whole wave's features; the
+    # unpack slices fuse away under XLA (see planes.pack_features)
+    batched_f = unpack_features(packed_f, layout)
     static = jax.vmap(lambda f: _static_pod_parts(cfg, planes, f))(batched_f)
     dom_counts, present = _dom_counts_init(cfg, planes)
     ipa = ((planes["ipa_counts"], planes["ipa_anti"], planes["ipa_pref"])
@@ -870,8 +875,11 @@ def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
 
     Returns (winners [P] int32 node index or -1, dict with updated
     used/nonzero_used/sel_counts planes + tie_consumed/tie_overflow)."""
+    from .planes import pack_features
+
     if tie_words is None:
         tie_words = ZERO_TIE_WORDS
-    return _batched_assign_jit(cfg, planes, batched_f, tie_words,
+    packed, layout = pack_features(batched_f)
+    return _batched_assign_jit(cfg, planes, packed, layout, tie_words,
                                np.int32(cursor_init) if isinstance(cursor_init, int) else cursor_init,
                                np.int32(frame_shift))

@@ -753,3 +753,56 @@ def pad_features(stacked: dict[str, np.ndarray], pad_to: int) -> dict[str, np.nd
             pad -= 1  # -1 = inactive term slot
         out[k] = np.concatenate([a, pad])
     return out
+
+
+# --------------------------------------------------------------------------
+# feature packing: ONE host→device transfer per wave
+# --------------------------------------------------------------------------
+
+def pack_features(stacked: dict[str, np.ndarray]):
+    """Pack a stacked feature batch into a single [P, F] int32 buffer plus
+    a STATIC layout tuple. A wave's features are ~30 tiny arrays; over a
+    tunneled device each array is its own host→device transfer paying full
+    round-trip latency, so the batch ships as one buffer and the kernel
+    unpacks it inside the trace (slices fuse away under XLA).
+
+    bool columns ride as 0/1 int32, uint32 bitmask columns are bitcast
+    (same bytes); values are reconstructed exactly — bit-identity holds.
+    """
+    cols = []
+    layout = []
+    off = 0
+    for name in sorted(stacked):
+        a = stacked[name]
+        a2 = a[:, None] if a.ndim == 1 else a
+        width = a2.shape[1]
+        if a.dtype == np.uint32:
+            tag = "uint32"
+            cols.append(a2.view(np.int32))
+        elif a.dtype == np.bool_:
+            tag = "bool"
+            cols.append(a2.astype(np.int32))
+        else:
+            tag = "int32"
+            cols.append(a2.astype(np.int32, copy=False))
+        layout.append((name, off, width, a.ndim, tag))
+        off += width
+    return np.ascontiguousarray(np.concatenate(cols, axis=1)), tuple(layout)
+
+
+def unpack_features(buf, layout):
+    """Inverse of pack_features INSIDE a jit trace (layout is static)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    for name, off, width, ndim, tag in layout:
+        sl = buf[:, off:off + width]
+        if tag == "bool":
+            sl = sl.astype(bool)
+        elif tag == "uint32":
+            sl = jax.lax.bitcast_convert_type(sl, jnp.uint32)
+        if ndim == 1:
+            sl = sl[:, 0]
+        out[name] = sl
+    return out

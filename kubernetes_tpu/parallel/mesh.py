@@ -137,11 +137,14 @@ def sharded_fit_and_score(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict, f
 def sharded_batched_assign(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
                            batched_f: dict, tie_words=None):
     """Sequential-greedy wave over node-sharded planes (lax.scan on pods)."""
+    from ..ops.planes import pack_features
+
     if tie_words is None:
         tie_words = ZERO_TIE_WORDS
-    return _batched_assign_jit(cfg, sharded_planes, replicate(mesh, batched_f),
-                               replicate(mesh, tie_words), np.int32(0),
-                               np.int32(0))
+    packed, layout = pack_features(batched_f)
+    return _batched_assign_jit(cfg, sharded_planes, replicate(mesh, packed),
+                               layout, replicate(mesh, tie_words),
+                               np.int32(0), np.int32(0))
 
 
 @functools.partial(jax.jit, static_argnums=0)
