@@ -258,6 +258,40 @@ def _consume_disruption(client: RESTStore, pdb, pod, retries: int = 3) -> bool:
     return False
 
 
+def cmd_top(client: RESTStore, args) -> int:
+    """kubectl top pods/nodes — the metrics.k8s.io view (PodMetrics
+    published by kubelets)."""
+    kind = _kind(args.resource)
+    if kind == "Pod":
+        metrics, _ = client.list("PodMetrics")
+        print("NAME\tCPU(m)\tMEMORY(Mi)")
+        for m in sorted(metrics, key=lambda m: m.meta.key):
+            if not args.all_namespaces and m.meta.namespace != args.namespace:
+                continue
+            print(f"{m.meta.name}\t{m.cpu_usage_milli}m\t"
+                  f"{m.memory_usage_bytes >> 20}Mi")
+        return 0
+    if kind == "Node":
+        metrics, _ = client.list("PodMetrics")
+        pods, _ = client.list("Pod")
+        node_of = {p.meta.key: p.spec.node_name for p in pods}
+        by_node: dict[str, list] = {}
+        for m in metrics:
+            node = node_of.get(m.meta.key)
+            if node:
+                by_node.setdefault(node, []).append(m)
+        print("NAME\tCPU(m)\tMEMORY(Mi)")
+        for node in sorted(n.meta.name for n in client.nodes()):
+            ms = by_node.get(node, [])
+            cpu = sum(m.cpu_usage_milli for m in ms)
+            mem = sum(m.memory_usage_bytes for m in ms)
+            print(f"{node}\t{cpu}m\t{mem >> 20}Mi")
+        return 0
+    print(f"error: top supports pods/nodes, not {args.resource}",
+          file=sys.stderr)
+    return 1
+
+
 def cmd_events(client: RESTStore, args) -> int:
     """kubectl get events — the Scheduled/FailedScheduling stream."""
     events = sorted(client.iter_kind("Event"),
@@ -311,6 +345,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     ev = sub.add_parser("events")
     ev.add_argument("-A", "--all-namespaces", action="store_true")
+
+    tp = sub.add_parser("top")
+    tp.add_argument("resource")
+    tp.add_argument("-A", "--all-namespaces", action="store_true")
     return parser
 
 
@@ -328,6 +366,7 @@ def main(argv: list[str] | None = None) -> int:
         "uncordon": lambda c, a: cmd_cordon(c, a, False),
         "drain": cmd_drain,
         "events": cmd_events,
+        "top": cmd_top,
     }
     return verbs[args.verb](client, args)
 

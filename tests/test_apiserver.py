@@ -389,3 +389,33 @@ class TestSelectors:
             assert exc.value.code == 400
         finally:
             server.shutdown()
+
+
+class TestKubectlTop:
+    def test_top_pods_and_nodes(self, capsys):
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.workloads import PodMetrics
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+        from kubernetes_tpu.store import Store
+        from tests.wrappers import make_node, make_pod
+
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        try:
+            store.create(make_node("n1", cpu="8", mem="16Gi"))
+            pod = make_pod("web-0")
+            pod.spec.node_name = "n1"
+            store.create(pod)
+            store.create(PodMetrics(meta=ObjectMeta(name="web-0"),
+                                    cpu_usage_milli=250,
+                                    memory_usage_bytes=64 << 20))
+            assert kubectl(["-s", server.url, "top", "pods"]) == 0
+            out = capsys.readouterr().out
+            assert "web-0\t250m\t64Mi" in out
+            assert kubectl(["-s", server.url, "top", "nodes"]) == 0
+            out = capsys.readouterr().out
+            assert "n1\t250m\t64Mi" in out
+        finally:
+            server.shutdown()
