@@ -72,6 +72,7 @@ class NodeLifecycleController(Controller):
     name = "node-lifecycle"
     watches = ("Node", "Lease")
     grace_period = 40.0  # node-monitor-grace-period default
+    clocked_queue = True  # staleness monitoring self-requeues
 
     def key_of(self, kind: str, obj) -> str | None:
         if kind == "Lease":
@@ -118,6 +119,10 @@ class NodeLifecycleController(Controller):
             self.store.update(node, check_version=False)
         if not fresh:
             self._evict_pods(key)
+        # continuous health monitoring (the reference's monitorNodeHealth
+        # 5s poll): a DEAD kubelet emits no further lease events, so the
+        # controller must wake itself to observe the staleness
+        self.queue.add_after(key, max(self.grace_period / 2, 0.2))
 
     def _evict_pods(self, node_name: str) -> None:
         """tainteviction — NoExecute evicts pods lacking a matching
