@@ -63,11 +63,32 @@ class ReplicaSetController(Controller):
             and not p.is_terminating
         ]
 
+    def _adopt_orphans(self, rs: ReplicaSet) -> None:
+        """ControllerRefManager adoption: a selector-matching pod with no
+        controller owner gains this ReplicaSet's controllerRef (so manually
+        created or orphaned pods count toward replicas instead of being
+        doubled up)."""
+        sel = rs.spec.selector
+        if sel is None or sel.empty:
+            return
+        for p in self.store.pods():
+            if p.meta.namespace != rs.meta.namespace or p.is_terminating:
+                continue
+            if any(r.controller for r in p.meta.owner_references):
+                continue
+            if not sel.matches(p.meta.labels):
+                continue
+            p.meta.owner_references = list(p.meta.owner_references) + [
+                _controller_ref(rs)
+            ]
+            self.store.update(p, check_version=False)
+
     def reconcile(self, key: str) -> None:
         try:
             rs = self.store.get("ReplicaSet", key)
         except NotFoundError:
             return  # GC deletes the orphans
+        self._adopt_orphans(rs)
         pods = self._active_owned_pods(rs)
         diff = rs.spec.replicas - len(pods)
         if diff > 0:

@@ -345,3 +345,52 @@ class TestNamespaceDrainDerived:
             ctl.sync_once()
         assert store.try_get("Lease", "team-a/lock") is None
         assert store.try_get("Namespace", "team-a") is None
+
+
+class TestAdoption:
+    def test_replicaset_adopts_matching_orphan(self):
+        """ControllerRefManager: an orphan pod matching the selector is
+        adopted and counts toward replicas (no doubling)."""
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.api.workloads import ReplicaSet, ReplicaSetSpec
+        from kubernetes_tpu.controllers import ReplicaSetController
+
+        store = Store()
+        orphan = make_pod("orphan", labels={"app": "web"})
+        store.create(orphan)
+        store.create(ReplicaSet(
+            meta=ObjectMeta(name="web"),
+            spec=ReplicaSetSpec(replicas=2,
+                                selector=LabelSelector.of({"app": "web"}),
+                                template=template({"app": "web"})),
+        ))
+        ctl = ReplicaSetController(store)
+        ctl.sync_once()
+        pods = [p for p in store.pods()
+                if p.meta.labels.get("app") == "web"]
+        assert len(pods) == 2  # orphan adopted + ONE new, not two new
+        adopted = store.get("Pod", "default/orphan")
+        assert any(r.controller and r.kind == "ReplicaSet"
+                   for r in adopted.meta.owner_references)
+
+    def test_orphan_with_other_owner_not_adopted(self):
+        from kubernetes_tpu.api.labels import LabelSelector
+        from kubernetes_tpu.api.meta import OwnerReference
+        from kubernetes_tpu.api.workloads import ReplicaSet, ReplicaSetSpec
+        from kubernetes_tpu.controllers import ReplicaSetController
+
+        store = Store()
+        owned = make_pod("foreign", labels={"app": "web"})
+        owned.meta.owner_references = [OwnerReference(
+            kind="StatefulSet", name="other", uid="u1", controller=True)]
+        store.create(owned)
+        store.create(ReplicaSet(
+            meta=ObjectMeta(name="web"),
+            spec=ReplicaSetSpec(replicas=1,
+                                selector=LabelSelector.of({"app": "web"}),
+                                template=template({"app": "web"})),
+        ))
+        ReplicaSetController(store).sync_once()
+        pods = [p for p in store.pods()
+                if p.meta.labels.get("app") == "web"]
+        assert len(pods) == 2  # foreign pod untouched; RS minted its own
