@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time as _time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 from urllib.parse import parse_qs, urlparse
@@ -63,6 +64,34 @@ _LABEL_TOKEN_RE = re.compile(f"^{_LABEL_TOKEN}$")
 # sentinel user for insecure serving (no authenticator configured): the
 # whole authn/authz chain is off, every request is trusted
 _TRUSTED = object()
+
+
+class AuditLog:
+    """The audit stage of the handler chain (staging/.../apiserver/pkg/
+    audit): one entry per request — who did what to which resource with
+    what outcome — kept in a bounded ring and streamed to an optional sink
+    (the audit-webhook/log-backend role)."""
+
+    def __init__(self, capacity: int = 1024, sink=None):
+        import collections
+
+        self.entries = collections.deque(maxlen=capacity)
+        self.sink = sink
+        self._lock = threading.Lock()
+
+    def record(self, user: str, verb: str, resource: str, key: str,
+               code: int) -> None:
+        entry = {"user": user, "verb": verb, "resource": resource,
+                 "key": key, "code": code, "ts": _time.time()}
+        with self._lock:
+            self.entries.append(entry)
+        if self.sink is not None:
+            self.sink(entry)
+
+    def find(self, **match) -> list[dict]:
+        with self._lock:
+            return [e for e in self.entries
+                    if all(e.get(k) == v for k, v in match.items())]
 
 
 def parse_label_selector(expr: str) -> list[tuple[str, str, str]]:
@@ -149,15 +178,18 @@ class AdmissionError(Exception):
 
 class APIServer:
     def __init__(self, store: Store, admission: list[AdmissionFn] | None = None,
-                 authenticator=None, authorizer=None, tracer=None):
+                 authenticator=None, authorizer=None, tracer=None,
+                 audit: AuditLog | None = None):
         """authenticator/authorizer None = the chain stage is skipped
         (insecure localhost serving, the in-tree trust model); passing a
         TokenAuthenticator + RBACAuthorizer (apiserver/auth.py) turns on
         the generic server's authn→authz handler-chain stages. tracer (a
         utils.tracing.Tracer) emits one span per request — the request-
-        filter spans of component-base/tracing."""
+        filter spans of component-base/tracing. Every API request is
+        audit-logged (who/verb/resource/outcome) to `audit`."""
         self.store = store
         self.tracer = tracer
+        self.audit = audit or AuditLog()
         self.admission = list(admission or [])
         self.authenticator = authenticator
         self.authorizer = authorizer
@@ -242,9 +274,11 @@ class APIServer:
                 if server.authenticator is None:
                     return _TRUSTED
                 try:
-                    return server.authenticator.authenticate(
+                    user = server.authenticator.authenticate(
                         self.headers.get("Authorization")
                     )
+                    self._audit_user = user.name
+                    return user
                 except AuthenticationError as e:
                     self._error(401, "Unauthorized", str(e))
                     return None
@@ -534,25 +568,58 @@ class APIServer:
             def log_message(self, *a):
                 pass
 
-        def traced(method_fn):
-            # request-filter span wrapper (component-base/tracing): one
-            # root span per request, named like the reference's
-            # "{method} {path}" server spans
+        _VERB_BY_METHOD = {"POST": "create", "PUT": "update",
+                           "DELETE": "delete"}
+
+        def instrumented(method_fn):
+            # request-filter wrapper: one root span per request
+            # (component-base/tracing) + one audit entry per API request
+            # (the audit stage of the handler chain)
             import functools
 
             @functools.wraps(method_fn)
             def wrapper(handler_self):
+                handler_self._audit_user = "system:unsecured"
+                handler_self._audit_code = 0
+
+                def run():
+                    return method_fn(handler_self)
+
                 tracer = server.tracer
-                if tracer is None or tracer.exporter is None:
-                    return method_fn(handler_self)
-                path = handler_self.path.split("?")[0]
-                with tracer.span(f"HTTP {handler_self.command} {path}"):
-                    return method_fn(handler_self)
+                try:
+                    if tracer is not None and tracer.exporter is not None:
+                        path = handler_self.path.split("?")[0]
+                        with tracer.span(
+                            f"HTTP {handler_self.command} {path}"
+                        ):
+                            return run()
+                    return run()
+                finally:
+                    route = handler_self._route()
+                    if route is not None:
+                        kind, key, _sub, query = route
+                        method = handler_self.command
+                        if method == "GET":
+                            verb = ("watch" if query.get("watch")
+                                    else "get" if key else "list")
+                        else:
+                            verb = _VERB_BY_METHOD.get(method, method.lower())
+                        server.audit.record(
+                            handler_self._audit_user, verb, kind, key,
+                            handler_self._audit_code,
+                        )
 
             return wrapper
 
+        _orig_send_response = Handler.send_response
+
+        def send_response(handler_self, code, message=None):
+            handler_self._audit_code = code
+            return _orig_send_response(handler_self, code, message)
+
+        Handler.send_response = send_response
         for verb in ("do_GET", "do_POST", "do_PUT", "do_DELETE"):
-            setattr(Handler, verb, traced(getattr(Handler, verb)))
+            setattr(Handler, verb, instrumented(getattr(Handler, verb)))
         return Handler
 
     def _admit(self, operation: str, obj) -> None:

@@ -377,3 +377,52 @@ class TestDiscoveryAuth:
                 assert r.status == 200
         finally:
             server.shutdown()
+
+
+class TestAuditLog:
+    def test_requests_audited_with_user_and_outcome(self):
+        store, server = secure_server()
+        try:
+            admin = RESTStore(server.url, token="admin-token")
+            pod = admin.create(make_pod("p1"))
+            admin.delete("Pod", pod.meta.key)
+            viewer = RESTStore(server.url, token="viewer-token")
+            with pytest.raises(RESTError):
+                viewer.create(make_pod("nope"))
+            # audit entries land just AFTER the response bytes: poll briefly
+            import time as _t
+
+            deadline = _t.monotonic() + 2
+            creates = []
+            while _t.monotonic() < deadline and len(creates) < 2:
+                creates = server.audit.find(verb="create", resource="Pod")
+                _t.sleep(0.005)
+            assert any(e["user"] == "admin" and e["code"] == 201
+                       for e in creates)
+            assert any(e["user"] == "alice" and e["code"] == 403
+                       for e in creates)
+            deletes = server.audit.find(verb="delete", resource="Pod")
+            assert deletes and deletes[0]["user"] == "admin"
+            assert deletes[0]["key"] == "default/p1"
+        finally:
+            server.shutdown()
+
+    def test_audit_sink_streams(self):
+        streamed = []
+        from kubernetes_tpu.apiserver.server import APIServer, AuditLog
+        from kubernetes_tpu.store.store import Store as _Store
+
+        server = APIServer(_Store(), audit=AuditLog(sink=streamed.append))
+        server.serve(0)
+        try:
+            client = RESTStore(server.url)
+            client.create(make_pod("p"))
+            import time as _t
+
+            deadline = _t.monotonic() + 2
+            while _t.monotonic() < deadline and not streamed:
+                _t.sleep(0.005)
+            assert streamed and streamed[0]["verb"] == "create"
+            assert streamed[0]["resource"] == "Pod"
+        finally:
+            server.shutdown()
