@@ -258,6 +258,70 @@ def _consume_disruption(client: RESTStore, pdb, pod, retries: int = 3) -> bool:
     return False
 
 
+def cmd_rollout(client: RESTStore, args) -> int:
+    """kubectl rollout status|history|undo for Deployments
+    (staging/.../kubectl/pkg/polymorphichelpers + rollback.go): revisions
+    live on the owned ReplicaSets' deployment.kubernetes.io/revision
+    annotations; undo copies a past revision's template back into the
+    deployment spec (minus the pod-template-hash label)."""
+    import time as _time
+
+    kind = _kind(args.resource)
+    if kind != "Deployment":
+        print("error: rollout supports deployments", file=sys.stderr)
+        return 1
+    key = _key(kind, args.name, args.namespace)
+    dep = client.get(kind, key)
+    rs_list = [
+        rs for rs in client.iter_kind("ReplicaSet")
+        if rs.meta.namespace == dep.meta.namespace
+        and any(r.kind == "Deployment" and r.name == dep.meta.name
+                and r.controller for r in rs.meta.owner_references)
+    ]
+    rev_key = "deployment.kubernetes.io/revision"
+    by_rev = {int(rs.meta.annotations.get(rev_key, 0)): rs for rs in rs_list}
+
+    if args.action == "history":
+        for rev in sorted(by_rev):
+            rs = by_rev[rev]
+            print(f"{rev}\t{rs.meta.name}\treplicas={rs.spec.replicas}")
+        return 0
+
+    if args.action == "status":
+        deadline = _time.monotonic() + args.timeout
+        while _time.monotonic() < deadline:
+            dep = client.get(kind, key)
+            if (dep.status.ready_replicas >= dep.spec.replicas
+                    and dep.status.updated_replicas >= dep.spec.replicas):
+                print(f'deployment "{args.name}" successfully rolled out')
+                return 0
+            _time.sleep(args.poll)
+        print(f'error: deployment "{args.name}" not rolled out: '
+              f"{dep.status.ready_replicas}/{dep.spec.replicas} ready",
+              file=sys.stderr)
+        return 1
+
+    if args.action == "undo":
+        current = int(dep.meta.annotations.get(rev_key, 0))
+        target_rev = args.to_revision or max(
+            (r for r in by_rev if r != current), default=0
+        )
+        if target_rev not in by_rev:
+            print(f"error: revision {target_rev} not found", file=sys.stderr)
+            return 1
+        rs = by_rev[target_rev]
+        template = rs.spec.template
+        labels = {k: v for k, v in template.labels.items()
+                  if k != "pod-template-hash"}
+        dep.spec.template = type(template)(labels=labels, spec=template.spec)
+        client.update(dep, check_version=False)
+        print(f"deployment/{args.name} rolled back to revision {target_rev}")
+        return 0
+
+    print(f"error: unknown rollout action {args.action}", file=sys.stderr)
+    return 1
+
+
 def cmd_top(client: RESTStore, args) -> int:
     """kubectl top pods/nodes — the metrics.k8s.io view (PodMetrics
     published by kubelets)."""
@@ -349,6 +413,14 @@ def build_parser() -> argparse.ArgumentParser:
     tp = sub.add_parser("top")
     tp.add_argument("resource")
     tp.add_argument("-A", "--all-namespaces", action="store_true")
+
+    ro = sub.add_parser("rollout")
+    ro.add_argument("action", choices=["status", "history", "undo"])
+    ro.add_argument("resource")
+    ro.add_argument("name")
+    ro.add_argument("--to-revision", type=int, default=0)
+    ro.add_argument("--timeout", type=float, default=10.0)
+    ro.add_argument("--poll", type=float, default=0.05)
     return parser
 
 
@@ -367,6 +439,7 @@ def main(argv: list[str] | None = None) -> int:
         "drain": cmd_drain,
         "events": cmd_events,
         "top": cmd_top,
+        "rollout": cmd_rollout,
     }
     return verbs[args.verb](client, args)
 

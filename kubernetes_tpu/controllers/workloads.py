@@ -125,6 +125,9 @@ class ReplicaSetController(Controller):
             self.store.update(rs, check_version=False)
 
 
+REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
+
+
 def _template_hash(dep: Deployment) -> str:
     import json
 
@@ -169,11 +172,20 @@ class DeploymentController(Controller):
             template = type(dep.spec.template)(
                 labels=labels, spec=_clone_pod_spec(dep.spec.template)
             )
+            # revision bookkeeping (deployment.kubernetes.io/revision):
+            # each new template gets the next revision number; old RSes
+            # stay (scaled to 0) as rollback targets
+            next_rev = 1 + max(
+                (int(rs.meta.annotations.get(REVISION_ANNOTATION, 0))
+                 for rs in owned),
+                default=0,
+            )
             new_rs = ReplicaSet(
                 meta=ObjectMeta(
                     name=want_name,
                     namespace=dep.meta.namespace,
                     labels=labels,
+                    annotations={REVISION_ANNOTATION: str(next_rev)},
                     owner_references=[_controller_ref(dep)],
                 ),
                 spec=ReplicaSetSpec(
@@ -183,6 +195,9 @@ class DeploymentController(Controller):
                 ),
             )
             self.store.create(new_rs)
+            if dep.meta.annotations.get(REVISION_ANNOTATION) != str(next_rev):
+                dep.meta.annotations[REVISION_ANNOTATION] = str(next_rev)
+                self.store.update(dep, check_version=False)
         elif new_rs.spec.replicas != dep.spec.replicas:
             new_rs.spec.replicas = dep.spec.replicas
             self.store.update(new_rs, check_version=False)

@@ -394,3 +394,82 @@ class TestAdoption:
         pods = [p for p in store.pods()
                 if p.meta.labels.get("app") == "web"]
         assert len(pods) == 2  # foreign pod untouched; RS minted its own
+
+
+class TestRolloutRevisions:
+    def test_rollout_history_and_undo(self, capsys):
+        """Template change → new revision; undo restores the previous
+        template and the controller converges pods back."""
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+        from kubernetes_tpu.controllers import (
+            DeploymentController,
+            ReplicaSetController,
+        )
+
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        try:
+            url = server.url
+            dc = DeploymentController(store)
+            rc = ReplicaSetController(store)
+            store.create(Deployment(
+                meta=ObjectMeta(name="web"),
+                spec=DeploymentSpec(replicas=2,
+                                    template=template({"app": "web"},
+                                                      cpu="100m")),
+            ))
+            dc.sync_once(); rc.sync_once()
+            # roll: new template (different cpu) → revision 2
+            dep = store.get("Deployment", "default/web")
+            dep.spec.template = template({"app": "web"}, cpu="200m")
+            store.update(dep, check_version=False)
+            dc.sync_once(); rc.sync_once()
+            dep = store.get("Deployment", "default/web")
+            assert dep.meta.annotations[
+                "deployment.kubernetes.io/revision"] == "2"
+            assert kubectl(["-s", url, "rollout", "history", "deploy",
+                            "web"]) == 0
+            out = capsys.readouterr().out
+            assert out.count("\n") == 2  # two revisions listed
+            # undo → template back to 100m, revision 3 minted on reconcile
+            assert kubectl(["-s", url, "rollout", "undo", "deploy",
+                            "web"]) == 0
+            dc.sync_once(); rc.sync_once()
+            dep = store.get("Deployment", "default/web")
+            req = dep.spec.template.spec.containers[0].requests["cpu"]
+            assert req == "100m"
+        finally:
+            server.shutdown()
+
+    def test_rollout_status_converges(self, capsys):
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+        from kubernetes_tpu.controllers import default_controllers, ControllerManager
+        from kubernetes_tpu.kubelet import start_hollow_nodes
+        from kubernetes_tpu.scheduler import Scheduler
+
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        try:
+            cm = ControllerManager(store, default_controllers(store))
+            sched = Scheduler(store)
+            sched.start()
+            kubelets = start_hollow_nodes(store, 2)
+            store.create(Deployment(
+                meta=ObjectMeta(name="api"),
+                spec=DeploymentSpec(replicas=2,
+                                    template=template({"app": "api"})),
+            ))
+            for _ in range(6):
+                cm.sync_once()
+                sched.schedule_pending()
+                for k in kubelets:
+                    k.sync_once()
+            assert kubectl(["-s", server.url, "rollout", "status", "deploy",
+                            "api", "--timeout", "2"]) == 0
+            assert "successfully rolled out" in capsys.readouterr().out
+        finally:
+            server.shutdown()
