@@ -131,6 +131,15 @@ class PlaneBuilder:
                     continue
                 self._write_row(p, i, ni, fp)
                 dirty.append(i)
+            # GLOBAL (non-row) tables must track vocab growth too: a term
+            # interned mid-run (first pod with that affinity) dirties every
+            # row's counts above, but its key-slot mapping lives here — a
+            # stale -1 makes the kernel reject every node for that term
+            for ti, (_ns, _sel, ki) in enumerate(self.vocabs.ipa_term_matchers):
+                if p.ipa_term_key[ti] != ki:
+                    p.ipa_term_key[ti] = ki
+                    if not dirty:
+                        dirty = [0]  # force a version bump + device refresh
             self.dirty_rows = dirty
             if dirty:
                 self._version += 1

@@ -294,12 +294,21 @@ class TPUBackend:
             # one fused jitted scatter for every plane: eager per-plane
             # .at[].set() dispatches (and first-compiles) one tiny program
             # per plane per idx-bucket — a dozen device round-trip latencies
-            # per wave on a tunneled chip. ipa_term_key is a global table;
-            # its changes force a full rebuild elsewhere.
+            # per wave on a tunneled chip. ipa_term_key is GLOBAL (not
+            # row-indexed): re-upload it whenever its content moved (a new
+            # term interned mid-run dirties every row but not the shape —
+            # a stale device copy maps the new term to key slot -1 and the
+            # kernel rejects every node).
             scatter_in = {k: v for k, v in dev.items() if k != "ipa_term_key"}
             rows_host = {k: host[k][idx] for k in scatter_in}
             updated = _scatter_rows_jit(scatter_in, rows_host, idx)
-            updated["ipa_term_key"] = dev["ipa_term_key"]
+            if np.array_equal(np.asarray(dev["ipa_term_key"]),
+                              host["ipa_term_key"]):
+                updated["ipa_term_key"] = dev["ipa_term_key"]
+            else:
+                updated["ipa_term_key"] = self._jax.device_put(
+                    host["ipa_term_key"]
+                )
             self._device_planes = updated
         self._device_version = planes.version
         self._device_buckets = planes.bucket_sizes
