@@ -134,3 +134,45 @@ def test_wave_mode_matches_per_pod(seed=44):
                         always_schedulable=True)
     assert waved == per_pod
     assert all(per_pod.values())  # truly no retries in this comparison
+
+
+def preemption_assignments(backend: str, seed: int) -> dict[str, tuple]:
+    """Small saturated cluster + a burst of high-priority preemptors."""
+    rng = random.Random(seed)
+    store = Store()
+    for i in range(8):
+        store.create(make_node(f"n{i}", cpu="4", mem="8Gi",
+                               zone=rng.choice(ZONES)))
+    s = Scheduler(store, profiles=[Profile(backend=backend)], seed=5)
+    s.start()
+    for i in range(16):  # fill: 2 low-prio pods per node
+        p = make_pod(f"low-{i:02d}", cpu="1800m", mem="1Gi")
+        p.spec.priority = 0
+        store.create(p)
+    s.schedule_pending()
+    for i in range(rng.randint(3, 5)):  # preemptor burst
+        p = make_pod(f"vip-{i}", cpu="3", mem="2Gi")
+        p.spec.priority = 100
+        store.create(p)
+    import time as _t
+
+    for _ in range(60):
+        s.schedule_pending()
+        vips = [p for p in store.pods() if p.meta.name.startswith("vip")]
+        if vips and all(v.spec.node_name for v in vips):
+            break
+        _t.sleep(0.2)  # ride out the post-preemption backoff (real clock)
+    return {p.meta.name: (p.spec.node_name, p.spec.priority)
+            for p in store.pods()}
+
+
+@pytest.mark.parametrize("seed", [3, 7])
+def test_preemption_parity_host_vs_tpu(seed):
+    host = preemption_assignments("host", seed)
+    tpu = preemption_assignments("tpu", seed)
+    # every preemptor must land in both backends
+    for name, (node, prio) in host.items():
+        if name.startswith("vip"):
+            assert node, f"{name} unscheduled on host"
+            assert tpu[name][0], f"{name} unscheduled on tpu"
+    assert tpu == host
