@@ -42,7 +42,114 @@ def _head(major: int, n: int) -> bytes:
     return bytes([mb | 27]) + n.to_bytes(8, "big")
 
 
+# -- native transcoder (JSON text ↔ CBOR in C++) -----------------------------
+#
+# The pure-Python encoder walks objects byte by byte; for the list-sized
+# payloads the binary format exists for, that is slower than the
+# C-accelerated json module. The native path (native/cbor_core.cpp) rides
+# json.dumps/json.loads for the Python-object half and does the byte work
+# in C++. Values outside the JSON data model (byte strings, >64-bit ints,
+# non-string map keys) transparently fall back to the pure codec.
+
+_native = None
+_native_tried = False
+
+
+def _load_native():
+    global _native, _native_tried
+    if _native_tried:
+        return _native
+    import ctypes
+
+    from ..utils.nativelib import load_native
+
+    lib = load_native("libcbor_core.so")  # locked build-and-load
+    if lib is not None and not hasattr(lib, "_cj_prototyped"):
+        lib.cj_json_to_cbor.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.cj_json_to_cbor.restype = ctypes.c_int64
+        lib.cj_cbor_to_json.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.cj_cbor_to_json.restype = ctypes.c_int64
+        lib.cj_free.argtypes = [ctypes.c_void_p]
+        lib.cj_free.restype = None
+        lib._cj_prototyped = True
+    _native = lib
+    _native_tried = True
+    return _native
+
+
+def _str_keys_only(obj) -> bool:
+    """json.dumps STRINGIFIES int/bool/None dict keys instead of raising —
+    the native path must not silently corrupt them; walk containers (not
+    leaf values) and punt to the pure codec on any non-str key."""
+    if isinstance(obj, dict):
+        return all(
+            isinstance(k, str) and _str_keys_only(v) for k, v in obj.items()
+        )
+    if isinstance(obj, (list, tuple)):
+        return all(_str_keys_only(v) for v in obj)
+    return True
+
+
+def _native_dumps(obj) -> bytes | None:
+    lib = _load_native()
+    if lib is None:
+        return None
+    if not _str_keys_only(obj):
+        return None  # non-str map keys: pure codec preserves them
+    import ctypes
+    import json as _json
+
+    try:
+        text = _json.dumps(obj, ensure_ascii=False, separators=(",", ":"))
+    except (TypeError, ValueError):
+        return None  # bytes or other non-JSON values: pure codec
+    raw = text.encode("utf-8")
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    rc = lib.cj_json_to_cbor(raw, len(raw), ctypes.byref(out),
+                             ctypes.byref(out_len))
+    if rc != 0:
+        return None
+    try:
+        return ctypes.string_at(out, out_len.value)
+    finally:
+        lib.cj_free(out)
+
+
+def _native_loads(data: bytes):
+    lib = _load_native()
+    if lib is None:
+        return None
+    import ctypes
+    import json as _json
+
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else \
+        (ctypes.c_uint8 * 1)()
+    out = ctypes.c_char_p()
+    out_len = ctypes.c_size_t()
+    rc = lib.cj_cbor_to_json(buf, len(data), ctypes.byref(out),
+                             ctypes.byref(out_len))
+    if rc != 0:
+        return None
+    try:
+        text = ctypes.string_at(out, out_len.value).decode("utf-8")
+    finally:
+        lib.cj_free(out)
+    return (_json.loads(text),)
+
+
 def dumps(obj) -> bytes:
+    native = _native_dumps(obj)
+    if native is not None:
+        return native
     out = bytearray()
     _encode(obj, out)
     return bytes(out)
@@ -136,6 +243,9 @@ class _Decoder:
 
 
 def loads(data: bytes):
+    native = _native_loads(data)
+    if native is not None:
+        return native[0]
     dec = _Decoder(data)
     obj = dec.decode()
     if dec.pos != len(data):

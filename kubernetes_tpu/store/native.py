@@ -60,9 +60,11 @@ def load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not _LIB_PATH.exists():
-            _build_library()
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        from ..utils.nativelib import load_native
+
+        lib = load_native("libstore_core.so")  # shared locked loader
+        if lib is None:
+            raise OSError("native store core unavailable")
         lib.sc_new.restype = ctypes.c_void_p
         lib.sc_free.argtypes = [ctypes.c_void_p]
         lib.sc_buf_free.argtypes = [ctypes.c_char_p]

@@ -1,0 +1,37 @@
+"""Shared loader for the native/ C++ cores.
+
+One locked build-and-load path for every native library (store engine,
+CBOR transcoder): builds via `make -C native` on first use, caches the
+CDLL, and returns None when the toolchain is unavailable so callers fall
+back to their pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_cache: dict[str, ctypes.CDLL | None] = {}
+_lock = threading.Lock()
+
+
+def load_native(lib_name: str) -> ctypes.CDLL | None:
+    """Load native/<lib_name> (building if missing); None = unavailable.
+    Thread-safe: concurrent first calls serialize on the build."""
+    with _lock:
+        if lib_name in _cache:
+            return _cache[lib_name]
+        lib = None
+        try:
+            path = _NATIVE_DIR / lib_name
+            if not path.exists():
+                subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                               capture_output=True)
+            lib = ctypes.CDLL(str(path))
+        except (OSError, subprocess.CalledProcessError):
+            lib = None
+        _cache[lib_name] = lib
+        return lib

@@ -1,0 +1,396 @@
+// Native CBOR transcoder: JSON text <-> CBOR bytes (RFC 8949 subset).
+//
+// The binary wire format (kubernetes_tpu/api/cbor.py) plays the protobuf
+// role of the reference's apimachinery serializers; a pure-Python encoder
+// walks objects byte by byte, which makes the "fast" format slower than
+// the C-accelerated json module. This transcoder moves the byte work to
+// C++: Python calls json.dumps (C speed), this converts the JSON text to
+// deterministic CBOR (definite lengths, shortest-form heads), and the
+// reverse path emits JSON text for json.loads. Values outside the JSON
+// data model (byte strings, >64-bit ints) return an error and Python
+// falls back to the pure codec.
+//
+// ctypes ABI (mirrors store_core.cpp): buffers are malloc'd here and
+// released with cj_free.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cstdio>
+#include <cmath>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Out {
+    std::string buf;
+    void u8(uint8_t b) { buf.push_back(static_cast<char>(b)); }
+    void raw(const char* p, size_t n) { buf.append(p, n); }
+};
+
+void head(Out& o, int major, uint64_t n) {
+    int mb = major << 5;
+    if (n < 24) {
+        o.u8(mb | static_cast<int>(n));
+    } else if (n < 0x100) {
+        o.u8(mb | 24); o.u8(static_cast<uint8_t>(n));
+    } else if (n < 0x10000) {
+        o.u8(mb | 25); o.u8(n >> 8); o.u8(n & 0xff);
+    } else if (n < 0x100000000ULL) {
+        o.u8(mb | 26);
+        for (int s = 24; s >= 0; s -= 8) o.u8((n >> s) & 0xff);
+    } else {
+        o.u8(mb | 27);
+        for (int s = 56; s >= 0; s -= 8) o.u8((n >> s) & 0xff);
+    }
+}
+
+// ---- JSON parser ---------------------------------------------------------
+
+struct Parser {
+    const char* p;
+    const char* end;
+    bool ok = true;
+
+    void ws() { while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++; }
+    bool lit(const char* s) {
+        size_t n = strlen(s);
+        if (static_cast<size_t>(end - p) >= n && memcmp(p, s, n) == 0) { p += n; return true; }
+        return false;
+    }
+};
+
+bool parse_value(Parser& in, Out& out);
+
+void utf8_append(std::string& s, uint32_t cp) {
+    if (cp < 0x80) s.push_back(static_cast<char>(cp));
+    else if (cp < 0x800) {
+        s.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+        s.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+        s.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+        s.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+        s.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+        s.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+        s.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+        s.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+        s.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+}
+
+bool parse_string_into(Parser& in, std::string& s) {
+    if (in.p >= in.end || *in.p != '"') return false;
+    in.p++;
+    while (in.p < in.end) {
+        unsigned char c = *in.p;
+        if (c == '"') { in.p++; return true; }
+        if (c == '\\') {
+            in.p++;
+            if (in.p >= in.end) return false;
+            char e = *in.p++;
+            switch (e) {
+                case '"': s.push_back('"'); break;
+                case '\\': s.push_back('\\'); break;
+                case '/': s.push_back('/'); break;
+                case 'b': s.push_back('\b'); break;
+                case 'f': s.push_back('\f'); break;
+                case 'n': s.push_back('\n'); break;
+                case 'r': s.push_back('\r'); break;
+                case 't': s.push_back('\t'); break;
+                case 'u': {
+                    if (in.end - in.p < 4) return false;
+                    char tmp[5] = {in.p[0], in.p[1], in.p[2], in.p[3], 0};
+                    uint32_t cp = static_cast<uint32_t>(strtoul(tmp, nullptr, 16));
+                    in.p += 4;
+                    if (cp >= 0xD800 && cp <= 0xDBFF && in.end - in.p >= 6
+                        && in.p[0] == '\\' && in.p[1] == 'u') {
+                        char tmp2[5] = {in.p[2], in.p[3], in.p[4], in.p[5], 0};
+                        uint32_t lo = static_cast<uint32_t>(strtoul(tmp2, nullptr, 16));
+                        if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                            in.p += 6;
+                        }
+                    }
+                    utf8_append(s, cp);
+                    break;
+                }
+                default: return false;
+            }
+        } else {
+            s.push_back(static_cast<char>(c));
+            in.p++;
+        }
+    }
+    return false;
+}
+
+bool parse_number(Parser& in, Out& out) {
+    const char* start = in.p;
+    if (in.p < in.end && *in.p == '-') in.p++;
+    bool is_float = false;
+    while (in.p < in.end) {
+        char c = *in.p;
+        if (c >= '0' && c <= '9') { in.p++; }
+        else if (c == '.' || c == 'e' || c == 'E' || c == '+' || c == '-') {
+            is_float = true; in.p++;
+        } else break;
+    }
+    std::string tok(start, in.p - start);
+    if (!is_float) {
+        errno = 0;
+        char* endp = nullptr;
+        long long v = strtoll(tok.c_str(), &endp, 10);
+        if (errno == ERANGE || endp != tok.c_str() + tok.size())
+            return false;  // >64-bit: caller falls back to the pure codec
+        if (v >= 0) head(out, 0, static_cast<uint64_t>(v));
+        else head(out, 1, static_cast<uint64_t>(-1 - v));
+        return true;
+    }
+    double d = strtod(tok.c_str(), nullptr);
+    out.u8(0xfb);
+    uint64_t bits;
+    memcpy(&bits, &d, 8);
+    for (int s = 56; s >= 0; s -= 8) out.u8((bits >> s) & 0xff);
+    return true;
+}
+
+bool parse_value(Parser& in, Out& out) {
+    in.ws();
+    if (in.p >= in.end) return false;
+    char c = *in.p;
+    if (c == 'n') { if (!in.lit("null")) return false; out.u8(0xf6); return true; }
+    if (c == 't') { if (!in.lit("true")) return false; out.u8(0xf5); return true; }
+    if (c == 'f') { if (!in.lit("false")) return false; out.u8(0xf4); return true; }
+    if (c == 'N') {  // NaN (python json.dumps emits it)
+        if (!in.lit("NaN")) return false;
+        out.u8(0xfb);
+        double d = NAN; uint64_t bits; memcpy(&bits, &d, 8);
+        for (int s = 56; s >= 0; s -= 8) out.u8((bits >> s) & 0xff);
+        return true;
+    }
+    if (c == 'I' || (c == '-' && in.end - in.p > 1 && in.p[1] == 'I')) {
+        bool neg = c == '-';
+        if (neg) in.p++;
+        if (!in.lit("Infinity")) return false;
+        out.u8(0xfb);
+        double d = neg ? -INFINITY : INFINITY;
+        uint64_t bits; memcpy(&bits, &d, 8);
+        for (int s = 56; s >= 0; s -= 8) out.u8((bits >> s) & 0xff);
+        return true;
+    }
+    if (c == '"') {
+        std::string s;
+        if (!parse_string_into(in, s)) return false;
+        head(out, 3, s.size());
+        out.raw(s.data(), s.size());
+        return true;
+    }
+    if (c == '[') {
+        in.p++;
+        // two-pass-free: transcode elements into a scratch buffer, count
+        std::vector<std::string> elems;
+        in.ws();
+        if (in.p < in.end && *in.p == ']') { in.p++; head(out, 4, 0); return true; }
+        while (true) {
+            Out elem;
+            if (!parse_value(in, elem)) return false;
+            elems.push_back(std::move(elem.buf));
+            in.ws();
+            if (in.p < in.end && *in.p == ',') { in.p++; continue; }
+            if (in.p < in.end && *in.p == ']') { in.p++; break; }
+            return false;
+        }
+        head(out, 4, elems.size());
+        for (auto& e : elems) out.raw(e.data(), e.size());
+        return true;
+    }
+    if (c == '{') {
+        in.p++;
+        std::vector<std::string> items;
+        in.ws();
+        if (in.p < in.end && *in.p == '}') { in.p++; head(out, 5, 0); return true; }
+        while (true) {
+            in.ws();
+            Out kv;
+            std::string key;
+            if (!parse_string_into(in, key)) return false;
+            head(kv, 3, key.size());
+            kv.raw(key.data(), key.size());
+            in.ws();
+            if (in.p >= in.end || *in.p != ':') return false;
+            in.p++;
+            if (!parse_value(in, kv)) return false;
+            items.push_back(std::move(kv.buf));
+            in.ws();
+            if (in.p < in.end && *in.p == ',') { in.p++; continue; }
+            if (in.p < in.end && *in.p == '}') { in.p++; break; }
+            return false;
+        }
+        head(out, 5, items.size());
+        for (auto& e : items) out.raw(e.data(), e.size());
+        return true;
+    }
+    return parse_number(in, out);
+}
+
+// ---- CBOR reader → JSON writer ------------------------------------------
+
+struct Reader {
+    const uint8_t* p;
+    const uint8_t* end;
+
+    bool take(uint64_t n, const uint8_t** out) {
+        if (static_cast<uint64_t>(end - p) < n) return false;
+        *out = p; p += n; return true;
+    }
+    bool length(int info, uint64_t* n) {
+        if (info < 24) { *n = static_cast<uint64_t>(info); return true; }
+        int extra = info == 24 ? 1 : info == 25 ? 2 : info == 26 ? 4 : info == 27 ? 8 : -1;
+        if (extra < 0) return false;
+        const uint8_t* b;
+        if (!take(static_cast<uint64_t>(extra), &b)) return false;
+        uint64_t v = 0;
+        for (int i = 0; i < extra; i++) v = (v << 8) | b[i];
+        *n = v;
+        return true;
+    }
+};
+
+void json_escape(std::string& out, const uint8_t* s, uint64_t n) {
+    out.push_back('"');
+    for (uint64_t i = 0; i < n; i++) {
+        uint8_t c = s[i];
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\b': out += "\\b"; break;
+            case '\f': out += "\\f"; break;
+            case '\n': out += "\\n"; break;
+            case '\r': out += "\\r"; break;
+            case '\t': out += "\\t"; break;
+            default:
+                if (c < 0x20) {
+                    char tmp[8];
+                    snprintf(tmp, sizeof tmp, "\\u%04x", c);
+                    out += tmp;
+                } else {
+                    out.push_back(static_cast<char>(c));  // raw UTF-8 is valid JSON
+                }
+        }
+    }
+    out.push_back('"');
+}
+
+bool emit_json(Reader& in, std::string& out) {
+    const uint8_t* b;
+    if (!in.take(1, &b)) return false;
+    int major = b[0] >> 5, info = b[0] & 0x1f;
+    if (major == 0 || major == 1) {
+        uint64_t n;
+        if (!in.length(info, &n)) return false;
+        if (major == 0) {
+            if (n > INT64_MAX) return false;
+            out += std::to_string(n);
+        } else {
+            if (n > INT64_MAX) return false;  // < -2^63: pure-codec territory
+            out += std::to_string(-1 - static_cast<int64_t>(n));
+        }
+        return true;
+    }
+    if (major == 2) return false;  // byte strings: not in the JSON model
+    if (major == 3) {
+        uint64_t n;
+        if (!in.length(info, &n)) return false;
+        const uint8_t* s;
+        if (!in.take(n, &s)) return false;
+        json_escape(out, s, n);
+        return true;
+    }
+    if (major == 4 || major == 5) {
+        uint64_t n;
+        if (!in.length(info, &n)) return false;
+        out.push_back(major == 4 ? '[' : '{');
+        for (uint64_t i = 0; i < n; i++) {
+            if (i) out.push_back(',');
+            if (major == 5) {
+                // JSON object keys must be text: any other CBOR key type
+                // (ints are legal CBOR) punts to the pure codec
+                if (in.p >= in.end || (*in.p >> 5) != 3) return false;
+                if (!emit_json(in, out)) return false;
+                out.push_back(':');
+            }
+            if (!emit_json(in, out)) return false;
+        }
+        out.push_back(major == 4 ? ']' : '}');
+        return true;
+    }
+    // major 7: simple / float
+    if (b[0] == 0xf6) { out += "null"; return true; }
+    if (b[0] == 0xf5) { out += "true"; return true; }
+    if (b[0] == 0xf4) { out += "false"; return true; }
+    if (b[0] == 0xfb) {
+        const uint8_t* f;
+        if (!in.take(8, &f)) return false;
+        uint64_t bits = 0;
+        for (int i = 0; i < 8; i++) bits = (bits << 8) | f[i];
+        double d;
+        memcpy(&d, &bits, 8);
+        if (std::isnan(d)) { out += "NaN"; return true; }
+        if (std::isinf(d)) { out += d > 0 ? "Infinity" : "-Infinity"; return true; }
+        char tmp[40];
+        snprintf(tmp, sizeof tmp, "%.17g", d);
+        out += tmp;
+        // keep it a FLOAT through json.loads: "3" would parse as int
+        if (!strpbrk(tmp, ".eEnN")) out += ".0";
+        return true;
+    }
+    return false;
+}
+
+char* dup_buffer(const std::string& s, size_t* out_len) {
+    char* mem = static_cast<char*>(malloc(s.size() ? s.size() : 1));
+    if (mem == nullptr) return nullptr;
+    memcpy(mem, s.data(), s.size());
+    *out_len = s.size();
+    return mem;
+}
+
+}  // namespace
+
+extern "C" {
+
+// JSON text → CBOR bytes. Returns 0 on success, -1 on unsupported input
+// (caller uses the pure-Python codec).
+int64_t cj_json_to_cbor(const char* json, size_t len,
+                        uint8_t** out, size_t* out_len) {
+    Parser in{json, json + len};
+    Out cbor;
+    if (!parse_value(in, cbor)) return -1;
+    in.ws();
+    if (in.p != in.end) return -1;  // trailing garbage
+    size_t n;
+    char* mem = dup_buffer(cbor.buf, &n);
+    if (mem == nullptr) return -1;
+    *out = reinterpret_cast<uint8_t*>(mem);
+    *out_len = n;
+    return 0;
+}
+
+// CBOR bytes → JSON text. Returns 0 on success, -1 on unsupported input.
+int64_t cj_cbor_to_json(const uint8_t* buf, size_t len,
+                        char** out, size_t* out_len) {
+    Reader in{buf, buf + len};
+    std::string json;
+    if (!emit_json(in, json)) return -1;
+    if (in.p != in.end) return -1;  // trailing bytes
+    char* mem = dup_buffer(json, out_len);
+    if (mem == nullptr) return -1;
+    *out = mem;
+    return 0;
+}
+
+void cj_free(void* p) { free(p); }
+
+}  // extern "C"
