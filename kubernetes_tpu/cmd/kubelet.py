@@ -1,0 +1,115 @@
+"""kubelet binary: a node agent joined to an API server over REST.
+
+Reference: cmd/kubelet — flags → KubeletServer → RunKubelet; the agent
+registers its Node, heartbeats a Lease, and drives the sync loop against
+the cluster through client-go (here RESTStore). Serves /healthz (the
+kubelet's 10248 endpoint) reporting sync-loop liveness.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..kubelet import Kubelet, Threshold
+from ..kubelet.eviction import MEMORY_AVAILABLE
+
+
+class KubeletServer:
+    def __init__(self, store, node, sync_period_s: float = 0.5,
+                 eviction_thresholds: list[Threshold] | None = None):
+        self.kubelet = Kubelet(store, node,
+                               eviction_thresholds=eviction_thresholds or [])
+        self.sync_period_s = sync_period_s
+        self.last_sync: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._http: ThreadingHTTPServer | None = None
+
+    def _build_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/healthz":
+                    last = server.last_sync
+                    healthy = (last is not None and time.monotonic() - last
+                               < 4 * server.sync_period_s + 10)
+                    body = b"ok" if healthy else b"stale"
+                    self.send_response(200 if healthy else 503)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        return Handler
+
+    def serve(self, port: int = 0) -> int:
+        self._http = ThreadingHTTPServer(("127.0.0.1", port),
+                                         self._build_handler())
+        threading.Thread(target=self._http.serve_forever, daemon=True).start()
+        return self._http.server_address[1]
+
+    def run(self, block: bool = False) -> None:
+        self.kubelet.register()
+
+        def loop():
+            while not self._stop.is_set():
+                self.kubelet.sync_loop_iteration()
+                self.last_sync = time.monotonic()
+                self._stop.wait(self.sync_period_s)
+
+        if block:
+            loop()
+        else:
+            self._thread = threading.Thread(target=loop, daemon=True)
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.kubelet.shutdown()
+        if self._http is not None:
+            self._http.shutdown()
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    from ..client.rest import RESTStore
+    from ..testing.wrappers import make_node
+
+    parser = argparse.ArgumentParser(description="node agent")
+    parser.add_argument("--server", required=True, help="API server URL")
+    parser.add_argument("--token", default="")
+    parser.add_argument("--node-name", required=True)
+    parser.add_argument("--cpu", default="8")
+    parser.add_argument("--memory", default="32Gi")
+    parser.add_argument("--zone", default="zone-0")
+    parser.add_argument("--port", type=int, default=10248)
+    parser.add_argument("--sync-period", type=float, default=0.5)
+    parser.add_argument("--eviction-memory-min-bytes", type=int, default=0)
+    args = parser.parse_args(argv)
+    store = RESTStore(args.server, token=args.token)
+    node = make_node(args.node_name, cpu=args.cpu, mem=args.memory,
+                     zone=args.zone)
+    thresholds = []
+    if args.eviction_memory_min_bytes:
+        thresholds.append(Threshold(MEMORY_AVAILABLE,
+                                    args.eviction_memory_min_bytes))
+    server = KubeletServer(store, node, sync_period_s=args.sync_period,
+                           eviction_thresholds=thresholds)
+    server.serve(args.port)
+    server.run(block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
