@@ -153,14 +153,24 @@ class Kubelet(NodeAgentBase):
             sid = self.runtime.run_pod_sandbox(key, ip=ip)
             self._sandboxes[key] = sid
             pod.status.pod_ip = ip
-        # converge containers: one CRI container per spec container; EXITED
-        # containers are restarted per restartPolicy (kuberuntime's
-        # computePodActions: Always restarts any exit, OnFailure restarts
-        # non-zero exits, Never leaves the corpse for status reporting)
         existing = {c.name: c for c in self.runtime.list_containers()
                     if c.sandbox_id == sid}
         run_s = pod.meta.annotations.get("kubemark.io/run-seconds")
         policy = pod.spec.restart_policy
+        # init containers run SEQUENTIALLY to completion before any main
+        # container starts (kuberuntime computePodActions: next init starts
+        # only after the previous succeeded; a failure under Never fails
+        # the pod, otherwise the init container retries per backoff)
+        if pod.spec.init_containers:
+            done, blocked = self._converge_init(pod, key, sid, existing)
+            if not done:
+                self._report_status(pod, sid, config_blocked=blocked,
+                                    initializing=True)
+                return
+        # converge MAIN containers: one CRI container per spec container;
+        # EXITED containers are restarted per restartPolicy (kuberuntime's
+        # computePodActions: Always restarts any exit, OnFailure restarts
+        # non-zero exits, Never leaves the corpse for status reporting)
         config_blocked = False  # pod-level: ANY container missing its refs
         for spec_c in pod.spec.containers:
             c = existing.get(spec_c.name)
@@ -197,6 +207,39 @@ class Kubelet(NodeAgentBase):
         else:
             self._config_errors.discard(key)
         self._report_status(pod, sid, config_blocked=config_blocked)
+
+    def _converge_init(self, pod, key: str, sid: str,
+                       existing: dict) -> tuple[bool, bool]:
+        """Run init containers one at a time; (all_succeeded,
+        config_blocked). Init containers default their run duration to 0
+        (instant success) unless the pod carries the init-run annotation."""
+        run_s = pod.meta.annotations.get("kubemark.io/init-run-seconds", "0")
+        for spec_c in pod.spec.init_containers:
+            c = existing.get(spec_c.name)
+            if c is not None and c.state == EXITED:
+                if c.exit_code == 0:
+                    continue  # this init step done; next one
+                if pod.spec.restart_policy == "Never":
+                    return False, False  # pod fails via status reporting
+                if not self._may_restart(key, spec_c.name, c):
+                    return False, False  # parked in backoff
+                self.runtime.remove_container(c.id)
+                c = None
+            if c is None:
+                env = self._resolve_env(pod, spec_c)
+                if env is None:
+                    return False, True  # CreateContainerConfigError
+                if spec_c.image:
+                    self.runtime.pull_image(spec_c.image)
+                cid = self.runtime.create_container(
+                    sid, spec_c.name, spec_c.image,
+                    run_seconds=float(run_s), env=env,
+                )
+                self.runtime.start_container(cid)
+                return False, False  # wait for it (sequential)
+            if c.state != EXITED:
+                return False, False  # still running: wait
+        return True, False
 
     def _resolve_env(self, pod, spec_c) -> dict | None:
         """EnvVar refs → concrete values (kubelet_pods makeEnvironment-
@@ -244,13 +287,39 @@ class Kubelet(NodeAgentBase):
         self._restart_backoff[bk] = (count + 1, now + delay)
         return True
 
-    def _report_status(self, pod, sid: str, config_blocked: bool = False) -> None:
+    def _report_status(self, pod, sid: str, config_blocked: bool = False,
+                       initializing: bool = False) -> None:
         """Container states → pod phase (kubelet's status manager), with
         probe results folded in: liveness failures kill the container
         (restart policy then applies next sync), readiness gates Ready.
         config_blocked (CreateContainerConfigError on any container) pins
         the pod Pending and NotReady — a pod missing one of its containers
-        must not serve traffic."""
+        must not serve traffic. initializing: init containers are still
+        running — Pending/NotReady, or Failed when an init step failed
+        under restartPolicy Never."""
+        if initializing:
+            init_failed = any(
+                c.state == EXITED and c.exit_code != 0
+                for c in self.runtime.list_containers()
+                if c.sandbox_id == sid
+                and c.name in {ic.name for ic in pod.spec.init_containers}
+            ) and pod.spec.restart_policy == "Never"
+            phase = FAILED if init_failed else PENDING
+            changed = phase != pod.status.phase
+            pod.status.phase = phase
+            cond = next((c for c in pod.status.conditions
+                         if c.type == "Ready"), None)
+            if cond is None or cond.status != "False":
+                pod.status.conditions = [
+                    c for c in pod.status.conditions if c.type != "Ready"
+                ] + [PodCondition(type="Ready", status="False")]
+                changed = True
+            if changed:
+                try:
+                    self.store.update(pod, check_version=False)
+                except (ConflictError, NotFoundError):
+                    pass
+            return
         states = [c for c in self.runtime.list_containers()
                   if c.sandbox_id == sid]
         running = {c.name for c in states

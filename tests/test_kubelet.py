@@ -714,3 +714,66 @@ class TestEnvResolution:
             assert "default/half" not in k._config_errors
         finally:
             k.shutdown()
+
+
+class TestInitContainers:
+    def make(self):
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        return store, clock, k
+
+    def sync(self, k, n=1):
+        for _ in range(n):
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+
+    def test_init_containers_run_sequentially_then_mains(self):
+        from kubernetes_tpu.api.types import Container
+
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("web")
+            pod.spec.node_name = "n1"
+            pod.spec.init_containers = [
+                Container(name="init-a", requests={"cpu": "100m"}),
+                Container(name="init-b", requests={"cpu": "100m"}),
+            ]
+            store.create(pod)
+            self.sync(k, n=4)  # one init step per sync, then mains
+            got = store.get("Pod", "default/web")
+            assert got.status.phase == RUNNING
+            by_name = {c.name: c for c in k.runtime.list_containers()}
+            assert by_name["init-a"].state == EXITED
+            assert by_name["init-b"].state == EXITED
+            assert by_name["c"].state == CONTAINER_RUNNING
+            # init-a completed BEFORE init-b started (sequential)
+            assert by_name["init-a"].started_at <= by_name["init-b"].started_at
+        finally:
+            k.shutdown()
+
+    def test_pod_pending_and_not_ready_while_initializing(self):
+        from kubernetes_tpu.api.types import Container, PENDING
+
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("slowinit")
+            pod.spec.node_name = "n1"
+            pod.meta.annotations["kubemark.io/init-run-seconds"] = "100"
+            pod.spec.init_containers = [
+                Container(name="init", requests={"cpu": "100m"}),
+            ]
+            store.create(pod)
+            self.sync(k, n=2)
+            got = store.get("Pod", "default/slowinit")
+            assert got.status.phase == PENDING
+            ready = next((c.status for c in got.status.conditions
+                          if c.type == "Ready"), None)
+            assert ready == "False"
+            assert not any(c.name == "c" for c in k.runtime.list_containers())
+            clock.step(101)  # init completes → mains start
+            self.sync(k, n=2)
+            assert store.get("Pod", "default/slowinit").status.phase == RUNNING
+        finally:
+            k.shutdown()
