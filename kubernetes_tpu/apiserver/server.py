@@ -600,8 +600,12 @@ class APIServer:
                         kind, key, _sub, query = route
                         method = handler_self.command
                         if method == "GET":
-                            verb = ("watch" if query.get("watch")
-                                    else "get" if key else "list")
+                            # mirror the serving path's precedence exactly
+                            # (key wins over ?watch): the audit verb must
+                            # match what authz evaluated
+                            verb = ("get" if key
+                                    else "watch" if query.get("watch")
+                                    else "list")
                         else:
                             verb = _VERB_BY_METHOD.get(method, method.lower())
                         server.audit.record(

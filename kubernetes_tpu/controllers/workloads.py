@@ -74,6 +74,8 @@ class ReplicaSetController(Controller):
         for p in self.store.pods():
             if p.meta.namespace != rs.meta.namespace or p.is_terminating:
                 continue
+            if p.status.phase in (SUCCEEDED, FAILED):
+                continue  # FilterActivePods: finished pods stay orphans
             if any(r.controller for r in p.meta.owner_references):
                 continue
             if not sel.matches(p.meta.labels):
@@ -198,9 +200,27 @@ class DeploymentController(Controller):
             if dep.meta.annotations.get(REVISION_ANNOTATION) != str(next_rev):
                 dep.meta.annotations[REVISION_ANNOTATION] = str(next_rev)
                 self.store.update(dep, check_version=False)
-        elif new_rs.spec.replicas != dep.spec.replicas:
-            new_rs.spec.replicas = dep.spec.replicas
-            self.store.update(new_rs, check_version=False)
+        else:
+            # rolling BACK to an existing RS (rollout undo): the reference
+            # bumps that RS to a fresh max revision, so history shows the
+            # rollback as a new step and a second undo returns to where we
+            # came from — a stale annotation would make undo a no-op
+            max_rev = max(
+                (int(rs.meta.annotations.get(REVISION_ANNOTATION, 0))
+                 for rs in owned),
+                default=0,
+            )
+            cur_rev = int(new_rs.meta.annotations.get(REVISION_ANNOTATION, 0))
+            if cur_rev < max_rev:
+                new_rev = str(max_rev + 1)
+                new_rs.meta.annotations[REVISION_ANNOTATION] = new_rev
+                dep.meta.annotations[REVISION_ANNOTATION] = new_rev
+                self.store.update(dep, check_version=False)
+                if new_rs.spec.replicas == dep.spec.replicas:
+                    self.store.update(new_rs, check_version=False)
+            if new_rs.spec.replicas != dep.spec.replicas:
+                new_rs.spec.replicas = dep.spec.replicas
+                self.store.update(new_rs, check_version=False)
         for rs in owned:
             if rs.meta.name != want_name and rs.spec.replicas != 0:
                 rs.spec.replicas = 0

@@ -164,6 +164,12 @@ class Kubelet(NodeAgentBase):
         if pod.spec.init_containers:
             done, blocked = self._converge_init(pod, key, sid, existing)
             if not done:
+                # a config-blocked INIT step must enter the retry set too,
+                # or the pod never re-syncs when the reference appears
+                if blocked:
+                    self._config_errors.add(key)
+                else:
+                    self._config_errors.discard(key)
                 self._report_status(pod, sid, config_blocked=blocked,
                                     initializing=True)
                 return

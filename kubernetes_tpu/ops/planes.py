@@ -135,13 +135,13 @@ class PlaneBuilder:
             # interned mid-run (first pod with that affinity) dirties every
             # row's counts above, but its key-slot mapping lives here — a
             # stale -1 makes the kernel reject every node for that term
+            tables_changed = False
             for ti, (_ns, _sel, ki) in enumerate(self.vocabs.ipa_term_matchers):
                 if p.ipa_term_key[ti] != ki:
                     p.ipa_term_key[ti] = ki
-                    if not dirty:
-                        dirty = [0]  # force a version bump + device refresh
+                    tables_changed = True
             self.dirty_rows = dirty
-            if dirty:
+            if dirty or tables_changed:
                 self._version += 1
                 p.version = self._version
         # _write_row may have interned new *values* (e.g. topology domains)

@@ -177,6 +177,7 @@ class TPUBackend:
         self._pending_dirty: set[int] | None = set()  # None = full re-put
         self._device_tables: dict | None = None
         self._tables_src: dict | None = None
+        self._uploaded_term_key: np.ndarray | None = None  # host-side copy
         self._jax = jax
         # pipelined-wave carry: the last launched kernel's output planes
         # (device arrays) feed the next launch directly, so back-to-back
@@ -280,6 +281,7 @@ class TPUBackend:
             self._device_planes = {
                 k: self._jax.device_put(a) for k, a in planes.as_dict().items()
             }
+            self._uploaded_term_key = planes.ipa_term_key.copy()
         elif self._device_version != planes.version and self._pending_dirty:
             # pad the dirty index list to a pow2 bucket (repeat the first
             # index — duplicate scatter writes of identical rows are benign)
@@ -302,19 +304,29 @@ class TPUBackend:
             scatter_in = {k: v for k, v in dev.items() if k != "ipa_term_key"}
             rows_host = {k: host[k][idx] for k in scatter_in}
             updated = _scatter_rows_jit(scatter_in, rows_host, idx)
-            if np.array_equal(np.asarray(dev["ipa_term_key"]),
-                              host["ipa_term_key"]):
-                updated["ipa_term_key"] = dev["ipa_term_key"]
-            else:
-                updated["ipa_term_key"] = self._jax.device_put(
-                    host["ipa_term_key"]
-                )
+            updated["ipa_term_key"] = dev["ipa_term_key"]
             self._device_planes = updated
+        self._fresh_term_key(planes)
         self._device_version = planes.version
         self._device_buckets = planes.bucket_sizes
         self._pending_dirty = set()
         self._refresh_tables(planes)
         return {**self._device_planes, **self._device_tables}
+
+    def _fresh_term_key(self, planes) -> None:
+        """Re-upload the GLOBAL ipa_term_key table when its HOST content
+        moved (a new term interned mid-run): the comparison is host-side
+        only (last-uploaded copy), so the steady state costs no device
+        traffic. Called from every device-input assembly point — the
+        scatter path skips this table, and the carry path bypasses
+        device_inputs entirely."""
+        host_tk = planes.ipa_term_key
+        if (self._uploaded_term_key is not None
+                and np.array_equal(self._uploaded_term_key, host_tk)):
+            return
+        if self._device_planes is not None:
+            self._device_planes["ipa_term_key"] = self._jax.device_put(host_tk)
+        self._uploaded_term_key = host_tk.copy()
 
     def _refresh_tables(self, planes) -> None:
         tables = self.extractor.affinity_tables(planes)
@@ -347,11 +359,13 @@ class TPUBackend:
                     self._pending_dirty = set()
                     self._device_version = planes.version
                     self._refresh_tables(planes)
+                    self._fresh_term_key(planes)
                     return {**self._device_planes, **carry,
                             **self._device_tables}
             elif compatible and self._pending_dirty == set():
                 self._device_version = planes.version
                 self._refresh_tables(planes)
+                self._fresh_term_key(planes)
                 return {**self._device_planes, **self._carry,
                         **self._device_tables}
             self.invalidate_carry()
@@ -505,6 +519,7 @@ class TPUBackend:
             self._pending_dirty = set()
             self._device_version = planes.version
             self._refresh_tables(planes)
+            self._fresh_term_key(planes)
             dev = {**self._device_planes, **self._carry, **self._device_tables}
         else:
             t_up = _time.perf_counter()

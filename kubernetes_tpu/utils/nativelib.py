@@ -28,8 +28,10 @@ def load_native(lib_name: str) -> ctypes.CDLL | None:
         try:
             path = _NATIVE_DIR / lib_name
             if not path.exists():
-                subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
-                               capture_output=True)
+                # build ONLY the requested target: one broken .cpp must not
+                # take down the other native cores
+                subprocess.run(["make", "-C", str(_NATIVE_DIR), lib_name],
+                               check=True, capture_output=True)
             lib = ctypes.CDLL(str(path))
         except (OSError, subprocess.CalledProcessError):
             lib = None

@@ -473,3 +473,50 @@ class TestRolloutRevisions:
             assert "successfully rolled out" in capsys.readouterr().out
         finally:
             server.shutdown()
+
+
+class TestRolloutUndoRevisionBump:
+    def test_second_undo_rolls_forward(self, capsys):
+        """Undo must mint a fresh revision (reference rollback semantics):
+        undo(rev2→rev1) yields rev3; a second undo returns to rev2."""
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+        from kubernetes_tpu.controllers import (
+            DeploymentController,
+            ReplicaSetController,
+        )
+
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        try:
+            url = server.url
+            dc = DeploymentController(store)
+            rc = ReplicaSetController(store)
+            store.create(Deployment(
+                meta=ObjectMeta(name="web"),
+                spec=DeploymentSpec(replicas=1,
+                                    template=template({"app": "web"},
+                                                      cpu="100m")),
+            ))
+            dc.sync_once(); rc.sync_once()
+            dep = store.get("Deployment", "default/web")
+            dep.spec.template = template({"app": "web"}, cpu="200m")
+            store.update(dep, check_version=False)
+            dc.sync_once(); rc.sync_once()
+            # undo #1: back to the 100m template, revision bumps to 3
+            assert kubectl(["-s", url, "rollout", "undo", "deploy", "web"]) == 0
+            dc.sync_once(); rc.sync_once()
+            dep = store.get("Deployment", "default/web")
+            assert dep.meta.annotations[
+                "deployment.kubernetes.io/revision"] == "3"
+            assert dep.spec.template.spec.containers[0].requests["cpu"] == "100m"
+            # undo #2: returns to the 200m template (revision 2's), rev 4
+            assert kubectl(["-s", url, "rollout", "undo", "deploy", "web"]) == 0
+            dc.sync_once(); rc.sync_once()
+            dep = store.get("Deployment", "default/web")
+            assert dep.spec.template.spec.containers[0].requests["cpu"] == "200m"
+            assert dep.meta.annotations[
+                "deployment.kubernetes.io/revision"] == "4"
+        finally:
+            server.shutdown()

@@ -777,3 +777,29 @@ class TestInitContainers:
             assert store.get("Pod", "default/slowinit").status.phase == RUNNING
         finally:
             k.shutdown()
+
+    def test_init_container_config_block_retries(self):
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.types import Container, EnvVar, KeyRef, PENDING
+        from kubernetes_tpu.api.workloads import ConfigMap
+
+        store, clock, k = self.make()
+        try:
+            pod = make_pod("blocked-init")
+            pod.spec.node_name = "n1"
+            pod.spec.init_containers = [Container(
+                name="init", requests={"cpu": "100m"},
+                env=(EnvVar("X", config_map_key_ref=KeyRef("later", "k")),),
+            )]
+            store.create(pod)
+            self.sync(k)
+            assert store.get("Pod",
+                             "default/blocked-init").status.phase == PENDING
+            assert "default/blocked-init" in k._config_errors
+            store.create(ConfigMap(meta=ObjectMeta(name="later"),
+                                   data={"k": "v"}))
+            self.sync(k, n=3)  # retry → init runs → mains start
+            assert store.get("Pod",
+                             "default/blocked-init").status.phase == RUNNING
+        finally:
+            k.shutdown()
