@@ -176,3 +176,59 @@ def test_preemption_parity_host_vs_tpu(seed):
             assert node, f"{name} unscheduled on host"
             assert tpu[name][0], f"{name} unscheduled on tpu"
     assert tpu == host
+
+
+def gang_assignments(backend: str, seed: int) -> dict[str, str]:
+    """Mixed gangs (with zone topology constraints) + plain pods."""
+    from kubernetes_tpu.api.meta import ObjectMeta
+    from kubernetes_tpu.api.types import (
+        GangPolicy,
+        PodGroup,
+        PodGroupSpec,
+        SchedulingConstraints,
+        TopologyConstraint,
+    )
+    from kubernetes_tpu.testing.wrappers import with_gang
+
+    rng = random.Random(seed)
+    store = Store()
+    for i in range(12):
+        store.create(make_node(f"n{i}", cpu="8", mem="16Gi",
+                               zone=ZONES[i % 3]))
+    s = Scheduler(store, profiles=[Profile(backend=backend)], seed=21,
+                  feature_gates={"GenericWorkload": True,
+                                 "TopologyAwareWorkloadScheduling": True})
+    s.start()
+    for g in range(3):
+        size = rng.randint(2, 4)
+        constraints = SchedulingConstraints()
+        if rng.random() < 0.5:
+            constraints = SchedulingConstraints(topology=(
+                TopologyConstraint(key="topology.kubernetes.io/zone",
+                                   mode="Required"),
+            ))
+        store.create(PodGroup(
+            meta=ObjectMeta(name=f"gang{g}"),
+            spec=PodGroupSpec(policy=GangPolicy(min_count=size),
+                              constraints=constraints),
+        ))
+        for i in range(size):
+            store.create(with_gang(
+                make_pod(f"gang{g}-{i}", cpu=rng.choice(("1", "2"))),
+                f"gang{g}",
+            ))
+        for i in range(rng.randint(0, 3)):  # plain pods interleaved
+            store.create(make_pod(f"plain{g}-{i}", cpu="500m"))
+        s.schedule_pending()
+    return {p.meta.name: p.spec.node_name for p in store.pods()}
+
+
+@pytest.mark.parametrize("seed", [5, 9])
+def test_gang_parity_host_vs_tpu(seed):
+    host = gang_assignments("host", seed)
+    tpu = gang_assignments("tpu", seed)
+    assert tpu == host
+    # every gang fully placed
+    for name, node in host.items():
+        if name.startswith("gang"):
+            assert node, f"{name} unscheduled"
