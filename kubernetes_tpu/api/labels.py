@@ -6,7 +6,7 @@ and meta/v1 LabelSelector. Operators: In, NotIn, Exists, DoesNotExist, Gt, Lt.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Mapping, Sequence
 
 IN = "In"

@@ -12,7 +12,7 @@ Role/ClusterRole bindings per request attribute tuple
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..api.rbac import ClusterRole, Role
 

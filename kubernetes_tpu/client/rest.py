@@ -17,9 +17,6 @@ from collections import deque
 
 from ..api.serialization import decode, encode
 from ..store.store import (
-    ADDED,
-    DELETED,
-    MODIFIED,
     AlreadyExistsError,
     ConflictError,
     Event,

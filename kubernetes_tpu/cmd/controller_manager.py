@@ -9,7 +9,6 @@ controller) while leadership holds; losing the lease stops them.
 from __future__ import annotations
 
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..controllers import ControllerManager, default_controllers

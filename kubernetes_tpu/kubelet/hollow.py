@@ -13,7 +13,6 @@ from __future__ import annotations
 import threading
 
 from ..api.types import (
-    FAILED,
     Node,
     PENDING,
     RUNNING,
@@ -21,7 +20,7 @@ from ..api.types import (
     PodCondition,
 )
 from ..store.store import ConflictError, NotFoundError
-from .agent import LEASE_NAMESPACE, NodeAgentBase
+from .agent import NodeAgentBase
 
 
 class FakeRuntime:

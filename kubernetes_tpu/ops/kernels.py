@@ -22,7 +22,7 @@ NodeResourcesFit, PodTopologySpread.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

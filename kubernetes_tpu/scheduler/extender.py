@@ -17,7 +17,7 @@ import http.client
 import json
 import urllib.error
 import urllib.request
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..api.types import Pod
 from .framework.interface import MAX_NODE_SCORE, Status

@@ -25,14 +25,11 @@ from .events import ClusterEventWithHint
 from .interface import (
     MAX_NODE_SCORE,
     MIN_NODE_SCORE,
-    Diagnosis,
     NodePluginScores,
     NodeToStatus,
     PreFilterResult,
     PostFilterResult,
-    SKIP,
     Status,
-    WAIT,
     WaitingPod,
     status_of,
 )

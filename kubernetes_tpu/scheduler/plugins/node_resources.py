@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ...api.resource import CPU, EPHEMERAL, MEM, PODS, ResourceNames, ResourceVec
+from ...api.resource import CPU, MEM, PODS, ResourceNames, ResourceVec
 from ...api.types import Pod
 from ..framework import events as ev
 from ..framework.events import ClusterEvent, ClusterEventWithHint, QUEUE, QUEUE_SKIP

@@ -19,7 +19,6 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ...api.storage import (
-    BINDING_WAIT_FOR_FIRST_CONSUMER,
     CLAIM_BOUND,
     NO_PROVISIONER,
     READ_WRITE_ONCE_POD,

@@ -24,7 +24,7 @@ from typing import Any, Callable, Iterable
 from ...api.types import Pod
 from ...utils.clock import Clock
 from ..framework import events as fwk_events
-from ..framework.events import ClusterEvent, ClusterEventWithHint, QUEUE, QUEUE_SKIP
+from ..framework.events import ClusterEvent, ClusterEventWithHint, QUEUE
 from ..framework.interface import Status
 from ..nodeinfo import PodInfo
 from .heap import KeyedHeap

@@ -15,19 +15,15 @@ for correctness, golden-testing, and the sparse long-tail plugins.
 from __future__ import annotations
 
 import random
-from typing import Callable
 
-from ..api.types import PENDING, Pod
+from ..api.types import Pod
 from .framework.cycle_state import CycleState
 from .framework.interface import (
     Diagnosis,
     FitError,
-    NodeToStatus,
-    PostFilterResult,
     ScheduleResult,
     Status,
     UNSCHEDULABLE,
-    UNSCHEDULABLE_AND_UNRESOLVABLE,
 )
 from .framework.runtime import Framework
 from .nodeinfo import NodeInfo, PodInfo

@@ -84,7 +84,7 @@ class Scheduler:
         self.cache = Cache(self.names)
         self.snapshot = Snapshot()
         self.feature_gates = dict(feature_gates or {})
-        from .extender import ExtenderConfig, HTTPExtender
+        from .extender import HTTPExtender
 
         self.extenders = [
             e if isinstance(e, HTTPExtender) else HTTPExtender(e)

@@ -10,7 +10,7 @@ Python (inc(*labels) / observe(value, *labels)).
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 ALPHA = "ALPHA"
 STABLE = "STABLE"
