@@ -251,6 +251,9 @@ class PodSpec:
     host_network: bool = False
     termination_grace_period_seconds: int = 30
     restart_policy: str = "Always"
+    # kubelet fails the pod this many seconds after it starts Running
+    # (kubelet_pods.go activeDeadlineHandler); None = no deadline
+    active_deadline_seconds: int | None = None
 
 
 @dataclass

@@ -143,6 +143,9 @@ class JobSpec:
     # batch/v1 ttlSecondsAfterFinished: the TTL-after-finished controller
     # deletes the Job this long after it completes (None = keep forever)
     ttl_seconds_after_finished: int | None = None
+    # batch/v1 activeDeadlineSeconds: the job controller fails the whole
+    # job (terminating its pods) once it has run this long
+    active_deadline_seconds: int | None = None
 
 
 @dataclass
@@ -152,6 +155,10 @@ class JobStatus:
     failed: int = 0
     completed: bool = False
     completion_time: float | None = None
+    start_time: float | None = None
+    # terminal failure reason ("BackoffLimitExceeded"/"DeadlineExceeded" —
+    # the Failed condition's reason in batch/v1)
+    failure_reason: str = ""
 
 
 @dataclass

@@ -244,6 +244,7 @@ class JobController(Controller):
 
     name = "job"
     watches = ("Job", "Pod")
+    clocked_queue = True  # activeDeadlineSeconds wakeups ride the clock
 
     def key_of(self, kind: str, obj) -> str | None:
         if kind == "Job":
@@ -274,6 +275,26 @@ class JobController(Controller):
         job.status.active = len(active)
         job.status.succeeded = succeeded
         job.status.failed = failed
+        if job.status.start_time is None:
+            job.status.start_time = self.clock.now()
+        # batch/v1 activeDeadlineSeconds (job_controller syncJob past-
+        # deadline): the whole job fails once it has run too long
+        deadline = job.spec.active_deadline_seconds
+        if (deadline is not None and not job.status.completed
+                and not job.status.failure_reason):
+            elapsed = self.clock.now() - job.status.start_time
+            if elapsed >= deadline:
+                job.status.failure_reason = "DeadlineExceeded"
+                for p in active:
+                    self.store.delete("Pod", p.meta.key)
+                job.status.active = 0
+                if job.status != old_status:
+                    self.store.update(job, check_version=False)
+                return
+            # wake exactly at the deadline (clocked delayed queue)
+            self.queue.add_after(key, deadline - elapsed + 0.1)
+        if job.status.failure_reason:
+            return  # terminally failed: never mint replacement pods
         if succeeded >= job.spec.completions:
             job.status.completed = True
             if job.status.completion_time is None:

@@ -58,6 +58,8 @@ class Kubelet(NodeAgentBase):
         # pods blocked on missing ConfigMap/Secret refs: retried each
         # housekeeping pass until the reference appears
         self._config_errors: set[str] = set()
+        # activeDeadlineSeconds wakeups: pod key → fail-at time
+        self._deadline_wakeup: dict[str, float] = {}
         # injected usage for tests / simulations (summary-API stand-in)
         self.pod_stats: dict[str, PodStats] = {}
         self.node_available: dict[str, int] = {}
@@ -111,6 +113,14 @@ class Kubelet(NodeAgentBase):
             if key not in dispatched:
                 self.workers.update_pod(key)
                 dispatched.add(key)
+        # expired active deadlines: fail the pod on time, not on the next
+        # unrelated event
+        for key, expiry in list(self._deadline_wakeup.items()):
+            if now >= expiry:
+                self._deadline_wakeup.pop(key, None)
+                if key not in dispatched:
+                    self.workers.update_pod(key)
+                    dispatched.add(key)
         # expired restart backoffs: retry the parked container (pop, not
         # del: a concurrent _teardown on a worker thread may already have
         # removed the entry)
@@ -143,6 +153,23 @@ class Kubelet(NodeAgentBase):
             # another node's pod here
             self._teardown(key)
             return
+        if pod.status.phase in (FAILED, SUCCEEDED):
+            # terminal phases are never resynced into running (the corpse
+            # keeps its containers for inspection until the object is GC'd)
+            return
+        # activeDeadlineSeconds (kubelet_pods activeDeadlineHandler): a
+        # Running pod past its deadline fails terminally
+        deadline = pod.spec.active_deadline_seconds
+        if (deadline is not None and pod.status.start_time is not None
+                and pod.status.phase == RUNNING):
+            expiry = pod.status.start_time + deadline
+            if self.clock.now() >= expiry:
+                self._deadline_wakeup.pop(key, None)
+                self._fail_pod(pod, "DeadlineExceeded",
+                               f"pod exceeded activeDeadlineSeconds="
+                               f"{deadline}")
+                return
+            self._deadline_wakeup[key] = expiry
         sid = self._sandboxes.get(key)
         if sid is None or all(
             s.id != sid for s in self.runtime.list_pod_sandboxes()
@@ -272,6 +299,24 @@ class Kubelet(NodeAgentBase):
             env[ev.name] = src.data[ref.key]
         return env
 
+    def _fail_pod(self, pod, reason: str, message: str) -> None:
+        """Terminal failure: stop containers, report Failed + NotReady."""
+        key = pod.meta.key
+        sid = self._sandboxes.get(key)
+        if sid is not None:
+            for c in self.runtime.list_containers():
+                if c.sandbox_id == sid:
+                    self.runtime.stop_container(c.id)
+        pod.status.phase = FAILED
+        pod.status.conditions = [
+            c for c in pod.status.conditions if c.type != "Ready"
+        ] + [PodCondition(type="Ready", status="False", reason=reason,
+                          message=message)]
+        try:
+            self.store.update(pod, check_version=False)
+        except (ConflictError, NotFoundError):
+            pass
+
     def _may_restart(self, key: str, cname: str, c) -> bool:
         """CrashLoopBackOff: exponential delay between restarts of the same
         container; a long successful run resets the loop."""
@@ -385,6 +430,7 @@ class Kubelet(NodeAgentBase):
         self.prober.forget_pod(key)
         self._config_errors.discard(key)
         self._backoff_wakeup.pop(key, None)
+        self._deadline_wakeup.pop(key, None)
         for bk in [b for b in self._restart_backoff if b[0] == key]:
             del self._restart_backoff[bk]
         self.store.try_delete("PodMetrics", key)

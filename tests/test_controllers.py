@@ -520,3 +520,27 @@ class TestRolloutUndoRevisionBump:
                 "deployment.kubernetes.io/revision"] == "4"
         finally:
             server.shutdown()
+
+
+class TestJobActiveDeadline:
+    def test_job_fails_past_deadline(self):
+        from kubernetes_tpu.controllers import JobController
+
+        store = Store()
+        clock = FakeClock()
+        job = Job(
+            meta=ObjectMeta(name="slow"),
+            spec=JobSpec(completions=3, parallelism=2,
+                         active_deadline_seconds=60, template=template()),
+        )
+        store.create(job)
+        jc = JobController(store, clock=clock)
+        jc.sync_once()
+        assert sum(1 for p in store.pods()) == 2  # parallelism pods minted
+        clock.step(61)
+        jc.sync_once()  # the deadline wakeup fires
+        got = store.get("Job", "default/slow")
+        assert got.status.failure_reason == "DeadlineExceeded"
+        assert not store.pods()  # active pods terminated
+        jc.sync_once()  # terminal: no replacements minted
+        assert not store.pods()

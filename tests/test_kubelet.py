@@ -803,3 +803,35 @@ class TestInitContainers:
                              "default/blocked-init").status.phase == RUNNING
         finally:
             k.shutdown()
+
+
+class TestActiveDeadline:
+    def test_pod_fails_past_deadline(self):
+        from kubernetes_tpu.api.types import FAILED
+
+        store = Store()
+        clock = FakeClock()
+        k = Kubelet(store, make_node("n1", cpu="8", mem="16Gi"), clock=clock)
+        k.register()
+        try:
+            pod = make_pod("slow")
+            pod.spec.node_name = "n1"
+            pod.spec.active_deadline_seconds = 30
+            store.create(pod)
+            k.sync_loop_iteration()
+            assert k.workers.drain()
+            assert store.get("Pod", "default/slow").status.phase == RUNNING
+            clock.step(31)
+            k.sync_loop_iteration()  # deadline wakeup fires
+            assert k.workers.drain()
+            got = store.get("Pod", "default/slow")
+            assert got.status.phase == FAILED
+            ready = next(c for c in got.status.conditions if c.type == "Ready")
+            assert ready.reason == "DeadlineExceeded"
+            # terminal: no restart on subsequent syncs (policy is Always)
+            k.sync_loop_iteration()
+            k.workers.drain()
+            assert not [c for c in k.runtime.list_containers()
+                        if c.state == CONTAINER_RUNNING]
+        finally:
+            k.shutdown()
