@@ -26,6 +26,7 @@ class WorkQueue:
         self._processing: set[Hashable] = set()
         self._failures: dict[Hashable, int] = {}
         self._delayed: list[tuple[float, int, Hashable]] = []
+        self._delayed_pending: dict[Hashable, float] = {}  # earliest wake
         self._seq = 0
         self._base_delay = base_delay
         self._max_delay = max_delay
@@ -42,9 +43,18 @@ class WorkQueue:
                 self._mu.notify()
 
     def add_after(self, item: Hashable, delay: float) -> None:
+        """Deliver `item` after `delay`. Dedup to the EARLIEST pending wake
+        per item (client-go delayingQueue semantics): controllers re-add
+        the same deadline on every reconcile, and without dedup the heap
+        grows by one timer per event."""
         with self._mu:
+            due = self._clock() + delay
+            pending = self._delayed_pending.get(item)
+            if pending is not None and pending <= due:
+                return
+            self._delayed_pending[item] = due
             self._seq += 1
-            heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
+            heapq.heappush(self._delayed, (due, self._seq, item))
             self._mu.notify()
 
     def add_rate_limited(self, item: Hashable) -> None:
@@ -60,7 +70,9 @@ class WorkQueue:
     def _flush_delayed_locked(self) -> None:
         now = self._clock()
         while self._delayed and self._delayed[0][0] <= now:
-            _, _, item = heapq.heappop(self._delayed)
+            t, _, item = heapq.heappop(self._delayed)
+            if self._delayed_pending.get(item) == t:
+                del self._delayed_pending[item]
             if item not in self._dirty:
                 self._dirty.add(item)
                 if item not in self._processing:

@@ -306,9 +306,13 @@ class JobController(Controller):
             return
         if failed > job.spec.backoff_limit:
             # terminal failure (job_controller.go syncJob BackoffLimitExceeded):
-            # stop replacing pods and tear down the active ones
+            # stop replacing pods and tear down the active ones. The reason
+            # is PERMANENT (batch/v1 Failed condition): even if the failed
+            # pods are later GC'd, the job must not resurrect
+            job.status.failure_reason = "BackoffLimitExceeded"
             for p in active:
                 self.store.delete("Pod", p.meta.key)
+            job.status.active = 0
             if job.status != old_status:
                 self.store.update(job, check_version=False)
             return

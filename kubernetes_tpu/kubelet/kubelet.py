@@ -156,6 +156,10 @@ class Kubelet(NodeAgentBase):
         if pod.status.phase in (FAILED, SUCCEEDED):
             # terminal phases are never resynced into running (the corpse
             # keeps its containers for inspection until the object is GC'd)
+            # — and their probe workers die NOW, or pods_due would
+            # re-dispatch this dead pod on every sync forever
+            self.prober.forget_pod(key)
+            self._deadline_wakeup.pop(key, None)
             return
         # activeDeadlineSeconds (kubelet_pods activeDeadlineHandler): a
         # Running pod past its deadline fails terminally

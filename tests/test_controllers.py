@@ -544,3 +544,28 @@ class TestJobActiveDeadline:
         assert not store.pods()  # active pods terminated
         jc.sync_once()  # terminal: no replacements minted
         assert not store.pods()
+
+
+class TestBackoffLimitPermanent:
+    def test_backoff_failed_job_never_resurrects(self):
+        from kubernetes_tpu.api.types import FAILED
+        from kubernetes_tpu.controllers import JobController
+
+        store = Store()
+        clock = FakeClock()
+        job = Job(meta=ObjectMeta(name="doomed"),
+                  spec=JobSpec(completions=2, parallelism=1, backoff_limit=0,
+                               template=template()))
+        store.create(job)
+        jc = JobController(store, clock=clock)
+        jc.sync_once()
+        (pod,) = store.pods()
+        pod.status.phase = FAILED
+        store.update(pod, check_version=False)
+        jc.sync_once()
+        got = store.get("Job", "default/doomed")
+        assert got.status.failure_reason == "BackoffLimitExceeded"
+        # the failed pod is GC'd later — the job must NOT restart
+        store.delete("Pod", pod.meta.key)
+        jc.sync_once()
+        assert not store.pods()
