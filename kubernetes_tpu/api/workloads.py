@@ -90,6 +90,10 @@ class StatefulSetSpec:
     # OrderedReady: ordinal i+1 waits for ordinal i to be running;
     # Parallel: all at once (apps/v1 PodManagementPolicyType)
     pod_management_policy: str = "OrderedReady"
+    # volumeClaimTemplates: per-ordinal stable storage — the controller
+    # mints PVC <tpl>-<set>-<ordinal> and mounts it; the PVC OUTLIVES its
+    # pod, so a recreated ordinal reattaches the same data
+    volume_claim_templates: tuple = ()  # tuple[storage.PersistentVolumeClaim]
 
 
 @dataclass

@@ -414,18 +414,46 @@ class StatefulSetController(Controller):
                 ),
                 spec=_clone_pod_spec(st.spec.template),
             )
+            self._attach_claims(st, o, pod)
             self.store.create(pod)
             if ordered:
                 break  # next ordinal waits for this one to run
 
         new_status = StatefulSetStatus(
             replicas=len(owned),
-            ready_replicas=sum(1 for p in owned.values() if self._pod_running(p)),
+            ready_replicas=sum(1 for p in owned.values()
+                               if self._pod_running(p)),
             observed_generation=st.meta.generation,
         )
         if new_status != st.status:
             st.status = new_status
             self.store.update(st, check_version=False)
+
+    def _attach_claims(self, st, ordinal: int, pod: Pod) -> None:
+        """volumeClaimTemplates → per-ordinal PVC <tpl>-<set>-<ordinal>,
+        created once and REUSED by a recreated ordinal (stable storage:
+        the PVC deliberately carries no owner ref to the pod; the
+        reference keeps it until the set's PVC retention policy says
+        otherwise)."""
+        import copy
+
+        from ..api.storage import Volume
+
+        for tpl in st.spec.volume_claim_templates:
+            claim_name = f"{tpl.meta.name}-{st.meta.name}-{ordinal}"
+            claim_key = f"{st.meta.namespace}/{claim_name}"
+            if self.store.try_get("PersistentVolumeClaim", claim_key) is None:
+                claim = copy.deepcopy(tpl)
+                claim.meta.name = claim_name
+                claim.meta.namespace = st.meta.namespace
+                claim.meta.uid = ""
+                claim.meta.resource_version = 0
+                claim.meta.owner_references = [_controller_ref(st)]
+                self.store.create(claim)
+            pod.spec.volumes = tuple(pod.spec.volumes) + (
+                Volume(name=tpl.meta.name,
+                       persistent_volume_claim=claim_name),
+            )
 
 
 class DaemonSetController(Controller):

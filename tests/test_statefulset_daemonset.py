@@ -209,3 +209,71 @@ def test_daemonset_perf_workload_runs():
                             name_filter="SchedulingDaemonset")
     (r,) = results
     assert r.scheduled == 50
+
+
+class TestVolumeClaimTemplates:
+    def test_per_ordinal_pvcs_minted_and_reused(self):
+        """volumeClaimTemplates: each ordinal gets its own PVC bound via
+        WFFC; a recreated ordinal reattaches the SAME claim (stable
+        storage), and the claim survives pod deletion."""
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.storage import (
+            PersistentVolumeClaim,
+            PersistentVolumeClaimSpec,
+        )
+        from kubernetes_tpu.api.workloads import StatefulSet, StatefulSetSpec
+        from kubernetes_tpu.controllers import StatefulSetController
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.testing.wrappers import (
+            make_node,
+            make_pv,
+            make_storage_class,
+        )
+
+        store = Store()
+        store.create(make_storage_class("local",
+                                        wait_for_first_consumer=True))
+        for i in range(2):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+            store.create(make_pv(f"pv-{i}", storage="10Gi",
+                                 storage_class="local",
+                                 node_names=(f"n{i}",)))
+        tpl = PersistentVolumeClaim(
+            meta=ObjectMeta(name="data"),
+            spec=PersistentVolumeClaimSpec(storage_class_name="local",
+                                           request={"storage": "5Gi"}),
+        )
+        store.create(StatefulSet(
+            meta=ObjectMeta(name="db"),
+            spec=StatefulSetSpec(replicas=2, template=_template({"app": "db"}),
+                                 volume_claim_templates=(tpl,),
+                                 pod_management_policy="Parallel"),
+        ))
+        ctl = StatefulSetController(store)
+        sched = Scheduler(store)
+        sched.start()
+        for _ in range(6):
+            ctl.sync_once()
+            sched.schedule_pending()
+        assert store.try_get("PersistentVolumeClaim",
+                             "default/data-db-0") is not None
+        assert store.try_get("PersistentVolumeClaim",
+                             "default/data-db-1") is not None
+        pod0 = store.get("Pod", "default/db-0")
+        assert any(v.persistent_volume_claim == "data-db-0"
+                   for v in pod0.spec.volumes)
+        node0 = pod0.spec.node_name
+        assert node0
+        claim0 = store.get("PersistentVolumeClaim", "default/data-db-0")
+        bound_pv = claim0.spec.volume_name
+        assert bound_pv  # WFFC bound at schedule time
+        # kill db-0: the claim SURVIVES; the recreated pod reattaches it
+        # and lands where its volume lives
+        store.delete("Pod", "default/db-0")
+        for _ in range(6):
+            ctl.sync_once()
+            sched.schedule_pending()
+        claim0 = store.get("PersistentVolumeClaim", "default/data-db-0")
+        assert claim0.spec.volume_name == bound_pv
+        pod0 = store.get("Pod", "default/db-0")
+        assert pod0.spec.node_name == node0  # pinned by its storage
