@@ -174,11 +174,14 @@ class ResourceClaimController(Controller):
 
 
 class EndpointSliceController(Controller):
-    """endpointslice controller — one slice per Service tracking ready
-    running pods matching the selector."""
+    """endpointslice controller — slices per Service tracking ready
+    running pods matching the selector, chunked at MAX_ENDPOINTS per slice
+    (discovery/v1's maxEndpointsPerSlice default 100: watch fan-out stays
+    bounded when a service has thousands of backends)."""
 
     name = "endpointslice"
     watches = ("Service", "Pod")
+    MAX_ENDPOINTS = 100
 
     def key_of(self, kind: str, obj) -> str | None:
         if kind == "Service":
@@ -191,13 +194,18 @@ class EndpointSliceController(Controller):
                 self.queue.add(svc.meta.key)
         return None
 
+    def _owned_slices(self, namespace: str, svc_name: str) -> list:
+        return [
+            s for s in self.store.iter_kind("EndpointSlice")
+            if s.meta.namespace == namespace and s.service_name == svc_name
+        ]
+
     def reconcile(self, key: str) -> None:
+        ns, _, svc_name = key.partition("/")
         svc = self.store.try_get("Service", key)
-        slice_key = f"{key}-endpoints"
         if svc is None:
-            existing = self.store.try_get("EndpointSlice", slice_key)
-            if existing is not None:
-                self.store.delete("EndpointSlice", existing.meta.key)
+            for s in self._owned_slices(ns, svc_name):
+                self.store.try_delete("EndpointSlice", s.meta.key)
             return
         from ..api.types import RUNNING
 
@@ -240,19 +248,35 @@ class EndpointSliceController(Controller):
             and svc.spec.selector
             and labels_subset(svc.spec.selector, p.meta.labels)
         )
-        name = f"{svc.meta.name}-endpoints"
-        existing = self.store.try_get("EndpointSlice", f"{svc.meta.namespace}/{name}")
-        if existing is None:
-            self.store.create(EndpointSlice(
-                meta=ObjectMeta(name=name, namespace=svc.meta.namespace),
-                service_name=svc.meta.name,
-                endpoints=endpoints,
-                ports=svc.spec.ports,
-            ))
-        elif existing.endpoints != endpoints or existing.ports != svc.spec.ports:
-            existing.endpoints = endpoints
-            existing.ports = svc.spec.ports
-            self.store.update(existing, check_version=False)
+        # chunk into slices of MAX_ENDPOINTS (stable order so chunks only
+        # churn where membership actually changed)
+        ordered = sorted(endpoints, key=lambda e: e.target_pod)
+        chunks = [tuple(ordered[i:i + self.MAX_ENDPOINTS])
+                  for i in range(0, len(ordered), self.MAX_ENDPOINTS)] or [()]
+        want_names = {f"{svc.meta.name}-endpoints-{i}" if i else
+                      f"{svc.meta.name}-endpoints"
+                      for i in range(len(chunks))}
+        for s in self._owned_slices(svc.meta.namespace, svc.meta.name):
+            if s.meta.name not in want_names:
+                self.store.try_delete("EndpointSlice", s.meta.key)
+        for i, chunk in enumerate(chunks):
+            name = (f"{svc.meta.name}-endpoints-{i}" if i
+                    else f"{svc.meta.name}-endpoints")
+            existing = self.store.try_get(
+                "EndpointSlice", f"{svc.meta.namespace}/{name}"
+            )
+            if existing is None:
+                self.store.create(EndpointSlice(
+                    meta=ObjectMeta(name=name, namespace=svc.meta.namespace),
+                    service_name=svc.meta.name,
+                    endpoints=chunk,
+                    ports=svc.spec.ports,
+                ))
+            elif (existing.endpoints != chunk
+                  or existing.ports != svc.spec.ports):
+                existing.endpoints = chunk
+                existing.ports = svc.spec.ports
+                self.store.update(existing, check_version=False)
 
 
 class NamespaceController(Controller):

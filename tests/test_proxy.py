@@ -244,3 +244,38 @@ class TestProxyServer:
             }
         finally:
             server.shutdown()
+
+
+class TestSliceChunking:
+    def test_large_service_chunks_into_multiple_slices(self):
+        """discovery/v1 maxEndpointsPerSlice: 250 backends → 3 slices; the
+        proxy aggregates them, and scale-down prunes surplus slices."""
+        from kubernetes_tpu.api.types import RUNNING
+        from kubernetes_tpu.controllers.lifecycle import EndpointSliceController
+        from tests.wrappers import make_pod
+
+        store = Store()
+        store.create(mk_service("big"))
+        for i in range(250):
+            pod = make_pod(f"big-{i:03d}", labels={"app": "big"})
+            pod.spec.node_name = "n1"
+            pod.status.phase = RUNNING
+            pod.status.pod_ip = f"10.{128 + i // 200}.{i // 250}.{i % 250 + 1}"
+            store.create(pod)
+        ctl = EndpointSliceController(store)
+        ctl.sync_once()
+        slices = [s for s in store.iter_kind("EndpointSlice")
+                  if s.service_name == "big"]
+        assert len(slices) == 3
+        assert sorted(len(s.endpoints) for s in slices) == [50, 100, 100]
+        p = Proxier(store, node_name="n1")
+        assert p.sync() == 1
+        rule = p.dataplane.rules()[("10.0.0.1", 80, "TCP")]
+        assert len(rule.backends) == 250  # proxy aggregates all slices
+        # scale down → surplus slices pruned
+        for i in range(60, 250):
+            store.delete("Pod", f"default/big-{i:03d}")
+        ctl.sync_once()
+        slices = [s for s in store.iter_kind("EndpointSlice")
+                  if s.service_name == "big"]
+        assert len(slices) == 1 and len(slices[0].endpoints) == 60
