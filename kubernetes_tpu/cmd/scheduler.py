@@ -42,6 +42,8 @@ class SchedulerServer:
                 ),
                 plugin_args=p.plugin_args,
                 backend=p.backend,
+                disabled_plugins=tuple(p.plugins.disabled),
+                enabled_plugins=tuple(p.plugins.enabled),
             )
             for p in config.profiles
         ]

@@ -45,10 +45,28 @@ class Profile:
     plugin_args: dict = field(default_factory=dict)
     weights: dict = field(default_factory=lambda: dict(DEFAULT_WEIGHTS))
     backend: str = "host"  # "host" | "tpu"
+    # per-profile plugin disable list (config PluginSet.disabled; "*" with
+    # enabled names = whitelist, per the reference's profile semantics)
+    disabled_plugins: tuple = ()
+    enabled_plugins: tuple = ()  # only meaningful with "*" in disabled
     # >0 with backend="tpu": schedule_pending pops runs of up to wave_size
     # pods and schedules each run in ONE device program (bit-identical to
     # per-pod, see ScheduleOneLoop.schedule_wave) — the throughput mode
     wave_size: int = 0
+
+
+def _apply_plugin_set(plugins: list, prof: "Profile") -> list:
+    """Per-profile enable/disable (apis/config Plugins semantics): names in
+    disabled are removed; disabled=("*",) whitelists enabled_plugins. The
+    infrastructural plugins every cycle needs (QueueSort, Bind) survive a
+    bare wildcard unless explicitly disabled by name."""
+    disabled = set(prof.disabled_plugins)
+    if not disabled:
+        return plugins
+    if "*" in disabled:
+        keep = set(prof.enabled_plugins) | {"PrioritySort", "DefaultBinder"}
+        return [p for p in plugins if p.name in keep]
+    return [p for p in plugins if p.name not in disabled]
 
 
 class Scheduler:
@@ -103,6 +121,18 @@ class Scheduler:
             plugins = default_plugins(
                 store, self.names, self.feature_gates, prof.plugin_args
             )
+            plugins = _apply_plugin_set(plugins, prof)
+            if prof.backend == "tpu":
+                from .tpu.backend import KERNEL_FILTER_PLUGINS
+
+                missing = KERNEL_FILTER_PLUGINS - {p.name for p in plugins}
+                if missing:
+                    raise ValueError(
+                        f"profile {prof.name!r}: kernel-modeled plugins "
+                        f"{sorted(missing)} cannot be disabled with "
+                        f"backend=tpu (the dense kernel always runs them); "
+                        f"use backend=host for this profile"
+                    )
             fw = Framework(
                 plugins, prof.weights, profile_name=prof.name, metrics=metrics, clock=self.clock
             )

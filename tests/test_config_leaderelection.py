@@ -174,3 +174,49 @@ class TestSchedulerServer:
         finally:
             s1.shutdown()
             s2.shutdown()
+
+
+class TestProfilePluginSets:
+    def test_disabled_plugin_is_not_run(self):
+        """A profile disabling TaintToleration schedules onto tainted
+        nodes (the filter is gone from the chain)."""
+        from kubernetes_tpu.api.types import Taint
+        from kubernetes_tpu.scheduler import Profile, Scheduler
+        from kubernetes_tpu.store import Store
+        from tests.wrappers import make_node, make_pod
+
+        store = Store()
+        n = make_node("tainted", cpu="8", mem="16Gi")
+        n.spec.taints = (Taint(key="k", value="v", effect="NoSchedule"),)
+        store.create(n)
+        store.create(make_pod("p", cpu="1"))
+        s = Scheduler(store, profiles=[Profile(
+            disabled_plugins=("TaintToleration",))])
+        s.start()
+        assert s.schedule_pending() == 1
+        assert store.get("Pod", "default/p").spec.node_name == "tainted"
+
+    def test_wildcard_whitelist(self):
+        from kubernetes_tpu.scheduler import Profile, Scheduler
+        from kubernetes_tpu.store import Store
+        from tests.wrappers import make_node, make_pod
+
+        store = Store()
+        store.create(make_node("n1", cpu="1", mem="1Gi"))
+        store.create(make_pod("huge", cpu="64"))  # way over capacity
+        s = Scheduler(store, profiles=[Profile(
+            disabled_plugins=("*",),
+            enabled_plugins=("NodeName",))])  # NO resources filter
+        s.start()
+        assert s.schedule_pending() == 1
+        assert store.get("Pod", "default/huge").spec.node_name == "n1"
+
+    def test_tpu_profile_rejects_disabling_kernel_plugins(self):
+        import pytest
+
+        from kubernetes_tpu.scheduler import Profile, Scheduler
+        from kubernetes_tpu.store import Store
+
+        with pytest.raises(ValueError, match="kernel-modeled"):
+            Scheduler(Store(), profiles=[Profile(
+                backend="tpu", disabled_plugins=("NodeResourcesFit",))])
