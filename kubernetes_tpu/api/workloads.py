@@ -116,6 +116,9 @@ class StatefulSet:
 class DaemonSetSpec:
     selector: LabelSelector | None = None
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    # RollingUpdate strategy: at most this many nodes may be without a
+    # running daemon during a template roll (apps/v1 default 1)
+    max_unavailable: int = 1
 
 
 @dataclass

@@ -546,6 +546,7 @@ class DaemonSetController(Controller):
             self.store.delete("Pod", p.meta.key)
         from ..api.meta import new_uid
 
+        want_hash = _template_hash(ds)
         for name in sorted(eligible):
             pods = by_node.get(name, [])
             if not pods:
@@ -554,7 +555,10 @@ class DaemonSetController(Controller):
                         name=f"{ds.meta.name}-{new_uid().rsplit('-', 1)[-1]}",
                         namespace=ds.meta.namespace,
                         labels=dict(ds.spec.template.labels),
-                        annotations={"daemonset.kubernetes.io/node": name},
+                        annotations={
+                            "daemonset.kubernetes.io/node": name,
+                            "daemonset.kubernetes.io/template-hash": want_hash,
+                        },
                         owner_references=[_controller_ref(ds)],
                     ),
                     spec=self._daemon_pod_spec(ds, name),
@@ -564,6 +568,28 @@ class DaemonSetController(Controller):
                 # at most one daemon per node; extra copies die
                 for dup in pods[1:]:
                     self.store.delete("Pod", dup.meta.key)
+
+        # RollingUpdate (daemon/update.go): replace stale-template daemons
+        # while keeping at most maxUnavailable nodes daemon-less — nodes
+        # already missing a running daemon consume the budget first
+        unavailable = sum(
+            1 for name in eligible
+            if not any(p.spec.node_name and not p.is_terminating
+                       for p in by_node.get(name, [])[:1])
+        )
+        budget = max(ds.spec.max_unavailable, 1) - unavailable
+        for name in sorted(eligible):
+            if budget <= 0:
+                break
+            pods = by_node.get(name, [])[:1]
+            if not pods:
+                continue
+            pod = pods[0]
+            if pod.meta.annotations.get(
+                "daemonset.kubernetes.io/template-hash"
+            ) != want_hash:
+                self.store.delete("Pod", pod.meta.key)
+                budget -= 1
         # pods for gone/ineligible nodes are removed
         for name, pods in by_node.items():
             if name not in eligible:

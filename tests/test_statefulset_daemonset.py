@@ -277,3 +277,57 @@ class TestVolumeClaimTemplates:
         assert claim0.spec.volume_name == bound_pv
         pod0 = store.get("Pod", "default/db-0")
         assert pod0.spec.node_name == node0  # pinned by its storage
+
+
+class TestDaemonSetRollingUpdate:
+    def test_template_change_rolls_one_node_at_a_time(self):
+        """daemon/update.go RollingUpdate: stale-template daemons are
+        replaced while at most maxUnavailable nodes lack a daemon."""
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.workloads import DaemonSet, DaemonSetSpec
+        from kubernetes_tpu.controllers import DaemonSetController
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.testing.wrappers import make_node
+
+        store = Store()
+        for i in range(4):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        store.create(DaemonSet(
+            meta=ObjectMeta(name="agent"),
+            spec=DaemonSetSpec(template=_template({"app": "agent"},
+                                                  cpu="100m")),
+        ))
+        ctl = DaemonSetController(store)
+        sched = Scheduler(store)
+        sched.start()
+
+        def converge():
+            for _ in range(10):
+                n = ctl.sync_once() + sched.schedule_pending()
+                if n == 0:
+                    break
+
+        converge()
+        hashes = {p.meta.annotations["daemonset.kubernetes.io/template-hash"]
+                  for p in store.pods()}
+        assert len(store.pods()) == 4 and len(hashes) == 1
+        (old_hash,) = hashes
+        # roll the template
+        ds = store.get("DaemonSet", "default/agent")
+        ds.spec.template = _template({"app": "agent"}, cpu="200m")
+        store.update(ds, check_version=False)
+        # ONE reconcile pass kills at most maxUnavailable stale daemons
+        ctl.sync_once()
+        stale = [p for p in store.pods()
+                 if p.meta.annotations["daemonset.kubernetes.io/template-hash"]
+                 == old_hash]
+        assert len(stale) >= 2  # not all replaced at once
+        converge()
+        final = store.pods()
+        assert len(final) == 4
+        assert all(
+            p.meta.annotations["daemonset.kubernetes.io/template-hash"]
+            != old_hash for p in final
+        )
+        assert all(p.spec.containers[0].requests["cpu"] == "200m"
+                   for p in final)
