@@ -141,9 +141,13 @@ def _template_hash(dep: Deployment) -> str:
 
 class DeploymentController(Controller):
     """deployment controller — one ReplicaSet per template hash; template
-    changes roll by scaling the new RS up and old ones to 0 (the rolling.go
-    surge/maxUnavailable dance collapsed to its fixed point, which is what
-    the in-process control loop converges to in one pass)."""
+    changes roll GRADUALLY per rolling.go: the new RS surges up to
+    replicas+maxSurge total, old RSes scale down only as far as
+    availability allows (available - (replicas - maxUnavailable)), so a
+    roll never dips below the availability floor. Recreate tears the old
+    RSes fully down before the new one scales up. "Available" uses the
+    same pragmatic definition as the StatefulSet controller: scheduled and
+    not terminating (Running when a kubelet reports it)."""
 
     name = "deployment"
     watches = ("Deployment", "ReplicaSet")
@@ -191,7 +195,7 @@ class DeploymentController(Controller):
                     owner_references=[_controller_ref(dep)],
                 ),
                 spec=ReplicaSetSpec(
-                    replicas=dep.spec.replicas,
+                    replicas=0,  # the rolling step below surges it up
                     selector=LabelSelector.of(labels),
                     template=template,
                 ),
@@ -218,13 +222,8 @@ class DeploymentController(Controller):
                 self.store.update(dep, check_version=False)
                 if new_rs.spec.replicas == dep.spec.replicas:
                     self.store.update(new_rs, check_version=False)
-            if new_rs.spec.replicas != dep.spec.replicas:
-                new_rs.spec.replicas = dep.spec.replicas
-                self.store.update(new_rs, check_version=False)
-        for rs in owned:
-            if rs.meta.name != want_name and rs.spec.replicas != 0:
-                rs.spec.replicas = 0
-                self.store.update(rs, check_version=False)
+        _deployment_roll(self.store, dep, new_rs,
+                         [rs for rs in owned if rs.meta.name != want_name])
         from ..api.workloads import DeploymentStatus
 
         new_status = DeploymentStatus(
@@ -236,6 +235,81 @@ class DeploymentController(Controller):
         if new_status != dep.status:
             dep.status = new_status
             self.store.update(dep, check_version=False)
+
+
+def _available_pods(store, rs) -> int:
+    """Pods of this RS counted as available: scheduled and not terminating
+    (Running when a kubelet reports phases) — the pragmatic availability
+    the StatefulSet controller uses too."""
+    return sum(
+        1 for p in store.pods()
+        if p.meta.namespace == rs.meta.namespace
+        and _owned_by(p, rs.meta.uid)
+        and bool(p.spec.node_name)
+        and not p.is_terminating
+        and p.status.phase not in (SUCCEEDED, FAILED)
+    )
+
+
+def _deployment_roll(store, dep, new_rs, olds) -> None:
+    """rolling.go's two moves: surge the new RS, scale old ones down only
+    as availability allows."""
+    strategy = dep.spec.strategy
+    desired = dep.spec.replicas
+    if strategy.type == "Recreate":
+        # tear old down fully, then bring the new RS up
+        for rs in olds:
+            if rs.spec.replicas != 0:
+                rs.spec.replicas = 0
+                store.update(rs, check_version=False)
+        old_gone = all(
+            _available_pods(store, rs) == 0 and rs.spec.replicas == 0
+            for rs in olds
+        )
+        target = desired if old_gone else new_rs.spec.replicas
+        if new_rs.spec.replicas != target:
+            new_rs.spec.replicas = target
+            store.update(new_rs, check_version=False)
+        return
+    # RollingUpdate: surge the new RS within replicas+maxSurge total
+    # (reconcileNewReplicaSet), then scale old RSes down only as far as
+    # availability allows (reconcileOldReplicaSets)
+    surge = max(strategy.max_surge,
+                1 if strategy.max_unavailable == 0 else 0)
+    total = new_rs.spec.replicas + sum(rs.spec.replicas for rs in olds)
+    max_total = desired + surge
+    if new_rs.spec.replicas < desired and total < max_total:
+        new_rs.spec.replicas = min(
+            desired, new_rs.spec.replicas + (max_total - total)
+        )
+        store.update(new_rs, check_version=False)
+    elif new_rs.spec.replicas > desired:
+        new_rs.spec.replicas = desired
+        store.update(new_rs, check_version=False)
+    # cleanupUnhealthyReplicas (rolling.go): old replicas that never became
+    # available cost nothing to remove — without this, one permanently
+    # pending old pod wedges the entire roll at the availability floor
+    for rs in sorted(olds, key=lambda r: r.meta.name):
+        if rs.spec.replicas == 0:
+            continue
+        unhealthy = rs.spec.replicas - _available_pods(store, rs)
+        if unhealthy > 0:
+            rs.spec.replicas = max(0, rs.spec.replicas - unhealthy)
+            store.update(rs, check_version=False)
+    available = _available_pods(store, new_rs) + sum(
+        _available_pods(store, rs) for rs in olds
+    )
+    min_available = desired - strategy.max_unavailable
+    budget = available - min_available
+    for rs in sorted(olds, key=lambda r: r.meta.name):
+        if budget <= 0:
+            break
+        if rs.spec.replicas == 0:
+            continue
+        down = min(rs.spec.replicas, budget)
+        rs.spec.replicas -= down
+        budget -= down
+        store.update(rs, check_version=False)
 
 
 class JobController(Controller):
@@ -466,6 +540,9 @@ class DaemonSetController(Controller):
 
     name = "daemonset"
     watches = ("DaemonSet", "Pod", "Node")
+    # a rolling replacement unavailable this long stops counting against
+    # the maxUnavailable budget (see reconcile)
+    ROLL_STUCK_GRACE_S = 60.0
 
     def key_of(self, kind: str, obj) -> str | None:
         if kind == "DaemonSet":
@@ -569,25 +646,47 @@ class DaemonSetController(Controller):
                 for dup in pods[1:]:
                     self.store.delete("Pod", dup.meta.key)
 
-        # RollingUpdate (daemon/update.go): replace stale-template daemons
-        # while keeping at most maxUnavailable nodes daemon-less — nodes
-        # already missing a running daemon consume the budget first
-        unavailable = sum(
-            1 for name in eligible
-            if not any(p.spec.node_name and not p.is_terminating
-                       for p in by_node.get(name, [])[:1])
-        )
-        budget = max(ds.spec.max_unavailable, 1) - unavailable
+        # RollingUpdate (daemon/update.go): replace stale-template daemons.
+        # - stale AND unavailable daemons delete budget-free (removing them
+        #   changes nothing for the node), so a sick node can't wedge the
+        #   roll for healthy ones;
+        # - the budget for killing AVAILABLE stale daemons is maxUnavailable
+        #   minus replacements still in flight (new-hash pods not yet
+        #   available) — that's what makes the roll one-node-at-a-time;
+        # - a replacement stuck unavailable past ROLL_STUCK_GRACE_S ages out
+        #   of the in-flight count (the reference excludes such nodes via
+        #   shouldRun fit simulation; the grace approximates it), so the
+        #   roll keeps moving. max_unavailable=0 genuinely freezes rolls of
+        #   available daemons.
+        def pod_available(p) -> bool:
+            return bool(p.spec.node_name) and not p.is_terminating
+
+        hash_key = "daemonset.kubernetes.io/template-hash"
+        now = self.clock.now()
+        in_flight = 0
+        for name in eligible:
+            pods = by_node.get(name, [])[:1]
+            if not pods:
+                continue
+            p = pods[0]
+            age = now - p.meta.creation_timestamp
+            # negative age = clock skew between store and controller
+            # clocks: fail OPEN (not in-flight) so the roll makes progress
+            if (p.meta.annotations.get(hash_key) == want_hash
+                    and not pod_available(p)
+                    and 0 <= age < self.ROLL_STUCK_GRACE_S):
+                in_flight += 1
+        budget = ds.spec.max_unavailable - in_flight
         for name in sorted(eligible):
-            if budget <= 0:
-                break
             pods = by_node.get(name, [])[:1]
             if not pods:
                 continue
             pod = pods[0]
-            if pod.meta.annotations.get(
-                "daemonset.kubernetes.io/template-hash"
-            ) != want_hash:
+            if pod.meta.annotations.get(hash_key) == want_hash:
+                continue
+            if not pod_available(pod):
+                self.store.delete("Pod", pod.meta.key)  # free
+            elif budget > 0:
                 self.store.delete("Pod", pod.meta.key)
                 budget -= 1
         # pods for gone/ineligible nodes are removed

@@ -83,23 +83,32 @@ class TestReplicaSet:
 
 class TestDeployment:
     def test_creates_replicaset_and_rolls_template(self):
+        """Rolling now GATES on availability (rolling.go): a roll needs a
+        scheduler + kubelets making new pods available before old ones
+        scale down, and never dips below replicas - maxUnavailable."""
+        from kubernetes_tpu.kubelet import start_hollow_nodes
+        from kubernetes_tpu.scheduler import Scheduler
+
         store = Store()
         cm = ControllerManager(store, default_controllers(store))
+        sched = Scheduler(store)
+        sched.start()
+        kubelets = start_hollow_nodes(store, 2)
         dep = Deployment(
             meta=ObjectMeta(name="api"),
             spec=DeploymentSpec(replicas=2, template=template(cpu="100m")),
         )
         store.create(dep)
-        converge(store, cm)
+        converge(store, cm, sched, kubelets)
         rsets = list(store.iter_kind("ReplicaSet"))
         assert len(rsets) == 1 and rsets[0].spec.replicas == 2
         assert len(store.pods()) == 2
         old_rs_name = rsets[0].meta.name
-        # template change -> new RS, old scaled to 0, orphan pods GC'd
+        # template change -> gradual roll to the new RS, old to 0
         cur = store.get("Deployment", "default/api")
         cur.spec.template = template(cpu="200m")
         store.update(cur, check_version=False)
-        converge(store, cm, rounds=12)
+        converge(store, cm, sched, kubelets, rounds=16)
         rsets = {rs.meta.name: rs for rs in store.iter_kind("ReplicaSet")}
         assert len(rsets) == 2
         assert rsets[old_rs_name].spec.replicas == 0
@@ -569,3 +578,114 @@ class TestBackoffLimitPermanent:
         store.delete("Pod", pod.meta.key)
         jc.sync_once()
         assert not store.pods()
+
+
+class TestRollingAvailabilityFloor:
+    def test_roll_never_dips_below_min_available(self):
+        """The point of maxUnavailable=0/maxSurge=1: at every step of the
+        roll at least `replicas` pods remain available."""
+        from kubernetes_tpu.kubelet import start_hollow_nodes
+        from kubernetes_tpu.scheduler import Scheduler
+
+        store = Store()
+        cm = ControllerManager(store, default_controllers(store))
+        sched = Scheduler(store)
+        sched.start()
+        kubelets = start_hollow_nodes(store, 3)
+        store.create(Deployment(
+            meta=ObjectMeta(name="web"),
+            spec=DeploymentSpec(replicas=3, template=template(cpu="100m")),
+        ))
+        converge(store, cm, sched, kubelets)
+
+        def available():
+            return sum(1 for p in store.pods()
+                       if p.spec.node_name and not p.is_terminating)
+
+        assert available() == 3
+        dep = store.get("Deployment", "default/web")
+        dep.spec.template = template(cpu="150m")
+        store.update(dep, check_version=False)
+        floor_violations = []
+        for _ in range(20):
+            n = cm.sync_once() + sched.schedule_pending()
+            for k in kubelets:
+                n += k.sync_once()
+            if available() < 3:  # replicas - maxUnavailable(0)
+                floor_violations.append(available())
+            if n == 0:
+                break
+        assert not floor_violations, floor_violations
+        pods = store.pods()
+        assert len(pods) == 3
+        assert all(str(p.spec.containers[0].requests["cpu"]) == "150m"
+                   for p in pods)
+
+
+class TestRollDeadlockRecovery:
+    def test_pending_old_replica_does_not_wedge_the_roll(self):
+        """cleanupUnhealthyReplicas: a never-available old pod costs
+        nothing to remove, so the roll completes for the healthy ones."""
+        from kubernetes_tpu.kubelet import start_hollow_nodes
+        from kubernetes_tpu.scheduler import Scheduler
+
+        store = Store()
+        cm = ControllerManager(store, default_controllers(store))
+        sched = Scheduler(store)
+        sched.start()
+        kubelets = start_hollow_nodes(store, 3, cpu="32")
+        # 4 replicas of 20-cpu pods over 3x32cpu nodes: the 4th stays
+        # Pending forever
+        store.create(Deployment(
+            meta=ObjectMeta(name="fat"),
+            spec=DeploymentSpec(replicas=4, template=template(cpu="20")),
+        ))
+        converge(store, cm, sched, kubelets, rounds=12)
+        # roll to a tiny template: must complete despite the pending pod
+        dep = store.get("Deployment", "default/fat")
+        dep.spec.template = template(cpu="100m")
+        store.update(dep, check_version=False)
+        converge(store, cm, sched, kubelets, rounds=24)
+        pods = store.pods()
+        assert len(pods) == 4
+        assert all(str(p.spec.containers[0].requests["cpu"]) == "100m"
+                   for p in pods)
+        assert all(p.spec.node_name for p in pods)
+
+    def test_sick_node_does_not_wedge_daemonset_roll(self):
+        """A node whose daemon can never schedule must not block the roll
+        on healthy nodes (stale-unavailable daemons delete budget-free)."""
+        from kubernetes_tpu.api.workloads import DaemonSet, DaemonSetSpec
+        from kubernetes_tpu.controllers import DaemonSetController
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.testing.wrappers import make_node
+
+        clock = FakeClock()
+        store = Store(clock=clock.now)
+        store.create(make_node("tiny", cpu="1", mem="1Gi"))  # can't fit
+        for i in range(3):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        store.create(DaemonSet(
+            meta=ObjectMeta(name="agent"),
+            spec=DaemonSetSpec(template=template({"app": "agent"},
+                                                 cpu="2")),
+        ))
+        ctl = DaemonSetController(store, clock=clock)
+        sched = Scheduler(store)
+        sched.start()
+        for _ in range(8):
+            if ctl.sync_once() + sched.schedule_pending() == 0:
+                break
+        ds = store.get("DaemonSet", "default/agent")
+        ds.spec.template = template({"app": "agent"}, cpu="3")
+        store.update(ds, check_version=False)
+        for _ in range(16):
+            n = ctl.sync_once() + sched.schedule_pending()
+            clock.step(61)  # stuck replacements age out of the budget
+            if n == 0:
+                break
+        rolled = [p for p in store.pods()
+                  if p.spec.node_name in ("n0", "n1", "n2")]
+        assert len(rolled) == 3
+        assert all(str(p.spec.containers[0].requests["cpu"]) == "3"
+                   for p in rolled)
