@@ -60,6 +60,9 @@ class DeploymentSpec:
     selector: LabelSelector | None = None
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
     strategy: DeploymentStrategy = field(default_factory=DeploymentStrategy)
+    # rollout pause (kubectl rollout pause): template changes don't roll
+    # while paused; pure scaling of the current RS still applies
+    paused: bool = False
 
 
 @dataclass

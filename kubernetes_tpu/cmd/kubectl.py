@@ -281,6 +281,12 @@ def cmd_rollout(client: RESTStore, args) -> int:
     rev_key = "deployment.kubernetes.io/revision"
     by_rev = {int(rs.meta.annotations.get(rev_key, 0)): rs for rs in rs_list}
 
+    if args.action in ("pause", "resume"):
+        dep.spec.paused = args.action == "pause"
+        client.update(dep, check_version=False)
+        print(f"deployment/{args.name} {args.action}d")
+        return 0
+
     if args.action == "history":
         for rev in sorted(by_rev):
             rs = by_rev[rev]
@@ -415,7 +421,8 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("-A", "--all-namespaces", action="store_true")
 
     ro = sub.add_parser("rollout")
-    ro.add_argument("action", choices=["status", "history", "undo"])
+    ro.add_argument("action",
+                    choices=["status", "history", "undo", "pause", "resume"])
     ro.add_argument("resource")
     ro.add_argument("name")
     ro.add_argument("--to-revision", type=int, default=0)

@@ -172,6 +172,29 @@ class DeploymentController(Controller):
             if rs.meta.namespace == dep.meta.namespace and _owned_by(rs, dep.meta.uid)
         ]
         new_rs = next((rs for rs in owned if rs.meta.name == want_name), None)
+        if dep.spec.paused:
+            # rollout paused (syncDeployment's paused branch): no new RS,
+            # no rolling — but pure scaling still applies: distribute the
+            # TOTAL-vs-desired delta across live RSes newest-first (clamped
+            # at 0), so a mid-roll pause keeps sum(replicas) == desired
+            # instead of inflating the largest RS to desired on its own
+            by_newest = sorted(
+                owned,
+                key=lambda r: int(
+                    r.meta.annotations.get(REVISION_ANNOTATION, 0)),
+                reverse=True,
+            )
+            delta = dep.spec.replicas - sum(r.spec.replicas for r in by_newest)
+            for rs in by_newest:
+                if delta == 0:
+                    break
+                step = max(delta, -rs.spec.replicas)
+                if step:
+                    rs.spec.replicas += step
+                    self.store.update(rs, check_version=False)
+                    delta -= step
+            self._write_status(dep, new_rs, owned)
+            return
         if new_rs is None:
             labels = dict(dep.spec.template.labels)
             labels["pod-template-hash"] = want_hash
@@ -224,12 +247,18 @@ class DeploymentController(Controller):
                     self.store.update(new_rs, check_version=False)
         _deployment_roll(self.store, dep, new_rs,
                          [rs for rs in owned if rs.meta.name != want_name])
+        self._write_status(dep, new_rs, owned)
+
+    def _write_status(self, dep, new_rs, owned) -> None:
         from ..api.workloads import DeploymentStatus
 
         new_status = DeploymentStatus(
             replicas=dep.spec.replicas,
-            updated_replicas=new_rs.spec.replicas,
-            ready_replicas=new_rs.status.ready_replicas,
+            updated_replicas=new_rs.spec.replicas if new_rs else 0,
+            # readiness counts every live RS: mid-roll (or paused mid-roll)
+            # part of the pods live in old RSes; rollout-status completion
+            # still gates on updated_replicas, so this can't fire early
+            ready_replicas=sum(r.status.ready_replicas for r in owned),
             observed_generation=dep.meta.generation,
         )
         if new_status != dep.status:
@@ -540,6 +569,7 @@ class DaemonSetController(Controller):
 
     name = "daemonset"
     watches = ("DaemonSet", "Pod", "Node")
+    clocked_queue = True  # roll-grace-expiry self-requeues ride the clock
     # a rolling replacement unavailable this long stops counting against
     # the maxUnavailable budget (see reconcile)
     ROLL_STUCK_GRACE_S = 60.0
@@ -641,6 +671,11 @@ class DaemonSetController(Controller):
                     spec=self._daemon_pod_spec(ds, name),
                 )
                 self.store.create(pod)
+                # visible to the in-flight budget below: a node whose
+                # replacement was minted THIS reconcile is already in
+                # flight (otherwise the budget double-spends when a second
+                # reconcile runs before the scheduler places the pod)
+                by_node[name] = [pod]
             else:
                 # at most one daemon per node; extra copies die
                 for dup in pods[1:]:
@@ -664,6 +699,7 @@ class DaemonSetController(Controller):
         hash_key = "daemonset.kubernetes.io/template-hash"
         now = self.clock.now()
         in_flight = 0
+        next_age_out = None  # earliest grace expiry among in-flight pods
         for name in eligible:
             pods = by_node.get(name, [])[:1]
             if not pods:
@@ -676,7 +712,11 @@ class DaemonSetController(Controller):
                     and not pod_available(p)
                     and 0 <= age < self.ROLL_STUCK_GRACE_S):
                 in_flight += 1
+                remain = self.ROLL_STUCK_GRACE_S - age
+                if next_age_out is None or remain < next_age_out:
+                    next_age_out = remain
         budget = ds.spec.max_unavailable - in_flight
+        budget_blocked = False
         for name in sorted(eligible):
             pods = by_node.get(name, [])[:1]
             if not pods:
@@ -689,6 +729,13 @@ class DaemonSetController(Controller):
             elif budget > 0:
                 self.store.delete("Pod", pod.meta.key)
                 budget -= 1
+            else:
+                budget_blocked = True
+        if budget_blocked and next_age_out is not None:
+            # stale daemons remain only because replacements hold the
+            # budget: wake when the first one ages out of the in-flight
+            # count — no unrelated event is needed to resume the roll
+            self.queue.add_after(key, next_age_out + 0.1)
         # pods for gone/ineligible nodes are removed
         for name, pods in by_node.items():
             if name not in eligible:

@@ -449,6 +449,13 @@ class TestRolloutRevisions:
             dep = store.get("Deployment", "default/web")
             req = dep.spec.template.spec.containers[0].requests["cpu"]
             assert req == "100m"
+            # pause / resume flip spec.paused through the API
+            assert kubectl(["-s", url, "rollout", "pause", "deploy",
+                            "web"]) == 0
+            assert store.get("Deployment", "default/web").spec.paused
+            assert kubectl(["-s", url, "rollout", "resume", "deploy",
+                            "web"]) == 0
+            assert not store.get("Deployment", "default/web").spec.paused
         finally:
             server.shutdown()
 
@@ -689,3 +696,91 @@ class TestRollDeadlockRecovery:
         assert len(rolled) == 3
         assert all(str(p.spec.containers[0].requests["cpu"]) == "3"
                    for p in rolled)
+
+
+class TestDeploymentPause:
+    """spec.paused halts rollouts (kubectl rollout pause) but not scaling."""
+
+    def _converge(self, store, ctl, sched, kubelets, rounds=12):
+        for _ in range(rounds):
+            n = ctl.sync_once() + sched.schedule_pending()
+            for kl in kubelets:
+                kl.sync_once()
+            if n == 0 and all(
+                p.status.phase == "Running" for p in store.pods()
+            ):
+                break
+
+    def test_paused_deployment_does_not_roll_but_scales(self):
+        from kubernetes_tpu.controllers import (
+            DeploymentController,
+            ReplicaSetController,
+        )
+
+        store = Store()
+        kubelets = []
+        for i in range(3):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+            kubelets.append(
+                HollowKubelet(store, store.get("Node", f"n{i}")))
+        store.create(Deployment(
+            meta=ObjectMeta(name="web"),
+            spec=DeploymentSpec(
+                replicas=2, template=template({"app": "web"}, cpu="1")),
+        ))
+        ctl = DeploymentController(store)
+        rsctl = ReplicaSetController(store)
+        sched = Scheduler(store)
+        sched.start()
+        for _ in range(12):
+            n = ctl.sync_once() + rsctl.sync_once() + sched.schedule_pending()
+            for kl in kubelets:
+                kl.sync_once()
+            if n == 0:
+                break
+        assert len([p for p in store.pods()]) == 2
+
+        dep = store.get("Deployment", "default/web")
+        dep.spec.paused = True
+        dep.spec.template = template({"app": "web"}, cpu="2")
+        store.update(dep, check_version=False)
+        for _ in range(8):
+            ctl.sync_once(); rsctl.sync_once(); sched.schedule_pending()
+            for kl in kubelets:
+                kl.sync_once()
+        # no new-template RS minted, no pods replaced
+        rses = [r for r in store.iter_kind("ReplicaSet")]
+        assert len(rses) == 1
+        assert all(
+            str(p.spec.containers[0].requests["cpu"]) == "1"
+            for p in store.pods()
+        )
+
+        # pure scaling still flows through while paused
+        dep = store.get("Deployment", "default/web")
+        dep.spec.replicas = 4
+        store.update(dep, check_version=False)
+        for _ in range(8):
+            ctl.sync_once(); rsctl.sync_once(); sched.schedule_pending()
+            for kl in kubelets:
+                kl.sync_once()
+        assert len([p for p in store.pods()]) == 4
+
+        # resume: the deferred template change now rolls
+        dep = store.get("Deployment", "default/web")
+        dep.spec.paused = False
+        store.update(dep, check_version=False)
+        for _ in range(24):
+            n = (ctl.sync_once() + rsctl.sync_once()
+                 + sched.schedule_pending())
+            for kl in kubelets:
+                kl.sync_once()
+            if n == 0 and all(
+                str(p.spec.containers[0].requests["cpu"]) == "2"
+                for p in store.pods()
+            ):
+                break
+        assert all(
+            str(p.spec.containers[0].requests["cpu"]) == "2"
+            for p in store.pods()
+        )
