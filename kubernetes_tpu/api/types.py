@@ -319,6 +319,9 @@ class NodeStatus:
     conditions: list[NodeCondition] = field(default_factory=list)
     images: list[ContainerImage] = field(default_factory=list)
     declared_features: tuple[str, ...] = ()
+    # daemonEndpoints.kubeletEndpoint.Port: where this node's kubelet
+    # serves /containerLogs etc. (the apiserver's log proxy dials it)
+    daemon_endpoint_port: int = 0
 
 
 @dataclass

@@ -247,9 +247,16 @@ class APIServer:
                 kind = parts[2]
                 rest = parts[3:]
                 sub = ""
-                if rest and rest[-1] in ("binding", "status"):
-                    sub = rest[-1]
-                    rest = rest[:-1]
+                if rest and rest[-1] in ("binding", "status", "log"):
+                    # subresource only when a full object key PRECEDES the
+                    # suffix (ns/name, or bare name for cluster-scoped) —
+                    # otherwise a pod literally named "log" is unreachable
+                    from .discovery import CLUSTER_SCOPED
+
+                    expect = 1 if kind in CLUSTER_SCOPED else 2
+                    if len(rest) == expect + 1:
+                        sub = rest[-1]
+                        rest = rest[:-1]
                 key = "/".join(rest)
                 return kind, key, sub, query
 
@@ -309,6 +316,51 @@ class APIServer:
                     )
                 return ok
 
+            def _proxy_pod_logs(self, key: str, query: dict) -> None:
+                from urllib.request import urlopen
+
+                try:
+                    pod = server.store.get("Pod", key)
+                except NotFoundError:
+                    self._error(404, "NotFound", f"pod {key} not found")
+                    return
+                if not pod.spec.node_name:
+                    self._error(400, "BadRequest", "pod is not scheduled")
+                    return
+                try:
+                    node = server.store.get("Node", pod.spec.node_name)
+                except NotFoundError:
+                    self._error(404, "NotFound", "pod's node is gone")
+                    return
+                port = node.status.daemon_endpoint_port
+                if not port:
+                    self._error(503, "ServiceUnavailable",
+                                "node's kubelet endpoint is unknown")
+                    return
+                container = query.get("container", "")
+                ns, name = key.split("/", 1)
+                url = (f"http://127.0.0.1:{port}/containerLogs/"
+                       f"{ns}/{name}/{container}")
+                if query.get("tailLines"):
+                    url += f"?tailLines={query['tailLines']}"
+                try:
+                    with urlopen(url, timeout=10) as resp:
+                        body = resp.read()
+                        code = resp.status
+                except Exception as e:  # noqa: BLE001 - proxied verbatim
+                    import urllib.error
+
+                    if isinstance(e, urllib.error.HTTPError):
+                        body, code = e.read(), e.code
+                    else:
+                        self._error(502, "BadGateway", f"kubelet: {e}")
+                        return
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/healthz" or self.path == "/readyz":
                     self._send_json(200, {"status": "ok"})
@@ -342,7 +394,18 @@ class APIServer:
                 if route is None:
                     self._error(404, "NotFound", "unknown path")
                     return
-                kind, key, _, query = route
+                kind, key, sub, query = route
+                if sub == "log":
+                    # pods/log subresource: proxy to the pod's kubelet
+                    # (registry/core/pod LogREST → node daemonEndpoints),
+                    # gated behind the separate pods/log RBAC resource
+                    if kind != "Pod":
+                        self._error(404, "NotFound", "log is a pod subresource")
+                        return
+                    if not self._authorized("get", "Pod/log", key):
+                        return
+                    self._proxy_pod_logs(key, query)
+                    return
                 verb = "get" if key else ("watch" if query.get("watch") else "list")
                 if not self._authorized(verb, kind, key):
                     return
@@ -450,6 +513,9 @@ class APIServer:
                     return
                 kind, key, sub, _ = route
                 body = self._read_body()
+                if sub == "log":
+                    self._error(405, "MethodNotAllowed", "pods/log is GET-only")
+                    return
                 if sub == "binding":
                     # the reference gates binding writes behind the separate
                     # pods/binding resource, NOT plain pod create — a
@@ -517,6 +583,9 @@ class APIServer:
                 # Content-Length bytes or the next request on this
                 # keep-alive connection parses them as a request line
                 body = self._read_body()
+                if sub == "log":
+                    self._error(405, "MethodNotAllowed", "pods/log is GET-only")
+                    return
                 if not self._authorized("update", kind, key):
                     return
                 try:
@@ -556,7 +625,10 @@ class APIServer:
                 if route is None:
                     self._error(404, "NotFound", "unknown path")
                     return
-                kind, key, _, _ = route
+                kind, key, sub, _ = route
+                if sub == "log":
+                    self._error(405, "MethodNotAllowed", "pods/log is GET-only")
+                    return
                 if not self._authorized("delete", kind, key):
                     return
                 try:

@@ -223,6 +223,23 @@ class RESTStore:
     def delete(self, kind: str, key: str):
         return decode(self._request("DELETE", f"/api/v1/{kind}/{key}"))
 
+    def pod_logs(self, key: str, container: str = "",
+                 tail_lines: int | None = None) -> str:
+        """GET pods/log subresource (apiserver proxies to the kubelet)."""
+        q = []
+        if container:
+            q.append(f"container={container}")
+        if tail_lines is not None:
+            q.append(f"tailLines={tail_lines}")
+        path = f"/api/v1/Pod/{key}/log" + ("?" + "&".join(q) if q else "")
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", headers=self._headers())
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            _raise_for(e.code, e.read().decode(errors="replace"), "")
+
     def try_delete(self, kind: str, key: str):
         """delete() tolerant of already-gone objects (Store.try_delete)."""
         try:

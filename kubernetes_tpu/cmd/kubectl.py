@@ -172,6 +172,20 @@ def cmd_cordon(client: RESTStore, args, unschedulable: bool = True) -> int:
     return 0
 
 
+def cmd_logs(client: RESTStore, args) -> int:
+    """kubectl logs: the pods/log subresource (apiserver proxies to the
+    pod's kubelet /containerLogs endpoint)."""
+    try:
+        sys.stdout.write(client.pod_logs(
+            _key("Pod", args.name, args.namespace),
+            container=args.container, tail_lines=args.tail,
+        ))
+        return 0
+    except Exception as e:  # noqa: BLE001 - CLI boundary
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
 def cmd_drain(client: RESTStore, args) -> int:
     """kubectl drain: cordon, then evict every pod on the node, honoring
     PodDisruptionBudgets (staging/.../kubectl/pkg/drain): an eviction that
@@ -420,6 +434,11 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("resource")
     tp.add_argument("-A", "--all-namespaces", action="store_true")
 
+    lg = sub.add_parser("logs")
+    lg.add_argument("name")
+    lg.add_argument("-c", "--container", default="")
+    lg.add_argument("--tail", type=int, default=None)
+
     ro = sub.add_parser("rollout")
     ro.add_argument("action",
                     choices=["status", "history", "undo", "pause", "resume"])
@@ -447,6 +466,7 @@ def main(argv: list[str] | None = None) -> int:
         "events": cmd_events,
         "top": cmd_top,
         "rollout": cmd_rollout,
+        "logs": cmd_logs,
     }
     return verbs[args.verb](client, args)
 

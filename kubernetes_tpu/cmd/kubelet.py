@@ -32,7 +32,39 @@ class KubeletServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path == "/healthz":
+                if self.path.startswith("/containerLogs/"):
+                    # kubelet API: /containerLogs/<ns>/<pod>/<container>
+                    # (?tailLines=N) — the apiserver's pods/log proxy target
+                    from urllib.parse import parse_qs, urlparse
+
+                    u = urlparse(self.path)
+                    parts = u.path.split("/")[2:]
+                    if len(parts) != 3:
+                        self.send_response(404); self.end_headers(); return
+                    ns, pod, container = parts
+                    q = parse_qs(u.query)
+                    tail = q.get("tailLines", [None])[0]
+                    try:
+                        tail_n = int(tail) if tail else None
+                    except ValueError:
+                        body = f"invalid tailLines {tail!r}".encode()
+                        tail_n, code = None, 400
+                    else:
+                        code = 200
+                    if code == 200:
+                        try:
+                            body = server.kubelet.container_logs(
+                                f"{ns}/{pod}", container, tail_lines=tail_n,
+                            ).encode()
+                        except KeyError as e:
+                            body = str(e).encode()
+                            code = 404
+                    self.send_response(code)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/healthz":
                     last = server.last_sync
                     healthy = (last is not None and time.monotonic() - last
                                < 4 * server.sync_period_s + 10)
@@ -54,7 +86,11 @@ class KubeletServer:
         self._http = ThreadingHTTPServer(("127.0.0.1", port),
                                          self._build_handler())
         threading.Thread(target=self._http.serve_forever, daemon=True).start()
-        return self._http.server_address[1]
+        bound = self._http.server_address[1]
+        # publish the endpoint (node.status.daemonEndpoints) so the
+        # apiserver's log proxy can dial this kubelet
+        self.kubelet.node.status.daemon_endpoint_port = bound
+        return bound
 
     def run(self, block: bool = False) -> None:
         self.kubelet.register()

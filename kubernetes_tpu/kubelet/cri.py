@@ -94,6 +94,10 @@ class InMemoryRuntime:
         self.sandboxes: dict[str, PodSandbox] = {}
         self.containers: dict[str, CRIContainer] = {}
         self.images: dict[str, Image] = {}
+        # per-container log buffers (kubelet ReadLogs boundary: the real
+        # runtime writes /var/log/pods/...; here lifecycle lines stand in
+        # for process output, keyed by container id)
+        self._logs: dict[str, list[str]] = {}
 
     # -- RuntimeService ------------------------------------------------------
 
@@ -131,6 +135,7 @@ class InMemoryRuntime:
             id=cid, sandbox_id=sandbox_id, name=name, image=image,
             run_seconds=run_seconds, env=dict(env or {}),
         )
+        self._log(cid, f"created container {name} (image {image})")
         return cid
 
     def start_container(self, container_id: str) -> None:
@@ -139,6 +144,7 @@ class InMemoryRuntime:
             raise RuntimeError(f"container {container_id} is {c.state}")
         c.state = CONTAINER_RUNNING
         c.started_at = self._clock()
+        self._log(container_id, f"started container {c.name}")
 
     def stop_container(self, container_id: str, timeout_s: float = 0) -> None:
         c = self.containers.get(container_id)
@@ -150,6 +156,18 @@ class InMemoryRuntime:
         if c is not None and c.state == CONTAINER_RUNNING:
             raise RuntimeError(f"container {container_id} still running")
         self.containers.pop(container_id, None)
+        self._logs.pop(container_id, None)
+
+    def read_logs(self, container_id: str, tail_lines: int | None = None
+                  ) -> str:
+        """CRI ReadLogs equivalent (the kubelet's /containerLogs source)."""
+        self._tick()
+        lines = self._logs.get(container_id, [])
+        if tail_lines is not None:
+            # kubectl --tail semantics: 0 prints nothing (lines[-0:] would
+            # be everything); negatives are treated the same
+            lines = lines[-tail_lines:] if tail_lines > 0 else []
+        return "".join(lines)
 
     def list_pod_sandboxes(self) -> list[PodSandbox]:
         return list(self.sandboxes.values())
@@ -180,6 +198,7 @@ class InMemoryRuntime:
         c.state = EXITED
         c.exit_code = code
         c.finished_at = self._clock()
+        self._log(c.id, f"container {c.name} exited (code {code})")
 
     def _tick(self) -> None:
         now = self._clock()
@@ -189,3 +208,8 @@ class InMemoryRuntime:
                 c.state = EXITED
                 c.exit_code = 0
                 c.finished_at = now
+                self._log(c.id, f"container {c.name} exited (code 0)")
+
+    def _log(self, container_id: str, line: str) -> None:
+        self._logs.setdefault(container_id, []).append(
+            f"{self._clock():.3f} {line}\n")

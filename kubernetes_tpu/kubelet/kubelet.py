@@ -134,6 +134,24 @@ class Kubelet(NodeAgentBase):
         self._housekeeping()
         return len(dispatched)
 
+    def container_logs(self, pod_key: str, container: str = "",
+                       tail_lines: int | None = None) -> str:
+        """kubelet /containerLogs source (kuberuntime ReadLogs): the pod's
+        named container's log from the CRI runtime. Empty container name
+        picks the pod's only container (kubectl logs semantics)."""
+        sid = self._sandboxes.get(pod_key)
+        if sid is None:
+            raise KeyError(f"no running sandbox for {pod_key}")
+        cands = [c for c in self.runtime.list_containers()
+                 if c.sandbox_id == sid
+                 and (not container or c.name == container)]
+        if not cands:
+            raise KeyError(f"no container {container!r} in {pod_key}")
+        if len(cands) > 1 and not container:
+            names = sorted(c.name for c in cands)
+            raise KeyError(f"container name required (one of {names})")
+        return self.runtime.read_logs(cands[0].id, tail_lines=tail_lines)
+
     def _my_pods(self):
         return [p for p in self.store.pods()
                 if p.spec.node_name == self.node_name]

@@ -835,3 +835,145 @@ class TestActiveDeadline:
                         if c.state == CONTAINER_RUNNING]
         finally:
             k.shutdown()
+
+
+class TestContainerLogs:
+    """kubectl logs path: CRI log buffers → kubelet container_logs →
+    KubeletServer /containerLogs → apiserver pods/log proxy → kubectl."""
+
+    def test_logs_flow_end_to_end(self, capsys):
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+        from kubernetes_tpu.cmd.kubelet import KubeletServer
+
+        store = Store()
+        api = APIServer(store)
+        api.serve(0)
+        ks = KubeletServer(store, make_node("n1"))
+        try:
+            ks.serve(0)
+            ks.kubelet.register()
+            pod = make_pod("web", image="busybox")
+            pod.spec.node_name = "n1"
+            store.create(pod)
+            ks.kubelet.sync_loop_iteration()
+            ks.kubelet.workers.drain()
+
+            assert kubectl(["-s", api.url, "logs", "web"]) == 0
+            out = capsys.readouterr().out
+            assert "created container" in out
+            assert "started container" in out
+
+            # tail trims to the newest lines
+            assert kubectl(["-s", api.url, "logs", "web", "--tail", "1"]) == 0
+            out = capsys.readouterr().out
+            assert out.count("\n") == 1
+            assert "started container" in out
+
+            # unknown container → error surfaced, nonzero exit
+            assert kubectl(["-s", api.url, "logs", "web",
+                            "-c", "nope"]) == 1
+        finally:
+            ks.shutdown()
+            api.shutdown()
+
+    def test_logs_of_unscheduled_pod_is_an_error(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.client.rest import RESTStore
+
+        store = Store()
+        api = APIServer(store)
+        api.serve(0)
+        try:
+            store.create(make_pod("pending"))
+            client = RESTStore(api.url)
+            with pytest.raises(Exception, match="not scheduled"):
+                client.pod_logs("default/pending")
+        finally:
+            api.shutdown()
+
+
+class TestLogsReviewRegressions:
+    """Review findings on the pods/log path."""
+
+    def _cluster(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.cmd.kubelet import KubeletServer
+
+        store = Store()
+        api = APIServer(store)
+        api.serve(0)
+        ks = KubeletServer(store, make_node("n1"))
+        ks.serve(0)
+        ks.kubelet.register()
+        pod = make_pod("web", image="busybox")
+        pod.spec.node_name = "n1"
+        store.create(pod)
+        ks.kubelet.sync_loop_iteration()
+        ks.kubelet.workers.drain()
+        return store, api, ks
+
+    def test_non_get_on_log_url_does_not_touch_the_pod(self):
+        import urllib.error
+        import urllib.request
+
+        store, api, ks = self._cluster()
+        try:
+            for method in ("DELETE", "PUT", "POST"):
+                req = urllib.request.Request(
+                    f"{api.url}/api/v1/Pod/default/web/log",
+                    method=method, data=b"{}",
+                )
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=5)
+                assert ei.value.code == 405
+            assert store.get("Pod", "default/web") is not None
+        finally:
+            ks.shutdown()
+            api.shutdown()
+
+    def test_pod_literally_named_log_is_reachable(self):
+        from kubernetes_tpu.client.rest import RESTStore
+
+        store, api, ks = self._cluster()
+        try:
+            store.create(make_pod("log"))
+            client = RESTStore(api.url)
+            assert client.get("Pod", "default/log").meta.name == "log"
+            client.delete("Pod", "default/log")
+            assert store.try_get("Pod", "default/log") is None
+        finally:
+            ks.shutdown()
+            api.shutdown()
+
+    def test_tail_zero_prints_nothing(self):
+        from kubernetes_tpu.client.rest import RESTStore
+
+        store, api, ks = self._cluster()
+        try:
+            client = RESTStore(api.url)
+            assert client.pod_logs("default/web", tail_lines=0) == ""
+        finally:
+            ks.shutdown()
+            api.shutdown()
+
+    def test_malformed_taillines_is_a_400_not_a_crash(self):
+        import urllib.error
+        import urllib.request
+
+        store, api, ks = self._cluster()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{api.url}/api/v1/Pod/default/web/log?tailLines=abc",
+                    timeout=5,
+                )
+            assert ei.value.code == 400
+            # kubelet handler survived: a good request still works
+            from kubernetes_tpu.client.rest import RESTStore
+
+            assert "started container" in RESTStore(api.url).pod_logs(
+                "default/web")
+        finally:
+            ks.shutdown()
+            api.shutdown()
