@@ -573,6 +573,64 @@ class APIServer:
                 except (KeyError, TypeError, ValueError) as e:
                     self._error(400, "BadRequest", f"undecodable body: {e}")
 
+            def do_PATCH(self):
+                """RFC 7386 JSON merge patch against the stored object
+                (the reference's application/merge-patch+json strategy:
+                objects merge recursively, null deletes a key, anything
+                else replaces)."""
+                route = self._route()
+                if route is None:
+                    self._error(404, "NotFound", "unknown path")
+                    return
+                kind, key, sub, _ = route
+                patch = self._read_body()
+                if sub:
+                    self._error(405, "MethodNotAllowed",
+                                "subresources are not patchable")
+                    return
+                if not isinstance(patch, dict):
+                    # a non-object root would REPLACE the whole object per
+                    # RFC 7386 — never a valid API object
+                    self._error(400, "BadRequest", "patch must be an object")
+                    return
+                if not self._authorized("patch", kind, key):
+                    return
+
+                def merge(base, delta):
+                    if not isinstance(delta, dict) or not isinstance(base, dict):
+                        return delta
+                    out = dict(base)
+                    for k, v in delta.items():
+                        if v is None:
+                            out.pop(k, None)
+                        else:
+                            out[k] = merge(out.get(k), v)
+                    return out
+
+                try:
+                    cur = server.store.get(kind, key)
+                    merged = merge(encode(cur), patch)
+                    obj = decode(merged, kind_class(kind))
+                    if obj.meta.key != key:
+                        self._error(400, "BadRequest",
+                                    "patch may not move the object")
+                        return
+                    # merge was computed against the live object: write it
+                    # back at that revision (a racing writer wins the CAS
+                    # and the client retries, apiserver patch semantics)
+                    obj.meta.resource_version = cur.meta.resource_version
+                    server._admit("UPDATE", obj)
+                    updated = server.store.update(obj)
+                    self._send_json(200, encode(updated))
+                except AdmissionError as e:
+                    self._error(e.code, "Invalid", str(e))
+                except NotFoundError as e:
+                    self._error(404, "NotFound", str(e))
+                except ConflictError as e:
+                    self._error(409, "Conflict", str(e))
+                except (KeyError, TypeError, ValueError, AttributeError) as e:
+                    self._error(400, "BadRequest", f"unmergeable patch: {e}")
+
             def do_PUT(self):
                 route = self._route()
                 if route is None:
@@ -621,6 +679,9 @@ class APIServer:
                     self._error(400, "BadRequest", f"undecodable body: {e}")
 
             def do_DELETE(self):
+                # drain the body first: DELETE rarely carries one, but
+                # unconsumed bytes desync the next keep-alive request
+                self._read_body()
                 route = self._route()
                 if route is None:
                     self._error(404, "NotFound", "unknown path")
@@ -641,7 +702,7 @@ class APIServer:
                 pass
 
         _VERB_BY_METHOD = {"POST": "create", "PUT": "update",
-                           "DELETE": "delete"}
+                           "PATCH": "patch", "DELETE": "delete"}
 
         def instrumented(method_fn):
             # request-filter wrapper: one root span per request
@@ -694,7 +755,8 @@ class APIServer:
             return _orig_send_response(handler_self, code, message)
 
         Handler.send_response = send_response
-        for verb in ("do_GET", "do_POST", "do_PUT", "do_DELETE"):
+        for verb in ("do_GET", "do_POST", "do_PUT", "do_PATCH",
+                     "do_DELETE"):
             setattr(Handler, verb, instrumented(getattr(Handler, verb)))
         return Handler
 

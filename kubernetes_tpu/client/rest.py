@@ -223,6 +223,11 @@ class RESTStore:
     def delete(self, kind: str, key: str):
         return decode(self._request("DELETE", f"/api/v1/{kind}/{key}"))
 
+    def patch(self, kind: str, key: str, patch: dict):
+        """RFC 7386 JSON merge patch; returns the updated object."""
+        out = self._request("PATCH", f"/api/v1/{kind}/{key}", patch)
+        return decode(out)
+
     def pod_logs(self, key: str, container: str = "",
                  tail_lines: int | None = None) -> str:
         """GET pods/log subresource (apiserver proxies to the kubelet)."""

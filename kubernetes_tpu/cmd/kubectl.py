@@ -172,6 +172,22 @@ def cmd_cordon(client: RESTStore, args, unschedulable: bool = True) -> int:
     return 0
 
 
+def cmd_patch(client: RESTStore, args) -> int:
+    kind = _kind(args.resource)
+    try:
+        patch = json.loads(args.patch)
+    except json.JSONDecodeError as e:
+        print(f"Error: invalid patch JSON: {e}", file=sys.stderr)
+        return 1
+    try:
+        client.patch(kind, _key(kind, args.name, args.namespace), patch)
+    except Exception as e:  # noqa: BLE001 - CLI boundary
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"{kind.lower()}/{args.name} patched")
+    return 0
+
+
 def cmd_logs(client: RESTStore, args) -> int:
     """kubectl logs: the pods/log subresource (apiserver proxies to the
     pod's kubelet /containerLogs endpoint)."""
@@ -434,6 +450,12 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("resource")
     tp.add_argument("-A", "--all-namespaces", action="store_true")
 
+    pt = sub.add_parser("patch")
+    pt.add_argument("resource")
+    pt.add_argument("name")
+    pt.add_argument("-p", "--patch", required=True,
+                    help="JSON merge patch (RFC 7386)")
+
     lg = sub.add_parser("logs")
     lg.add_argument("name")
     lg.add_argument("-c", "--container", default="")
@@ -467,6 +489,7 @@ def main(argv: list[str] | None = None) -> int:
         "top": cmd_top,
         "rollout": cmd_rollout,
         "logs": cmd_logs,
+        "patch": cmd_patch,
     }
     return verbs[args.verb](client, args)
 

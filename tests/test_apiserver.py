@@ -419,3 +419,115 @@ class TestKubectlTop:
             assert "n1\t250m\t64Mi" in out
         finally:
             server.shutdown()
+
+
+class TestMergePatch:
+    """PATCH = RFC 7386 JSON merge patch (application/merge-patch+json)."""
+
+    def _serve(self):
+        store = Store()
+        server = APIServer(store)
+        server.serve(0)
+        return store, server
+
+    def test_patch_merges_recursively(self):
+        from kubernetes_tpu.client.rest import RESTStore
+        from tests.wrappers import make_pod
+
+        store, server = self._serve()
+        try:
+            pod = make_pod("web", labels={"app": "web", "tier": "fe"})
+            store.create(pod)
+            client = RESTStore(server.url)
+            got = client.patch("Pod", "default/web", {
+                "meta": {"labels": {"tier": None, "track": "canary"}},
+            })
+            assert got.meta.labels == {"app": "web", "track": "canary"}
+            # persisted, and other fields untouched
+            cur = store.get("Pod", "default/web")
+            assert cur.meta.labels == {"app": "web", "track": "canary"}
+            assert cur.spec.containers
+        finally:
+            server.shutdown()
+
+    def test_patch_scales_a_deployment(self, capsys):
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.workloads import Deployment, DeploymentSpec
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+
+        store, server = self._serve()
+        try:
+            store.create(Deployment(meta=ObjectMeta(name="web"),
+                                    spec=DeploymentSpec(replicas=2)))
+            rc = kubectl(["-s", server.url, "patch", "deploy", "web",
+                          "-p", '{"spec": {"replicas": 5}}'])
+            assert rc == 0
+            assert store.get("Deployment", "default/web").spec.replicas == 5
+        finally:
+            server.shutdown()
+
+    def test_patch_cannot_move_or_invent_objects(self):
+        import urllib.error
+
+        from kubernetes_tpu.client.rest import RESTStore
+        from kubernetes_tpu.store.store import NotFoundError as NF
+        from tests.wrappers import make_pod
+
+        store, server = self._serve()
+        try:
+            client = RESTStore(server.url)
+            with pytest.raises((NF, urllib.error.HTTPError)):
+                client.patch("Pod", "default/ghost", {"spec": {}})
+            store.create(make_pod("web"))
+            with pytest.raises(Exception, match="may not move"):
+                client.patch("Pod", "default/web",
+                             {"meta": {"name": "other"}})
+        finally:
+            server.shutdown()
+
+    def test_non_object_patch_body_is_a_400(self):
+        import urllib.error
+        import urllib.request
+
+        from tests.wrappers import make_pod
+
+        store, server = self._serve()
+        try:
+            store.create(make_pod("web"))
+            req = urllib.request.Request(
+                f"{server.url}/api/v1/Pod/default/web", data=b"[1,2]",
+                method="PATCH",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 400
+        finally:
+            server.shutdown()
+
+    def test_viewer_may_not_patch(self):
+        from kubernetes_tpu.apiserver.auth import (
+            RBACAuthorizer,
+            TokenAuthenticator,
+            User,
+            bootstrap_policy,
+        )
+        from kubernetes_tpu.client.rest import RESTStore
+        from tests.wrappers import make_pod
+
+        store = Store()
+        for obj in bootstrap_policy():
+            store.create(obj)
+        server = APIServer(
+            store,
+            authenticator=TokenAuthenticator({"vt": User("alice", ())}),
+            authorizer=RBACAuthorizer(store),
+        )
+        server.serve(0)
+        try:
+            store.create(make_pod("locked"))
+            viewer = RESTStore(server.url, token="vt")
+            with pytest.raises(Exception, match="Forbidden|403"):
+                viewer.patch("Pod", "default/locked",
+                             {"meta": {"labels": {"x": "y"}}})
+        finally:
+            server.shutdown()
