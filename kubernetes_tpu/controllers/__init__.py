@@ -8,6 +8,7 @@ from .quota import QuotaController, quota_admission
 from .lifecycle import (
     EndpointSliceController,
     GarbageCollector,
+    PodGCController,
     NamespaceController,
     NodeLifecycleController,
     ResourceClaimController,
@@ -45,6 +46,7 @@ def default_controllers(store, clock=None) -> list[Controller]:
         CronJobController(store, informers, clock=clock),
         HPAController(store, informers, clock=clock),
         QuotaController(store, informers),
+        PodGCController(store, informers),
     ]
 
 
@@ -52,7 +54,7 @@ __all__ = [
     "Controller", "ControllerManager", "CronJobController",
     "DaemonSetController",
     "DeploymentController", "DisruptionController",
-    "EndpointSliceController", "GarbageCollector", "HPAController",
+    "EndpointSliceController", "GarbageCollector", "PodGCController", "HPAController",
     "JobController",
     "NamespaceController", "NodeLifecycleController",
     "QuotaController", "ReplicaSetController", "ResourceClaimController",

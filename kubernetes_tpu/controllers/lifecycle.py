@@ -63,6 +63,54 @@ class GarbageCollector(Controller):
         return n
 
 
+class PodGCController(Controller):
+    """podgc (pkg/controller/podgc/gc_controller.go) — three sweeps:
+
+    - ORPHANED pods: bound to a node that no longer exists → delete (the
+      kubelet that would run them is gone, nothing else will clean up);
+    - TERMINATED pods beyond `terminated_threshold`: oldest finished pods
+      deleted first, keeping the newest threshold-many (the reference's
+      --terminated-pod-gc-threshold, default 12500);
+    - UNSCHEDULED terminating pods: deleted immediately (no kubelet will
+      ever finalize them).
+    """
+
+    name = "pod-gc"
+    watches = ("Pod", "Node")
+    TERMINATED_THRESHOLD = 12500
+
+    def __init__(self, store, informers=None, clock=None,
+                 terminated_threshold: int | None = None):
+        super().__init__(store, informers, clock=clock)
+        self.terminated_threshold = (
+            self.TERMINATED_THRESHOLD if terminated_threshold is None
+            else terminated_threshold)
+
+    def key_of(self, kind: str, obj) -> str | None:
+        # any pod/node event triggers one global sweep (the reference runs
+        # gc() on a 20s period; event-driven is strictly fresher)
+        return "sweep"
+
+    def reconcile(self, key: str) -> None:
+        from ..api.types import FAILED, SUCCEEDED
+
+        nodes = {n.meta.name for n in self.store.nodes()}
+        terminated = []
+        for p in list(self.store.pods()):
+            phase = p.status.phase
+            if p.spec.node_name and p.spec.node_name not in nodes:
+                self.store.try_delete("Pod", p.meta.key)  # orphaned
+            elif p.is_terminating and not p.spec.node_name:
+                self.store.try_delete("Pod", p.meta.key)  # never ran
+            elif phase in (SUCCEEDED, FAILED):
+                terminated.append(p)
+        excess = len(terminated) - self.terminated_threshold
+        if excess > 0:
+            terminated.sort(key=lambda p: p.meta.creation_timestamp)
+            for p in terminated[:excess]:
+                self.store.try_delete("Pod", p.meta.key)
+
+
 class NodeLifecycleController(Controller):
     """node_lifecycle_controller.go — Lease-staleness drives Ready condition
     and the unreachable NoExecute taint; pods on unreachable nodes are

@@ -784,3 +784,53 @@ class TestDeploymentPause:
             str(p.spec.containers[0].requests["cpu"]) == "2"
             for p in store.pods()
         )
+
+
+class TestPodGC:
+    """podgc: orphaned pods, terminated-pod threshold, unscheduled
+    terminating pods."""
+
+    def test_orphaned_pods_are_deleted_when_node_goes_away(self):
+        from kubernetes_tpu.controllers import PodGCController
+
+        store = Store()
+        store.create(make_node("n1"))
+        p = make_pod("runner")
+        p.spec.node_name = "n1"
+        store.create(p)
+        gc = PodGCController(store)
+        gc.sync_once()
+        assert store.try_get("Pod", "default/runner") is not None
+        store.delete("Node", "n1")
+        gc.sync_once()
+        assert store.try_get("Pod", "default/runner") is None
+
+    def test_terminated_pods_trimmed_oldest_first(self):
+        from kubernetes_tpu.controllers import PodGCController
+
+        clock = FakeClock()
+        store = Store(clock=clock.now)
+        store.create(make_node("n1"))
+        for i in range(6):
+            p = make_pod(f"done-{i}")
+            p.spec.node_name = "n1"
+            p.status.phase = SUCCEEDED
+            store.create(p)
+            clock.step(1)
+        gc = PodGCController(store, terminated_threshold=4)
+        gc.sync_once()
+        left = sorted(p.meta.name for p in store.pods())
+        assert left == ["done-2", "done-3", "done-4", "done-5"]
+
+    def test_unscheduled_terminating_pod_is_collected(self):
+        from kubernetes_tpu.controllers import PodGCController
+
+        store = Store()
+        p = make_pod("stuck")
+        store.create(p)
+        p = store.get("Pod", "default/stuck")
+        p.meta.deletion_timestamp = 1.0
+        store.update(p, check_version=False)
+        gc = PodGCController(store)
+        gc.sync_once()
+        assert store.try_get("Pod", "default/stuck") is None
