@@ -13,58 +13,88 @@ Baseline: the reference's CI threshold for this workload, 270 pods/s on the
 Average from 1-second bind windows (util.go:459-603 semantics); p50/p99 of
 the pod-scheduling SLI latency ride along.
 
+Wedge-proofing: the accelerator is probed in a SUBPROCESS with a timeout,
+so a hung device tunnel (which wedges jax backend init forever, inside a
+lock no later call can bypass) can never hang or zero this bench. On probe
+failure the bench falls back to CPU — the JSON line then carries
+`device: "cpu"` and `fallback_reason`, and exits 0 with a real number.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The full BASELINE-table suite lives in bench_suite.py (one line per row).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 
 BASELINE_PODS_PER_S = 270.0
 WAVE_SIZE = 512
 
+_PROBE_SRC = (
+    "import jax; ds = jax.devices(); print('PLATFORM=' + ds[0].platform)"
+)
+
+
+def probe_device(timeout_s: float) -> tuple[str | None, str | None]:
+    """(platform, error): probe accelerator init in a killable subprocess.
+
+    Bare `jax.devices()` in-process hangs forever when the device tunnel is
+    wedged (round-3 failure mode) — and even a watchdog thread can't recover
+    because the wedged init holds jax's backend lock. A subprocess is the
+    only probe the parent can always walk away from.
+    """
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "accelerator unreachable (device init timed out)"
+    except Exception as e:  # noqa: BLE001 - reported, not swallowed
+        return None, f"device probe failed: {type(e).__name__}: {e}"
+    for line in out.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1], None
+    tail = (out.stderr or out.stdout).strip()[-300:]
+    return None, f"device probe rc={out.returncode}: {tail}"
+
+
+def force_cpu() -> None:
+    """Point jax at CPU before (and after) import — the _ensure_devices recipe."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 def main() -> None:
     base = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, base)
+
+    timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "300"))
+    platform, probe_err = probe_device(timeout_s)
+    fallback_reason = None
+    if platform is None:
+        fallback_reason = probe_err
+        force_cpu()
+        platform = "cpu"
+    elif platform != "tpu":
+        # e.g. the tunnel resolved to CPU already; make it explicit, and say
+        # so — a mis-provisioned accelerator must not look like an
+        # intentional CPU run
+        fallback_reason = f"probe resolved platform {platform!r}, not tpu"
+        force_cpu()
+        platform = "cpu"
+
     # persistent XLA compilation cache: the big wave programs compile once
     # per machine; repeat runs measure steady-state scheduling, not compiles
     # (env vars don't engage the cache on this JAX build — see jaxcache.py)
     from kubernetes_tpu.utils.jaxcache import enable_persistent_cache
 
     enable_persistent_cache()  # defaults near the repo; env knob still wins
-
-    # device watchdog: a wedged accelerator tunnel hangs jax backend init
-    # forever — surface an error line instead of a silent hang
-    import threading
-
-    probe_done = threading.Event()
-    probe_err: list[str] = []
-
-    def probe():
-        try:
-            import jax
-
-            jax.devices()
-        except Exception as e:  # noqa: BLE001 - reported, not swallowed
-            probe_err.append(f"{type(e).__name__}: {e}")
-        finally:
-            probe_done.set()
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    timed_out = not probe_done.wait(timeout=float(os.environ.get(
-        "BENCH_DEVICE_TIMEOUT_S", "300")))
-    if timed_out or probe_err:
-        print(json.dumps({
-            "metric": "full_pipeline_scheduling_throughput_5k_nodes",
-            "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
-            "error": ("accelerator unreachable (device init timed out)"
-                      if timed_out else probe_err[0]),
-        }))
-        sys.exit(1)
 
     from kubernetes_tpu.perf.harness import WorkloadExecutor, load_config
 
@@ -91,15 +121,17 @@ def main() -> None:
             "value": 0.0,
             "unit": "pods/s",
             "vs_baseline": 0.0,
+            "device": platform,
             "error": f"only {result.scheduled}/{expected} pods scheduled",
         }))
         sys.exit(1)
     prof = executor.scheduler.loop.phase_profile
-    print(json.dumps({
+    line = {
         "metric": "full_pipeline_scheduling_throughput_5k_nodes",
         "value": round(pods_per_s, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_s / BASELINE_PODS_PER_S, 2),
+        "device": platform,
         "scheduled": result.scheduled,
         "sli_p50_s": sli.get("Perc50"),
         "sli_p99_s": sli.get("Perc99"),
@@ -114,7 +146,10 @@ def main() -> None:
         "wave_profile_s": {
             k: round(v, 2) for k, v in algo.backend.perf.items()
         },
-    }))
+    }
+    if fallback_reason:
+        line["fallback_reason"] = fallback_reason
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -123,11 +123,20 @@ def bootstrap_policy() -> list:
     from ..api.meta import ObjectMeta
     from ..api.rbac import ClusterRoleBinding, PolicyRule, RoleRef, Subject
 
+    from ..apiserver.discovery import all_kinds
+
+    # the reference's "view" aggregate explicitly EXCLUDES secrets
+    # (bootstrappolicy/policy.go: view omits secrets "to avoid escalation");
+    # enumerate readable kinds from the scheme so Secret can never ride a
+    # wildcard into the any-authenticated-user grant
+    # "Pod/log" is the read subresource the server authorizes separately
+    # (upstream's view clusterrole includes pods/log explicitly)
+    viewable = tuple(k for k in all_kinds() if k != "Secret") + ("Pod/log",)
     return [
         ClusterRole(meta=ObjectMeta(name="cluster-admin", namespace=""),
                     rules=(PolicyRule(("*",), ("*",)),)),
         ClusterRole(meta=ObjectMeta(name="view", namespace=""),
-                    rules=(PolicyRule(("get", "list", "watch"), ("*",)),)),
+                    rules=(PolicyRule(("get", "list", "watch"), viewable),)),
         ClusterRoleBinding(
             meta=ObjectMeta(name="system:authenticated-view", namespace=""),
             subjects=(Subject("Group", AUTHENTICATED),),

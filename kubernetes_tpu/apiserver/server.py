@@ -198,6 +198,12 @@ class APIServer:
         from ..api.versioning import default_scheme
 
         self.scheme = default_scheme()
+        # serializes admission+create per namespace: quota admission checks
+        # live usage, and with ThreadingHTTPServer two concurrent creates in
+        # one namespace could otherwise both pass the check and both commit
+        # (the reference serializes via CAS on ResourceQuota status)
+        self._create_locks: dict[str, threading.Lock] = {}
+        self._create_locks_mu = threading.Lock()
         self._http: ThreadingHTTPServer | None = None
         self.port = 0
 
@@ -526,7 +532,16 @@ class APIServer:
                     # decode applies the namespace default, the raw body may
                     # omit it
                     resource = kind
-                if key and "/" in key:
+                from .discovery import CLUSTER_SCOPED
+
+                if kind in CLUSTER_SCOPED:
+                    # cluster-scoped creates authorize against namespace ""
+                    # so only ClusterRoleBindings can grant them — a
+                    # namespaced Role/RoleBinding must never be able to mint
+                    # e.g. a ClusterRoleBinding (rbac.go: RoleBindings grant
+                    # within their namespace only)
+                    ns = ""
+                elif key and "/" in key:
                     ns = key.split("/", 1)[0]
                 else:
                     # mirror decode's ObjectMeta default ("default") so an
@@ -561,8 +576,10 @@ class APIServer:
                             f"body key {obj.meta.key!r} != URL key {key!r}",
                         )
                         return
-                    server._admit("CREATE", obj)
-                    created = server.store.create(obj)
+                    with server._create_lock(getattr(obj.meta, "namespace",
+                                                     "")):
+                        server._admit("CREATE", obj)
+                        created = server.store.create(obj)
                     self._send_json(201, encode(created))
                 except AdmissionError as e:
                     self._error(e.code, "Invalid", str(e))
@@ -763,6 +780,10 @@ class APIServer:
     def _admit(self, operation: str, obj) -> None:
         for fn in self.admission:
             fn(operation, obj)
+
+    def _create_lock(self, namespace: str) -> threading.Lock:
+        with self._create_locks_mu:
+            return self._create_locks.setdefault(namespace, threading.Lock())
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -71,8 +71,12 @@ class WorkQueue:
         now = self._clock()
         while self._delayed and self._delayed[0][0] <= now:
             t, _, item = heapq.heappop(self._delayed)
-            if self._delayed_pending.get(item) == t:
-                del self._delayed_pending[item]
+            if self._delayed_pending.get(item) != t:
+                # superseded heap entry: an earlier wake already delivered
+                # (or retimed) this item — a stale timer must not deliver
+                # a second, spurious copy
+                continue
+            del self._delayed_pending[item]
             if item not in self._dirty:
                 self._dirty.add(item)
                 if item not in self._processing:

@@ -379,6 +379,84 @@ class TestDiscoveryAuth:
             server.shutdown()
 
 
+class TestClusterScopedCreateAuthz:
+    """rbac.go: RoleBindings grant within their namespace only — they can
+    never authorize cluster-scoped writes (those carry namespace "")."""
+
+    def test_namespaced_wildcard_role_cannot_mint_clusterrolebinding(self):
+        store, server = secure_server()
+        try:
+            store.create(Role(
+                meta=ObjectMeta(name="ns-admin", namespace="default"),
+                rules=(PolicyRule(("*",), ("*",)),),
+            ))
+            store.create(RoleBinding(
+                meta=ObjectMeta(name="devs", namespace="default"),
+                subjects=(Subject("User", "dev"),),
+                role_ref=RoleRef("Role", "ns-admin"),
+            ))
+            client = RESTStore(server.url, token="dev-token")
+            with pytest.raises(RESTError) as exc:
+                client.create(ClusterRoleBinding(
+                    meta=ObjectMeta(name="evil", namespace=""),
+                    subjects=(Subject("User", "dev"),),
+                    role_ref=RoleRef("ClusterRole", "cluster-admin"),
+                ))
+            assert exc.value.code == 403
+            assert store.try_get("ClusterRoleBinding", "evil") is None
+        finally:
+            server.shutdown()
+
+    def test_clusterrolebinding_grant_allows_cluster_scoped_create(self):
+        store, server = secure_server()
+        try:
+            from kubernetes_tpu.api.rbac import ClusterRole
+
+            store.create(ClusterRole(
+                meta=ObjectMeta(name="crb-creator", namespace=""),
+                rules=(PolicyRule(("create",), ("ClusterRoleBinding",)),),
+            ))
+            store.create(ClusterRoleBinding(
+                meta=ObjectMeta(name="dev-crb-creator", namespace=""),
+                subjects=(Subject("User", "dev"),),
+                role_ref=RoleRef("ClusterRole", "crb-creator"),
+            ))
+            client = RESTStore(server.url, token="dev-token")
+            client.create(ClusterRoleBinding(
+                meta=ObjectMeta(name="granted", namespace=""),
+                subjects=(Subject("User", "dev"),),
+                role_ref=RoleRef("ClusterRole", "view"),
+            ))
+            assert store.try_get("ClusterRoleBinding", "granted") is not None
+        finally:
+            server.shutdown()
+
+
+class TestViewExcludesSecrets:
+    def test_authenticated_viewer_cannot_read_secrets(self):
+        """The reference's view aggregate explicitly excludes secrets; the
+        any-authenticated bootstrap grant must not leak them."""
+        store, server = secure_server()
+        try:
+            from kubernetes_tpu.api.workloads import Secret
+
+            store.create(Secret(
+                meta=ObjectMeta(name="s1", namespace="default"),
+                data={"password": "hunter2"},
+            ))
+            client = RESTStore(server.url, token="viewer-token")
+            with pytest.raises(RESTError) as exc:
+                client.get("Secret", "default/s1")
+            assert exc.value.code == 403
+            with pytest.raises(RESTError) as exc:
+                client.list("Secret")
+            assert exc.value.code == 403
+            # non-secret reads still flow through the view grant
+            assert client.pods() == []
+        finally:
+            server.shutdown()
+
+
 class TestAuditLog:
     def test_requests_audited_with_user_and_outcome(self):
         store, server = secure_server()

@@ -117,6 +117,52 @@ class TestQuotaEndToEnd:
             boot.shutdown()
 
 
+class TestQuotaConcurrentCreates:
+    def test_parallel_creates_cannot_exceed_quota(self):
+        """Regression: admission recomputes live usage outside any lock;
+        with ThreadingHTTPServer two in-flight creates in one namespace
+        could both pass the check and both commit. The server now
+        serializes admission+create per namespace."""
+        import threading
+
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.client.rest import RESTError, RESTStore
+
+        cap = 3
+        store = Store()
+        store.create(mk_quota({"pods": cap}))
+        server = APIServer(store, admission=[quota_admission(store)])
+        server.serve(0)
+        try:
+            n_threads = 12
+            start = threading.Barrier(n_threads)
+            outcomes: list[bool] = []
+            mu = threading.Lock()
+
+            def worker(i: int) -> None:
+                client = RESTStore(server.url)
+                start.wait()
+                try:
+                    client.create(make_pod(f"p{i}", cpu="10m"))
+                    ok = True
+                except RESTError:
+                    ok = False
+                with mu:
+                    outcomes.append(ok)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            committed = sum(1 for o in outcomes if o)
+            assert committed == cap
+            assert len(list(store.iter_kind("Pod"))) == cap
+        finally:
+            server.shutdown()
+
+
 class TestQuotaControllerNonPodKinds:
     def test_service_count_stays_fresh(self):
         from kubernetes_tpu.api.workloads import Service, ServiceSpec

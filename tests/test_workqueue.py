@@ -1,0 +1,88 @@
+"""WorkQueue semantics tests.
+
+Modeled on client-go util/workqueue tests (queue_test.go,
+delaying_queue_test.go, rate_limiting_queue_test.go): dedup while queued,
+re-add during processing redelivers once, delayed dedup keeps the earliest
+wake, and a superseded timer never delivers a spurious second copy.
+"""
+
+from kubernetes_tpu.client.workqueue import WorkQueue
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestWorkQueue:
+    def test_dedup_while_queued(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("a")
+        assert len(q) == 1
+        assert q.get(timeout=0.1) == "a"
+        q.done("a")
+        assert q.get(timeout=0.05) is None
+
+    def test_readd_during_processing_redelivers_once(self):
+        q = WorkQueue()
+        q.add("a")
+        assert q.get(timeout=0.1) == "a"
+        q.add("a")  # while processing: goes dirty, not queued
+        q.add("a")
+        assert len(q) == 0
+        q.done("a")
+        assert q.get(timeout=0.1) == "a"
+        q.done("a")
+        assert q.get(timeout=0.05) is None
+
+    def test_add_after_fires_at_deadline(self):
+        clock = FakeClock()
+        q = WorkQueue(clock=clock)
+        q.add_after("a", 5.0)
+        assert q.get(timeout=0.05) is None
+        clock.t = 5.0
+        assert q.get(timeout=0.5) == "a"
+
+    def test_superseded_delayed_entry_does_not_redeliver(self):
+        """Regression: add_after dedups to the earliest wake, but the
+        superseded (later) heap entry must ALSO be suppressed when it pops —
+        not just its bookkeeping — or the item fires twice."""
+        clock = FakeClock()
+        q = WorkQueue(clock=clock)
+        q.add_after("a", 10.0)
+        q.add_after("a", 5.0)  # earlier wake supersedes the 10s timer
+        clock.t = 5.0
+        assert q.get(timeout=0.5) == "a"
+        q.done("a")
+        clock.t = 11.0  # the stale 10s heap entry pops now
+        assert q.get(timeout=0.2) is None
+
+    def test_later_add_after_does_not_delay_earlier(self):
+        clock = FakeClock()
+        q = WorkQueue(clock=clock)
+        q.add_after("a", 5.0)
+        q.add_after("a", 10.0)  # later: ignored, earliest wins
+        clock.t = 5.0
+        assert q.get(timeout=0.5) == "a"
+
+    def test_rate_limited_backoff_grows_and_forget_resets(self):
+        clock = FakeClock()
+        q = WorkQueue(base_delay=1.0, max_delay=8.0, clock=clock)
+        q.add_rate_limited("a")  # 1s
+        clock.t = 1.0
+        assert q.get(timeout=0.5) == "a"
+        q.done("a")
+        q.add_rate_limited("a")  # 2s
+        clock.t = 2.9
+        assert q.get(timeout=0.05) is None
+        clock.t = 3.0
+        assert q.get(timeout=0.5) == "a"
+        q.done("a")
+        q.forget("a")
+        q.add_rate_limited("a")  # back to 1s
+        clock.t = 4.0
+        assert q.get(timeout=0.5) == "a"
