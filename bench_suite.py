@@ -1,0 +1,120 @@
+"""Full BASELINE-table bench suite: one JSON line per reference perf row.
+
+The headline bench (bench.py) runs the single SchedulingBasic row; the
+reference's CI enforces floors across its whole scheduler_perf table
+(BASELINE.md). This suite runs every floored row through the same real
+pipeline (store → informers → queue → TPU wave kernel → bind writeback)
+and prints one JSON line per row:
+
+  {"metric", "value", "unit": "pods/s", "floor", "vs_floor", "pass",
+   "device", "scheduled", "sli_p99_s"}
+
+plus a final summary line. Exit 0 iff every row meets its floor.
+
+Reference rows (test/integration/scheduler_perf/*/performance-config.yaml):
+  SchedulingBasic 5000Nodes_10000Pods         >= 270   misc:71-80
+  SchedulingDaemonset 5000Nodes               >= 390   misc:146-160
+  PreemptionAsync 500Nodes                    >= 160   misc:292-325
+  TopologySpreading 5000Nodes_5000Pods        >= 85    topology_spreading:67-76
+  SchedulingWFFCVolumes 5000Nodes_2000Pods    >= 90    volumes:121-130
+  SchedulingWithResourceClaims 500Nodes       >= 40    dra:133-136
+  GangScheduling 500Nodes                     >= 100   (fork feature; floor
+                                                        from our own r04 run)
+
+Wedge-proofing is shared with bench.py: subprocess device probe + labeled
+CPU fallback, so a dead accelerator tunnel degrades to a valid CPU number.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from bench import force_cpu, probe_device
+
+WAVE_SIZE = 512
+
+# (config, case, workload, short label) — the workload's `threshold` in the
+# YAML is the floor; keep the table here limited to naming
+ROWS = [
+    ("misc.yaml", "SchedulingBasic", "5000Nodes_10000Pods", "basic_5000"),
+    ("misc.yaml", "SchedulingDaemonset", "5000Nodes", "daemonset_5000"),
+    ("misc.yaml", "PreemptionAsync", "500Nodes", "preemption_async_500"),
+    ("topology_spreading.yaml", "TopologySpreading", "5000Nodes_5000Pods",
+     "topology_spreading_5000"),
+    ("volumes.yaml", "SchedulingWFFCVolumes", "5000Nodes_2000Pods",
+     "wffc_volumes_5000"),
+    ("dra.yaml", "SchedulingWithResourceClaims", "500Nodes", "dra_500"),
+    ("gang.yaml", "GangScheduling", "500Nodes", "gang_500"),
+]
+
+
+def main() -> None:
+    base = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, base)
+
+    timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "300"))
+    platform, probe_err = probe_device(timeout_s)
+    fallback_reason = None
+    if platform != "tpu":
+        fallback_reason = probe_err or (
+            f"probe resolved platform {platform!r}, not tpu")
+        force_cpu()
+        platform = "cpu"
+
+    from kubernetes_tpu.utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    from kubernetes_tpu.perf.harness import WorkloadExecutor, load_config
+
+    cfg_dir = os.path.join(base, "kubernetes_tpu/perf/configs")
+    all_pass = True
+    summary: dict[str, float] = {}
+    only = os.environ.get("BENCH_SUITE_ONLY", "")
+    for cfg_name, case_name, wl_name, label in ROWS:
+        if only and only not in label:
+            continue
+        cases = load_config(os.path.join(cfg_dir, cfg_name))
+        case = next(c for c in cases if c["name"] == case_name)
+        workload = next(w for w in case["workloads"] if w["name"] == wl_name)
+        floor = workload.get("threshold")
+        executor = WorkloadExecutor(case, workload, backend="tpu",
+                                    wave_size=WAVE_SIZE)
+        result = executor.run()
+        sli = {}
+        for item in result.data_items:
+            if item.unit == "seconds":
+                sli = item.data
+        value = round(result.throughput, 1)
+        ok = floor is None or value >= floor
+        all_pass = all_pass and ok
+        summary[label] = value
+        line = {
+            "metric": f"scheduling_throughput_{label}",
+            "value": value,
+            "unit": "pods/s",
+            "floor": floor,
+            "vs_floor": round(value / floor, 2) if floor else None,
+            "pass": ok,
+            "device": platform,
+            "scheduled": result.scheduled,
+            "sli_p99_s": sli.get("Perc99"),
+        }
+        if fallback_reason:
+            line["fallback_reason"] = fallback_reason
+        print(json.dumps(line), flush=True)
+    print(json.dumps({
+        "metric": "bench_suite_summary",
+        "value": float(sum(summary.values())),
+        "unit": "pods/s (sum over rows)",
+        "rows": summary,
+        "all_pass": all_pass,
+        "device": platform,
+    }), flush=True)
+    sys.exit(0 if all_pass else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,8 +12,6 @@ bart0sh/kubernetes @ ~v1.36-dev), re-designed TPU-first:
 - ``ops``       dense pods x nodes feasibility/score kernels (JAX/Pallas) — the
                 TPU-native replacement for framework/parallelize goroutine fan-out
 - ``parallel``  device mesh + shard_map collectives (nodes axis over ICI)
-- ``models``    the TPU scheduling backend: tensorized snapshots + batched
-                multi-pod assignment ("the flagship model")
 - ``utils``     metrics, clock, logging, feature gates
 """
 

@@ -1,0 +1,244 @@
+"""CustomResourceDefinitions: user-defined kinds served like built-ins.
+
+Reference: staging/src/k8s.io/apiextensions-apiserver — the third server in
+the reference's delegation chain (cmd/kube-apiserver/app/server.go:176).
+There, creating a CustomResourceDefinition object dynamically installs REST
+storage for the named kind; instances are unstructured objects validated
+against a structural OpenAPI v3 schema, and flow through storage, watch,
+informers and kubectl exactly like compiled-in kinds.
+
+Here the same effect comes from the runtime registry (`runtime.Scheme`
+analogue, api/serialization._KINDS): `register_custom_kind(crd)` mints a
+dynamic CustomObject subclass whose `kind` is the CRD's, registers it, and
+from then on decode/encode/store/watch/informers/kubectl all handle it with
+zero special cases. Validation (a structural-schema subset: type,
+properties, required, enum, minimum/maximum, items, pattern) runs in the
+apiserver's admission chain (apiserver/admission.py crd_admission).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from .meta import ObjectMeta
+
+
+@dataclass
+class CRDNames:
+    """spec.names subset (apiextensions/v1 CustomResourceDefinitionNames)."""
+
+    kind: str = ""
+    plural: str = ""  # defaulted to lowercase(kind) + "s"
+
+    def defaulted_plural(self) -> str:
+        return self.plural or (self.kind.lower() + "s")
+
+
+@dataclass
+class CRDSpec:
+    """apiextensions/v1 CustomResourceDefinitionSpec subset: one served
+    version, a structural schema for `spec` (+ optional top-level fields)."""
+
+    names: CRDNames = field(default_factory=CRDNames)
+    group: str = "custom.example"
+    scope: str = "Namespaced"  # "Namespaced" | "Cluster"
+    # JSON-Schema subset applied to the instance's `spec` dict
+    schema: dict = field(default_factory=dict)
+
+
+@dataclass
+class CustomResourceDefinition:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CRDSpec = field(default_factory=CRDSpec)
+    # "Established" once registered and ready to serve (the apiextensions
+    # Established condition)
+    status: dict = field(default_factory=dict)
+
+    kind = "CustomResourceDefinition"
+
+
+@dataclass
+class CustomObject:
+    """The unstructured instance type every registered CRD kind shares.
+
+    Per-CRD subclasses minted by register_custom_kind override the class
+    `kind`, so the reflective codec, the store's _kind_of, informers, and
+    kubectl treat instances exactly like compiled-in dataclasses. `spec`
+    and `status` are free-form dicts (apiextensions unstructured.Unstructured).
+    """
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+    kind = "CustomObject"
+
+
+@dataclass
+class WebhookRule:
+    """admissionregistration/v1 RuleWithOperations subset."""
+
+    operations: tuple[str, ...] = ("CREATE", "UPDATE")
+    kinds: tuple[str, ...] = ("*",)
+
+    def matches(self, operation: str, kind: str) -> bool:
+        return (("*" in self.operations or operation in self.operations)
+                and ("*" in self.kinds or kind in self.kinds))
+
+
+@dataclass
+class ValidatingWebhook:
+    """admissionregistration/v1 ValidatingWebhook subset: clientConfig.url
+    only (no CA bundle — plain HTTP to in-cluster endpoints here)."""
+
+    name: str = ""
+    url: str = ""
+    rules: tuple[WebhookRule, ...] = ()
+    failure_policy: str = "Fail"  # "Fail" | "Ignore"
+    timeout_s: float = 5.0
+
+
+@dataclass
+class ValidatingWebhookConfiguration:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: tuple[ValidatingWebhook, ...] = ()
+
+    kind = "ValidatingWebhookConfiguration"
+
+
+# -- structural-schema validation (apiextensions pkg/apiserver/validation) --
+
+_TYPE_MAP = {
+    "object": dict,
+    "array": (list, tuple),
+    "string": str,
+    "boolean": bool,
+}
+
+
+def validate_schema(value, schema: dict, path: str = "spec") -> list[str]:
+    """Validate `value` against the structural-schema subset; returns a
+    list of violation messages (empty = valid)."""
+    errs: list[str] = []
+    if not schema:
+        return errs
+    t = schema.get("type")
+    if t:
+        if t == "integer":
+            if isinstance(value, bool) or not isinstance(value, int):
+                return [f"{path}: expected integer, got {type(value).__name__}"]
+        elif t == "number":
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                return [f"{path}: expected number, got {type(value).__name__}"]
+        else:
+            want = _TYPE_MAP.get(t)
+            if want is None:
+                return [f"{path}: unknown schema type {t!r}"]
+            if not isinstance(value, want) or (
+                t != "boolean" and isinstance(value, bool)
+            ):
+                return [f"{path}: expected {t}, got {type(value).__name__}"]
+    if "enum" in schema and value not in schema["enum"]:
+        errs.append(f"{path}: {value!r} not in enum {schema['enum']}")
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        if "minimum" in schema and value < schema["minimum"]:
+            errs.append(f"{path}: {value} below minimum {schema['minimum']}")
+        if "maximum" in schema and value > schema["maximum"]:
+            errs.append(f"{path}: {value} above maximum {schema['maximum']}")
+    if isinstance(value, str) and "pattern" in schema:
+        if re.search(schema["pattern"], value) is None:
+            errs.append(f"{path}: {value!r} does not match pattern "
+                        f"{schema['pattern']!r}")
+    if isinstance(value, dict):
+        for req in schema.get("required", ()):
+            if req not in value:
+                errs.append(f"{path}.{req}: required field missing")
+        props = schema.get("properties", {})
+        for k, v in value.items():
+            if k in props:
+                errs.extend(validate_schema(v, props[k], f"{path}.{k}"))
+    if isinstance(value, (list, tuple)) and "items" in schema:
+        for i, v in enumerate(value):
+            errs.extend(validate_schema(v, schema["items"], f"{path}[{i}]"))
+    return errs
+
+
+# -- dynamic kind registry -------------------------------------------------
+
+_BUILTIN_GUARD: set[str] | None = None
+
+
+def _builtin_kinds() -> set[str]:
+    global _BUILTIN_GUARD
+    if _BUILTIN_GUARD is None:
+        from . import serialization
+
+        serialization._register_all()
+        _BUILTIN_GUARD = set(serialization._KINDS)
+    return _BUILTIN_GUARD
+
+
+def validate_custom_kind(crd: CustomResourceDefinition) -> None:
+    """Name/conflict validation WITHOUT side effects — the admission
+    chain's half. Registration itself must only happen after the CRD
+    commits to the store (a later admission denial or store conflict must
+    not leak scheme/alias/scope state)."""
+    from . import serialization
+
+    kind = crd.spec.names.kind
+    if not kind or not kind[0].isupper() or not kind.isalnum():
+        raise ValueError(f"invalid CRD kind name {kind!r}")
+    if kind in _builtin_kinds():
+        raise ValueError(f"kind {kind!r} conflicts with a built-in kind")
+    existing = serialization._KINDS.get(kind)
+    if existing is not None and not issubclass(existing, CustomObject):
+        raise ValueError(f"kind {kind!r} already registered")
+
+
+def register_custom_kind(crd: CustomResourceDefinition) -> type:
+    """Install the CRD's kind into the scheme: decode/encode, store,
+    watches, informers, kubectl aliases, and discovery all pick it up.
+    Idempotent; raises ValueError for invalid or conflicting names."""
+    from ..apiserver.discovery import CLUSTER_SCOPED
+    from ..cmd.kubectl import ALIASES
+    from . import serialization
+
+    validate_custom_kind(crd)
+    kind = crd.spec.names.kind
+    existing = serialization._KINDS.get(kind)
+    if existing is not None:
+        return existing
+    cls = type(kind, (CustomObject,), {"kind": kind})
+    serialization._KINDS[kind] = cls
+    ALIASES.setdefault(kind.lower(), kind)
+    ALIASES.setdefault(crd.spec.names.defaulted_plural().lower(), kind)
+    if crd.spec.scope == "Cluster":
+        CLUSTER_SCOPED.add(kind)
+    return cls
+
+
+def unregister_custom_kind(kind: str) -> None:
+    """Remove a dynamic kind from the scheme (CRD deletion)."""
+    from ..apiserver.discovery import CLUSTER_SCOPED
+    from ..cmd.kubectl import ALIASES
+    from . import serialization
+
+    cls = serialization._KINDS.get(kind)
+    if cls is None or not issubclass(cls, CustomObject) or cls is CustomObject:
+        return
+    del serialization._KINDS[kind]
+    CLUSTER_SCOPED.discard(kind)
+    for alias, target in list(ALIASES.items()):
+        if target == kind:
+            del ALIASES[alias]
+
+
+def registered_custom_kinds() -> list[str]:
+    from . import serialization
+
+    return sorted(
+        k for k, cls in serialization._KINDS.items()
+        if isinstance(cls, type) and issubclass(cls, CustomObject)
+        and cls is not CustomObject
+    )

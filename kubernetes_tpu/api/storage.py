@@ -37,6 +37,10 @@ BINDING_WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
 # Provisioner value meaning "static PVs only" (storage/v1 well-known)
 NO_PROVISIONER = "kubernetes.io/no-provisioner"
 
+# persistentVolumeReclaimPolicy (core/v1)
+RECLAIM_RETAIN = "Retain"
+RECLAIM_DELETE = "Delete"
+
 # Well-known zone/region labels the VolumeZone plugin matches
 # (reference: pkg/scheduler/framework/plugins/volumezone/volume_zone.go
 # topologyLabels).
@@ -81,6 +85,7 @@ class PersistentVolumeSpec:
     node_affinity: NodeSelector | None = None  # required topology
     claim_ref: str = ""  # "namespace/name" of the bound claim
     csi_driver: str = ""  # CSI driver name, "" for in-tree/local volumes
+    reclaim_policy: str = RECLAIM_RETAIN  # persistentVolumeReclaimPolicy
 
 
 @dataclass
@@ -142,6 +147,9 @@ class StorageClass:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = NO_PROVISIONER
     volume_binding_mode: str = BINDING_IMMEDIATE
+    # reclaim policy stamped onto dynamically provisioned PVs (the
+    # reference defaults provisioned volumes to Delete)
+    reclaim_policy: str = RECLAIM_DELETE
 
     kind = "StorageClass"
 

@@ -83,10 +83,120 @@ def namespace_lifecycle_admission(store):
     return admit
 
 
+def crd_admission(store):
+    """apiextensions-apiserver in admission-plugin form: a
+    CustomResourceDefinition CREATE validates + establishes the kind in the
+    scheme (Established condition); CREATE/UPDATE of any registered custom
+    kind validates the instance's spec against the CRD's structural schema
+    (apiextensions pkg/apiserver/validation)."""
+    from ..api.extensions import (
+        CustomObject,
+        validate_custom_kind,
+        validate_schema,
+    )
+
+    def admit(operation: str, obj) -> None:
+        if (operation == "CREATE"
+                and getattr(obj, "kind", "") == "CustomResourceDefinition"):
+            try:
+                validate_custom_kind(obj)
+            except ValueError as e:
+                raise AdmissionError(str(e), code=422)
+            kind = obj.spec.names.kind
+            if any(c.spec.names.kind == kind
+                   and c.meta.key != obj.meta.key
+                   for c in store.iter_kind("CustomResourceDefinition")):
+                raise AdmissionError(
+                    f"kind {kind!r} is already served by another "
+                    "CustomResourceDefinition", code=409)
+            # registration itself happens in the server AFTER the create
+            # commits — admission must be side-effect free on rejection
+            obj.status["conditions"] = [
+                {"type": "Established", "status": "True"}
+            ]
+            return
+        if operation in ("CREATE", "UPDATE") and isinstance(obj, CustomObject):
+            crd = next(
+                (c for c in store.iter_kind("CustomResourceDefinition")
+                 if c.spec.names.kind == obj.kind), None,
+            )
+            if crd is None:
+                # kind registered but its CRD is gone (deleted mid-flight)
+                raise AdmissionError(
+                    f"no established CustomResourceDefinition for kind "
+                    f"{obj.kind!r}", code=404,
+                )
+            errs = validate_schema(obj.spec, crd.spec.schema)
+            if errs:
+                raise AdmissionError(
+                    f"{obj.kind} {obj.meta.key} is invalid: "
+                    + "; ".join(errs), code=422,
+                )
+
+    return admit
+
+
+def webhook_admission(store):
+    """Out-of-process validating admission
+    (staging/src/k8s.io/apiserver/pkg/admission/plugin/webhook): each
+    matching webhook gets an AdmissionReview POST; allowed=false rejects
+    the request, call failures honor failurePolicy (Fail → reject,
+    Ignore → skip). Webhook configurations themselves are exempt so a
+    broken webhook can always be fixed (the reference's bootstrap
+    safeguard)."""
+    import json as _json
+    from urllib import request as _urlreq
+    from urllib.error import URLError
+
+    def admit(operation: str, obj) -> None:
+        kind = getattr(obj, "kind", "")
+        if kind == "ValidatingWebhookConfiguration":
+            return
+        payload = None
+        for cfg in store.iter_kind("ValidatingWebhookConfiguration"):
+            for wh in cfg.webhooks:
+                if not any(r.matches(operation, kind) for r in wh.rules):
+                    continue
+                if payload is None:
+                    from ..api.serialization import encode
+
+                    payload = _json.dumps({
+                        "apiVersion": "admission.k8s.io/v1",
+                        "kind": "AdmissionReview",
+                        "request": {"operation": operation, "kind": kind,
+                                    "object": encode(obj)},
+                    }).encode()
+                try:
+                    req = _urlreq.Request(
+                        wh.url, data=payload, method="POST",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with _urlreq.urlopen(req, timeout=wh.timeout_s) as r:
+                        resp = _json.loads(r.read())
+                except (URLError, OSError, ValueError) as e:
+                    if wh.failure_policy == "Ignore":
+                        continue
+                    raise AdmissionError(
+                        f"admission webhook {wh.name!r} call failed: {e}",
+                        code=500,
+                    )
+                result = resp.get("response", {})
+                if not result.get("allowed", False):
+                    msg = (result.get("status") or {}).get("message", "denied")
+                    raise AdmissionError(
+                        f"admission webhook {wh.name!r} denied the request: "
+                        f"{msg}", code=403,
+                    )
+
+    return admit
+
+
 def default_admission_chain(store) -> list:
     """The plugins every control plane enables (mutating before
-    validating, as the reference orders its chain)."""
+    validating, as the reference orders its chain; webhooks run last,
+    as the reference's ValidatingAdmissionWebhook does)."""
     from ..controllers.quota import quota_admission
 
     return [cluster_scope_admission(), priority_admission(store),
-            namespace_lifecycle_admission(store), quota_admission(store)]
+            namespace_lifecycle_admission(store), crd_admission(store),
+            quota_admission(store), webhook_admission(store)]

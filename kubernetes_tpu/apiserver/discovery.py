@@ -20,7 +20,9 @@ from ..api.serialization import kind_class
 # kinds that are cluster-scoped (namespace "" convention)
 CLUSTER_SCOPED = {"Node", "Namespace", "CSINode", "PodGroup", "ClusterRole",
                   "ClusterRoleBinding", "PriorityClass", "ResourceSlice",
-                  "DeviceClass", "StorageClass", "PersistentVolume"}
+                  "DeviceClass", "StorageClass", "PersistentVolume",
+                  "CustomResourceDefinition",
+                  "ValidatingWebhookConfiguration"}
 
 _VERBS = ["create", "delete", "get", "list", "update", "watch"]
 

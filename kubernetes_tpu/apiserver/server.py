@@ -204,6 +204,13 @@ class APIServer:
         # (the reference serializes via CAS on ResourceQuota status)
         self._create_locks: dict[str, threading.Lock] = {}
         self._create_locks_mu = threading.Lock()
+        # re-establish dynamic kinds from a pre-populated store (restart
+        # from snapshot: the scheme must serve existing CRDs immediately,
+        # as apiextensions does on startup)
+        from ..api.extensions import register_custom_kind
+
+        for crd in store.iter_kind("CustomResourceDefinition"):
+            register_custom_kind(crd)
         self._http: ThreadingHTTPServer | None = None
         self.port = 0
 
@@ -466,10 +473,12 @@ class APIServer:
                              selected=None) -> None:
                 """selected: optional predicate — events whose object
                 doesn't match are dropped server-side (the watch-cache
-                selector filtering of staging/.../storage/cacher). DELETED
-                events for matching objects still flow; an object UPDATED
-                out of the selector emits nothing further (the reference
-                synthesizes DELETED there — documented simplification)."""
+                selector filtering of staging/.../storage/cacher). Selector
+                transitions follow cacher semantics exactly: an object
+                MODIFIED out of the selector synthesizes DELETED (carrying
+                the current object), one MODIFIED into it synthesizes
+                ADDED — detected via Event.prev_obj, the PrevObject of
+                cacher's watchCacheEvent."""
                 watch = server.store.watch(kind, from_revision=from_revision)
                 use_cbor = self._wants_cbor()
                 if use_cbor:
@@ -496,9 +505,18 @@ class APIServer:
                             # thread + store watch forever on quiet kinds
                             write_chunk(b"\x00\x00\x00\x00" if use_cbor else b"\n")
                             continue
-                        if selected is not None and not selected(ev.obj):
-                            continue
-                        payload = {"type": ev.type, "object": encode(ev.obj),
+                        ev_type = ev.type
+                        if selected is not None:
+                            curr = selected(ev.obj)
+                            prev = (ev.prev_obj is not None
+                                    and selected(ev.prev_obj))
+                            if ev_type == "MODIFIED" and curr and not prev:
+                                ev_type = "ADDED"  # transitioned in
+                            elif ev_type == "MODIFIED" and prev and not curr:
+                                ev_type = "DELETED"  # transitioned out
+                            elif not curr:
+                                continue
+                        payload = {"type": ev_type, "object": encode(ev.obj),
                                    "revision": ev.revision}
                         if use_cbor:
                             # length-prefixed CBOR frames: binary bodies
@@ -559,7 +577,11 @@ class APIServer:
                         server.store.update(pod, check_version=False)
                         self._send_json(201, {"status": "Success"})
                         return
-                    if body.get("apiVersion", "") not in ("", "v1"):
+                    from ..api.extensions import CustomObject
+
+                    klass = kind_class(kind)
+                    if (body.get("apiVersion", "") not in ("", "v1")
+                            and not issubclass(klass, CustomObject)):
                         obj = server.scheme.decode_versioned(body)
                         if obj.kind != kind:
                             # authz ran against the URL kind; a body of a
@@ -569,17 +591,31 @@ class APIServer:
                                         f"kind {kind!r}")
                             return
                     else:
-                        obj = decode(body, kind_class(kind))
+                        # custom kinds carry their CRD group's apiVersion;
+                        # they decode unversioned (apiextensions serves
+                        # them without scheme conversion)
+                        obj = decode(body, klass)
                     if key and obj.meta.key != key:
                         self._error(
                             400, "BadRequest",
                             f"body key {obj.meta.key!r} != URL key {key!r}",
                         )
                         return
+                    # chain order: everything (incl. webhook HTTP calls)
+                    # runs unserialized; only the quota check-and-commit
+                    # pair holds the per-namespace lock (upstream also runs
+                    # ResourceQuota as the last admission plugin)
+                    server._admit("CREATE", obj)
                     with server._create_lock(getattr(obj.meta, "namespace",
                                                      "")):
-                        server._admit("CREATE", obj)
+                        server._admit_serialized("CREATE", obj)
                         created = server.store.create(obj)
+                    if kind == "CustomResourceDefinition":
+                        # establish only after the CRD committed: an
+                        # admission denial must not leak scheme state
+                        from ..api.extensions import register_custom_kind
+
+                        register_custom_kind(created)
                     self._send_json(201, encode(created))
                 except AdmissionError as e:
                     self._error(e.code, "Invalid", str(e))
@@ -664,7 +700,11 @@ class APIServer:
                 if not self._authorized("update", kind, key):
                     return
                 try:
-                    if body.get("apiVersion", "") not in ("", "v1"):
+                    from ..api.extensions import CustomObject
+
+                    klass = kind_class(kind)
+                    if (body.get("apiVersion", "") not in ("", "v1")
+                            and not issubclass(klass, CustomObject)):
                         obj = server.scheme.decode_versioned(body)
                         if obj.kind != kind:
                             self._error(400, "BadRequest",
@@ -672,7 +712,9 @@ class APIServer:
                                         f"kind {kind!r}")
                             return
                     else:
-                        obj = decode(body, kind_class(kind))
+                        # custom kinds decode unversioned whatever group
+                        # apiVersion they carry (as in do_POST)
+                        obj = decode(body, klass)
                     if obj.meta.key != key:
                         # the authz decision above was made against the URL
                         # key; a body naming a different object would bypass
@@ -711,6 +753,8 @@ class APIServer:
                     return
                 try:
                     deleted = server.store.delete(kind, key)
+                    if kind == "CustomResourceDefinition":
+                        server._drop_custom_kind(deleted)
                     self._send_json(200, encode(deleted))
                 except NotFoundError as e:
                     self._error(404, "NotFound", str(e))
@@ -779,11 +823,30 @@ class APIServer:
 
     def _admit(self, operation: str, obj) -> None:
         for fn in self.admission:
-            fn(operation, obj)
+            if not getattr(fn, "serialize_with_create", False):
+                fn(operation, obj)
+
+    def _admit_serialized(self, operation: str, obj) -> None:
+        """Plugins that must be atomic with the following store commit
+        (quota's check-and-reserve); runs under the per-namespace create
+        lock, after the unserialized chain."""
+        for fn in self.admission:
+            if getattr(fn, "serialize_with_create", False):
+                fn(operation, obj)
 
     def _create_lock(self, namespace: str) -> threading.Lock:
         with self._create_locks_mu:
             return self._create_locks.setdefault(namespace, threading.Lock())
+
+    def _drop_custom_kind(self, crd) -> None:
+        """CRD deletion cleanup: delete served instances, then retire the
+        kind from the scheme (the apiextensions finalizer's job)."""
+        from ..api.extensions import unregister_custom_kind
+
+        kind = crd.spec.names.kind
+        for obj in list(self.store.iter_kind(kind)):
+            self.store.try_delete(kind, obj.meta.key)
+        unregister_custom_kind(kind)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -5,6 +5,7 @@ from .cronjob import CronJobController
 from .disruption import DisruptionController
 from .hpa import HPAController
 from .quota import QuotaController, quota_admission
+from .volume import PersistentVolumeController
 from .lifecycle import (
     EndpointSliceController,
     GarbageCollector,
@@ -47,6 +48,7 @@ def default_controllers(store, clock=None) -> list[Controller]:
         HPAController(store, informers, clock=clock),
         QuotaController(store, informers),
         PodGCController(store, informers),
+        PersistentVolumeController(store, informers),
     ]
 
 
@@ -58,6 +60,7 @@ __all__ = [
     "JobController",
     "NamespaceController", "NodeLifecycleController",
     "QuotaController", "ReplicaSetController", "ResourceClaimController",
+    "PersistentVolumeController",
     "StatefulSetController", "TTLAfterFinishedController",
     "default_controllers", "quota_admission",
 ]

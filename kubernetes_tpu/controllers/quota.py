@@ -108,4 +108,7 @@ def quota_admission(store):
                         code=403,
                     )
 
+    # the live-usage check must be atomic with the store commit: the server
+    # runs tagged plugins under its per-namespace create lock
+    admit.serialize_with_create = True
     return admit

@@ -355,6 +355,10 @@ def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
     # NodeName (node_name.go:79)
     f_name = (f["name_idx"] != -1) & (iota != f["name_idx"])
 
+    # NodeAffinity single-name fast path (node_affinity.go:159): pinned
+    # pods carry the node row index as a feature instead of an allow row
+    f_pin = (f["aff_pin"] != -1) & (iota != f["aff_pin"])
+
     # TaintToleration filter (taint_toleration.go:119)
     tid = planes["taints"]
     tol = jnp.take(f["tol"], jnp.clip(tid, 0), axis=0)
@@ -400,7 +404,7 @@ def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
     ipa1, ipa2, ipa3 = _ipa_filters(cfg, planes, f)
 
     fails = jnp.stack(
-        [f_unsched, f_name, f_taint, f_aff, f_ports, f_fit]
+        [f_unsched, f_name, f_taint, f_aff | f_pin, f_ports, f_fit]
         + pts_missing + pts_skew + [ipa1, ipa2, ipa3]
     )
     feasible = valid & ~fails.any(axis=0)
@@ -612,9 +616,11 @@ def _static_pod_parts(cfg: KernelConfig, planes: dict, f: dict) -> dict:
     row = jnp.take(planes["aff_match"], f["aff_sig"], axis=0)
     allow = jnp.take(planes["aff_allow"], f["aff_sig"], axis=0)
     f_aff = ~(jnp.take(row, planes["group_id"]) & allow)
+    f_pin = (f["aff_pin"] != -1) & (iota != f["aff_pin"])
     conflict = (planes["port_words"] & f["ports"][None, :]) != 0
     f_ports = f["has_ports"] & conflict.any(axis=1)
-    static_ok = valid & ~(f_unsched | f_name | f_taint | f_aff | f_ports)
+    static_ok = valid & ~(f_unsched | f_name | f_pin | f_taint | f_aff
+                          | f_ports)
 
     ptid = planes["prefer_taints"]
     tolp = jnp.take(f["tol_prefer"], jnp.clip(ptid, 0), axis=0)

@@ -374,8 +374,9 @@ class PodFeatureExtractor:
         self.names = names
         self.vocabs = vocabs
         self.system_default_spread = system_default_spread
-        self._aff_sigs: dict = {}
+        self._aff_sigs: dict = {}  # full-spec key -> (sig, pin name | None)
         self._aff_specs: list = []
+        self._aff_spec_ids: dict = {}  # residual-spec key -> sig (dedup)
         self._aff_tables: dict | None = None
         self._aff_tables_key: tuple | None = None
         self._feat_cache: dict = {}
@@ -493,8 +494,15 @@ class PodFeatureExtractor:
 
         # node affinity / nodeSelector resolved to a shared signature row
         # (node_affinity.go:218; signature reuse mirrors SignPod,
-        # staging/.../framework/signers.go — identical pods share one row)
-        f["aff_sig"] = np.int32(self._affinity_sig(pod))
+        # staging/.../framework/signers.go — identical pods share one row).
+        # A single-name required affinity (the daemonset shape) rides as a
+        # per-pod pin index instead (node_affinity.go:159 fast path); -2 =
+        # pinned to a node not in this snapshot -> infeasible everywhere
+        sig, pin_name = self._affinity_sig(pod)
+        f["aff_sig"] = np.int32(sig)
+        f["aff_pin"] = np.int32(
+            -1 if pin_name is None else planes.node_index.get(pin_name, -2)
+        )
 
         # host ports (node_ports.go:75) — wildcard-ip pods only; the
         # (proto, port) bitset is exact for those
@@ -636,13 +644,19 @@ class PodFeatureExtractor:
         f["ipa_anti_add"] = anti_add
         f["ipa_pref_add"] = pref_add
 
-    def _affinity_sig(self, pod: Pod) -> int:
+    def _affinity_sig(self, pod: Pod) -> tuple[int, str | None]:
         """Intern the pod's (nodeSelector, node affinity) spec into a
-        signature id; identical pods share one table row.
+        (signature id, pinned node name | None); identical pods share one
+        table row.
 
         match_fields support is limited to the reference's own fast path —
         a single term whose fields are `In(metadata.name, [...])`
-        (node_affinity.go:159) — expressed as a node allowlist.
+        (node_affinity.go:159) — expressed as a node allowlist. When that
+        allowlist is a SINGLE name and the term carries no expressions, the
+        pin comes back as a per-pod feature and NO signature is minted:
+        a daemonset-style run of uniquely-pinned pods must share one table
+        row, not grow the [sigs, nodes] allow matrix by one row per pod
+        (which made 5k daemon pods rebuild+upload a 5k-row table per wave).
         """
         aff = pod.spec.affinity
         node_aff = aff.node_affinity if aff else None
@@ -650,10 +664,11 @@ class PodFeatureExtractor:
         preferred = tuple(node_aff.preferred) if node_aff else ()
         selector = tuple(sorted(pod.spec.node_selector.items()))
         key = (selector, repr(required), repr(preferred))
-        sig = self._aff_sigs.get(key)
-        if sig is not None:
-            return sig
+        cached = self._aff_sigs.get(key)
+        if cached is not None:
+            return cached
 
+        pin: str | None = None
         allowed_names: frozenset | None = None
         terms_for_groups = None
         if required is not None:
@@ -669,23 +684,37 @@ class PodFeatureExtractor:
                     vals = set(fr.values)
                     allowed = vals if allowed is None else (allowed & vals)
                 allowed_names = frozenset(allowed or ())
-                # strip fields; expressions still gate per group
-                from ..api.types import NodeSelector, NodeSelectorTerm
-                terms_for_groups = NodeSelector(
-                    (NodeSelectorTerm(terms[0].match_expressions, ()),)
-                )
+                if (len(allowed_names) == 1
+                        and not terms[0].match_expressions):
+                    pin = next(iter(allowed_names))
+                    allowed_names = None
+                else:
+                    # strip fields; expressions still gate per group
+                    from ..api.types import NodeSelector, NodeSelectorTerm
+                    terms_for_groups = NodeSelector(
+                        (NodeSelectorTerm(terms[0].match_expressions, ()),)
+                    )
             else:
                 terms_for_groups = required
         for term in preferred:
             if term.preference.match_fields:
                 raise FallbackNeeded("preferred term with match_fields")
 
-        sig = len(self._aff_specs)
-        self._aff_specs.append(
-            (dict(pod.spec.node_selector), terms_for_groups, preferred, allowed_names)
-        )
-        self._aff_sigs[key] = sig
-        return sig
+        # intern the residual spec — shared across every pod whose affinity
+        # differs only by its pinned name
+        spec_key = (selector, repr(terms_for_groups), repr(preferred),
+                    allowed_names)
+        sig = self._aff_spec_ids.get(spec_key)
+        if sig is None:
+            sig = len(self._aff_specs)
+            self._aff_specs.append(
+                (dict(pod.spec.node_selector), terms_for_groups, preferred,
+                 allowed_names)
+            )
+            self._aff_spec_ids[spec_key] = sig
+        result = (sig, pin)
+        self._aff_sigs[key] = result
+        return result
 
     def affinity_tables(self, planes: Planes) -> dict[str, np.ndarray]:
         """Materialize the signature rows against the current group vocab and

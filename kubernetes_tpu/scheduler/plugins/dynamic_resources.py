@@ -6,10 +6,11 @@ per-node allocation attempt, Reserve, PreBind, Unreserve) with the structured
 allocator from staging/src/k8s.io/dynamic-resource-allocation/ and in-memory
 allocation tracking mirroring dra_manager.go / allocateddevices.go.
 
-The allocator here is typed-selector based (api/dra.py) rather than CEL; the
-per-node allocation attempt is the same shape: gather the node's device
-inventory, subtract devices already allocated (claim statuses + in-flight
-assumes), then greedily satisfy each request.
+Device selectors evaluate a CEL subset (utils/cel.py, wired at
+api/dra.py) alongside typed selectors; the per-node allocation attempt is
+the same shape: gather the node's device inventory, subtract devices
+already allocated (claim statuses + in-flight assumes), then greedily
+satisfy each request.
 """
 
 from __future__ import annotations

@@ -157,12 +157,24 @@ class VolumeBinder:
         # deterministic smallest-fit-first order (pv_util sorts by size)
         return sorted(pv_list, key=lambda p: (p.storage_capacity, p.meta.name))
 
+    def candidates_for_claims(self, claims: _ClaimsToBind,
+                              pv_list: list[PersistentVolume]) -> dict:
+        """Per-claim availability prefilter (node-independent half of
+        FindMatchingVolume): drops PVs bound to other claims once per cycle
+        so the per-node scan touches only genuinely available volumes."""
+        return {
+            pvc.meta.key: [pv for pv in pv_list
+                           if self._pv_available(pv, pvc.meta.key)]
+            for pvc in claims.unbound_delayed
+        }
+
     def find_pod_volumes(
         self,
         pod: Pod,
         claims: _ClaimsToBind,
         node_info: NodeInfo,
         pv_list: list[PersistentVolume] | None = None,
+        by_claim: dict[str, list] | None = None,
     ) -> tuple[PodVolumes, list[str]]:
         """binder.go FindPodVolumes — returns (decision, conflict reasons)."""
         reasons: list[str] = []
@@ -173,11 +185,18 @@ class VolumeBinder:
                 reasons.append(ERR_REASON_NODE_CONFLICT)
                 return volumes, reasons
         for pvc in claims.unbound_delayed:
-            if pv_list is None:
-                pv_list = self.list_candidate_pvs()
+            if by_claim is not None:
+                # availability-prefiltered at PreFilter (node-independent):
+                # the per-node scan must not re-walk every already-bound PV
+                # — at scale that was O(boundPVs × nodes) per pod
+                cands = by_claim.get(pvc.meta.key, ())
+            else:
+                if pv_list is None:
+                    pv_list = self.list_candidate_pvs()
+                cands = pv_list
             chosen = None
             taken = {pv for pv, _ in volumes.static_bindings}
-            for pv in pv_list:
+            for pv in cands:
                 if pv.meta.key in taken:
                     continue
                 if self._pv_available(pv, pvc.meta.key) and self._pv_matches(
@@ -268,11 +287,13 @@ class VolumeBinder:
 
 
 class _BindingState:
-    __slots__ = ("claims", "per_node", "pv_candidates")
+    __slots__ = ("claims", "per_node", "pv_candidates", "by_claim")
 
-    def __init__(self, claims: _ClaimsToBind, pv_candidates=None):
+    def __init__(self, claims: _ClaimsToBind, pv_candidates=None,
+                 by_claim=None):
         self.claims = claims
         self.pv_candidates: list | None = pv_candidates
+        self.by_claim: dict | None = by_claim
         self.per_node: dict[str, PodVolumes] = {}
 
 
@@ -303,7 +324,9 @@ class VolumeBinding(Plugin):
         candidates = (
             self.binder.list_candidate_pvs() if claims.unbound_delayed else []
         )
-        state.write(self.STATE_KEY, _BindingState(claims, candidates))
+        by_claim = self.binder.candidates_for_claims(claims, candidates)
+        state.write(self.STATE_KEY,
+                    _BindingState(claims, candidates, by_claim))
         return None, None
 
     def _state(self, state) -> _BindingState | None:
@@ -314,7 +337,7 @@ class VolumeBinding(Plugin):
         if s is None:
             return Status()
         volumes, reasons = self.binder.find_pod_volumes(
-            pod, s.claims, node_info, s.pv_candidates
+            pod, s.claims, node_info, s.pv_candidates, by_claim=s.by_claim
         )
         if reasons:
             # UnschedulableAndUnresolvable (volume_binding.go Filter): no

@@ -62,6 +62,11 @@ class Event:
     # wall-clock emit time (time.perf_counter); consumers like the perf
     # harness's throughput collector need true write times, not drain times
     ts: float = 0.0
+    # previous object state on MODIFIED (the watch cache's
+    # watchCacheEvent.PrevObject): lets selector-filtered watches detect an
+    # object transitioning out of (or into) the selector and synthesize
+    # DELETED/ADDED, exactly as staging/.../storage/cacher does
+    prev_obj: Any = None
 
 
 class Watch:
@@ -200,7 +205,8 @@ class Store:
             rev = self._bump()
             obj.meta.resource_version = rev
             objs[key] = obj
-            self._emit(kind, Event(MODIFIED, copy.deepcopy(obj), rev, time.perf_counter()))
+            self._emit(kind, Event(MODIFIED, copy.deepcopy(obj), rev,
+                                   time.perf_counter(), prev_obj=cur))
             return copy.deepcopy(obj)
 
     def bind_pod(self, key: str, node_name: str) -> Any:
@@ -224,7 +230,8 @@ class Store:
             rev = self._bump()
             obj.meta.resource_version = rev
             objs[key] = obj
-            self._emit("Pod", Event(MODIFIED, obj, rev, time.perf_counter()))
+            self._emit("Pod", Event(MODIFIED, obj, rev,
+                                        time.perf_counter(), prev_obj=cur))
             return obj
 
     @staticmethod
@@ -263,7 +270,8 @@ class Store:
                 rev = self._bump()
                 obj.meta.resource_version = rev
                 objs[key] = obj
-                self._emit("Pod", Event(MODIFIED, obj, rev, time.perf_counter()))
+                self._emit("Pod", Event(MODIFIED, obj, rev,
+                                        time.perf_counter(), prev_obj=cur))
                 out.append("bound")
         return out
 
@@ -300,7 +308,8 @@ class Store:
             rev = self._bump()
             obj.meta.resource_version = rev
             objs[key] = obj
-            self._emit("Pod", Event(MODIFIED, obj, rev, time.perf_counter()))
+            self._emit("Pod", Event(MODIFIED, obj, rev,
+                                        time.perf_counter(), prev_obj=cur))
             return obj
 
     def delete(self, kind: str, key: str) -> Any:

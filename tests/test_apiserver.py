@@ -362,6 +362,48 @@ class TestSelectors:
         finally:
             server.shutdown()
 
+    def test_selector_transition_synthesizes_deleted_and_added(self):
+        """cacher semantics: an object MODIFIED out of an active selector
+        watch emits a synthesized DELETED (else clients hold it stale
+        forever); MODIFIED back in emits ADDED."""
+        from kubernetes_tpu.client.rest import RESTStore
+
+        store, server = self.setup_cluster()
+        try:
+            client = RESTStore(server.url)
+            pods, rev = client.list("Pod", label_selector="app=web")
+            assert {p.meta.name for p in pods} == {"a", "b"}
+            w = client.watch("Pod", from_revision=rev,
+                             label_selector="app=web")
+            # flip "a" out of the selector: client must see DELETED
+            a = store.get("Pod", "default/a")
+            a.meta.labels = {"app": "db"}
+            store.update(a)
+            ev = w.next(timeout=5)
+            assert ev is not None
+            assert (ev.type, ev.obj.meta.name) == ("DELETED", "a")
+            # flip it back in: client must see ADDED
+            a = store.get("Pod", "default/a")
+            a.meta.labels = {"app": "web", "tier": "fe"}
+            store.update(a)
+            ev = w.next(timeout=5)
+            assert ev is not None
+            assert (ev.type, ev.obj.meta.name) == ("ADDED", "a")
+            # an object that never matched stays invisible through updates
+            c = store.get("Pod", "default/c")
+            c.meta.labels = {"app": "db", "x": "1"}
+            store.update(c)
+            # and an in-selector update is a plain MODIFIED
+            b = store.get("Pod", "default/b")
+            b.meta.labels = {"app": "web", "tier": "be", "y": "2"}
+            store.update(b)
+            ev = w.next(timeout=5)
+            assert ev is not None
+            assert (ev.type, ev.obj.meta.name) == ("MODIFIED", "b")
+            w.stop()
+        finally:
+            server.shutdown()
+
     def test_unknown_field_selector_400(self):
         import pytest
 

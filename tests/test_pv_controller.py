@@ -1,0 +1,196 @@
+"""PersistentVolume lifecycle controller tests.
+
+Modeled on pkg/controller/volume/persistentvolume/pv_controller_test.go
+(syncClaim/syncVolume table tests) and the binding integration suite:
+immediate-mode claims bind outside the scheduler, dynamic provisioning,
+pre-bound convergence, and reclaim policies.
+"""
+
+import time
+
+from kubernetes_tpu.api.storage import (
+    CLAIM_BOUND,
+    CLAIM_PENDING,
+    RECLAIM_DELETE,
+    RECLAIM_RETAIN,
+    VOLUME_AVAILABLE,
+    VOLUME_BOUND,
+    VOLUME_RELEASED,
+)
+from kubernetes_tpu.controllers.volume import PersistentVolumeController
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store import Store
+from tests.wrappers import (
+    make_node,
+    make_pod,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+    with_pvc,
+)
+
+
+def controller(store):
+    c = PersistentVolumeController(store)
+    c.sync_once()
+    return c
+
+
+class TestImmediateBinding:
+    def test_binds_smallest_adequate_pv(self):
+        store = Store()
+        store.create(make_storage_class("fast", wait_for_first_consumer=False))
+        store.create(make_pv("big", storage="100Gi", storage_class="fast"))
+        store.create(make_pv("small", storage="10Gi", storage_class="fast"))
+        store.create(make_pvc("data", storage="5Gi", storage_class="fast"))
+        controller(store)
+        pvc = store.get("PersistentVolumeClaim", "default/data")
+        assert pvc.status.phase == CLAIM_BOUND
+        assert pvc.spec.volume_name == "small"
+        pv = store.get("PersistentVolume", "small")
+        assert pv.status.phase == VOLUME_BOUND
+        assert pv.spec.claim_ref == "default/data"
+        assert store.get("PersistentVolume", "big").status.phase == \
+            VOLUME_AVAILABLE
+
+    def test_class_capacity_access_mode_mismatches_stay_pending(self):
+        store = Store()
+        store.create(make_storage_class("fast", wait_for_first_consumer=False))
+        store.create(make_pv("wrong-class", storage="10Gi",
+                             storage_class="slow"))
+        store.create(make_pv("too-small", storage="1Gi",
+                             storage_class="fast"))
+        store.create(make_pv("wrong-mode", storage="10Gi",
+                             storage_class="fast",
+                             access_modes=("ReadOnlyMany",)))
+        store.create(make_pvc("data", storage="5Gi", storage_class="fast"))
+        controller(store)
+        assert store.get("PersistentVolumeClaim", "default/data") \
+            .status.phase == CLAIM_PENDING
+
+    def test_wffc_claim_left_to_scheduler(self):
+        store = Store()
+        store.create(make_storage_class("local", wait_for_first_consumer=True))
+        store.create(make_pv("pv1", storage="10Gi", storage_class="local"))
+        store.create(make_pvc("data", storage="5Gi", storage_class="local"))
+        controller(store)
+        assert store.get("PersistentVolumeClaim", "default/data") \
+            .status.phase == CLAIM_PENDING
+
+    def test_late_pv_unblocks_pending_claim(self):
+        store = Store()
+        store.create(make_storage_class("fast", wait_for_first_consumer=False))
+        store.create(make_pvc("data", storage="5Gi", storage_class="fast"))
+        c = controller(store)
+        assert store.get("PersistentVolumeClaim", "default/data") \
+            .status.phase == CLAIM_PENDING
+        store.create(make_pv("late", storage="10Gi", storage_class="fast"))
+        c.sync_once()
+        assert store.get("PersistentVolumeClaim", "default/data") \
+            .status.phase == CLAIM_BOUND
+
+    def test_prebound_claim_converges(self):
+        store = Store()
+        store.create(make_pv("pv1", storage="10Gi", storage_class=""))
+        store.create(make_pvc("data", storage="5Gi", volume_name="pv1"))
+        controller(store)
+        pvc = store.get("PersistentVolumeClaim", "default/data")
+        assert pvc.status.phase == CLAIM_BOUND
+        assert store.get("PersistentVolume", "pv1").spec.claim_ref == \
+            "default/data"
+
+    def test_pv_prebound_to_claim_wins_over_smaller(self):
+        store = Store()
+        store.create(make_storage_class("fast", wait_for_first_consumer=False))
+        store.create(make_pv("small", storage="6Gi", storage_class="fast"))
+        reserved = make_pv("reserved", storage="50Gi", storage_class="fast")
+        reserved.spec.claim_ref = "default/data"
+        store.create(reserved)
+        store.create(make_pvc("data", storage="5Gi", storage_class="fast"))
+        controller(store)
+        assert store.get("PersistentVolumeClaim", "default/data") \
+            .spec.volume_name == "reserved"
+
+
+class TestDynamicProvisioning:
+    def test_immediate_class_provisions(self):
+        store = Store()
+        store.create(make_storage_class(
+            "csi", provisioner="ebs.csi.example.com",
+            wait_for_first_consumer=False))
+        store.create(make_pvc("data", storage="8Gi", storage_class="csi"))
+        controller(store)
+        pvc = store.get("PersistentVolumeClaim", "default/data")
+        assert pvc.status.phase == CLAIM_BOUND
+        pv = store.get("PersistentVolume", pvc.spec.volume_name)
+        assert pv.spec.csi_driver == "ebs.csi.example.com"
+        assert pv.spec.reclaim_policy == RECLAIM_DELETE
+        assert pv.storage_capacity == pvc.requested_storage
+
+    def test_no_provisioner_class_does_not_provision(self):
+        store = Store()
+        store.create(make_storage_class("manual",
+                                        wait_for_first_consumer=False))
+        store.create(make_pvc("data", storage="8Gi", storage_class="manual"))
+        controller(store)
+        assert store.get("PersistentVolumeClaim", "default/data") \
+            .status.phase == CLAIM_PENDING
+        assert list(store.iter_kind("PersistentVolume")) == []
+
+
+class TestReclaim:
+    def test_retain_releases(self):
+        store = Store()
+        store.create(make_storage_class("fast", wait_for_first_consumer=False))
+        pv = make_pv("pv1", storage="10Gi", storage_class="fast")
+        pv.spec.reclaim_policy = RECLAIM_RETAIN
+        store.create(pv)
+        store.create(make_pvc("data", storage="5Gi", storage_class="fast"))
+        c = controller(store)
+        store.delete("PersistentVolumeClaim", "default/data")
+        c.sync_once()
+        pv = store.get("PersistentVolume", "pv1")
+        assert pv.status.phase == VOLUME_RELEASED
+        # a Released volume is NOT matched by new claims
+        store.create(make_pvc("data2", storage="5Gi", storage_class="fast"))
+        c.sync_once()
+        assert store.get("PersistentVolumeClaim", "default/data2") \
+            .status.phase == CLAIM_PENDING
+
+    def test_delete_reclaims(self):
+        store = Store()
+        store.create(make_storage_class(
+            "csi", provisioner="ebs.csi.example.com",
+            wait_for_first_consumer=False))
+        store.create(make_pvc("data", storage="8Gi", storage_class="csi"))
+        c = controller(store)
+        pvc = store.get("PersistentVolumeClaim", "default/data")
+        pv_name = pvc.spec.volume_name
+        store.delete("PersistentVolumeClaim", "default/data")
+        c.sync_once()
+        assert store.try_get("PersistentVolume", pv_name) is None
+
+
+class TestUnstrandsPods:
+    def test_pod_with_unbound_immediate_pvc_schedules_after_bind(self):
+        """The round-3 gap: a pod using an unbound immediate-mode PVC was
+        rejected with ERR_REASON_UNBOUND_IMMEDIATE and nothing would ever
+        bind the claim. With the PV controller running, the bind lands and
+        the PVC update requeues the pod (VolumeBinding EventsToRegister)."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_storage_class("fast", wait_for_first_consumer=False))
+        store.create(make_pvc("data", storage="5Gi", storage_class="fast"))
+        store.create(with_pvc(make_pod("p1", cpu="1"), "data"))
+        s = Scheduler(store)
+        s.start()
+        s.schedule_pending()
+        assert store.get("Pod", "default/p1").spec.node_name == ""
+        # the controller arrives (or catches up) and binds the claim
+        store.create(make_pv("pv1", storage="10Gi", storage_class="fast"))
+        c = controller(store)
+        assert store.get("PersistentVolumeClaim", "default/data") \
+            .status.phase == CLAIM_BOUND
+        time.sleep(1.1)  # per-pod backoff on the real clock
+        s.schedule_pending()
+        assert store.get("Pod", "default/p1").spec.node_name == "n1"
