@@ -36,6 +36,10 @@ class ObjectMeta:
     creation_timestamp: float = 0.0
     deletion_timestamp: float | None = None
     owner_references: list[OwnerReference] = field(default_factory=list)
+    # server-side apply field ownership (metadata.managedFields): entries
+    # {"manager", "operation", "fields": [dotted paths]} maintained by
+    # apiserver/apply.py
+    managed_fields: list[dict] = field(default_factory=list)
 
     @property
     def key(self) -> str:
@@ -48,6 +52,7 @@ class ObjectMeta:
             labels=dict(self.labels),
             annotations=dict(self.annotations),
             owner_references=list(self.owner_references),
+            managed_fields=[dict(e) for e in self.managed_fields],
         )
 
 

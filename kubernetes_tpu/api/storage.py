@@ -84,6 +84,10 @@ class PersistentVolumeSpec:
     storage_class_name: str = ""
     node_affinity: NodeSelector | None = None  # required topology
     claim_ref: str = ""  # "namespace/name" of the bound claim
+    # UID of the bound claim (claimRef.uid): distinguishes the claim
+    # INSTANCE — a deleted-and-recreated same-named PVC must not keep the
+    # old PV bound (pv_controller.go checks exactly this)
+    claim_ref_uid: str = ""
     csi_driver: str = ""  # CSI driver name, "" for in-tree/local volumes
     reclaim_policy: str = RECLAIM_RETAIN  # persistentVolumeReclaimPolicy
 

@@ -96,6 +96,23 @@ def crd_admission(store):
     )
 
     def admit(operation: str, obj) -> None:
+        if (operation == "UPDATE"
+                and getattr(obj, "kind", "") == "CustomResourceDefinition"):
+            try:
+                validate_custom_kind(obj)
+            except ValueError as e:
+                raise AdmissionError(str(e), code=422)
+            stored = store.try_get("CustomResourceDefinition", obj.meta.key)
+            if stored is not None:
+                # apiextensions: names.kind and scope are immutable — a
+                # kind rename would orphan served instances and desync the
+                # scheme registration
+                if stored.spec.names.kind != obj.spec.names.kind:
+                    raise AdmissionError(
+                        "spec.names.kind is immutable", code=422)
+                if stored.spec.scope != obj.spec.scope:
+                    raise AdmissionError("spec.scope is immutable", code=422)
+            return
         if (operation == "CREATE"
                 and getattr(obj, "kind", "") == "CustomResourceDefinition"):
             try:

@@ -601,21 +601,7 @@ class APIServer:
                             f"body key {obj.meta.key!r} != URL key {key!r}",
                         )
                         return
-                    # chain order: everything (incl. webhook HTTP calls)
-                    # runs unserialized; only the quota check-and-commit
-                    # pair holds the per-namespace lock (upstream also runs
-                    # ResourceQuota as the last admission plugin)
-                    server._admit("CREATE", obj)
-                    with server._create_lock(getattr(obj.meta, "namespace",
-                                                     "")):
-                        server._admit_serialized("CREATE", obj)
-                        created = server.store.create(obj)
-                    if kind == "CustomResourceDefinition":
-                        # establish only after the CRD committed: an
-                        # admission denial must not leak scheme state
-                        from ..api.extensions import register_custom_kind
-
-                        register_custom_kind(created)
+                    created = self._commit_create(kind, obj)
                     self._send_json(201, encode(created))
                 except AdmissionError as e:
                     self._error(e.code, "Invalid", str(e))
@@ -635,7 +621,7 @@ class APIServer:
                 if route is None:
                     self._error(404, "NotFound", "unknown path")
                     return
-                kind, key, sub, _ = route
+                kind, key, sub, query = route
                 patch = self._read_body()
                 if sub:
                     self._error(405, "MethodNotAllowed",
@@ -647,6 +633,11 @@ class APIServer:
                     self._error(400, "BadRequest", "patch must be an object")
                     return
                 if not self._authorized("patch", kind, key):
+                    return
+                if query.get("fieldManager"):
+                    # server-side apply (fieldmanager): managedFields
+                    # ownership + conflict detection + dropped-field removal
+                    self._server_side_apply(kind, key, patch, query)
                     return
 
                 def merge(base, delta):
@@ -735,6 +726,71 @@ class APIServer:
                 except NotFoundError as e:
                     self._error(404, "NotFound", str(e))
                 except (KeyError, TypeError, ValueError) as e:
+                    self._error(400, "BadRequest", f"undecodable body: {e}")
+
+            def _commit_create(self, kind: str, obj):
+                """The ONE create sequence (shared by POST and apply-create
+                so they can't drift): unserialized admission chain (incl.
+                webhook HTTP calls) → per-namespace lock around the quota
+                check-and-commit pair (upstream also runs ResourceQuota as
+                the last admission plugin) → post-commit CRD establishment
+                (an admission denial must not leak scheme state)."""
+                server._admit("CREATE", obj)
+                with server._create_lock(getattr(obj.meta, "namespace", "")):
+                    server._admit_serialized("CREATE", obj)
+                    created = server.store.create(obj)
+                if kind == "CustomResourceDefinition":
+                    from ..api.extensions import register_custom_kind
+
+                    register_custom_kind(created)
+                return created
+
+            def _server_side_apply(self, kind: str, key: str, applied: dict,
+                                   query: dict) -> None:
+                """fieldmanager apply: create-or-merge with ownership
+                tracking; 409 names the conflicting manager unless
+                force=true transfers the fields."""
+                from .apply import ApplyConflict, apply_doc
+
+                manager = query["fieldManager"]
+                force = query.get("force") == "true"
+                try:
+                    cur = server.store.try_get(kind, key)
+                    if cur is None and not self._authorized(
+                        "create", kind, key
+                    ):
+                        # apply-create needs the create verb too (upstream
+                        # authorizes both); patch alone must not mint
+                        # objects. key-derived namespace matches do_POST's
+                        # scoping: cluster-scoped keys carry no "/" -> ""
+                        return
+                    merged = apply_doc(None if cur is None else encode(cur),
+                                       applied, manager, force)
+                    obj = decode(merged, kind_class(kind))
+                    if obj.meta.key != key:
+                        self._error(400, "BadRequest",
+                                    f"body key {obj.meta.key!r} != URL "
+                                    f"key {key!r}")
+                        return
+                    if cur is None:
+                        created = self._commit_create(kind, obj)
+                        self._send_json(201, encode(created))
+                        return
+                    obj.meta.resource_version = cur.meta.resource_version
+                    server._admit("UPDATE", obj)
+                    updated = server.store.update(obj)
+                    self._send_json(200, encode(updated))
+                except ApplyConflict as e:
+                    self._error(409, "Conflict", str(e))
+                except AdmissionError as e:
+                    self._error(e.code, "Invalid", str(e))
+                except AlreadyExistsError as e:
+                    self._error(409, "AlreadyExists", str(e))
+                except ConflictError as e:
+                    self._error(409, "Conflict", str(e))
+                except NotFoundError as e:
+                    self._error(404, "NotFound", str(e))
+                except (KeyError, TypeError, ValueError, AttributeError) as e:
                     self._error(400, "BadRequest", f"undecodable body: {e}")
 
             def do_DELETE(self):

@@ -175,6 +175,11 @@ class RESTStore:
         return json.loads(raw.decode())
 
     def _request(self, method: str, path: str, body: dict | None = None) -> dict:
+        return self._request_with_status(method, path, body)[0]
+
+    def _request_with_status(
+        self, method: str, path: str, body: dict | None = None
+    ) -> tuple[dict, int]:
         data = self._encode_body(body) if body is not None else None
         req = urllib.request.Request(
             f"{self.base_url}{path}", data=data, method=method,
@@ -184,7 +189,7 @@ class RESTStore:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return self._decode_body(
                     resp.read(), resp.headers.get("Content-Type") or ""
-                )
+                ), resp.status
         except urllib.error.HTTPError as e:
             raw = e.read()
             reason = ""
@@ -226,6 +231,23 @@ class RESTStore:
     def patch(self, kind: str, key: str, patch: dict):
         """RFC 7386 JSON merge patch; returns the updated object."""
         out = self._request("PATCH", f"/api/v1/{kind}/{key}", patch)
+        return decode(out)
+
+    def apply(self, kind: str, key: str, config: dict,
+              field_manager: str, force: bool = False):
+        """Server-side apply (fieldmanager): create-or-merge `config` with
+        per-field ownership; raises ConflictError when a field is owned by
+        another manager (force=True transfers it). Sets
+        `last_apply_created` (True when the apply created the object —
+        HTTP 201 vs 200) for callers that report it."""
+        from urllib.parse import quote
+
+        q = (f"?fieldManager={quote(field_manager, safe='')}"
+             + ("&force=true" if force else ""))
+        out, code = self._request_with_status(
+            "PATCH", f"/api/v1/{kind}/{key}{q}", config
+        )
+        self.last_apply_created = code == 201
         return decode(out)
 
     def pod_logs(self, key: str, container: str = "",

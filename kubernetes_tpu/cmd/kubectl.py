@@ -122,17 +122,22 @@ def _load_manifests(path: str) -> list[dict]:
 
 
 def cmd_apply(client: RESTStore, args) -> int:
+    """Server-side apply under the "kubectl" field manager (the reference's
+    kubectl --server-side path): per-field ownership, conflict detection
+    (--force-conflicts transfers), dropped fields removed."""
+    from kubernetes_tpu.store.store import ConflictError
+
+    force = getattr(args, "force_conflicts", False)
     for doc in _load_manifests(args.filename):
-        obj = decode(doc)
+        obj = decode(doc)  # decode validates the manifest + resolves keys
         try:
-            client.create(obj)
-            print(f"{obj.kind.lower()}/{obj.meta.name} created")
-        except AlreadyExistsError:
-            cur = client.get(obj.kind, obj.meta.key)
-            obj.meta.resource_version = cur.meta.resource_version
-            obj.meta.uid = cur.meta.uid
-            client.update(obj, check_version=False)
-            print(f"{obj.kind.lower()}/{obj.meta.name} configured")
+            client.apply(obj.kind, obj.meta.key, doc, "kubectl", force=force)
+        except ConflictError as e:
+            print(f"Error: {e}\nhint: --force-conflicts transfers ownership",
+                  file=sys.stderr)
+            return 1
+        print(f"{obj.kind.lower()}/{obj.meta.name} "
+              f"{'created' if client.last_apply_created else 'configured'}")
     return 0
 
 
@@ -423,6 +428,9 @@ def build_parser() -> argparse.ArgumentParser:
     for verb in ("apply", "create"):
         a = sub.add_parser(verb)
         a.add_argument("-f", "--filename", required=True)
+        if verb == "apply":
+            a.add_argument("--force-conflicts", action="store_true",
+                           dest="force_conflicts")
 
     rm = sub.add_parser("delete")
     rm.add_argument("resource")

@@ -89,9 +89,17 @@ class PersistentVolumeController(Controller):
             return
         sc = self.store.try_get("StorageClass", pvc.spec.storage_class_name) \
             if pvc.spec.storage_class_name else None
-        if sc is not None and sc.is_wait_for_first_consumer:
+        wffc = sc is not None and sc.is_wait_for_first_consumer
+        stale, pv = self._scan_volumes(pvc, match=not wffc)
+        for name in stale:
+            # a PV still referencing a PREVIOUS instance of this claim key
+            # (delete + recreate before we reconciled) is dead — reclaim
+            # it, or it stays Bound-with-stale-claimRef forever. This runs
+            # for WFFC claims too: the binder refuses stale-uid PVs, so
+            # only reclaim can free them.
+            self._sync_volume(name)
+        if wffc:
             return  # the scheduler's binder owns WFFC claims
-        pv = self._find_best_match(pvc)
         if pv is None and sc is not None and sc.provisioner != NO_PROVISIONER:
             pv = self._provision(pvc, sc)
         if pv is not None:
@@ -103,20 +111,32 @@ class PersistentVolumeController(Controller):
         pv = self.store.try_get("PersistentVolume", pvc.spec.volume_name)
         if pv is None:
             return  # claim references a missing PV: stays Pending (lost)
-        if pv.spec.claim_ref in ("", pvc.meta.key):
+        if pv.spec.claim_ref in ("", pvc.meta.key) and (
+            not pv.spec.claim_ref_uid
+            or pv.spec.claim_ref_uid == pvc.meta.uid
+        ):
             self._bind(pv, pvc)
-        # else: PV belongs to another claim — claim stays Pending
+        # else: PV belongs to another claim (instance) — stays Pending
 
-    def _find_best_match(self, pvc):
-        """pvIndex.findBestMatchForClaim: smallest Available PV satisfying
-        class, capacity, and access modes; a PV pre-bound to THIS claim
-        wins outright."""
+    def _scan_volumes(self, pvc, match: bool = True):
+        """ONE pass over PVs serving two roles (pv_controller.go folds both
+        into its indexed lookups): collect stale same-key references (uid
+        mismatch → reclaim) and, when `match`, find the best available
+        volume — smallest Available PV satisfying class/capacity/access
+        modes; a PV pre-bound to THIS claim instance wins outright."""
+        stale: list[str] = []
+        prebound = None
         best = None
         for pv in self.store.iter_kind("PersistentVolume"):
-            if pv.status.phase != VOLUME_AVAILABLE:
-                continue
             if pv.spec.claim_ref == pvc.meta.key:
-                return pv
+                if (pv.spec.claim_ref_uid
+                        and pv.spec.claim_ref_uid != pvc.meta.uid):
+                    stale.append(pv.meta.key)
+                elif pv.status.phase == VOLUME_AVAILABLE:
+                    prebound = pv
+                continue
+            if not match or pv.status.phase != VOLUME_AVAILABLE:
+                continue
             if pv.spec.claim_ref:
                 continue
             if pv.spec.storage_class_name != pvc.spec.storage_class_name:
@@ -127,7 +147,7 @@ class PersistentVolumeController(Controller):
                 continue
             if best is None or pv.storage_capacity < best.storage_capacity:
                 best = pv
-        return best
+        return stale, (prebound if prebound is not None else best)
 
     def _provision(self, pvc, sc):
         """Dynamic provisioning (provisionClaimOperation): mint a PV sized
@@ -142,6 +162,7 @@ class PersistentVolumeController(Controller):
             access_modes=tuple(pvc.spec.access_modes),
             storage_class_name=sc.meta.name,
             claim_ref=pvc.meta.key,
+            claim_ref_uid=pvc.meta.uid,
             csi_driver="" if sc.provisioner == NO_PROVISIONER
             else sc.provisioner,
             reclaim_policy=sc.reclaim_policy,
@@ -154,8 +175,11 @@ class PersistentVolumeController(Controller):
         """bindVolumeToClaim + bindClaimToVolume: PV half first, claim half
         second; each write skipped when already converged so reconciles
         are idempotent."""
-        if pv.spec.claim_ref != pvc.meta.key or pv.status.phase != VOLUME_BOUND:
+        if (pv.spec.claim_ref != pvc.meta.key
+                or pv.spec.claim_ref_uid != pvc.meta.uid
+                or pv.status.phase != VOLUME_BOUND):
             pv.spec.claim_ref = pvc.meta.key
+            pv.spec.claim_ref_uid = pvc.meta.uid
             pv.status.phase = VOLUME_BOUND
             self.store.update(pv, check_version=False)
         if (pvc.spec.volume_name != pv.meta.name
@@ -176,9 +200,11 @@ class PersistentVolumeController(Controller):
                 self.store.update(pv, check_version=False)
             return
         pvc = self.store.try_get("PersistentVolumeClaim", pv.spec.claim_ref)
-        if pvc is not None:
+        if pvc is not None and (not pv.spec.claim_ref_uid
+                                or pvc.meta.uid == pv.spec.claim_ref_uid):
             return  # bound (or pre-bound awaiting _sync_claim)
-        # claim is gone: reclaim
+        # claim is gone — or a DIFFERENT same-named claim took its place
+        # (uid mismatch): either way the bound instance is dead, reclaim
         if pv.status.phase == VOLUME_BOUND:
             if pv.spec.reclaim_policy == RECLAIM_DELETE:
                 self.store.try_delete("PersistentVolume", name)

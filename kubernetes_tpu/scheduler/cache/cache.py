@@ -220,6 +220,8 @@ class Cache:
         with self._mu:
             latest = self._head.info.generation if self._head else snapshot.generation
             changed_membership = False
+            derived_dirty = False
+            touched: list[str] = []
             item = self._head
             while item is not None and item.info.generation > snapshot.generation:
                 info = item.info
@@ -232,7 +234,19 @@ class Cache:
                 else:
                     if existing is None:
                         changed_membership = True
+                    elif (bool(existing.pods_with_affinity)
+                          != bool(info.pods_with_affinity)
+                          or bool(existing.pods_with_required_anti_affinity)
+                          != bool(info.pods_with_required_anti_affinity)):
+                        # affinity flags flipped: derived lists must rebuild
+                        # (cache.go:202-276 — ONLY then)
+                        derived_dirty = True
+                    elif existing.pods_with_affinity or \
+                            existing.pods_with_required_anti_affinity:
+                        derived_dirty = True  # stale ref sits in the lists
                     snapshot.node_info_map[name] = info.clone()
+                    snapshot.note_change(name)
+                    touched.append(name)
                 item = item.next
 
             # remove snapshot nodes no longer in cache
@@ -250,14 +264,20 @@ class Cache:
                 snapshot.node_info_list = [
                     snapshot.node_info_map[n] for n in order if n in snapshot.node_info_map
                 ]
-            else:
-                # refresh references in the ordered list (clones replaced)
-                snapshot.node_info_list = [
-                    snapshot.node_info_map[n.name]
-                    for n in snapshot.node_info_list
-                    if n.name in snapshot.node_info_map
-                ]
-            snapshot.rebuild_derived_lists()
+                snapshot.note_membership()
+                snapshot.refresh_list_index()
+                snapshot.rebuild_derived_lists()
+            elif touched:
+                # patch replaced clones at their known positions instead of
+                # rebuilding the full O(N) ordered list per update — the
+                # per-pod hybrid path updates 1-2 nodes per cycle
+                idx = snapshot.list_index()
+                for name in touched:
+                    i = idx.get(name)
+                    if i is not None:
+                        snapshot.node_info_list[i] = snapshot.node_info_map[name]
+                if derived_dirty:
+                    snapshot.rebuild_derived_lists()
             snapshot.pod_group_states = self.pod_group_states.snapshot()
             snapshot.generation = latest
             return snapshot

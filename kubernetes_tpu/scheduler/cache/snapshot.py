@@ -9,7 +9,11 @@ narrow the visible node list to a placement's nodes.
 
 from __future__ import annotations
 
+import itertools
+
 from ..nodeinfo import NodeInfo, PodInfo
+
+_snapshot_uids = itertools.count(1)
 
 
 class Placement:
@@ -37,6 +41,42 @@ class Snapshot:
         self._assumed: list[tuple[str, str]] = []  # (pod_key, node_name)
         self._placement_stack: list[list[NodeInfo]] = []
         self.pod_group_states: dict[str, "object"] = {}
+        # change feed for O(changed) consumers (the planes builder): every
+        # node mutation appends its name; membership/order changes bump
+        # membership_version (consumers must re-list). changelog_base is
+        # the version of changelog[0] — entries older than base were
+        # compacted away and force a full scan.
+        self.version = 0
+        self.membership_version = 0
+        self.changelog: list[str] = []
+        self.changelog_base = 0
+        self.uid = next(_snapshot_uids)  # identity across consumer caches
+        self._list_index: dict[str, int] = {}
+        self._list_index_version = -1
+
+    def list_index(self) -> dict[str, int]:
+        """name -> node_info_list position, rebuilt lazily whenever
+        membership (and thus order) changed."""
+        if self._list_index_version != self.membership_version:
+            self.refresh_list_index()
+        return self._list_index
+
+    def refresh_list_index(self) -> None:
+        self._list_index = {
+            ni.name: i for i, ni in enumerate(self.node_info_list)
+        }
+        self._list_index_version = self.membership_version
+
+    def note_change(self, node_name: str) -> None:
+        self.version += 1
+        self.changelog.append(node_name)
+        if len(self.changelog) > 8192:
+            drop = len(self.changelog) // 2
+            del self.changelog[:drop]
+            self.changelog_base += drop
+
+    def note_membership(self) -> None:
+        self.membership_version += 1
 
     # -- reads (SharedLister / NodeInfoLister) -----------------------------
 
@@ -65,6 +105,7 @@ class Snapshot:
         if ni is None:
             raise KeyError(f"node {node_name} not in snapshot")
         ni.add_pod(pi)
+        self.note_change(node_name)
         self._assumed.append((pi.key, node_name))
         if pi.has_affinity_constraints and ni not in self.have_pods_with_affinity_list:
             self.have_pods_with_affinity_list.append(ni)
@@ -77,6 +118,7 @@ class Snapshot:
         if ni is None:
             return
         ni.remove_pod(pod_key)
+        self.note_change(node_name)
         try:
             self._assumed.remove((pod_key, node_name))
         except ValueError:
@@ -97,11 +139,13 @@ class Snapshot:
         wanted = set(placement.node_names)
         self.node_info_list = [n for n in self.node_info_list if n.name in wanted]
         self.rebuild_derived_lists()
+        self.note_membership()
 
     def forget_placement(self) -> None:
         if self._placement_stack:
             self.node_info_list = self._placement_stack.pop()
             self.rebuild_derived_lists()
+            self.note_membership()
 
     def num_nodes_in_placement(self) -> int:
         return len(self.node_info_list)

@@ -146,6 +146,61 @@ class Framework:
                 return st
         return Status()
 
+    def run_filter_plugins_batch(
+        self, state: CycleState, pod: Pod, node_infos: list[NodeInfo]
+    ) -> list[Status]:
+        """run_filter_plugins over a node list in one call. Plugins that
+        implement `filter_batch(state, pod, nodes) -> [Status|None]` answer
+        all nodes at once (node-independent work runs once per pod — the
+        host-side analogue of the dense kernel); others loop per node.
+        Identical semantics to per-node run_filter_plugins: plugin order
+        preserved, first rejection wins per node. A filter_batch returning
+        None falls back to that plugin's per-node filter."""
+        statuses: list[Status | None] = [None] * len(node_infos)
+        pending = list(range(len(node_infos)))
+        for p in self.filter_plugins:
+            if p.name in state.skip_filter_plugins or not pending:
+                continue
+            batch = getattr(p, "filter_batch", None)
+            res = None
+            if callable(batch):
+                nis = [node_infos[i] for i in pending]
+                res = self._timed(
+                    "Filter", p.name, lambda b=batch, nis=nis: b(state, pod, nis)
+                )
+            if res is not None and len(res) != len(pending):
+                raise ValueError(
+                    f"plugin {p.name} filter_batch returned {len(res)} "
+                    f"statuses for {len(pending)} nodes"
+                )
+            if res is not None:
+                still = []
+                for i, st in zip(pending, res):
+                    if st is None:
+                        still.append(i)
+                        continue
+                    st = status_of(st)
+                    if st.is_success:
+                        still.append(i)
+                    else:
+                        st.plugin = st.plugin or p.name
+                        statuses[i] = st
+                pending = still
+            else:
+                still = []
+                for i in pending:
+                    st = status_of(self._timed(
+                        "Filter", p.name,
+                        lambda p=p, i=i: p.filter(state, pod, node_infos[i]),
+                    ))
+                    if st.is_success:
+                        still.append(i)
+                    else:
+                        st.plugin = st.plugin or p.name
+                        statuses[i] = st
+                pending = still
+        return [st if st is not None else Status() for st in statuses]
+
     def run_filter_plugins_with_nominated_pods(
         self, state: CycleState, pod: Pod, node_info: NodeInfo, nominated_pod_infos
     ) -> Status:
@@ -207,9 +262,17 @@ class Framework:
         msg = "; ".join(s.message() for s in statuses if s.reasons)
         return None, Status.unschedulable(msg or "no postfilter plugin made progress")
 
-    def run_pre_score_plugins(self, state: CycleState, pod: Pod, nodes: list[NodeInfo]) -> Status:
-        skipped: set[str] = set()
+    def run_pre_score_plugins(self, state: CycleState, pod: Pod,
+                              nodes: list[NodeInfo],
+                              skip: set[str] | frozenset = frozenset()) -> Status:
+        """`skip` pre-seeds the score skip set WITHOUT running those
+        plugins' pre_score — the hybrid path passes the kernel-covered
+        plugins (their scores come from the device, so their host PreScore
+        precompute over every node is pure waste)."""
+        skipped: set[str] = set(skip)
         for p in self.pre_score_plugins:
+            if p.name in skipped:
+                continue
             st = status_of(
                 self._timed("PreScore", p.name, lambda p=p: p.pre_score(state, pod, nodes))
             )
@@ -236,13 +299,20 @@ class Framework:
         all_scores: dict[str, list[tuple[str, int]]] = {ni.name: [] for ni in nodes}
         for p in active:
             raw: list = []
-            for ni in nodes:
-                score, st = self._timed("Score", p.name, lambda p=p, ni=ni: p.score(state, pod, ni))
-                st = status_of(st)
-                if not st.is_success:
-                    st.plugin = st.plugin or p.name
-                    return [], st
-                raw.append([ni.name, score])
+            batch = getattr(p, "score_batch", None)
+            if callable(batch):
+                vals = self._timed(
+                    "Score", p.name, lambda b=batch: b(state, pod, nodes)
+                )
+                raw = [[ni.name, v] for ni, v in zip(nodes, vals)]
+            else:
+                for ni in nodes:
+                    score, st = self._timed("Score", p.name, lambda p=p, ni=ni: p.score(state, pod, ni))
+                    st = status_of(st)
+                    if not st.is_success:
+                        st.plugin = st.plugin or p.name
+                        return [], st
+                    raw.append([ni.name, score])
             norm = getattr(p, "normalize_score", None)
             if callable(norm):
                 st = status_of(norm(state, pod, raw))

@@ -129,9 +129,17 @@ class VolumeBinder:
                 )
         return out, None
 
-    def _pv_available(self, pv: PersistentVolume, pvc_key: str) -> bool:
+    def _pv_available(self, pv: PersistentVolume, pvc) -> bool:
         claimed = pv.spec.claim_ref or self.assumed.get(pv.meta.key, "")
-        return claimed in ("", pvc_key)
+        if claimed == "":
+            return True
+        if claimed != pvc.meta.key:
+            return False
+        # same key: the claimRef.uid must match the claim INSTANCE — a PV
+        # still referencing a deleted-and-recreated same-named claim is
+        # awaiting reclaim, not available (pv_controller.go uid check)
+        return (not pv.spec.claim_ref_uid
+                or pv.spec.claim_ref_uid == pvc.meta.uid)
 
     def _pv_matches(self, pv: PersistentVolume, pvc: PersistentVolumeClaim,
                     node_info: NodeInfo) -> bool:
@@ -152,8 +160,10 @@ class VolumeBinder:
 
     def list_candidate_pvs(self) -> list[PersistentVolume]:
         """One sorted PV listing per scheduling cycle (computed at PreFilter,
-        reused by every per-node Filter — the input is node-independent)."""
-        pv_list, _ = self.store.list("PersistentVolume")
+        reused by every per-node Filter — the input is node-independent).
+        Shared refs, read-only: a deepcopy of every PV per pod cycle was
+        the profile's top cost at 5k nodes."""
+        pv_list = self.store.list_refs("PersistentVolume")
         # deterministic smallest-fit-first order (pv_util sorts by size)
         return sorted(pv_list, key=lambda p: (p.storage_capacity, p.meta.name))
 
@@ -164,7 +174,7 @@ class VolumeBinder:
         so the per-node scan touches only genuinely available volumes."""
         return {
             pvc.meta.key: [pv for pv in pv_list
-                           if self._pv_available(pv, pvc.meta.key)]
+                           if self._pv_available(pv, pvc)]
             for pvc in claims.unbound_delayed
         }
 
@@ -175,12 +185,18 @@ class VolumeBinder:
         node_info: NodeInfo,
         pv_list: list[PersistentVolume] | None = None,
         by_claim: dict[str, list] | None = None,
+        bound_pvs: list | None = None,
     ) -> tuple[PodVolumes, list[str]]:
         """binder.go FindPodVolumes — returns (decision, conflict reasons)."""
         reasons: list[str] = []
         volumes = PodVolumes()
-        for pvc in claims.bound:
-            pv = self.store.try_get("PersistentVolume", pvc.spec.volume_name)
+        if bound_pvs is None:
+            bound_pvs = [
+                (pvc, self.store.try_get("PersistentVolume",
+                                         pvc.spec.volume_name))
+                for pvc in claims.bound
+            ]
+        for pvc, pv in bound_pvs:
             if pv is None or not self.pv_fits_node(pv, node_info):
                 reasons.append(ERR_REASON_NODE_CONFLICT)
                 return volumes, reasons
@@ -199,7 +215,7 @@ class VolumeBinder:
             for pv in cands:
                 if pv.meta.key in taken:
                     continue
-                if self._pv_available(pv, pvc.meta.key) and self._pv_matches(
+                if self._pv_available(pv, pvc) and self._pv_matches(
                     pv, pvc, node_info
                 ):
                     chosen = pv
@@ -237,6 +253,7 @@ class VolumeBinder:
                 pv = self.store.get("PersistentVolume", pv_key)
                 pvc = self.store.get("PersistentVolumeClaim", pvc_key)
                 pv.spec.claim_ref = pvc_key
+                pv.spec.claim_ref_uid = pvc.meta.uid
                 pv.status.phase = VOLUME_BOUND
                 pvc.spec.volume_name = pv.meta.name
                 pvc.status.phase = CLAIM_BOUND
@@ -252,6 +269,7 @@ class VolumeBinder:
                 pv.spec.access_modes = pvc.spec.access_modes
                 pv.spec.capacity = dict(pvc.spec.request)
                 pv.spec.claim_ref = pvc_key
+                pv.spec.claim_ref_uid = pvc.meta.uid
                 pv.status.phase = VOLUME_BOUND
                 if node_name:
                     from ...api.types import (
@@ -287,13 +305,27 @@ class VolumeBinder:
 
 
 class _BindingState:
-    __slots__ = ("claims", "per_node", "pv_candidates", "by_claim")
+    __slots__ = ("claims", "per_node", "pv_candidates", "by_claim",
+                 "bound_pvs", "pv_by_key", "pvc_by_key")
 
     def __init__(self, claims: _ClaimsToBind, pv_candidates=None,
-                 by_claim=None):
+                 by_claim=None, bound_pvs=None):
         self.claims = claims
         self.pv_candidates: list | None = pv_candidates
         self.by_claim: dict | None = by_claim
+        # bound claims' PVs prefetched once per cycle — the per-node Filter
+        # and Score must not pay a store deepcopy per (pod, node)
+        self.bound_pvs: list = bound_pvs or []
+        self.pv_by_key: dict = {
+            pv.meta.key: pv for pv in (pv_candidates or ())
+        }
+        for _, pv in self.bound_pvs:
+            if pv is not None:
+                self.pv_by_key.setdefault(pv.meta.key, pv)
+        self.pvc_by_key: dict = {
+            pvc.meta.key: pvc
+            for pvc in claims.bound + claims.unbound_delayed
+        }
         self.per_node: dict[str, PodVolumes] = {}
 
 
@@ -325,8 +357,13 @@ class VolumeBinding(Plugin):
             self.binder.list_candidate_pvs() if claims.unbound_delayed else []
         )
         by_claim = self.binder.candidates_for_claims(claims, candidates)
+        bound_pvs = [
+            (pvc, self.binder.store.try_get("PersistentVolume",
+                                            pvc.spec.volume_name))
+            for pvc in claims.bound
+        ]
         state.write(self.STATE_KEY,
-                    _BindingState(claims, candidates, by_claim))
+                    _BindingState(claims, candidates, by_claim, bound_pvs))
         return None, None
 
     def _state(self, state) -> _BindingState | None:
@@ -337,7 +374,8 @@ class VolumeBinding(Plugin):
         if s is None:
             return Status()
         volumes, reasons = self.binder.find_pod_volumes(
-            pod, s.claims, node_info, s.pv_candidates, by_claim=s.by_claim
+            pod, s.claims, node_info, s.pv_candidates, by_claim=s.by_claim,
+            bound_pvs=s.bound_pvs,
         )
         if reasons:
             # UnschedulableAndUnresolvable (volume_binding.go Filter): no
@@ -345,6 +383,130 @@ class VolumeBinding(Plugin):
             return Status.unresolvable(*reasons, plugin=self.name)
         s.per_node[node_info.name] = volumes
         return Status()
+
+    def filter_batch(self, state, pod: Pod, node_infos):
+        """All-nodes Filter in one call (the host long-tail analogue of the
+        dense kernel): the per-claim candidate scan is node-independent
+        except for PV node affinity, so one ordered pass over candidates
+        assigns every node its first matching volume — bit-identical to
+        calling filter() per node, at O(candidates + nodes) instead of
+        O(candidates x nodes). Returns None (-> per-node fallback) for
+        multi-claim pods, whose within-pod taken-set interplay the
+        vectorization doesn't model."""
+        s = self._state(state)
+        if s is None:
+            return [None] * len(node_infos)
+        if len(s.claims.unbound_delayed) > 1:
+            return None
+        n = len(node_infos)
+        statuses: list[Status | None] = [None] * n
+        conflict = None
+        for pvc, pv in s.bound_pvs:
+            if pv is None:
+                conflict = Status.unresolvable(ERR_REASON_NODE_CONFLICT,
+                                               plugin=self.name)
+                return [conflict] * n
+            if pv.spec.node_affinity is None:
+                continue
+            for i, ni in enumerate(node_infos):
+                if statuses[i] is None and not self.binder.pv_fits_node(
+                    pv, ni
+                ):
+                    if conflict is None:
+                        conflict = Status.unresolvable(
+                            ERR_REASON_NODE_CONFLICT, plugin=self.name)
+                    statuses[i] = conflict
+        if not s.claims.unbound_delayed:
+            empty = PodVolumes()
+            for i, ni in enumerate(node_infos):
+                if statuses[i] is None:
+                    s.per_node[ni.name] = empty
+            return statuses
+
+        pvc = s.claims.unbound_delayed[0]
+        # node-independent half of _pv_matches, once per cycle
+        cands = [
+            pv for pv in (s.by_claim or {}).get(pvc.meta.key, ())
+            if self.binder._pv_available(pv, pvc)
+            and pv.spec.storage_class_name == pvc.spec.storage_class_name
+            and set(pvc.spec.access_modes) <= set(pv.spec.access_modes)
+            and pv.storage_capacity >= pvc.requested_storage
+        ]
+        remaining = [i for i in range(n) if statuses[i] is None]
+        assignment: dict[int, PersistentVolume] = {}
+        for pv in cands:
+            if not remaining:
+                break
+            if pv.spec.node_affinity is None:
+                for i in remaining:
+                    assignment[i] = pv
+                remaining = []
+            else:
+                still = []
+                for i in remaining:
+                    if self.binder.pv_fits_node(pv, node_infos[i]):
+                        assignment[i] = pv
+                    else:
+                        still.append(i)
+                remaining = still
+        sc = self.binder.store.try_get(
+            "StorageClass", pvc.spec.storage_class_name
+        )
+        prov = (PodVolumes(dynamic_provisions=[pvc.meta.key])
+                if sc is not None and sc.provisioner != NO_PROVISIONER
+                else None)
+        bind_fail = None
+        vol_cache: dict[str, PodVolumes] = {}
+        for i, ni in enumerate(node_infos):
+            if statuses[i] is not None:
+                continue
+            pv = assignment.get(i)
+            if pv is not None:
+                vol = vol_cache.get(pv.meta.key)
+                if vol is None:
+                    vol = PodVolumes(
+                        static_bindings=[(pv.meta.key, pvc.meta.key)]
+                    )
+                    vol_cache[pv.meta.key] = vol
+                s.per_node[ni.name] = vol
+            elif prov is not None:
+                s.per_node[ni.name] = prov
+            else:
+                if bind_fail is None:
+                    bind_fail = Status.unresolvable(
+                        ERR_REASON_BIND_CONFLICT, plugin=self.name)
+                statuses[i] = bind_fail
+        return statuses
+
+    def score_batch(self, state, pod: Pod, node_infos) -> list[int]:
+        """Score over all nodes at once; assignments sharing a PodVolumes
+        share one capacity computation."""
+        s = self._state(state)
+        if s is None:
+            return [0] * len(node_infos)
+        cache: dict[int, int] = {}
+        out = []
+        for ni in node_infos:
+            volumes = s.per_node.get(ni.name)
+            if volumes is None or not volumes.static_bindings:
+                out.append(0)
+                continue
+            key = id(volumes)
+            val = cache.get(key)
+            if val is None:
+                total_req = total_cap = 0
+                for pv_key, pvc_key in volumes.static_bindings:
+                    pv = s.pv_by_key.get(pv_key)
+                    pvc = s.pvc_by_key.get(pvc_key)
+                    if pv is None or pvc is None:
+                        continue
+                    total_req += pvc.requested_storage
+                    total_cap += pv.storage_capacity
+                val = ((MAX_NODE_SCORE * total_req) // total_cap
+                       if total_cap else 0)
+                cache[key] = val
+            out.append(val)
+        return out
 
     def score(self, state, pod: Pod, node_info: NodeInfo):
         """Static-binding utilization shape: tighter fit scores higher
@@ -359,8 +521,10 @@ class VolumeBinding(Plugin):
         total_req = 0
         total_cap = 0
         for pv_key, pvc_key in volumes.static_bindings:
-            pv = self.binder.store.try_get("PersistentVolume", pv_key)
-            pvc = self.binder.store.try_get("PersistentVolumeClaim", pvc_key)
+            # cycle-state lookups, NOT store gets: a deepcopy per
+            # (pod, node) Score call dominated the 5k-node profile
+            pv = s.pv_by_key.get(pv_key)
+            pvc = s.pvc_by_key.get(pvc_key)
             if pv is None or pvc is None:
                 continue
             total_req += pvc.requested_storage

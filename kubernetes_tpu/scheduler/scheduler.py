@@ -143,7 +143,8 @@ class Scheduler:
                 backend = TPUBackend(self.names, plugin_args=prof.plugin_args)
                 fw.tpu_backend = backend
                 self.algorithms[prof.name] = TPUSchedulingAlgorithm(
-                    fw, backend, rng=random.Random(seed)
+                    fw, backend, rng=random.Random(seed),
+                    host_tail_percentage=prof.percentage_of_nodes_to_score,
                 )
                 self.algorithms[prof.name].extenders = self.extenders
             else:

@@ -49,7 +49,7 @@ from ..framework.interface import (
     ScheduleResult,
     Status,
 )
-from ..schedule_one import SchedulingAlgorithm
+from ..schedule_one import SchedulingAlgorithm, num_feasible_nodes_to_find
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_rows_jit(dev: dict, rows: dict, idx):
@@ -720,12 +720,21 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
     fallback, so decisions match the host algorithm bit-for-bit at
     percentageOfNodesToScore=100."""
 
-    def __init__(self, framework, backend: TPUBackend, rng=None, nominator=None):
+    def __init__(self, framework, backend: TPUBackend, rng=None,
+                 nominator=None, host_tail_percentage: int = 0):
         super().__init__(framework, percentage_of_nodes_to_score=100,
                          rng=rng, nominator=nominator)
         self.backend = backend
         self.fallback_count = 0
         self.kernel_count = 0
+        # the dense kernel evaluates EVERY node for free, so the kernel
+        # path stays at 100%; the HYBRID path's host long-tail stage is
+        # where per-node work costs, and it follows the reference's own
+        # adaptive sampling (numFeasibleNodesToFind + rotation + early
+        # exit, schedule_one.go:775,862) at this percentage (0 = the
+        # adaptive 50-nodes/125 formula; clusters under 100 nodes always
+        # evaluate everything, so small-cluster decisions are unchanged)
+        self.host_tail_percentage = host_tail_percentage
 
     def schedule_pod(self, state, pod: Pod, snapshot) -> ScheduleResult:
         if snapshot.num_nodes() == 0:
@@ -821,7 +830,6 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         through the same select_host rng draw."""
         fw = self.fw
         nodes = snapshot.list_nodes()
-        by_name = {ni.name: ni for ni in nodes}
         pre_result, st = fw.run_pre_filter_plugins(state, pod, nodes)
         if not st.is_success:
             if st.is_rejected:
@@ -844,50 +852,92 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         state.skip_filter_plugins = prefilter_skips | set(
             KERNEL_FILTER_PLUGINS
         )
-        diagnosis = self.backend.build_diagnosis(pod, planes, out)
-        feasible_idx = np.flatnonzero(out["feasible"][: planes.n])
+        # host-failure statuses only; the kernel's per-node failure rows are
+        # materialized lazily at the FitError site (build_diagnosis walks
+        # every infeasible node — O(N) python per pod if done eagerly)
+        diagnosis = Diagnosis()
+        feasible_mask = out["feasible"]
+        node_index = planes.node_index
+        # the host long-tail stage follows findNodesThatPassFilters:775
+        # exactly: rotate the start index, evaluate kernel-feasible nodes
+        # in rotated order, early-exit at numFeasibleNodesToFind. The
+        # kernel already gave the dense verdict for EVERY node — sampling
+        # here bounds only the per-node host-plugin work. With
+        # host_tail_percentage=100 (or < 100 nodes) this walks everything
+        # in snapshot order, matching the host path at 100% bit-for-bit.
+        host_nodes = (nodes if allowed is None
+                      else [ni for ni in nodes if ni.name in allowed])
+        num_all = len(host_nodes)
+        num_to_find = num_feasible_nodes_to_find(
+            self.host_tail_percentage, num_all
+        )
+        start = self.next_start_node_index % num_all if num_all else 0
         survivors: list[tuple[int, object]] = []
-        for i in feasible_idx:
-            name = planes.node_names[int(i)]
-            ni = by_name.get(name)
-            if ni is None:
-                continue
-            if allowed is not None and name not in allowed:
-                diagnosis.node_to_status.set(name, Status.unresolvable(
-                    "node(s) didn't satisfy plugin prefilter result"
-                ))
-                continue
-            npis = self._nominated_pod_infos(pod, ni)
-            if npis:
-                # two-pass nominated treatment (schedule_one.go:1190).
-                # Pass 1 — WITH nominated pods assumed — needs the FULL
-                # chain on an unpolluted state clone: the kernel verdict
-                # didn't model the nominated pods. Pass 2 — the bare node —
-                # keeps the kernel skips: the kernel's out["feasible"]
-                # already IS the bare-node dense verdict, so only the long
-                # tail runs again.
-                state.skip_filter_plugins = prefilter_skips
-                state_clone = state.clone()
-                state.skip_filter_plugins = prefilter_skips | set(
-                    KERNEL_FILTER_PLUGINS
+        evaluated = num_all
+        pos = 0
+        done = False
+        while pos < num_all and not done:
+            # chunk of kernel-feasible candidates, in rotated order
+            chunk: list[tuple[int, object, int]] = []
+            want = max(num_to_find - len(survivors), 1)
+            while pos < num_all and len(chunk) < want:
+                ni = host_nodes[(start + pos) % num_all]
+                ki = node_index.get(ni.name)
+                pos += 1
+                if ki is not None and feasible_mask[ki]:
+                    chunk.append((ki, ni, pos))  # pos = evaluated-if-last
+            if not chunk:
+                break
+            noms = [self._nominated_pod_infos(pod, ni)
+                    for _, ni, _ in chunk]
+            if any(noms):
+                sts = []
+                for (ki, ni, _), npis in zip(chunk, noms):
+                    if npis:
+                        # two-pass nominated treatment
+                        # (schedule_one.go:1190). Pass 1 — WITH nominated
+                        # pods assumed — needs the FULL chain on an
+                        # unpolluted state clone: the kernel verdict didn't
+                        # model the nominated pods. Pass 2 — the bare
+                        # node — keeps the kernel skips: out["feasible"]
+                        # already IS the bare-node dense verdict.
+                        state.skip_filter_plugins = prefilter_skips
+                        state_clone = state.clone()
+                        state.skip_filter_plugins = prefilter_skips | set(
+                            KERNEL_FILTER_PLUGINS
+                        )
+                        ni_with = ni.clone()
+                        for npi in npis:
+                            ni_with.add_pod(npi)
+                            fw.run_pre_filter_extension_add_pod(
+                                state_clone, pod, npi, ni_with
+                            )
+                        host_st = fw.run_filter_plugins(
+                            state_clone, pod, ni_with
+                        )
+                        if host_st.is_success:
+                            host_st = fw.run_filter_plugins(state, pod, ni)
+                    else:
+                        host_st = fw.run_filter_plugins(state, pod, ni)
+                    sts.append(host_st)
+            else:
+                sts = fw.run_filter_plugins_batch(
+                    state, pod, [ni for _, ni, _ in chunk]
                 )
-                ni_with = ni.clone()
-                for npi in npis:
-                    ni_with.add_pod(npi)
-                    fw.run_pre_filter_extension_add_pod(
-                        state_clone, pod, npi, ni_with
-                    )
-                host_st = fw.run_filter_plugins(state_clone, pod, ni_with)
+            for (ki, ni, at), host_st in zip(chunk, sts):
                 if host_st.is_success:
-                    host_st = fw.run_filter_plugins(state, pod, ni)
-            else:
-                host_st = fw.run_filter_plugins(state, pod, ni)
-            if host_st.is_success:
-                survivors.append((int(i), ni))
-            else:
-                diagnosis.node_to_status.set(name, host_st)
-                if host_st.plugin:
-                    diagnosis.unschedulable_plugins.add(host_st.plugin)
+                    survivors.append((ki, ni))
+                    if len(survivors) >= num_to_find:
+                        evaluated = at
+                        done = True
+                        break
+                else:
+                    diagnosis.node_to_status.set(ni.name, host_st)
+                    if host_st.plugin:
+                        diagnosis.unschedulable_plugins.add(host_st.plugin)
+        self.next_start_node_index = (
+            (start + evaluated) % num_all if num_all else 0
+        )
         if survivors and self.extenders:
             # extenders prune AFTER in-tree filters (findNodesThatPass-
             # Extenders, schedule_one.go:890) — same position here, on the
@@ -904,18 +954,28 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
                              if ni.name in kept_names]
         if not survivors:
             state.skip_filter_plugins = prefilter_skips  # see above
-            raise FitError(pod, snapshot.num_nodes(), diagnosis)
+            # materialize the kernel's per-node failure rows now (lazy —
+            # the success path never pays this O(N) walk), then overlay
+            # the host-stage verdicts, which are more specific
+            full = self.backend.build_diagnosis(pod, planes, out)
+            full.node_to_status.node_to_status.update(
+                diagnosis.node_to_status.node_to_status
+            )
+            full.unschedulable_plugins |= diagnosis.unschedulable_plugins
+            if allowed is not None:
+                full.node_to_status.absent_nodes_status = Status.unresolvable(
+                    "node(s) didn't satisfy plugin prefilter result"
+                )
+            raise FitError(pod, snapshot.num_nodes(), full)
         node_infos = [ni for _, ni in survivors]
-        st = fw.run_pre_score_plugins(state, pod, node_infos)
+        # kernel-covered score plugins are pre-seeded into the skip set so
+        # their host PreScore precompute never runs — their weighted scores
+        # are already in the kernel total (counting them host-side too
+        # would double them)
+        st = fw.run_pre_score_plugins(state, pod, node_infos,
+                                      skip=set(KERNEL_SCORE_PLUGINS))
         if not st.is_success:
             raise RuntimeError(f"prescore failed: {st.reasons}")
-        # AFTER PreScore: run_pre_score_plugins REPLACES the skip set with
-        # its own Skip returns — union the kernel-covered plugins back in
-        # or their weighted scores would be counted twice (once in the
-        # kernel total, once host-side)
-        state.skip_score_plugins = set(state.skip_score_plugins) | set(
-            KERNEL_SCORE_PLUGINS
-        )
         host_scores, st = fw.run_score_plugins(state, pod, node_infos)
         if not st.is_success:
             raise RuntimeError(f"score failed: {st.reasons}")

@@ -331,6 +331,14 @@ class Store:
         except NotFoundError:
             return None
 
+    def list_refs(self, kind: str) -> list[Any]:
+        """The stored objects WITHOUT copies — read-only by the same
+        convention as event objects (client-go shared-cache semantics).
+        For hot per-cycle listings (the volume binder's PV candidates) the
+        per-call deepcopy of list() is the dominant cost at scale."""
+        with self._mu:
+            return list(self._objects.get(kind, {}).values())
+
     def list(self, kind: str, namespace: str | None = None) -> tuple[list[Any], int]:
         """Returns (objects, revision) — the revision to start a watch from.
         namespace filters BEFORE the deepcopy: a namespace-scoped consumer

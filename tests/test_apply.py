@@ -1,0 +1,212 @@
+"""Server-side apply (fieldmanager) tests.
+
+Modeled on staging/src/k8s.io/apiserver/pkg/endpoints/handlers/fieldmanager
+tests: ownership recording, cross-manager conflicts + forced transfer,
+dropped-field removal, and the canonical kubectl/HPA replicas scenario
+(the motivating example in the SSA KEP)."""
+
+import pytest
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.apiserver.apply import ApplyConflict, apply_doc, field_paths
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTStore
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.store.store import ConflictError
+
+
+def server_pair():
+    store = Store()
+    server = APIServer(store)
+    server.serve(0)
+    return store, server
+
+
+class TestFieldPaths:
+    def test_leaves_lists_atomic_identity_excluded(self):
+        doc = {
+            "kind": "Pod", "apiVersion": "v1",
+            "meta": {"name": "p", "namespace": "default",
+                     "labels": {"app": "web", "tier": "fe"}},
+            "spec": {"priority": 5, "tolerations": [{"key": "k"}],
+                     "affinity": {}},
+        }
+        assert field_paths(doc) == {
+            "meta/labels/app", "meta/labels/tier",
+            "spec/priority", "spec/tolerations", "spec/affinity",
+        }
+
+    def test_dotted_and_slashed_keys_unambiguous(self):
+        """k8s label keys routinely contain '.' and '/'
+        (app.kubernetes.io/name) — paths must stay reversible."""
+        doc = {"meta": {"labels": {"app.kubernetes.io/name": "x"}},
+               "spec": {"a": {"b": 1}, "a.b": 2}}
+        paths = field_paths(doc)
+        assert "meta/labels/app.kubernetes.io~1name" in paths
+        assert "spec/a/b" in paths and "spec/a.b" in paths
+
+    def test_dropped_dotted_label_is_removed(self):
+        one = apply_doc(None, {"meta": {"labels": {
+            "app.kubernetes.io/name": "x", "plain": "y"}}}, "m")
+        two = apply_doc(one, {"meta": {"labels": {"plain": "y"}}}, "m")
+        assert two["meta"]["labels"] == {"plain": "y"}
+
+
+class TestApplyDoc:
+    def test_create_records_ownership(self):
+        merged = apply_doc(None, {"kind": "Pod",
+                                  "meta": {"name": "p"},
+                                  "spec": {"priority": 3}}, "mgr-a")
+        mf = merged["meta"]["managed_fields"]
+        assert mf == [{"manager": "mgr-a", "operation": "Apply",
+                       "fields": ["spec/priority"]}]
+
+    def test_disjoint_managers_coexist(self):
+        one = apply_doc(None, {"meta": {"labels": {"a": "1"}}}, "mgr-a")
+        two = apply_doc(one, {"meta": {"labels": {"b": "2"}}}, "mgr-b")
+        assert two["meta"]["labels"] == {"a": "1", "b": "2"}
+        managers = {e["manager"] for e in two["meta"]["managed_fields"]}
+        assert managers == {"mgr-a", "mgr-b"}
+
+    def test_conflict_and_forced_transfer(self):
+        one = apply_doc(None, {"meta": {"labels": {"a": "1"}}}, "mgr-a")
+        with pytest.raises(ApplyConflict) as exc:
+            apply_doc(one, {"meta": {"labels": {"a": "2"}}}, "mgr-b")
+        assert "mgr-a" in str(exc.value)
+        forced = apply_doc(one, {"meta": {"labels": {"a": "2"}}}, "mgr-b",
+                           force=True)
+        assert forced["meta"]["labels"]["a"] == "2"
+        owners = {e["manager"]: e["fields"]
+                  for e in forced["meta"]["managed_fields"]}
+        assert "meta/labels/a" in owners["mgr-b"]
+        assert "mgr-a" not in owners  # fully transferred entry dropped
+
+    def test_dropped_field_removed(self):
+        one = apply_doc(None, {"meta": {"labels": {"a": "1", "b": "2"}}},
+                        "mgr-a")
+        two = apply_doc(one, {"meta": {"labels": {"a": "1"}}}, "mgr-a")
+        assert two["meta"]["labels"] == {"a": "1"}
+
+    def test_dropped_field_kept_when_other_manager_owns(self):
+        one = apply_doc(None, {"meta": {"labels": {"a": "1"}}}, "mgr-a")
+        # b applies the same value — no conflict is raised only for
+        # different fields; same field conflicts, so use force
+        two = apply_doc(one, {"meta": {"labels": {"a": "1"}}}, "mgr-b",
+                        force=True)
+        # a drops the field from its config; b still owns it -> kept
+        three = apply_doc(two, {"meta": {"labels": {}}}, "mgr-a")
+        assert three["meta"]["labels"]["a"] == "1"
+
+
+class TestApplyOverHTTP:
+    def test_kubectl_hpa_replicas_scenario(self):
+        """The SSA KEP's motivating case: kubectl applies a Deployment
+        without replicas, the HPA's manager applies replicas, and a later
+        kubectl apply that re-adds replicas conflicts until forced."""
+        store, server = server_pair()
+        try:
+            client = RESTStore(server.url)
+            manifest = {
+                "kind": "Deployment",
+                "meta": {"name": "web", "namespace": "default"},
+                "spec": {"replicas": 1, "selector": {"app": "web"}},
+            }
+            client.apply("Deployment", "default/web", manifest, "kubectl")
+            # kubectl stops managing replicas (HPA takes over)
+            del manifest["spec"]["replicas"]
+            client.apply("Deployment", "default/web", manifest, "kubectl")
+            client.apply("Deployment", "default/web",
+                         {"spec": {"replicas": 5}}, "hpa")
+            obj = store.get("Deployment", "default/web")
+            assert obj.spec.replicas == 5
+            # kubectl re-adding replicas now conflicts with the HPA
+            manifest["spec"]["replicas"] = 1
+            with pytest.raises(ConflictError) as exc:
+                client.apply("Deployment", "default/web", manifest, "kubectl")
+            assert "hpa" in str(exc.value)
+            client.apply("Deployment", "default/web", manifest, "kubectl",
+                         force=True)
+            assert store.get("Deployment", "default/web").spec.replicas == 1
+        finally:
+            server.shutdown()
+
+    def test_apply_create_requires_create_verb(self):
+        """Patch-only RBAC must not mint new objects through apply-create
+        (upstream authorizes apply against create when the object is new)."""
+        from kubernetes_tpu.api.meta import ObjectMeta as OM
+        from kubernetes_tpu.api.rbac import (
+            PolicyRule,
+            Role,
+            RoleBinding,
+            RoleRef,
+            Subject,
+        )
+        from kubernetes_tpu.apiserver.auth import (
+            RBACAuthorizer,
+            TokenAuthenticator,
+            User,
+        )
+        from kubernetes_tpu.client.rest import RESTError
+
+        store = Store()
+        store.create(Role(
+            meta=OM(name="patcher", namespace="default"),
+            rules=(PolicyRule(("patch",), ("Pod",)),),
+        ))
+        store.create(RoleBinding(
+            meta=OM(name="patchers", namespace="default"),
+            subjects=(Subject("User", "dev"),),
+            role_ref=RoleRef("Role", "patcher"),
+        ))
+        authn = TokenAuthenticator({"t": User("dev", ())})
+        server = APIServer(store, authenticator=authn,
+                           authorizer=RBACAuthorizer(store))
+        server.serve(0)
+        try:
+            client = RESTStore(server.url, token="t")
+            with pytest.raises(RESTError) as exc:
+                client.apply("Pod", "default/new",
+                             {"kind": "Pod", "meta": {"name": "new"}}, "m")
+            assert exc.value.code == 403
+            assert store.try_get("Pod", "default/new") is None
+            # with an existing object, patch alone suffices
+            from tests.wrappers import make_pod
+
+            store.create(make_pod("existing"))
+            client.apply("Pod", "default/existing",
+                         {"meta": {"labels": {"a": "1"}}}, "m")
+            assert store.get("Pod", "default/existing").meta.labels["a"] == "1"
+        finally:
+            server.shutdown()
+
+    def test_kubectl_cli_apply_conflict_flow(self, tmp_path, capsys):
+        import json
+
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+
+        store, server = server_pair()
+        try:
+            client = RESTStore(server.url)
+            f = tmp_path / "pod.json"
+            f.write_text(json.dumps({
+                "kind": "Pod", "meta": {"name": "p", "namespace": "default",
+                                        "labels": {"app": "x"}},
+            }))
+            assert kubectl(["--server", server.url, "apply", "-f",
+                            str(f)]) == 0
+            assert "created" in capsys.readouterr().out
+            assert kubectl(["--server", server.url, "apply", "-f",
+                            str(f)]) == 0
+            assert "configured" in capsys.readouterr().out
+            # another manager owns the label now
+            client.apply("Pod", "default/p",
+                         {"meta": {"labels": {"app": "y"}}}, "other",
+                         force=True)
+            assert kubectl(["--server", server.url, "apply", "-f",
+                            str(f)]) == 1
+            assert "force-conflicts" in capsys.readouterr().err
+            assert kubectl(["--server", server.url, "apply",
+                            "--force-conflicts", "-f", str(f)]) == 0
+            assert store.get("Pod", "default/p").meta.labels["app"] == "x"
+        finally:
+            server.shutdown()

@@ -174,6 +174,33 @@ class TestCRDLifecycle:
             client.create(mk_crd(kind="Pod"))
         assert exc.value.code == 422
 
+    def test_crd_kind_and_scope_immutable_on_update(self, cluster):
+        store, server = cluster
+        client = RESTStore(server.url)
+        crd = client.create(mk_crd())
+        renamed = client.get("CustomResourceDefinition",
+                             "widgets.custom.example")
+        renamed.spec.names.kind = "Gadget"
+        with pytest.raises(RESTError) as exc:
+            client.update(renamed, check_version=False)
+        assert exc.value.code == 422
+        rescoped = client.get("CustomResourceDefinition",
+                              "widgets.custom.example")
+        rescoped.spec.scope = "Cluster"
+        with pytest.raises(RESTError) as exc:
+            client.update(rescoped, check_version=False)
+        assert exc.value.code == 422
+        # schema updates ARE allowed and take effect
+        evolved = client.get("CustomResourceDefinition",
+                             "widgets.custom.example")
+        evolved.spec.schema = {"type": "object"}
+        client.update(evolved, check_version=False)
+        from kubernetes_tpu.api.serialization import kind_class
+
+        client.create(kind_class("Widget")(
+            meta=ObjectMeta(name="freeform"), spec={"anything": True}))
+        assert crd is not None
+
     def test_kubectl_get_custom_kind(self, cluster, capsys):
         store, server = cluster
         client = RESTStore(server.url)

@@ -157,6 +157,30 @@ class TestReclaim:
         assert store.get("PersistentVolumeClaim", "default/data2") \
             .status.phase == CLAIM_PENDING
 
+    def test_recreated_same_name_claim_does_not_wedge_old_pv(self):
+        """claimRef.uid guard: deleting a bound PVC and recreating one with
+        the same name must still reclaim the old PV (the new claim is a
+        different instance) and bind the new claim to a fresh volume."""
+        store = Store()
+        store.create(make_storage_class("fast", wait_for_first_consumer=False))
+        pv = make_pv("pv1", storage="10Gi", storage_class="fast")
+        pv.spec.reclaim_policy = RECLAIM_DELETE
+        store.create(pv)
+        store.create(make_pvc("data", storage="5Gi", storage_class="fast"))
+        c = controller(store)
+        assert store.get("PersistentVolume", "pv1").status.phase == \
+            VOLUME_BOUND
+        # delete + recreate the claim before the controller reconciles
+        store.delete("PersistentVolumeClaim", "default/data")
+        store.create(make_pvc("data", storage="5Gi", storage_class="fast"))
+        store.create(make_pv("pv2", storage="10Gi", storage_class="fast"))
+        c.sync_once()
+        # old PV reclaimed (Delete), new claim bound to the fresh volume
+        assert store.try_get("PersistentVolume", "pv1") is None
+        pvc = store.get("PersistentVolumeClaim", "default/data")
+        assert pvc.status.phase == CLAIM_BOUND
+        assert pvc.spec.volume_name == "pv2"
+
     def test_delete_reclaims(self):
         store = Store()
         store.create(make_storage_class(
