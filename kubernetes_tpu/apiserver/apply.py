@@ -118,14 +118,19 @@ def apply_doc(stored: dict | None, applied: dict, manager: str,
     merged = _merge(dict(stored or {}), applied)
 
     # fields we owned but dropped from the configuration are removed —
-    # unless some other manager still owns them
+    # unless some other manager still owns them or anything UNDER them
+    # (an empty-dict leaf like "spec/affinity" must not take another
+    # manager's "spec/affinity/zone" down with it)
     if prev is not None:
         others: set[str] = set()
         for entry in mf:
             if entry is not prev:
                 others |= set(entry.get("fields") or ())
         for path in sorted(set(prev.get("fields") or ()) - new_paths):
-            if path not in others:
+            subtree = path + "/"
+            if path not in others and not any(
+                o.startswith(subtree) for o in others
+            ):
                 _delete_path(merged, path)
 
     mf = [e for e in mf

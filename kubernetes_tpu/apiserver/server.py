@@ -781,7 +781,10 @@ class APIServer:
                     updated = server.store.update(obj)
                     self._send_json(200, encode(updated))
                 except ApplyConflict as e:
-                    self._error(409, "Conflict", str(e))
+                    # distinct reason: a field-OWNERSHIP conflict needs the
+                    # --force-conflicts remedy; a CAS race ("Conflict")
+                    # just needs a retry — clients must tell them apart
+                    self._error(409, "FieldManagerConflict", str(e))
                 except AdmissionError as e:
                     self._error(e.code, "Invalid", str(e))
                 except AlreadyExistsError as e:

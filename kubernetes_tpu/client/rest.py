@@ -30,12 +30,20 @@ class RESTError(Exception):
         self.code = code
 
 
+class ApplyConflictError(ConflictError):
+    """Server-side apply field-OWNERSHIP conflict (reason
+    FieldManagerConflict) — needs --force-conflicts, unlike a plain CAS
+    Conflict which just needs a retry."""
+
+
 def _raise_for(code: int, message: str, reason: str = ""):
     if code == 404:
         raise NotFoundError(message)
     if code == 409:
         if reason == "AlreadyExists":
             raise AlreadyExistsError(message)
+        if reason == "FieldManagerConflict":
+            raise ApplyConflictError(message)
         raise ConflictError(message)
     raise RESTError(code, message)
 

@@ -127,14 +127,31 @@ def cmd_apply(client: RESTStore, args) -> int:
     (--force-conflicts transfers), dropped fields removed."""
     from kubernetes_tpu.store.store import ConflictError
 
+    from kubernetes_tpu.client.rest import ApplyConflictError
+
     force = getattr(args, "force_conflicts", False)
     for doc in _load_manifests(args.filename):
         obj = decode(doc)  # decode validates the manifest + resolves keys
         try:
-            client.apply(obj.kind, obj.meta.key, doc, "kubectl", force=force)
-        except ConflictError as e:
+            # a plain Conflict is a CAS race against a concurrent writer:
+            # retry (the reference's patch handler retries internally); a
+            # FieldManagerConflict is ownership and needs --force-conflicts
+            for attempt in range(3):
+                try:
+                    client.apply(obj.kind, obj.meta.key, doc, "kubectl",
+                                 force=force)
+                    break
+                except ApplyConflictError:
+                    raise
+                except ConflictError:
+                    if attempt == 2:
+                        raise
+        except ApplyConflictError as e:
             print(f"Error: {e}\nhint: --force-conflicts transfers ownership",
                   file=sys.stderr)
+            return 1
+        except ConflictError as e:
+            print(f"Error: {e}", file=sys.stderr)
             return 1
         print(f"{obj.kind.lower()}/{obj.meta.name} "
               f"{'created' if client.last_apply_created else 'configured'}")

@@ -137,27 +137,39 @@ class PlaneBuilder:
                     continue
                 self._write_row(p, i, ni, fp)
                 dirty.append(i)
-            # GLOBAL (non-row) tables must track vocab growth too: a term
-            # interned mid-run (first pod with that affinity) dirties every
-            # row's counts above, but its key-slot mapping lives here — a
-            # stale -1 makes the kernel reject every node for that term
-            tables_changed = False
-            for ti, (_ns, _sel, ki) in enumerate(self.vocabs.ipa_term_matchers):
-                if p.ipa_term_key[ti] != ki:
-                    p.ipa_term_key[ti] = ki
-                    tables_changed = True
-            self.dirty_rows = dirty
-            if dirty or tables_changed:
-                self._version += 1
-                p.version = self._version
-        # _write_row may have interned new *values* (e.g. topology domains)
-        # mid-pass; restamp the row cache with the post-write fingerprint so
-        # the next sync doesn't see a spurious mismatch and rewrite every row.
-        # Row content is invariant to value-vocab growth (ids are append-only;
-        # shape-affecting growth changes bucket sizes and forces a rebuild).
+            self._finish_row_sync(p, dirty)
+        self._stamp_sync(snapshot, p, fp)
+        return p
+
+    def _finish_row_sync(self, p: Planes, dirty: list[int]) -> None:
+        """Shared tail of both sync paths: refresh GLOBAL (non-row) tables
+        — a term interned mid-run (first pod with that affinity) dirties
+        every row's counts, but its key-slot mapping lives here; a stale -1
+        makes the kernel reject every node for that term — then record the
+        dirty rows and bump the version when anything moved."""
+        tables_changed = False
+        for ti, (_ns, _sel, ki) in enumerate(self.vocabs.ipa_term_matchers):
+            if p.ipa_term_key[ti] != ki:
+                p.ipa_term_key[ti] = ki
+                tables_changed = True
+        self.dirty_rows = dirty
+        if dirty or tables_changed:
+            self._version += 1
+            p.version = self._version
+
+    def _stamp_sync(self, snapshot, p: Planes, fp: tuple) -> None:
+        """Shared tail of both sync paths: _write_row may have interned new
+        *values* (e.g. topology domains) mid-pass; restamp the row cache
+        with the post-write fingerprint so the next sync doesn't see a
+        spurious mismatch and rewrite every row. Row content is invariant
+        to value-vocab growth (ids are append-only; shape-affecting growth
+        changes bucket sizes and forces a rebuild). Records the fast-path
+        key for the next sync."""
         fp2 = _canonical_fingerprint(self.vocabs, self.names)
         if fp2 != fp:
-            self._row_cache = {nm: (gen, fp2) for nm, (gen, _) in self._row_cache.items()}
+            self._row_cache = {
+                nm: (gen, fp2) for nm, (gen, _) in self._row_cache.items()
+            }
         self._planes = p
         self._last_sync = (
             getattr(snapshot, "uid", None),
@@ -165,7 +177,6 @@ class PlaneBuilder:
             getattr(snapshot, "membership_version", None),
             fp2,
         )
-        return p
 
     def _fast_sync(self, snapshot):
         """O(changed) sync via the snapshot's change feed: when this builder
@@ -207,23 +218,8 @@ class PlaneBuilder:
                 continue
             self._write_row(p, i, ni, fp)
             dirty.append(i)
-        tables_changed = False
-        for ti, (_ns, _sel, ki) in enumerate(self.vocabs.ipa_term_matchers):
-            if p.ipa_term_key[ti] != ki:
-                p.ipa_term_key[ti] = ki
-                tables_changed = True
-        self.dirty_rows = dirty
-        if dirty or tables_changed:
-            self._version += 1
-            p.version = self._version
-        # _write_row may intern new values mid-pass (fingerprint drift):
-        # restamp exactly as the full path does
-        fp2 = _canonical_fingerprint(self.vocabs, self.names)
-        if fp2 != fp:
-            self._row_cache = {
-                nm: (gen, fp2) for nm, (gen, _) in self._row_cache.items()
-            }
-        self._last_sync = (snapshot.uid, sv, snapshot.membership_version, fp2)
+        self._finish_row_sync(p, dirty)
+        self._stamp_sync(snapshot, p, fp)
         return p
 
     def topo_domains(self, planes: Planes) -> tuple[int, ...]:

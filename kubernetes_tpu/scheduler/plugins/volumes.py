@@ -178,6 +178,75 @@ class VolumeBinder:
             for pvc in claims.unbound_delayed
         }
 
+    def node_neutral_volumes(self, pod: Pod) -> PodVolumes | None:
+        """The pod's volume decision when it provably CANNOT depend on the
+        node — the batched wave's eligibility check (the wave kernel can't
+        run per-node host plugins, so only pods whose entire volume stage
+        is node-invariant may ride it). Returns None whenever any volume
+        plugin would need per-node evaluation or the decision would fail
+        (the hybrid path then produces the right status):
+
+        - bound claims: PV must exist, carry no node affinity, no zone
+          labels (VolumeZone), no CSI driver (NodeVolumeLimits)
+        - no ReadWriteOncePod access modes anywhere (VolumeRestrictions)
+        - each unbound WFFC claim's FIRST matching available candidate must
+          be unpinned/zone-free/non-CSI — then every node chooses that same
+          volume, so Filter passes everywhere and Score is a constant shift
+          that cannot move the argmax or its tie set — or there must be a
+          provisionable class (provisioning pins the new PV only AFTER node
+          selection)."""
+        claims, err = self.get_claims(pod)
+        if err is not None or claims is None:
+            return None
+        volumes = PodVolumes()
+        for pvc in claims.bound:
+            if READ_WRITE_ONCE_POD in pvc.spec.access_modes:
+                return None
+            pv = self.store.try_get("PersistentVolume", pvc.spec.volume_name)
+            if (pv is None or pv.spec.node_affinity is not None
+                    or pv.spec.csi_driver
+                    or any(k in pv.meta.labels for k in ZONE_LABELS)):
+                return None
+        pv_list = None
+        taken: set[str] = set()
+        for pvc in claims.unbound_delayed:
+            if READ_WRITE_ONCE_POD in pvc.spec.access_modes:
+                return None
+            if pv_list is None:
+                pv_list = self.list_candidate_pvs()
+            chosen = None
+            for pv in pv_list:
+                if pv.meta.key in taken:
+                    continue
+                if not self._pv_available(pv, pvc):
+                    continue
+                if pv.spec.storage_class_name != pvc.spec.storage_class_name:
+                    continue
+                if not set(pvc.spec.access_modes) <= set(pv.spec.access_modes):
+                    continue
+                if pv.storage_capacity < pvc.requested_storage:
+                    continue
+                # the first otherwise-matching candidate decides: if it is
+                # node-dependent in any way, per-node choices can diverge
+                if (pv.spec.node_affinity is not None or pv.spec.csi_driver
+                        or any(k in pv.meta.labels for k in ZONE_LABELS)):
+                    return None
+                chosen = pv
+                break
+            if chosen is not None:
+                taken.add(chosen.meta.key)
+                volumes.static_bindings.append(
+                    (chosen.meta.key, pvc.meta.key)
+                )
+                continue
+            sc = self.store.try_get(
+                "StorageClass", pvc.spec.storage_class_name
+            )
+            if sc is None or sc.provisioner == NO_PROVISIONER:
+                return None  # BIND_CONFLICT everywhere: hybrid reports it
+            volumes.dynamic_provisions.append(pvc.meta.key)
+        return volumes
+
     def find_pod_volumes(
         self,
         pod: Pod,

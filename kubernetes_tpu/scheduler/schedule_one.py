@@ -442,11 +442,15 @@ class ScheduleOneLoop:
                 self.queue.done(qpi.key)
                 continue
             algo = self.algorithms.get(fw.profile_name)
+            # ORDER MATTERS: wave_eligible has side effects for claim pods
+            # (binder assume + plan stash), so every other precondition —
+            # including the same-profile check — must pass first, or a
+            # trailer pod would leak an assumed PV with no revert path
             eligible = (
                 isinstance(algo, TPUSchedulingAlgorithm)
                 and pod.spec.scheduling_group is None
-                and algo.wave_eligible(pod)
                 and (wave_algo is None or algo is wave_algo)
+                and algo.wave_eligible(pod)
             )
             if not eligible:
                 trailer = qpi
@@ -530,6 +534,7 @@ class ScheduleOneLoop:
             algo.fallback_count += len(wave)
             t3 = _time.perf_counter()
             for qpi in wave:
+                algo.revert_wave_plan(qpi.pod)
                 self.schedule_pod_info(qpi)
             prof["finish"] += _time.perf_counter() - t3
             return processed + len(wave)
@@ -570,6 +575,7 @@ class ScheduleOneLoop:
             algo.fallback_count += len(wave)
             t1 = _time.perf_counter()
             for qpi in wave:
+                algo.revert_wave_plan(qpi.pod)
                 self.schedule_pod_info(qpi)
             prof["finish"] += _time.perf_counter() - t1
             return len(wave)
@@ -583,10 +589,25 @@ class ScheduleOneLoop:
                 # host=None re-runs reproduce the FitError (no rng draws, no
                 # state change — safe under a live successor); invalidated
                 # pods re-run because the carry diverged
+                algo.revert_wave_plan(qpi.pod)
                 self.schedule_pod_info(qpi)
                 continue
             fw = self.framework_for_pod(qpi.pod)
             state = CycleState()
+            vol_plan = algo.take_wave_plan(qpi.pod.meta.key)
+            if vol_plan is not None:
+                # node-neutral volume decision made at wave admission:
+                # seed the cycle state so Reserve/PreBind run the normal
+                # VolumeBinding flow against the selected host
+                from .plugins.volumes import (
+                    VolumeBinding,
+                    _BindingState,
+                    _ClaimsToBind,
+                )
+
+                bs = _BindingState(_ClaimsToBind())
+                bs.per_node[host] = vol_plan
+                state.write(VolumeBinding.STATE_KEY, bs)
             result = ScheduleResult(
                 suggested_host=host, evaluated_nodes=planes.n, feasible_nodes=1
             )
@@ -594,6 +615,8 @@ class ScheduleOneLoop:
                 state, fw, qpi, result, from_wave=True
             )
             if not status.is_success:
+                if vol_plan is not None:
+                    algo.safe_revert_volumes(vol_plan)
                 self._handle_scheduling_failure(
                     fw, qpi, status, self.queue.moved_count
                 )

@@ -727,6 +727,8 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         self.backend = backend
         self.fallback_count = 0
         self.kernel_count = 0
+        # pod key -> node-neutral PodVolumes assumed at wave admission
+        self._wave_plans: dict[str, object] = {}
         # the dense kernel evaluates EVERY node for free, so the kernel
         # path stays at 100%; the HYBRID path's host long-tail stage is
         # where per-node work costs, and it follows the reference's own
@@ -800,11 +802,67 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
                     and any(e.is_interested(pod) for e in self.extenders))
 
     def wave_eligible(self, pod: Pod) -> bool:
-        """Only fully-kernel pods ride the batched wave (hybrid pods need
-        per-node host plugin calls the scan can't carry)."""
-        return (not self._must_fall_back(pod)
-                and not self._needs_host_compose(pod)
-                and not self._has_relevant_nominations(pod))
+        """Fully-kernel pods ride the batched wave, and so do claim pods
+        whose volume decision is provably node-NEUTRAL (binder.
+        node_neutral_volumes): their host volume stage collapses to a
+        per-pod constant the wave finish applies after node selection.
+        Accepting such a pod aches an immediate binder assume (stashed in
+        _wave_plans) so the NEXT pod's neutrality check sees this pod's
+        chosen volume — the sequential-greedy invariant the wave carries
+        for resources, mirrored for volumes."""
+        if self._must_fall_back(pod) or self._has_relevant_nominations(pod):
+            return False
+        from ...api.storage import pod_claim_names
+        from ..plugins.node_declared_features import infer_required_features
+
+        if pod.spec.resource_claims:
+            return False
+        if infer_required_features(pod):
+            return False
+        if self.extenders and any(e.is_interested(pod)
+                                  for e in self.extenders):
+            return False
+        if pod_claim_names(pod):
+            binder = self._volume_binder()
+            if binder is None:
+                return False
+            plan = binder.node_neutral_volumes(pod)
+            if plan is None:
+                return False
+            binder.assume_pod_volumes(plan)
+            self._wave_plans[pod.meta.key] = plan
+            return True
+        return True
+
+    def _volume_binder(self):
+        from ..plugins.volumes import VolumeBinding
+
+        for p in self.fw.reserve_plugins:
+            if isinstance(p, VolumeBinding):
+                return p.binder
+        return None
+
+    def take_wave_plan(self, pod_key: str):
+        """Pop the stashed neutral volume decision (wave finish path)."""
+        return self._wave_plans.pop(pod_key, None)
+
+    def revert_wave_plan(self, pod: Pod) -> None:
+        """Release a stashed plan's binder assumes — every wave path that
+        re-runs the pod per-pod (launch fallback, poisoned carry, kernel
+        infeasible) must call this first or the assumed PV stays reserved."""
+        plan = self._wave_plans.pop(pod.meta.key, None)
+        if plan is not None:
+            self.safe_revert_volumes(plan)
+
+    def safe_revert_volumes(self, plan) -> None:
+        """Revert only assumes that still belong to this plan's claims — a
+        later pod may have legitimately re-assumed the same PV."""
+        binder = self._volume_binder()
+        if binder is None:
+            return
+        for pv_key, pvc_key in plan.static_bindings:
+            if binder.assumed.get(pv_key) == pvc_key:
+                binder.assumed.pop(pv_key, None)
 
     def _has_relevant_nominations(self, pod: Pod) -> bool:
         """Any nominated pod (≥ priority) that must be simulated during

@@ -124,6 +124,64 @@ class TestVolumeBinding:
         assert bound[0].spec.volume_name == "only-pv"
 
 
+class TestVolumeNeutralWave:
+    def test_unpinned_wffc_pods_ride_the_wave(self):
+        """Claim pods whose volume decision is node-neutral (unpinned PVs)
+        go through the batched wave kernel, not the per-pod hybrid path —
+        and their claims still come out bound."""
+        store = Store()
+        for i in range(8):
+            store.create(make_node(f"n{i}"))
+        store.create(make_storage_class("wffc", wait_for_first_consumer=True))
+        for i in range(6):
+            store.create(make_pv(f"pv{i}", storage="10Gi",
+                                 storage_class="wffc"))
+            store.create(make_pvc(f"c{i}", storage="5Gi",
+                                  storage_class="wffc"))
+            store.create(with_pvc(make_pod(f"p{i}", cpu="100m"), f"c{i}"))
+        from kubernetes_tpu.scheduler import Profile
+
+        s = new_scheduler(store, profiles=[Profile(backend="tpu",
+                                                   wave_size=8)])
+        algo = s.algorithms["default-scheduler"]
+        assert s.schedule_pending() == 6
+        assert algo.kernel_count == 6 and algo.fallback_count == 0
+        for i in range(6):
+            pvc = store.get("PersistentVolumeClaim", f"default/c{i}")
+            assert pvc.status.phase == CLAIM_BOUND
+            assert store.get("Pod", f"default/p{i}").spec.node_name
+        # distinct pods chose distinct volumes (sequential assume carried)
+        bound_pvs = {
+            store.get("PersistentVolumeClaim", f"default/c{i}")
+            .spec.volume_name for i in range(6)
+        }
+        assert len(bound_pvs) == 6
+        assert algo._wave_plans == {}  # no leaked stashes
+
+    def test_pinned_pv_pods_stay_on_hybrid_path(self):
+        """A node-pinned (local) PV makes the volume stage node-dependent:
+        the pod must NOT be wave-batched, and must still land on the PV's
+        node."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_node("n2"))
+        store.create(make_storage_class("local", wait_for_first_consumer=True))
+        store.create(make_pv("pv-n2", storage="10Gi", storage_class="local",
+                             node_names=("n2",)))
+        store.create(make_pvc("data", storage="5Gi", storage_class="local"))
+        store.create(with_pvc(make_pod("p1", cpu="100m"), "data"))
+        from kubernetes_tpu.scheduler import Profile
+
+        s = new_scheduler(store, profiles=[Profile(backend="tpu",
+                                                   wave_size=8)])
+        algo = s.algorithms["default-scheduler"]
+        assert not algo.wave_eligible(
+            store.get("Pod", "default/p1")
+        )
+        assert s.schedule_pending() == 1
+        assert node_of(store, "p1") == "n2"
+
+
 class TestVolumeZone:
     def test_zone_conflict_filters_node(self):
         store = Store()
