@@ -186,24 +186,125 @@ class DefaultPreemption(Plugin):
 
     # -- victim search -------------------------------------------------------
 
+    def _fit_plugin(self):
+        from .node_resources import NodeResourcesFit
+
+        for p in self.handle.framework.filter_plugins:
+            if isinstance(p, NodeResourcesFit):
+                return p
+        return None
+
+    @staticmethod
+    def _fits_resources(fitp, req, node_info: NodeInfo, used: list[int],
+                        pod_count: int) -> bool:
+        """NodeResourcesFit.filter's exact arithmetic against an overridden
+        usage vector (fit.go:673-760) — the reprieve loop's only possible
+        failure mode when nothing but resources can be affected."""
+        from ...api.resource import PODS
+
+        alloc = node_info.allocatable
+        if pod_count + 1 > alloc[PODS]:
+            return False
+        width = len(used)
+        for i in range(width):
+            r = req[i]
+            if r == 0 or i == PODS:
+                continue
+            rname = (fitp.names.names[i] if i < fitp.names.width
+                     else f"res{i}")
+            if rname in fitp.ignored:
+                continue
+            if r > alloc[i] - used[i]:
+                return False
+        return True
+
+    @staticmethod
+    def _resource_only(pod: Pod, node_info: NodeInfo) -> bool:
+        """True when re-ADDING a victim can only break NodeResourcesFit:
+        the preemptor carries no inter-pod (anti)affinity, host ports,
+        hard spread constraints, or claims, and no pod on the node carries
+        required anti-affinity (a reprieved victim's anti term could
+        otherwise reject the preemptor). Static plugins (taints/affinity/
+        name/unschedulable) are victim-independent and already vetted by
+        the full-chain maximal-removal check."""
+        from ...api.storage import pod_claim_names
+
+        aff = pod.spec.affinity
+        if aff is not None and (aff.pod_affinity is not None
+                                or aff.pod_anti_affinity is not None):
+            return False
+        if node_info.pods_with_required_anti_affinity:
+            return False
+        if any(p.host_port > 0 for c in pod.spec.containers
+               for p in c.ports):
+            return False
+        if any(c.when_unsatisfiable == "DoNotSchedule"
+               for c in pod.spec.topology_spread_constraints):
+            return False
+        if pod_claim_names(pod) or pod.spec.resource_claims:
+            return False
+        from .node_declared_features import infer_required_features
+
+        # NodeDeclaredFeatures sits BEFORE NodeResourcesFit in the host
+        # chain but has no kernel row — a kernel NodeResourcesFit verdict
+        # cannot prove it passed, so a features-requiring pod must take
+        # the full-chain path
+        if infer_required_features(pod):
+            return False
+        return True
+
     def _select_victims_on_node(self, state, pod: Pod, node_info: NodeInfo,
-                                pdbs: list):
+                                pdbs: list, status_plugin: str = ""):
         """SelectVictimsOnNode (default_preemption.go:207): remove all lower-
         priority pods, check fit, then reprieve as many as possible — PDB-
         violating victims first, then the rest, highest priority first.
-        Returns (victims, num_pdb_violations) or None."""
+        Returns (victims, num_pdb_violations) or None.
+
+        HOT LOOP #3 (preemption.go:408 DryRunPreemption) treatment:
+        - a resource necessary-condition check runs BEFORE the node clone +
+          full filter chain (maximal removal is the best case — if
+          resources still don't fit, nothing can succeed);
+        - when re-adding a victim can only move resources
+          (_resource_only), the reprieve loop runs NodeResourcesFit's
+          arithmetic instead of the full framework chain per victim;
+        - and when additionally the node's failure verdict came from
+          NodeResourcesFit itself, the maximal-removal full-chain check is
+          skipped too — the kernel reports the FIRST failing filter row,
+          NodeResourcesFit sits after every row that could apply to this
+          pod (_resource_only rules out ports/spread/IPA/features), so
+          that verdict proves all static filters pass."""
         fw = self.handle.framework
-        ni = node_info.clone()
-        state = state.clone()
-        lower = [pi for pi in ni.iter_pods()
+        lower = [pi for pi in node_info.iter_pods()
                  if pi.pod.spec.priority < pod.spec.priority]
         if not lower:
             return None
-        for pi in lower:
-            ni.remove_pod(pi.key)
-            fw.run_pre_filter_extension_remove_pod(state, pod, pi, ni)
-        if not fw.run_filter_plugins(state, pod, ni).is_success:
-            return None  # even with all victims gone the pod doesn't fit
+        fitp = self._fit_plugin()
+        req = used = None
+        resource_only = False
+        if fitp is not None:
+            req = fitp._pod_info(state, pod).request
+            width = max(len(req.v), len(node_info.allocatable.v))
+            used = [node_info.requested[i] for i in range(width)]
+            for pi in lower:
+                for i in range(width):
+                    used[i] -= pi.request[i]
+            if not self._fits_resources(
+                fitp, req, node_info, used,
+                len(node_info.pods) - len(lower),
+            ):
+                return None  # necessary condition: skip the clone + chain
+            resource_only = self._resource_only(pod, node_info)
+        if not (resource_only and status_plugin == fitp.name):
+            # static filters not yet proven: run the maximal-removal full
+            # chain on a clone (also the reprieve vehicle when plugins
+            # beyond NodeResourcesFit can be affected)
+            ni = node_info.clone()
+            state = state.clone()
+            for pi in lower:
+                ni.remove_pod(pi.key)
+                fw.run_pre_filter_extension_remove_pod(state, pod, pi, ni)
+            if not fw.run_filter_plugins(state, pod, ni).is_success:
+                return None  # even with all victims gone: no fit
         # MoreImportantPod order: priority desc, then earlier start
         lower.sort(key=lambda pi: (-pi.pod.spec.priority,
                                    pi.pod.meta.creation_timestamp))
@@ -211,15 +312,29 @@ class DefaultPreemption(Plugin):
         victims: list[PodInfo] = []
         num_violations = 0
 
-        def reprieve(pi: PodInfo) -> bool:
-            ni.add_pod(pi)
-            fw.run_pre_filter_extension_add_pod(state, pod, pi, ni)
-            if fw.run_filter_plugins(state, pod, ni).is_success:
-                return True
-            ni.remove_pod(pi.key)
-            fw.run_pre_filter_extension_remove_pod(state, pod, pi, ni)
-            victims.append(pi)
-            return False
+        if resource_only:
+            kept = [len(node_info.pods) - len(lower)]
+
+            def reprieve(pi: PodInfo) -> bool:
+                trial = [u + pi.request[i] for i, u in enumerate(used)]
+                # +1 for the preemptor itself, on top of kept pods
+                if self._fits_resources(fitp, req, node_info, trial,
+                                        kept[0] + 1):
+                    used[:] = trial
+                    kept[0] += 1
+                    return True
+                victims.append(pi)
+                return False
+        else:
+            def reprieve(pi: PodInfo) -> bool:
+                ni.add_pod(pi)
+                fw.run_pre_filter_extension_add_pod(state, pod, pi, ni)
+                if fw.run_filter_plugins(state, pod, ni).is_success:
+                    return True
+                ni.remove_pod(pi.key)
+                fw.run_pre_filter_extension_remove_pod(state, pod, pi, ni)
+                victims.append(pi)
+                return False
 
         for pi in violating:
             if not reprieve(pi):
@@ -282,7 +397,10 @@ class DefaultPreemption(Plugin):
             scanned += 1
             if node_to_status.get(ni.name).code != UNSCHEDULABLE:
                 continue  # UnschedulableAndUnresolvable can't be fixed by eviction
-            found = self._select_victims_on_node(state, pod, ni, pdbs)
+            found = self._select_victims_on_node(
+                state, pod, ni, pdbs,
+                status_plugin=node_to_status.get(ni.name).plugin,
+            )
             if found is not None:
                 victims, violations = found
                 candidates.append(_Candidate(ni.name, victims, violations))

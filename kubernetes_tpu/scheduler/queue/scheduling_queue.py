@@ -91,11 +91,18 @@ class SchedulingQueue:
         pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
         pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
         pod_max_in_unschedulable_pods: float = DEFAULT_MAX_IN_UNSCHEDULABLE_PODS,
+        pop_from_backoff: bool = True,
     ):
         self._clock = clock or Clock()
         self._mu = threading.Condition()
         self._active = KeyedHeap[QueuedPodInfo](lambda q: q.key, less_fn)
         self._backoff = KeyedHeap[QueuedPodInfo](
+            lambda q: q.key, lambda a, b: a.backoff_expiry < b.backoff_expiry
+        )
+        # error backoffs live in their OWN heap (backoff_queue.go
+        # podErrorBackoffQ): pop-from-backoff must never short-circuit an
+        # error backoff — it exists to protect the apiserver
+        self._error_backoff = KeyedHeap[QueuedPodInfo](
             lambda q: q.key, lambda a, b: a.backoff_expiry < b.backoff_expiry
         )
         self._unschedulable: dict[str, QueuedPodInfo] = {}
@@ -104,6 +111,11 @@ class SchedulingQueue:
         self._hint_map = queueing_hint_map or {}
         self._initial_backoff = pod_initial_backoff
         self._max_backoff = pod_max_backoff
+        # SchedulerPopFromBackoffQ (kube_features.go:913, default on since
+        # 1.33): an idle scheduler pops the earliest-expiry backoff pod
+        # instead of sleeping out the window — retries (nominated
+        # preemptors especially) stop paying whole backoff windows
+        self._pop_from_backoff = pop_from_backoff
         self._max_unschedulable_duration = pod_max_in_unschedulable_pods
         # in-flight tracking
         self._event_seq = itertools.count(1)
@@ -155,7 +167,9 @@ class SchedulingQueue:
             count = qpi.consecutive_errors_count
         if count == 0:
             return 0.0
-        duration = self._initial_backoff * (2 ** (count - 1))
+        # cap the exponent before floating: a long failure streak must
+        # saturate at max backoff, not overflow
+        duration = self._initial_backoff * (2 ** min(count - 1, 40))
         return min(duration, self._max_backoff)
 
     def _move_to_active_or_backoff_locked(self, qpi: QueuedPodInfo, event_label: str) -> None:
@@ -170,7 +184,10 @@ class SchedulingQueue:
         expiry = self._align_to_window(qpi.timestamp + duration)
         if duration > 0 and expiry > now:
             qpi.backoff_expiry = expiry
-            self._backoff.add(qpi)
+            if qpi.consecutive_errors_count > 0:
+                self._error_backoff.add(qpi)
+            else:
+                self._backoff.add(qpi)
         else:
             self._active.add(qpi)
             self._mu.notify()
@@ -194,7 +211,7 @@ class SchedulingQueue:
         re-evaluated through PreEnqueue (scheduling_queue.go Update)."""
         with self._mu:
             key = new_pod.meta.key
-            for heap in (self._active, self._backoff):
+            for heap in (self._active, self._backoff, self._error_backoff):
                 qpi = heap.get(key)
                 if qpi is not None:
                     qpi.pod_info.pod = new_pod
@@ -216,22 +233,31 @@ class SchedulingQueue:
             key = pod.meta.key
             self._active.delete(key)
             self._backoff.delete(key)
+            self._error_backoff.delete(key)
             self._unschedulable.pop(key, None)
             self._nominated.pop(key, None)
 
     def pop(self, timeout: float | None = None) -> QueuedPodInfo | None:
         with self._mu:
             self._flush_backoff_locked()
-            while len(self._active) == 0 and not self._closed:
+            while (len(self._active) == 0 and not self._closed
+                   and not (self._pop_from_backoff and len(self._backoff))):
                 if not self._mu.wait(timeout=timeout if timeout is not None else 0.1):
                     if timeout is not None:
                         return None
                 self._flush_backoff_locked()
-                if timeout is not None and len(self._active) == 0:
+                if (timeout is not None and len(self._active) == 0
+                        and not (self._pop_from_backoff
+                                 and len(self._backoff))):
                     return None
             if self._closed:
                 return None
-            qpi = self._active.pop()
+            if len(self._active):
+                qpi = self._active.pop()
+            else:
+                # activeQ drained: pop the earliest-expiry backoff pod
+                # early (backoff_queue.go popBackoffQ semantics)
+                qpi = self._backoff.pop()
             qpi.attempts += 1
             # each attempt reports its OWN rejectors (the reference replaces
             # UnschedulablePlugins per failure, never accumulates): a stale
@@ -249,7 +275,8 @@ class SchedulingQueue:
         """Remove a specific pod from whichever tier holds it (gang popping,
         scheduling_queue.go PopSpecificPod:1017)."""
         with self._mu:
-            qpi = self._active.delete(key) or self._backoff.delete(key)
+            qpi = (self._active.delete(key) or self._backoff.delete(key)
+                   or self._error_backoff.delete(key))
             if qpi is None:
                 qpi = self._unschedulable.pop(key, None)
             if qpi is None:
@@ -372,7 +399,9 @@ class SchedulingQueue:
         with self._mu:
             for pod in pods:
                 key = pod.meta.key
-                qpi = self._unschedulable.pop(key, None) or self._backoff.delete(key)
+                qpi = (self._unschedulable.pop(key, None)
+                       or self._backoff.delete(key)
+                       or self._error_backoff.delete(key))
                 if qpi is None:
                     continue
                 qpi.timestamp = self._clock.now()
@@ -381,12 +410,13 @@ class SchedulingQueue:
 
     def _flush_backoff_locked(self) -> None:
         now = self._clock.now()
-        while True:
-            head = self._backoff.peek()
-            if head is None or head.backoff_expiry > now:
-                break
-            self._active.add(self._backoff.pop())
-            self._mu.notify()
+        for heap in (self._backoff, self._error_backoff):
+            while True:
+                head = heap.peek()
+                if head is None or head.backoff_expiry > now:
+                    break
+                self._active.add(heap.pop())
+                self._mu.notify()
 
     def flush_unschedulable_leftover(self) -> None:
         """Pods parked longer than podMaxInUnschedulablePodsDuration re-enter
@@ -454,11 +484,15 @@ class SchedulingQueue:
 
     def pending_pods(self) -> tuple[int, int, int]:
         with self._mu:
-            return len(self._active), len(self._backoff), len(self._unschedulable)
+            return (len(self._active),
+                    len(self._backoff) + len(self._error_backoff),
+                    len(self._unschedulable))
 
     def has_pod(self, key: str) -> bool:
         with self._mu:
-            return key in self._active or key in self._backoff or key in self._unschedulable
+            return (key in self._active or key in self._backoff
+                    or key in self._error_backoff
+                    or key in self._unschedulable)
 
     def close(self) -> None:
         with self._mu:

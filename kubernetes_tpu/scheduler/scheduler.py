@@ -162,6 +162,9 @@ class Scheduler:
             clock=self.clock,
             pre_enqueue_plugins=pre_enqueue,
             queueing_hint_map=hint_map,
+            pop_from_backoff=self.feature_gates.get(
+                "SchedulerPopFromBackoffQ", True
+            ),
         )
         # OpportunisticBatching (KEP-5598, alpha -> default off as in the
         # reference): one shared batch cache; flushed on node-shape events

@@ -741,9 +741,19 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
     def schedule_pod(self, state, pod: Pod, snapshot) -> ScheduleResult:
         if snapshot.num_nodes() == 0:
             raise FitError(pod, 0, Diagnosis())
-        if self._must_fall_back(pod):
-            self.fallback_count += 1
-            return super().schedule_pod(state, pod, snapshot)
+        pre_filter_done = None
+        if pod.status.nominated_node_name:
+            # evaluateNominatedNode fast path (schedule_one.go:718): try
+            # the nominee host-side (ONE node); when it no longer fits,
+            # fall through to the normal kernel/hybrid cycle — exactly how
+            # the host path continues its scan, but without paying a full
+            # per-node host chain over the whole cluster
+            res, pre_filter_done = self._evaluate_nominated(
+                state, pod, snapshot
+            )
+            if res is not None:
+                self.fallback_count += 1  # host-path decision
+                return res
         hybrid = (self._needs_host_compose(pod)
                   or self._has_relevant_nominations(pod))
         try:
@@ -753,7 +763,8 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
             return super().schedule_pod(state, pod, snapshot)
         self.kernel_count += 1
         if hybrid:
-            return self._schedule_hybrid(state, pod, snapshot, planes, out)
+            return self._schedule_hybrid(state, pod, snapshot, planes, out,
+                                         pre_filter_done=pre_filter_done)
 
         feasible_idx = np.flatnonzero(out["feasible"][: planes.n])
         if feasible_idx.size == 0:
@@ -876,7 +887,7 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         return getattr(self.nominator, "has_nominated_pods", lambda: False)()
 
     def _schedule_hybrid(self, state, pod: Pod, snapshot, planes,
-                         out) -> ScheduleResult:
+                         out, pre_filter_done=None) -> ScheduleResult:
         """Kernel feasibility/scores ∩ host long-tail plugins.
 
         The kernel already filtered+scored the dense plugins over every
@@ -888,7 +899,11 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         through the same select_host rng draw."""
         fw = self.fw
         nodes = snapshot.list_nodes()
-        pre_result, st = fw.run_pre_filter_plugins(state, pod, nodes)
+        if pre_filter_done is not None:
+            # PreFilter already ran this cycle (nominee fast path)
+            pre_result, st = pre_filter_done
+        else:
+            pre_result, st = fw.run_pre_filter_plugins(state, pod, nodes)
         if not st.is_success:
             if st.is_rejected:
                 d = Diagnosis()
@@ -1058,9 +1073,34 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         )
 
     def _must_fall_back(self, pod: Pod) -> bool:
-        # a preemptor revisiting its own nomination takes the host path:
-        # evaluateNominatedNode's nominee-first fast path (schedule_one.go:
-        # 718) is host logic. Everything else — including OTHER pods while
-        # nominations exist — runs kernel or hybrid (nominated nodes get
-        # the host two-pass treatment inside the hybrid survivor loop).
+        # a preemptor revisiting its own nomination is handled per-pod
+        # (nominee-first in schedule_pod), never batched in a wave.
+        # Everything else — including OTHER pods while nominations exist —
+        # runs kernel or hybrid (nominated nodes get the host two-pass
+        # treatment inside the hybrid survivor loop).
         return bool(pod.status.nominated_node_name)
+
+    def _evaluate_nominated(self, state, pod: Pod, snapshot):
+        """Host-side nominee check. Returns (result, pre_filter_done):
+        result is a ScheduleResult when the nominee still fits, else None;
+        pre_filter_done is the (pre_result, status) pair from the PreFilter
+        pass so the hybrid continuation doesn't recompute the most
+        expensive host stage for exactly the pods this fast path serves."""
+        ni = snapshot.get(pod.status.nominated_node_name)
+        if ni is None:
+            return None, None
+        pre_done = self.fw.run_pre_filter_plugins(
+            state, pod, snapshot.list_nodes()
+        )
+        pre_result, st = pre_done
+        if not st.is_success:
+            return None, pre_done  # the main cycle diagnoses this
+        if (pre_result is not None and pre_result.node_names is not None
+                and ni.name not in pre_result.node_names):
+            return None, pre_done
+        diagnosis = Diagnosis()
+        if self._filter_one(state, pod, ni, diagnosis):
+            return ScheduleResult(
+                suggested_host=ni.name, evaluated_nodes=1, feasible_nodes=1
+            ), pre_done
+        return None, pre_done

@@ -16,7 +16,10 @@ def _setup(n_nodes=20, cpu="4", wave=16):
     store = Store()
     for i in range(n_nodes):
         store.create(make_node(f"n{i}", cpu=cpu, mem="16Gi", zone=f"z{i % 4}"))
-    sched = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=wave)])
+    # pop-from-backoff off: these tests observe the PARKED nominated state
+    # between scheduling rounds, which the accelerated retry would clear
+    sched = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=wave)],
+                      feature_gates={"SchedulerPopFromBackoffQ": False})
     sched.start()
     return store, sched
 

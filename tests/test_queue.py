@@ -89,6 +89,64 @@ class TestQueueBasics:
         assert q.pop(timeout=0.01) is None
 
 
+class TestPopFromBackoffQ:
+    def test_idle_pop_short_circuits_unschedulable_backoff(self):
+        """SchedulerPopFromBackoffQ (default on since 1.33): an empty
+        activeQ pops the earliest-expiry backoff pod early instead of
+        sleeping out the window."""
+        clock = FakeClock()
+        q = new_queue(clock)
+        qadd(q, make_pod("p"))
+        qpi = q.pop()
+        qpi.unschedulable_plugins = {"NodeResourcesFit"}
+        q.add_unschedulable_if_not_present(qpi, q.moved_count)
+        q.move_all_to_active_or_backoff(ClusterEvent(ev.WILDCARD, ev.ALL))
+        # backoff not expired, activeQ empty -> early pop
+        got = q.pop(timeout=0.01)
+        assert got is not None and got.key == "default/p"
+
+    def test_active_pods_win_over_backoff_pops(self):
+        clock = FakeClock()
+        q = new_queue(clock)
+        qadd(q, make_pod("backing"))
+        qpi = q.pop()
+        qpi.unschedulable_plugins = {"NodeResourcesFit"}
+        q.add_unschedulable_if_not_present(qpi, q.moved_count)
+        q.move_all_to_active_or_backoff(ClusterEvent(ev.WILDCARD, ev.ALL))
+        qadd(q, make_pod("fresh"))
+        got = q.pop(timeout=0.01)
+        assert got is not None and got.key == "default/fresh"
+
+    def test_error_backoff_is_never_short_circuited(self):
+        """backoff_queue.go podErrorBackoffQ: error backoffs protect the
+        apiserver — an idle pop must NOT bypass them (a hot retry loop on
+        persistent errors would hammer the control plane)."""
+        clock = FakeClock()
+        q = new_queue(clock)
+        qadd(q, make_pod("p"))
+        qpi = q.pop()
+        qpi.unschedulable_plugins = set()  # no rejector = error
+        q.add_unschedulable_if_not_present(qpi, q.moved_count)
+        q.move_all_to_active_or_backoff(ClusterEvent(ev.WILDCARD, ev.ALL))
+        assert q.pop(timeout=0.01) is None
+        clock.step(1.05)
+        got = q.pop(timeout=0.01)
+        assert got is not None and got.key == "default/p"
+
+    def test_gate_off_restores_window_semantics(self):
+        clock = FakeClock()
+        q = SchedulingQueue(priority_less, clock=clock,
+                            pop_from_backoff=False)
+        qadd(q, make_pod("p"))
+        qpi = q.pop()
+        qpi.unschedulable_plugins = {"NodeResourcesFit"}
+        q.add_unschedulable_if_not_present(qpi, q.moved_count)
+        q.move_all_to_active_or_backoff(ClusterEvent(ev.WILDCARD, ev.ALL))
+        assert q.pop(timeout=0.01) is None
+        clock.step(1.05)
+        assert q.pop(timeout=0.01) is not None
+
+
 class TestUnschedulableFlow:
     def test_failed_pod_parks_then_event_requeues(self):
         clock = FakeClock()
