@@ -1,0 +1,117 @@
+"""Sharded-wave throughput on a multi-device mesh (CPU-mesh evidence).
+
+Measures the ICI-sharded scheduling kernel (SURVEY §2.9 item 1: the
+pods×nodes feasibility/score program partitioned over the nodes axis, with
+the scan-carried batched assignment) at a scale where sharding matters —
+1024 nodes over 8 devices (128 bucket rows per shard), streaming 512-pod
+waves — and prints ONE JSON line with the steady-state sharded wave
+throughput plus the single-device number for the same program.
+
+On a multi-chip TPU the same `scheduler_mesh` program runs over ICI; this
+bench provisions virtual CPU devices (the driver-validated
+`xla_force_host_platform_device_count` path) so the partitioned collectives
+are exercised for real, even when only one physical chip is attached.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+N_DEVICES = 8
+N_NODES = 1024
+WAVE = 512
+ROUNDS = 4
+
+
+def main() -> None:
+    base = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, base)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={N_DEVICES}"
+        ).strip()
+    from __graft_entry__ import _ensure_devices
+
+    _ensure_devices(N_DEVICES)
+    import jax
+
+    from kubernetes_tpu.api.resource import ResourceNames
+    from kubernetes_tpu.ops import stack_features
+    from kubernetes_tpu.ops.kernels import batched_assign
+    from kubernetes_tpu.parallel import (
+        scheduler_mesh,
+        shard_planes,
+        sharded_batched_assign,
+    )
+    from kubernetes_tpu.scheduler.tpu.backend import TPUBackend
+    from kubernetes_tpu.testing import make_pod, synthetic_cluster, with_spread
+    from kubernetes_tpu.utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    names = ResourceNames()
+    _, snapshot = synthetic_cluster(N_NODES, n_zones=8, init_pods_per_node=1,
+                                    names=names)
+    backend = TPUBackend(names)
+    pods = []
+    for i in range(WAVE):
+        p = make_pod(f"w{i}", cpu=f"{1 + i % 2}", mem="1Gi",
+                     labels={"app": f"g{i % 4}"})
+        p = with_spread(p, max_skew=4, key="topology.kubernetes.io/zone",
+                        when="DoNotSchedule")
+        pods.append(p)
+    for p in pods:
+        backend.extractor.register(p)
+    planes = backend.builder.sync(snapshot)
+    cfg = backend.kernel_config(planes)
+    inputs = {**planes.as_dict(), **backend.extractor.affinity_tables(planes)}
+    stacked = stack_features(
+        [backend.extractor.features(p, planes) for p in pods]
+    )
+    mesh = scheduler_mesh(n_devices=N_DEVICES, wave=2)
+    dev = shard_planes(mesh, inputs)
+
+    def run_sharded():
+        w, st = sharded_batched_assign(cfg, mesh, dev, stacked)
+        jax.block_until_ready(w)
+        return w
+
+    def run_single():
+        w, st = batched_assign(cfg, inputs, stacked)
+        jax.block_until_ready(w)
+        return w
+
+    run_sharded()  # compile
+    run_single()
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        w = run_sharded()
+    sharded_s = (time.perf_counter() - t0) / ROUNDS
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        run_single()
+    single_s = (time.perf_counter() - t0) / ROUNDS
+    import numpy as np
+
+    placed = int((np.asarray(w) >= 0).sum())
+    print(json.dumps({
+        "metric": "sharded_wave_assign_throughput_1k_nodes",
+        "value": round(WAVE / sharded_s, 1),
+        "unit": "pods/s (kernel only)",
+        "devices": N_DEVICES,
+        "nodes": N_NODES,
+        "wave": WAVE,
+        "placed": placed,
+        "single_device_pods_per_s": round(WAVE / single_s, 1),
+        "sharded_vs_single": round(single_s / sharded_s, 2),
+        "device": "cpu-mesh",
+    }))
+
+
+if __name__ == "__main__":
+    main()

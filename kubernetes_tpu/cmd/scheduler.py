@@ -56,6 +56,23 @@ class SchedulerServer:
             parallelism=config.parallelism,
             extenders=config.extenders,
         )
+        # SIGUSR2 → cache dump + cache/store comparison (the reference's
+        # backend/cache/debugger wiring)
+        from ..scheduler.cache.debugger import CacheDebugger
+
+        backend = next(
+            (b for algo in self.scheduler.algorithms.values()
+             if (b := getattr(algo, "backend", None)) is not None),
+            None,
+        )
+        self.debugger = CacheDebugger(
+            self.scheduler.cache, self.scheduler.queue, store,
+            backend=backend,
+        )
+        try:
+            self.debugger.install()
+        except ValueError:
+            pass  # not the main thread (tests): on-demand calls still work
         self.elector = None
         if config.leader_election.leader_elect:
             from ..client.leaderelection import LeaderElector

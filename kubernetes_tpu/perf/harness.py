@@ -404,6 +404,10 @@ class WorkloadExecutor:
         n = self._count(op)
         size = int(_resolve(op.get("podsPerGroup", 2), self.params))
         template = op.get("podTemplate", self.pod_template)
+        if op.get("collectMetrics") and not self._collecting:
+            self._start_collecting()
+        if op.get("collectMetrics"):
+            self._measured += n * size
         for g in range(n):
             name = f"group-{g}-{self._pod_seq}"
             self.store.create(

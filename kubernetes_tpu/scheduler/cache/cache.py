@@ -142,6 +142,10 @@ class Cache:
                 return
             self._remove_pod_locked(key)
 
+    def is_assumed_key(self, key: str) -> bool:
+        with self._mu:
+            return key in self._assumed_pods
+
     def is_assumed_pod(self, pod: Pod) -> bool:
         with self._mu:
             return pod.meta.key in self._assumed_pods

@@ -46,6 +46,15 @@ class _ClaimState:
     claims: list[ResourceClaim] = field(default_factory=list)
     base_taken: set = field(default_factory=set)  # (driver, pool, device)
     slices: list = field(default_factory=list)
+    # slice-order-preserving inventory, split once per cycle: entries are
+    # (slice_idx, driver, pool, device) so a per-node merge reproduces the
+    # exact candidate order a full slice walk would produce
+    inv_global: list = field(default_factory=list)
+    inv_by_node: dict = field(default_factory=dict)
+    # claim key -> [(driver, selectors)] resolved once (DeviceClass lookups
+    # are node-independent; re-resolving per node deepcopied the class per
+    # (pod, node) at 500-node scale)
+    requirements: dict = field(default_factory=dict)
     needs_allocation: bool = False
     # node name -> {claim key -> AllocationResult} computed by Filter
     allocations_per_node: dict[str, dict[str, AllocationResult]] = field(
@@ -55,8 +64,17 @@ class _ClaimState:
     reserved_node: str = ""
 
     def clone(self) -> "_ClaimState":
-        c = _ClaimState(list(self.claims), set(self.base_taken),
-                        list(self.slices), self.needs_allocation)
+        c = _ClaimState(
+            claims=list(self.claims),
+            base_taken=set(self.base_taken),
+            slices=list(self.slices),
+            needs_allocation=self.needs_allocation,
+        )
+        # the prebuilt inventory/requirements are per-cycle read-only:
+        # sharing the structures (not the containers) is safe
+        c.inv_global = list(self.inv_global)
+        c.inv_by_node = {n: list(v) for n, v in self.inv_by_node.items()}
+        c.requirements = dict(self.requirements)
         c.allocations_per_node = {
             n: dict(m) for n, m in self.allocations_per_node.items()
         }
@@ -77,8 +95,7 @@ class DRAManager:
     def allocated_device_ids(self) -> set[tuple[str, str, str]]:
         """(driver, pool, device) triples currently taken cluster-wide."""
         taken: set[tuple[str, str, str]] = set()
-        claims, _ = self.store.list("ResourceClaim")
-        for claim in claims:
+        for claim in self.store.list_refs("ResourceClaim"):
             alloc = claim.status.allocation
             if alloc is not None:
                 for d in alloc.devices:
@@ -117,6 +134,33 @@ class Allocator:
         return driver, selectors
 
     @staticmethod
+    def _merged_inventory(cycle_state, node_name: str):
+        """Per-node inventory in exact slice order, cached per node on the
+        cycle state — allocate() runs once per (claim, node), and the merge
+        must not be rebuilt per claim."""
+        inv_cache = getattr(cycle_state, "_inv_cache", None)
+        if inv_cache is None:
+            inv_cache = {}
+            cycle_state._inv_cache = inv_cache
+        inv = inv_cache.get(node_name)
+        if inv is not None:
+            return inv
+        node_entries = cycle_state.inv_by_node.get(node_name, [])
+        if cycle_state.inv_global:
+            import heapq
+
+            inv = [
+                (d, p, dev) for _, d, p, dev in heapq.merge(
+                    cycle_state.inv_global, node_entries,
+                    key=lambda e: e[0],
+                )
+            ]
+        else:
+            inv = [(d, p, dev) for _, d, p, dev in node_entries]
+        inv_cache[node_name] = inv
+        return inv
+
+    @staticmethod
     def node_inventory(slices: list, node_name: str):
         """(driver, pool, device) inventory visible to one node, from a
         pre-listed slice set.
@@ -137,16 +181,28 @@ class Allocator:
         self, claim: ResourceClaim, node_name: str,
         taken: set[tuple[str, str, str]],
         slices: list | None = None,
+        cycle_state=None,
     ) -> AllocationResult | None:
         """Greedy per-request allocation; mutates `taken` on success so one
-        Filter pass can allocate several claims without double-booking."""
-        if slices is None:
-            slices, _ = self.store.list("ResourceSlice")
-        inventory = self.node_inventory(slices, node_name)
+        Filter pass can allocate several claims without double-booking.
+        With `cycle_state` (the PreFilter-built _ClaimState) the inventory
+        and class requirements come prebuilt — O(node's devices) per call
+        instead of a full slice walk + DeviceClass store gets per node."""
+        reqs = None
+        if cycle_state is not None:
+            inventory = self._merged_inventory(cycle_state, node_name)
+            reqs = cycle_state.requirements.get(claim.meta.key)
+        else:
+            if slices is None:
+                slices = self.store.list_refs("ResourceSlice")
+            inventory = self.node_inventory(slices, node_name)
         picked: list[DeviceAllocationResult] = []
         newly: list[tuple[str, str, str]] = []
-        for request in claim.spec.requests:
-            driver, selectors = self._class_requirements(request)
+        for ri, request in enumerate(claim.spec.requests):
+            if reqs is not None:
+                driver, selectors = reqs[ri]
+            else:
+                driver, selectors = self._class_requirements(request)
             need = request.count
             for drv, pool, dev in inventory:
                 if need == 0:
@@ -221,7 +277,21 @@ class DynamicResources(Plugin):
         )
         if s.needs_allocation:
             s.base_taken = self.manager.allocated_device_ids()
-            s.slices, _ = self.store.list("ResourceSlice")
+            s.slices = self.store.list_refs("ResourceSlice")
+            for idx, sl in enumerate(s.slices):
+                if sl.all_nodes:
+                    for dev in sl.devices:
+                        s.inv_global.append((idx, sl.driver, sl.pool, dev))
+                else:
+                    pool = f"{sl.node_name}/{sl.pool}"
+                    lst = s.inv_by_node.setdefault(sl.node_name, [])
+                    for dev in sl.devices:
+                        lst.append((idx, sl.driver, pool, dev))
+            s.requirements = {
+                c.meta.key: [self.allocator._class_requirements(r)
+                             for r in c.spec.requests]
+                for c in s.claims
+            }
         state.write(self.STATE_KEY, s)
         return None, None
 
@@ -250,7 +320,8 @@ class DynamicResources(Plugin):
                 continue
             if taken is None:
                 taken = set(s.base_taken)
-            alloc = self.allocator.allocate(claim, node_name, taken, s.slices)
+            alloc = self.allocator.allocate(claim, node_name, taken,
+                                            cycle_state=s)
             if alloc is None:
                 return Status.unschedulable(ERR_CANNOT_ALLOCATE, plugin=self.name)
             node_allocs[claim.meta.key] = alloc

@@ -1,0 +1,82 @@
+"""Cache debugger tests (backend/cache/debugger dumper + comparer)."""
+
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.cache.debugger import CacheDebugger
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+def _cluster(backend="host"):
+    store = Store()
+    for i in range(4):
+        store.create(make_node(f"n{i}", cpu="8"))
+    profiles = [Profile(backend=backend,
+                        wave_size=8 if backend == "tpu" else 0)]
+    sched = Scheduler(store, profiles=profiles)
+    sched.start()
+    for i in range(6):
+        store.create(make_pod(f"p{i}", cpu="1"))
+    sched.schedule_pending()
+    return store, sched
+
+
+class TestDumper:
+    def test_dump_lists_nodes_queue_and_assumed(self):
+        store, sched = _cluster()
+        lines: list[str] = []
+        dbg = CacheDebugger(sched.cache, sched.queue, store,
+                            log=lines.append)
+        out = dbg.dump()
+        assert "Dump of cached NodeInfo" in out
+        for i in range(4):
+            assert f"node n{i}:" in out
+        assert "Dump of scheduling queue" in out
+        assert lines  # dump also logs
+
+
+class TestComparer:
+    def test_clean_cluster_has_no_issues(self):
+        store, sched = _cluster()
+        dbg = CacheDebugger(sched.cache, sched.queue, store,
+                            log=lambda *_: None)
+        assert dbg.compare() == []
+
+    def test_detects_cache_store_drift(self):
+        store, sched = _cluster()
+        dbg = CacheDebugger(sched.cache, sched.queue, store,
+                            log=lambda *_: None)
+        # a node the cache never learned about
+        store.create(make_node("ghost", cpu="8"))
+        issues = dbg.compare()
+        assert any("ghost" in i and "not in cache" in i for i in issues)
+        # a bound pod the cache lost
+        sched.pump()  # absorb the node event first
+        assert dbg.compare() == []
+        from kubernetes_tpu.api.meta import ObjectMeta
+
+        rogue = make_pod("rogue", cpu="1")
+        rogue.spec.node_name = "n0"
+        store.create(rogue)  # store knows; cache not pumped
+        issues = dbg.compare()
+        assert any("rogue" in i and "missing from cache" in i
+                   for i in issues)
+
+    def test_assumed_pods_are_not_flagged(self):
+        store, sched = _cluster()
+        dbg = CacheDebugger(sched.cache, sched.queue, store,
+                            log=lambda *_: None)
+        extra = make_pod("assumed-only", cpu="1")
+        sched.cache.assume_pod(extra, "n0")
+        assert dbg.compare() == []  # assumed-not-yet-bound is legitimate
+
+
+class TestCarryComparer:
+    def test_wave_carry_coherent_after_drain(self):
+        store, sched = _cluster(backend="tpu")
+        sched.loop.wait_for_bindings()
+        algo = sched.algorithms["default-scheduler"]
+        dbg = CacheDebugger(sched.cache, sched.queue, store,
+                            backend=algo.backend, log=lambda *_: None)
+        snapshot = sched.loop.snapshot
+        sched.cache.update_snapshot(snapshot)
+        assert dbg.compare_carry(snapshot) == []

@@ -159,3 +159,24 @@ class TestDynamicResources:
         assert s.schedule_pending() == 1
         claim = store.get("ResourceClaim", "default/c1")
         assert {d.device for d in claim.status.allocation.devices} == {"dev-2", "dev-3"}
+
+
+class TestClaimStateClone:
+    def test_clone_preserves_prebuilt_allocator_state(self):
+        """Regression: clone() used positional args and silently dropped the
+        PreFilter-built inventory/requirements (and flipped
+        needs_allocation), crashing or falsely failing DRA pods inside the
+        nominated-pods double-filter and preemption dry runs."""
+        from kubernetes_tpu.scheduler.plugins.dynamic_resources import (
+            _ClaimState,
+        )
+
+        s = _ClaimState(needs_allocation=True)
+        s.inv_global = [(0, "drv", "pool", object())]
+        s.inv_by_node = {"n1": [(1, "drv", "n1/pool", object())]}
+        s.requirements = {"default/claim": [("drv", [])]}
+        c = s.clone()
+        assert c.needs_allocation is True
+        assert c.inv_global == s.inv_global
+        assert c.inv_by_node == s.inv_by_node
+        assert c.requirements == s.requirements
