@@ -32,6 +32,12 @@ import sys
 
 BASELINE_PODS_PER_S = 270.0
 WAVE_SIZE = 512
+# pod-scheduling SLI p99 target at the headline scale (the reference tracks
+# the SLI histogram as a first-class result, metrics.go:312). The workload
+# creates its 10k measure pods in one burst, so the last pod's SLI is
+# bounded below by drain time (~expected_pods/throughput) — 20 s demands
+# both throughput AND a wave composition that doesn't starve stragglers.
+SLI_P99_TARGET_S = 20.0
 
 _PROBE_SRC = (
     "import jax; ds = jax.devices(); print('PLATFORM=' + ds[0].platform)"
@@ -135,6 +141,9 @@ def main() -> None:
         "scheduled": result.scheduled,
         "sli_p50_s": sli.get("Perc50"),
         "sli_p99_s": sli.get("Perc99"),
+        "sli_p99_target_s": SLI_P99_TARGET_S,
+        "sli_p99_ok": (sli.get("Perc99") is not None
+                       and sli["Perc99"] <= SLI_P99_TARGET_S),
         "kernel_pods": algo.kernel_count,
         "fallback_pods": algo.fallback_count,
         "phase_profile_s": {
