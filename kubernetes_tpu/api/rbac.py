@@ -28,6 +28,25 @@ class PolicyRule:
                 and (WILDCARD in self.resources or resource in self.resources))
 
 
+def service_account_username(namespace: str, name: str) -> str:
+    """system:serviceaccount:<ns>:<name> (serviceaccount.MakeUsername) —
+    the ONE place the identity format lives (Subject.matches and the token
+    issuer both derive from it)."""
+    return f"system:serviceaccount:{namespace}:{name}"
+
+
+@dataclass
+class ServiceAccount:
+    """core/v1 ServiceAccount: the in-cluster workload identity
+    (pkg/apis/core types.go ServiceAccount). Token issuance lives in the
+    apiserver's TokenRequest subresource (apiserver/auth.py
+    ServiceAccountIssuer)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+
+    kind = "ServiceAccount"
+
+
 @dataclass(frozen=True)
 class Subject:
     """User / Group / ServiceAccount reference."""
@@ -42,7 +61,9 @@ class Subject:
         if self.kind == "Group":
             return self.name in user.groups
         if self.kind == "ServiceAccount":
-            return user.name == f"system:serviceaccount:{self.namespace}:{self.name}"
+            return user.name == service_account_username(
+                self.namespace, self.name
+            )
         return False
 
 

@@ -254,6 +254,9 @@ class PodSpec:
     # kubelet fails the pod this many seconds after it starts Running
     # (kubelet_pods.go activeDeadlineHandler); None = no deadline
     active_deadline_seconds: int | None = None
+    # in-cluster identity (core/v1 serviceAccountName); defaulted to
+    # "default" by the serviceaccount admission plugin
+    service_account_name: str = ""
 
 
 @dataclass

@@ -83,6 +83,46 @@ def namespace_lifecycle_admission(store):
     return admit
 
 
+def service_account_admission(store):
+    """plugin/pkg/admission/serviceaccount (DefaultServiceAccount subset):
+    default pod.spec.serviceAccountName to "default"; a pod naming a
+    NON-default account that doesn't exist is rejected (the default one is
+    created asynchronously by the ServiceAccount controller, so it is not
+    required to exist yet — documented divergence from the reference,
+    which waits for it)."""
+
+    def admit(operation: str, obj) -> None:
+        if getattr(obj, "kind", "") != "Pod":
+            return
+        if operation == "UPDATE":
+            # pod identity is immutable (the reference's validation):
+            # an update must not retarget serviceAccountName
+            stored = store.try_get("Pod", obj.meta.key)
+            if (stored is not None and obj.spec.service_account_name
+                    and stored.spec.service_account_name
+                    and obj.spec.service_account_name
+                    != stored.spec.service_account_name):
+                raise AdmissionError(
+                    "pod spec.serviceAccountName is immutable", code=422,
+                )
+            return
+        if operation != "CREATE":
+            return
+        if not obj.spec.service_account_name:
+            obj.spec.service_account_name = "default"
+            return
+        if obj.spec.service_account_name == "default":
+            return
+        key = f"{obj.meta.namespace}/{obj.spec.service_account_name}"
+        if store.try_get("ServiceAccount", key) is None:
+            raise AdmissionError(
+                f"pod references service account {key} which does not "
+                "exist", code=422,
+            )
+
+    return admit
+
+
 def crd_admission(store):
     """apiextensions-apiserver in admission-plugin form: a
     CustomResourceDefinition CREATE validates + establishes the kind in the
@@ -215,5 +255,6 @@ def default_admission_chain(store) -> list:
     from ..controllers.quota import quota_admission
 
     return [cluster_scope_admission(), priority_admission(store),
-            namespace_lifecycle_admission(store), crd_admission(store),
+            namespace_lifecycle_admission(store),
+            service_account_admission(store), crd_admission(store),
             quota_admission(store), webhook_admission(store)]

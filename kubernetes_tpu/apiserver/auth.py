@@ -34,16 +34,105 @@ class AuthenticationError(Exception):
     """Invalid credentials (401; distinct from no credentials)."""
 
 
+class ServiceAccountIssuer:
+    """HMAC-signed ServiceAccount tokens (pkg/serviceaccount's
+    JWTTokenGenerator role, symmetric-key form): the TokenRequest
+    subresource mints them, authentication verifies signature + expiry and
+    — like the reference — that the account still exists, so deleting a
+    ServiceAccount revokes its tokens."""
+
+    def __init__(self, store, key: bytes | None = None,
+                 clock=None):
+        import secrets as _secrets
+        import time as _time
+
+        self.store = store
+        self.key = key or _secrets.token_bytes(32)
+        self._now = clock or _time.time
+
+    @staticmethod
+    def _b64(data: bytes) -> str:
+        import base64
+
+        return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+    def _sign(self, payload: str) -> str:
+        import hashlib
+        import hmac as _hmac
+
+        return self._b64(_hmac.new(self.key, payload.encode(),
+                                   hashlib.sha256).digest())
+
+    def issue(self, namespace: str, name: str,
+              expiration_seconds: int = 3600) -> str:
+        import json
+
+        from ..api.rbac import service_account_username
+
+        sa = self.store.try_get("ServiceAccount", f"{namespace}/{name}")
+        payload = self._b64(json.dumps({
+            "sub": service_account_username(namespace, name),
+            "ns": namespace, "name": name,
+            # the token binds to the account INSTANCE: delete + recreate
+            # must not resurrect previously minted tokens
+            # (pkg/serviceaccount claims carry the UID the same way)
+            "uid": sa.meta.uid if sa is not None else "",
+            "exp": self._now() + expiration_seconds,
+        }, sort_keys=True).encode())
+        return f"sa.{payload}.{self._sign(payload)}"
+
+    def authenticate(self, token: str) -> User | None:
+        """User for a valid SA token, None when the token isn't ours
+        (callers fall through to other authenticators)."""
+        import base64
+        import hmac as _hmac
+        import json
+
+        if not token.startswith("sa."):
+            return None
+        try:
+            _, payload, sig = token.split(".", 2)
+        except ValueError:
+            return None
+        if not _hmac.compare_digest(sig, self._sign(payload)):
+            raise AuthenticationError("invalid service account token")
+        claims = json.loads(
+            base64.urlsafe_b64decode(payload + "=" * (-len(payload) % 4))
+        )
+        if claims["exp"] < self._now():
+            raise AuthenticationError("service account token expired")
+        key = f'{claims["ns"]}/{claims["name"]}'
+        sa = self.store.try_get("ServiceAccount", key)
+        if sa is None:
+            raise AuthenticationError(
+                "service account has been deleted"
+            )
+        if claims.get("uid") and sa.meta.uid != claims["uid"]:
+            raise AuthenticationError(
+                "service account token predates the current account "
+                "instance"
+            )
+        return User(claims["sub"], (
+            "system:serviceaccounts",
+            f'system:serviceaccounts:{claims["ns"]}',
+            AUTHENTICATED,
+        ))
+
+
 class TokenAuthenticator:
-    """Static bearer-token table (the --token-auth-file model).
+    """Static bearer-token table (the --token-auth-file model), optionally
+    chained with a ServiceAccountIssuer (the authenticator union the
+    reference builds in its authn chain).
 
     authenticate() returns the token's user, the anonymous user when no
     credentials are presented (anonymous-auth=true semantics), and raises
     AuthenticationError for a credential that doesn't resolve — presenting a
     bad token must not silently degrade to anonymous."""
 
-    def __init__(self, tokens: dict[str, User] | None = None):
+    def __init__(self, tokens: dict[str, User] | None = None,
+                 sa_issuer: "ServiceAccountIssuer | None" = None):
         self._tokens = dict(tokens or {})
+        self.sa_issuer = sa_issuer
 
     def add_token(self, token: str, user: User) -> None:
         self._tokens[token] = user
@@ -54,7 +143,10 @@ class TokenAuthenticator:
         scheme, _, credential = authorization_header.partition(" ")
         if scheme.lower() != "bearer" or not credential:
             raise AuthenticationError("unsupported authorization scheme")
-        user = self._tokens.get(credential.strip())
+        credential = credential.strip()
+        user = self._tokens.get(credential)
+        if user is None and self.sa_issuer is not None:
+            user = self.sa_issuer.authenticate(credential)
         if user is None:
             raise AuthenticationError("unknown bearer token")
         if AUTHENTICATED not in user.groups:

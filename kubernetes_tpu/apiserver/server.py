@@ -260,7 +260,8 @@ class APIServer:
                 kind = parts[2]
                 rest = parts[3:]
                 sub = ""
-                if rest and rest[-1] in ("binding", "status", "log"):
+                if rest and rest[-1] in ("binding", "status", "log",
+                                         "token"):
                     # subresource only when a full object key PRECEDES the
                     # suffix (ns/name, or bare name for cluster-scoped) —
                     # otherwise a pod literally named "log" is unreachable
@@ -540,11 +541,12 @@ class APIServer:
                 if sub == "log":
                     self._error(405, "MethodNotAllowed", "pods/log is GET-only")
                     return
-                if sub == "binding":
+                if sub in ("binding", "token"):
                     # the reference gates binding writes behind the separate
                     # pods/binding resource, NOT plain pod create — a
-                    # create-only grant must not mutate existing pods
-                    resource = f"{kind}/binding"
+                    # create-only grant must not mutate existing pods; the
+                    # serviceaccounts/token subresource is gated the same way
+                    resource = f"{kind}/{sub}"
                 else:
                     # authorize against where the object will actually land:
                     # decode applies the namespace default, the raw body may
@@ -568,6 +570,41 @@ class APIServer:
                 if not self._authorized("create", resource, key, namespace=ns):
                     return
                 try:
+                    if sub == "token":
+                        # TokenRequest subresource (authentication.k8s.io
+                        # TokenRequest via serviceaccounts/token) — only
+                        # the ServiceAccount kind carries it; authz ran
+                        # against <kind>/token, so any other kind must 404
+                        # rather than mint under the wrong RBAC resource
+                        if kind != "ServiceAccount":
+                            self._error(404, "NotFound",
+                                        f"{kind} has no token subresource")
+                            return
+                        issuer = getattr(server.authenticator, "sa_issuer",
+                                         None) if server.authenticator else None
+                        if issuer is None:
+                            self._error(400, "BadRequest",
+                                        "token issuance not configured")
+                            return
+                        if server.store.try_get(
+                            "ServiceAccount", key
+                        ) is None:
+                            self._error(404, "NotFound",
+                                        f"ServiceAccount {key}")
+                            return
+                        exp = int(body.get("expirationSeconds", 3600))
+                        if exp <= 0:
+                            self._error(400, "BadRequest",
+                                        "expirationSeconds must be "
+                                        "positive")
+                            return
+                        exp = max(exp, 600)  # the reference floors at 10m
+                        ns, _, name = key.partition("/")
+                        self._send_json(201, {
+                            "token": issuer.issue(ns, name, exp),
+                            "expirationSeconds": exp,
+                        })
+                        return
                     if sub == "binding":
                         # pods/binding subresource (registry/core/pod BindingREST)
                         pod = server.store.get(kind, key)

@@ -59,10 +59,12 @@ class ClusterBootstrap:
     def _phase_control_plane(self, serve_port: int) -> None:
         authn = authz = None
         if self.secure:
+            from ..apiserver.auth import ServiceAccountIssuer
+
             authn = TokenAuthenticator({
                 self.admin_token: User("kubernetes-admin",
                                        ("system:masters",)),
-            })
+            }, sa_issuer=ServiceAccountIssuer(self.store))
             authz = RBACAuthorizer(self.store)
         from ..apiserver.admission import default_admission_chain
 

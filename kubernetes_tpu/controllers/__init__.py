@@ -5,6 +5,7 @@ from .cronjob import CronJobController
 from .disruption import DisruptionController
 from .hpa import HPAController
 from .quota import QuotaController, quota_admission
+from .serviceaccount import ServiceAccountController
 from .volume import PersistentVolumeController
 from .lifecycle import (
     EndpointSliceController,
@@ -49,6 +50,7 @@ def default_controllers(store, clock=None) -> list[Controller]:
         QuotaController(store, informers),
         PodGCController(store, informers),
         PersistentVolumeController(store, informers),
+        ServiceAccountController(store, informers),
     ]
 
 
@@ -60,7 +62,7 @@ __all__ = [
     "JobController",
     "NamespaceController", "NodeLifecycleController",
     "QuotaController", "ReplicaSetController", "ResourceClaimController",
-    "PersistentVolumeController",
+    "PersistentVolumeController", "ServiceAccountController",
     "StatefulSetController", "TTLAfterFinishedController",
     "default_controllers", "quota_admission",
 ]

@@ -1,0 +1,304 @@
+"""ServiceAccount identity tests: controller, admission defaulting,
+TokenRequest issuance, SA-token authentication + RBAC.
+
+Modeled on pkg/controller/serviceaccount tests, the serviceaccount
+admission plugin, and pkg/serviceaccount token tests: every namespace gets
+a default account, pods resolve an identity, minted tokens authenticate as
+system:serviceaccount:<ns>:<name> with the serviceaccounts groups, deleting
+the account revokes its tokens.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.rbac import (
+    ClusterRole,
+    ClusterRoleBinding,
+    PolicyRule,
+    RoleRef,
+    ServiceAccount,
+    Subject,
+)
+from kubernetes_tpu.apiserver.admission import service_account_admission
+from kubernetes_tpu.apiserver.auth import (
+    AuthenticationError,
+    RBACAuthorizer,
+    ServiceAccountIssuer,
+    TokenAuthenticator,
+    User,
+    bootstrap_policy,
+)
+from kubernetes_tpu.apiserver.server import AdmissionError, APIServer
+from kubernetes_tpu.client.rest import RESTError, RESTStore
+from kubernetes_tpu.controllers.serviceaccount import ServiceAccountController
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_pod
+
+
+def mk_ns(name):
+    from kubernetes_tpu.api.workloads import Namespace
+
+    return Namespace(meta=ObjectMeta(name=name, namespace=""))
+
+
+class TestController:
+    def test_default_sa_created_per_namespace(self):
+        store = Store()
+        store.create(mk_ns("default"))
+        store.create(mk_ns("team-a"))
+        c = ServiceAccountController(store)
+        c.sync_once()
+        assert store.try_get("ServiceAccount", "default/default") is not None
+        assert store.try_get("ServiceAccount", "team-a/default") is not None
+
+    def test_deleted_default_sa_recreated(self):
+        store = Store()
+        store.create(mk_ns("default"))
+        c = ServiceAccountController(store)
+        c.sync_once()
+        store.delete("ServiceAccount", "default/default")
+        c.sync_once()
+        assert store.try_get("ServiceAccount", "default/default") is not None
+
+
+class TestAdmission:
+    def test_pod_defaults_to_default_sa(self):
+        store = Store()
+        admit = service_account_admission(store)
+        pod = make_pod("p")
+        admit("CREATE", pod)
+        assert pod.spec.service_account_name == "default"
+
+    def test_missing_named_sa_rejected(self):
+        store = Store()
+        admit = service_account_admission(store)
+        pod = make_pod("p")
+        pod.spec.service_account_name = "builder"
+        with pytest.raises(AdmissionError):
+            admit("CREATE", pod)
+        sa = ServiceAccount()
+        sa.meta.name, sa.meta.namespace = "builder", "default"
+        store.create(sa)
+        admit("CREATE", pod)  # exists now: allowed
+
+
+class TestTokens:
+    def _store_with_sa(self, ns="default", name="builder"):
+        store = Store()
+        sa = ServiceAccount()
+        sa.meta.name, sa.meta.namespace = name, ns
+        store.create(sa)
+        return store
+
+    def test_issue_and_authenticate(self):
+        store = self._store_with_sa()
+        issuer = ServiceAccountIssuer(store)
+        token = issuer.issue("default", "builder")
+        user = issuer.authenticate(token)
+        assert user.name == "system:serviceaccount:default:builder"
+        assert "system:serviceaccounts" in user.groups
+        assert "system:serviceaccounts:default" in user.groups
+
+    def test_tampered_token_rejected(self):
+        store = self._store_with_sa()
+        issuer = ServiceAccountIssuer(store)
+        token = issuer.issue("default", "builder")
+        with pytest.raises(AuthenticationError):
+            issuer.authenticate(token[:-2] + "xx")
+
+    def test_expired_token_rejected(self):
+        store = self._store_with_sa()
+        t = [1000.0]
+        issuer = ServiceAccountIssuer(store, clock=lambda: t[0])
+        token = issuer.issue("default", "builder", expiration_seconds=60)
+        assert issuer.authenticate(token) is not None
+        t[0] += 61
+        with pytest.raises(AuthenticationError):
+            issuer.authenticate(token)
+
+    def test_deleting_sa_revokes_tokens(self):
+        store = self._store_with_sa()
+        issuer = ServiceAccountIssuer(store)
+        token = issuer.issue("default", "builder")
+        store.delete("ServiceAccount", "default/builder")
+        with pytest.raises(AuthenticationError):
+            issuer.authenticate(token)
+
+    def test_recreated_sa_does_not_resurrect_old_tokens(self):
+        """UID binding: delete + recreate (e.g. the controller recreating
+        a default account) must NOT revalidate previously minted tokens."""
+        store = self._store_with_sa()
+        issuer = ServiceAccountIssuer(store)
+        token = issuer.issue("default", "builder")
+        store.delete("ServiceAccount", "default/builder")
+        sa = ServiceAccount()
+        sa.meta.name, sa.meta.namespace = "builder", "default"
+        store.create(sa)  # new instance, new uid
+        with pytest.raises(AuthenticationError):
+            issuer.authenticate(token)
+        fresh = issuer.issue("default", "builder")
+        assert issuer.authenticate(fresh) is not None
+
+    def test_sa_name_immutable_on_update(self):
+        store = self._store_with_sa()
+        admit = service_account_admission(store)
+        pod = make_pod("p")
+        admit("CREATE", pod)
+        store.create(pod)
+        changed = store.get("Pod", "default/p")
+        changed.spec.service_account_name = "builder"
+        with pytest.raises(AdmissionError):
+            admit("UPDATE", changed)
+
+    def test_foreign_tokens_fall_through(self):
+        store = self._store_with_sa()
+        issuer = ServiceAccountIssuer(store)
+        assert issuer.authenticate("some-static-token") is None
+
+
+class TestTokenRequestEndToEnd:
+    def test_mint_over_http_then_use_with_rbac(self):
+        """Full flow: admin mints a token via the serviceaccounts/token
+        subresource; the SA authenticates with it; an RBAC binding on the
+        ServiceAccount subject authorizes its writes."""
+        store = Store()
+        for obj in bootstrap_policy():
+            store.create(obj)
+        sa = ServiceAccount()
+        sa.meta.name, sa.meta.namespace = "ci", "default"
+        store.create(sa)
+        issuer = ServiceAccountIssuer(store)
+        authn = TokenAuthenticator(
+            {"admin": User("admin", ("system:masters",))},
+            sa_issuer=issuer,
+        )
+        server = APIServer(store, authenticator=authn,
+                           authorizer=RBACAuthorizer(store))
+        server.serve(0)
+        try:
+            import json
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"{server.url}/api/v1/ServiceAccount/default/ci/token",
+                data=json.dumps({"expirationSeconds": 600}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json",
+                         "Authorization": "Bearer admin"},
+            )
+            with urllib.request.urlopen(req) as r:
+                token = json.loads(r.read())["token"]
+            client = RESTStore(server.url, token=token)
+            # reads flow through the bootstrap view grant (authenticated)
+            assert client.pods() == []
+            # writes denied until a binding names the ServiceAccount
+            with pytest.raises(RESTError) as exc:
+                client.create(make_pod("from-ci"))
+            assert exc.value.code == 403
+            store.create(ClusterRole(
+                meta=ObjectMeta(name="pod-creator", namespace=""),
+                rules=(PolicyRule(("create",), ("Pod",)),),
+            ))
+            store.create(ClusterRoleBinding(
+                meta=ObjectMeta(name="ci-creates", namespace=""),
+                subjects=(Subject("ServiceAccount", "ci", "default"),),
+                role_ref=RoleRef("ClusterRole", "pod-creator"),
+            ))
+            client.create(make_pod("from-ci"))
+            assert store.try_get("Pod", "default/from-ci") is not None
+        finally:
+            server.shutdown()
+
+    def test_token_subresource_only_on_serviceaccounts(self):
+        """A grant on <otherkind>/token must not mint identity tokens."""
+        store = Store()
+        sa = ServiceAccount()
+        sa.meta.name, sa.meta.namespace = "default", "default"
+        store.create(sa)
+        authn = TokenAuthenticator(
+            {"admin": User("admin", ("system:masters",))},
+            sa_issuer=ServiceAccountIssuer(store),
+        )
+        server = APIServer(store, authenticator=authn,
+                           authorizer=RBACAuthorizer(store))
+        server.serve(0)
+        try:
+            import json
+            import urllib.error
+            import urllib.request
+
+            from tests.wrappers import make_pod as _mk
+
+            store.create(_mk("default"))  # Pod default/default exists
+            req = urllib.request.Request(
+                f"{server.url}/api/v1/Pod/default/default/token",
+                data=json.dumps({}).encode(), method="POST",
+                headers={"Content-Type": "application/json",
+                         "Authorization": "Bearer admin"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 404
+        finally:
+            server.shutdown()
+
+    def test_negative_expiration_rejected(self):
+        store = Store()
+        sa = ServiceAccount()
+        sa.meta.name, sa.meta.namespace = "ci", "default"
+        store.create(sa)
+        authn = TokenAuthenticator(
+            {"admin": User("admin", ("system:masters",))},
+            sa_issuer=ServiceAccountIssuer(store),
+        )
+        server = APIServer(store, authenticator=authn,
+                           authorizer=RBACAuthorizer(store))
+        server.serve(0)
+        try:
+            import json
+            import urllib.error
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"{server.url}/api/v1/ServiceAccount/default/ci/token",
+                data=json.dumps({"expirationSeconds": -600}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json",
+                         "Authorization": "Bearer admin"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 400
+        finally:
+            server.shutdown()
+
+    def test_token_subresource_requires_authorization(self):
+        store = Store()
+        for obj in bootstrap_policy():
+            store.create(obj)
+        sa = ServiceAccount()
+        sa.meta.name, sa.meta.namespace = "ci", "default"
+        store.create(sa)
+        authn = TokenAuthenticator(
+            {"viewer": User("alice", ())},
+            sa_issuer=ServiceAccountIssuer(store),
+        )
+        server = APIServer(store, authenticator=authn,
+                           authorizer=RBACAuthorizer(store))
+        server.serve(0)
+        try:
+            import json
+            import urllib.error
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"{server.url}/api/v1/ServiceAccount/default/ci/token",
+                data=json.dumps({}).encode(), method="POST",
+                headers={"Content-Type": "application/json",
+                         "Authorization": "Bearer viewer"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 403
+        finally:
+            server.shutdown()
