@@ -95,11 +95,18 @@ def service_account_admission(store):
         if getattr(obj, "kind", "") != "Pod":
             return
         if operation == "UPDATE":
-            # pod identity is immutable (the reference's validation):
-            # an update must not retarget serviceAccountName
+            # pod identity is immutable (the reference's validation): an
+            # update must not retarget serviceAccountName, and clearing it
+            # must not erase the identity either — an empty field carries
+            # the stored value forward
             stored = store.try_get("Pod", obj.meta.key)
-            if (stored is not None and obj.spec.service_account_name
-                    and stored.spec.service_account_name
+            if stored is None:
+                return
+            if not obj.spec.service_account_name:
+                obj.spec.service_account_name = \
+                    stored.spec.service_account_name
+                return
+            if (stored.spec.service_account_name
                     and obj.spec.service_account_name
                     != stored.spec.service_account_name):
                 raise AdmissionError(

@@ -1,0 +1,106 @@
+"""Container manager: QoS classes, cgroup tree model, node-allocatable
+admission.
+
+Reference: pkg/kubelet/cm/ — the kubelet's resource-enforcement layer.
+Modeled here: QoS classification (pkg/apis/core/v1/helper/qos GetPodQOS),
+the kubepods cgroup hierarchy (qos_container_manager.go: Guaranteed pods sit
+directly under kubepods, Burstable/BestEffort under their QoS parents), and
+the node-allocatable admission predicate (pkg/kubelet/lifecycle/predicate.go:
+a pod whose requests exceed what is left of allocatable is rejected with
+OutOf<resource> BEFORE any container starts — the kubelet's last line of
+defense when a race beats the scheduler's view).
+"""
+
+from __future__ import annotations
+
+from ..api.resource import CPU, MEM, ResourceNames, ResourceVec
+
+GUARANTEED = "Guaranteed"
+BURSTABLE = "Burstable"
+BEST_EFFORT = "BestEffort"
+
+
+def pod_qos(pod) -> str:
+    """GetPodQOS: Guaranteed iff every container has cpu+mem limits equal
+    to its requests; BestEffort iff nothing sets requests or limits;
+    Burstable otherwise."""
+    containers = list(pod.spec.init_containers) + list(pod.spec.containers)
+    any_set = False
+    guaranteed = bool(containers)
+    for c in containers:
+        req = {k: v for k, v in c.requests.items() if k in ("cpu", "memory")}
+        lim = {k: v for k, v in c.limits.items() if k in ("cpu", "memory")}
+        if req or lim:
+            any_set = True
+        if not (set(lim) == {"cpu", "memory"}
+                and all(req.get(k, lim[k]) == lim[k] for k in lim)):
+            guaranteed = False
+    if guaranteed and any_set:
+        return GUARANTEED
+    if any_set:
+        return BURSTABLE
+    return BEST_EFFORT
+
+
+class ContainerManager:
+    """Tracks admitted pods' reservations against node allocatable and
+    models their cgroup placement."""
+
+    def __init__(self, node, names: ResourceNames | None = None):
+        self.names = names or ResourceNames()
+        self.allocatable = ResourceVec.from_map(
+            node.status.allocatable, self.names, floor=True
+        )
+        self._reserved: dict[str, ResourceVec] = {}  # pod key -> requests
+        self._qos: dict[str, str] = {}
+
+    def _pod_requests(self, pod) -> ResourceVec:
+        from ..api.resource import pod_request_vec
+
+        return pod_request_vec(pod, self.names)
+
+    def admit(self, pod) -> tuple[bool, str, str]:
+        """(ok, reason, message) — the allocatable admission predicate.
+        Idempotent per pod key (re-syncs re-admit freely)."""
+        key = pod.meta.key
+        if key in self._reserved:
+            return True, "", ""
+        req = self._pod_requests(pod)
+        used = ResourceVec(self.names.width)
+        for r in self._reserved.values():
+            used.add(r)
+        width = max(len(req.v), len(self.allocatable.v))
+        for i in range(width):
+            if req[i] > 0 and req[i] > self.allocatable[i] - used[i]:
+                rname = (self.names.names[i] if i < self.names.width
+                         else f"res{i}")
+                reason = "OutOf" + ("cpu" if i == CPU else
+                                    "memory" if i == MEM else rname)
+                return False, reason, (
+                    f"Node didn't have enough resource: {rname}, "
+                    f"requested: {req[i]}, used: {used[i]}, "
+                    f"capacity: {self.allocatable[i]}"
+                )
+        self._reserved[key] = req
+        self._qos[key] = pod_qos(pod)
+        return True, "", ""
+
+    def release(self, pod_key: str) -> None:
+        self._reserved.pop(pod_key, None)
+        self._qos.pop(pod_key, None)
+
+    def cgroup_path(self, pod) -> str:
+        """qos_container_manager.go hierarchy: Guaranteed pods live
+        directly under kubepods; the other classes under their QoS
+        parent."""
+        qos = self._qos.get(pod.meta.key) or pod_qos(pod)
+        slug = (pod.meta.uid or pod.meta.key).replace("/", "_")
+        if qos == GUARANTEED:
+            return f"/kubepods/pod{slug}"
+        return f"/kubepods/{qos.lower()}/pod{slug}"
+
+    def reserved_total(self) -> ResourceVec:
+        total = ResourceVec(self.names.width)
+        for r in self._reserved.values():
+            total.add(r)
+        return total

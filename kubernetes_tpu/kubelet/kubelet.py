@@ -63,6 +63,12 @@ class Kubelet(NodeAgentBase):
         # injected usage for tests / simulations (summary-API stand-in)
         self.pod_stats: dict[str, PodStats] = {}
         self.node_available: dict[str, int] = {}
+        # resource managers (pkg/kubelet/cm + volumemanager)
+        from .cm import ContainerManager
+        from .volumemanager import VolumeManager
+
+        self.container_manager = ContainerManager(node)
+        self.volume_manager = VolumeManager(store)
 
     RESTART_BACKOFF_BASE_S = 10.0   # kubelet.go MaxContainerBackOff family
     RESTART_BACKOFF_MAX_S = 300.0
@@ -192,6 +198,23 @@ class Kubelet(NodeAgentBase):
                                f"{deadline}")
                 return
             self._deadline_wakeup[key] = expiry
+        # node-allocatable admission (lifecycle/predicate.go): runs before
+        # ANY container work; a pod that lost the race for node resources
+        # fails terminally with OutOf<resource>
+        ok, reason, msg = self.container_manager.admit(pod)
+        if not ok:
+            self._fail_pod(pod, reason, msg)
+            return
+        # WaitForAttachAndMount: claim-backed volumes must resolve to a
+        # bound PV and mount before containers start; a blocked pod waits
+        # in the retry set exactly like a missing ConfigMap reference,
+        # with the unmounted-volumes message surfaced on the Ready
+        # condition so the stall is diagnosable
+        mounted, vol_msg = self.volume_manager.mount_pod(pod)
+        if not mounted:
+            self._config_errors.add(key)
+            self._report_volume_blocked(pod, vol_msg)
+            return
         sid = self._sandboxes.get(key)
         if sid is None or all(
             s.id != sid for s in self.runtime.list_pod_sandboxes()
@@ -320,6 +343,25 @@ class Kubelet(NodeAgentBase):
                 return None
             env[ev.name] = src.data[ref.key]
         return env
+
+    def _report_volume_blocked(self, pod, message: str) -> None:
+        """Pending + Ready=False with the unmounted-volumes message (the
+        kubelet's ContainersNotReady report while WaitForAttachAndMount
+        blocks); idempotent so retries don't storm the store."""
+        cond = next((c for c in pod.status.conditions if c.type == "Ready"),
+                    None)
+        if (pod.status.phase == PENDING and cond is not None
+                and cond.status == "False" and cond.message == message):
+            return
+        pod.status.phase = PENDING
+        pod.status.conditions = [
+            c for c in pod.status.conditions if c.type != "Ready"
+        ] + [PodCondition(type="Ready", status="False",
+                          reason="ContainersNotReady", message=message)]
+        try:
+            self.store.update(pod, check_version=False)
+        except (ConflictError, NotFoundError):
+            pass
 
     def _fail_pod(self, pod, reason: str, message: str) -> None:
         """Terminal failure: stop containers, report Failed + NotReady."""
@@ -456,6 +498,8 @@ class Kubelet(NodeAgentBase):
         for bk in [b for b in self._restart_backoff if b[0] == key]:
             del self._restart_backoff[bk]
         self.store.try_delete("PodMetrics", key)
+        self.container_manager.release(key)
+        self.volume_manager.unmount_pod(key)
         sid = self._sandboxes.pop(key, None)
         if sid is None:
             return

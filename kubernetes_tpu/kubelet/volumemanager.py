@@ -1,0 +1,76 @@
+"""Volume manager: attach/mount bookkeeping for claim-backed volumes.
+
+Reference: pkg/kubelet/volumemanager/ — the kubelet reconciles a desired
+state (every claim-backed volume of every admitted pod) against an actual
+state (attached volumes, per-pod mounts), and containers may not start
+until every volume is mounted (kubelet's WaitForAttachAndMount; pods sit
+in ContainerCreating with an "unmounted volumes" message until then).
+
+In this in-memory runtime model "attach" and "mount" are bookkeeping
+transitions, but the CONTRACT is real: an unbound or missing claim blocks
+the pod's containers, claims resolve through the PV they are bound to, and
+teardown unmounts (and detaches when the last pod using the volume goes)."""
+
+from __future__ import annotations
+
+
+class VolumeManager:
+    def __init__(self, store):
+        self.store = store
+        self.attached: set[str] = set()  # PV names attached to this node
+        self.mounts: dict[str, set[str]] = {}  # pod key -> mounted PV names
+
+    def mount_pod(self, pod) -> tuple[bool, str]:
+        """WaitForAttachAndMount: resolve every claim-backed volume to its
+        bound PV and mount it; (False, why) leaves the pod blocked in
+        ContainerCreating."""
+        from ..api.storage import CLAIM_BOUND
+
+        if pod.meta.key in self.mounts:
+            # already mounted: a Running pod keeps its volumes even if the
+            # claim is later deleted/unbound (the real kubelet never
+            # unmounts a live pod's volumes behind it); re-validation would
+            # demote Running pods on every sync
+            return True, ""
+        wanted: list[str] = []
+        for v in pod.spec.volumes:
+            claim_name = v.claim_name(pod.meta.name)
+            if not claim_name:
+                continue  # hostPath / emptyDir need no attach
+            key = f"{pod.meta.namespace}/{claim_name}"
+            pvc = self.store.try_get("PersistentVolumeClaim", key)
+            if pvc is None:
+                return False, (
+                    f'unmounted volumes=[{v.name}]: claim "{key}" not found'
+                )
+            if pvc.status.phase != CLAIM_BOUND or not pvc.spec.volume_name:
+                return False, (
+                    f'unmounted volumes=[{v.name}]: claim "{key}" is not '
+                    "bound"
+                )
+            pv = self.store.try_get("PersistentVolume",
+                                    pvc.spec.volume_name)
+            if pv is None:
+                return False, (
+                    f'unmounted volumes=[{v.name}]: volume '
+                    f'"{pvc.spec.volume_name}" not found'
+                )
+            wanted.append(pv.meta.name)
+        for name in wanted:
+            self.attached.add(name)
+        self.mounts[pod.meta.key] = set(wanted)
+        return True, ""
+
+    def unmount_pod(self, pod_key: str) -> None:
+        """Teardown: unmount this pod's volumes; detach a volume once its
+        last mount is gone (attach_detach reconciler semantics)."""
+        gone = self.mounts.pop(pod_key, set())
+        still = set()
+        for mounts in self.mounts.values():
+            still |= mounts
+        for name in gone - still:
+            self.attached.discard(name)
+
+    def volumes_in_use(self) -> list[str]:
+        """NodeStatus.volumesInUse equivalent (sorted PV names)."""
+        return sorted(self.attached)

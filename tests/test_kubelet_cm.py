@@ -1,0 +1,204 @@
+"""Kubelet resource-manager tests: QoS classes, cgroup placement,
+node-allocatable admission, volume manager.
+
+Modeled on pkg/apis/core/v1/helper/qos tests, pkg/kubelet/cm
+qos_container_manager tests, lifecycle/predicate tests, and
+volumemanager/volume_manager_test.go.
+"""
+
+from kubernetes_tpu.api.types import FAILED, RUNNING, Container
+from kubernetes_tpu.kubelet.cm import (
+    BEST_EFFORT,
+    BURSTABLE,
+    GUARANTEED,
+    ContainerManager,
+    pod_qos,
+)
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.volumemanager import VolumeManager
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.utils.clock import FakeClock
+from tests.wrappers import (
+    make_node,
+    make_pod,
+    make_pv,
+    make_pvc,
+    with_pvc,
+)
+
+
+def pod_with(requests=None, limits=None):
+    p = make_pod("q")
+    p.spec.containers = [Container(name="c", requests=requests or {},
+                                   limits=limits or {})]
+    return p
+
+
+class TestQoS:
+    def test_guaranteed(self):
+        p = pod_with(requests={"cpu": "1", "memory": "1Gi"},
+                     limits={"cpu": "1", "memory": "1Gi"})
+        assert pod_qos(p) == GUARANTEED
+
+    def test_guaranteed_requests_defaulted_from_limits(self):
+        p = pod_with(limits={"cpu": "1", "memory": "1Gi"})
+        assert pod_qos(p) == GUARANTEED
+
+    def test_burstable(self):
+        assert pod_qos(pod_with(requests={"cpu": "1"})) == BURSTABLE
+        p = pod_with(requests={"cpu": "1", "memory": "1Gi"},
+                     limits={"cpu": "2", "memory": "1Gi"})
+        assert pod_qos(p) == BURSTABLE
+
+    def test_best_effort(self):
+        assert pod_qos(pod_with()) == BEST_EFFORT
+
+    def test_cgroup_placement(self):
+        node = make_node("n1", cpu="8")
+        cm = ContainerManager(node)
+        g = pod_with(limits={"cpu": "1", "memory": "1Gi"})
+        g.meta.uid = "gid"
+        b = pod_with(requests={"cpu": "1"})
+        b.meta.uid = "bid"
+        assert cm.cgroup_path(g) == "/kubepods/podgid"
+        assert cm.cgroup_path(b) == "/kubepods/burstable/podbid"
+
+
+class TestAllocatableAdmission:
+    def test_admits_until_full_then_out_of_cpu(self):
+        cm = ContainerManager(make_node("n1", cpu="4", mem="32Gi"))
+        ok, _, _ = cm.admit(make_pod("a", cpu="2"))
+        assert ok
+        ok, _, _ = cm.admit(make_pod("b", cpu="2"))
+        assert ok
+        ok, reason, msg = cm.admit(make_pod("c", cpu="1"))
+        assert not ok and reason == "OutOfcpu" and "cpu" in msg
+
+    def test_release_frees_capacity(self):
+        cm = ContainerManager(make_node("n1", cpu="4", mem="32Gi"))
+        assert cm.admit(make_pod("a", cpu="4"))[0]
+        assert not cm.admit(make_pod("b", cpu="1"))[0]
+        cm.release("default/a")
+        assert cm.admit(make_pod("b", cpu="1"))[0]
+
+    def test_kubelet_fails_overcommitted_pod(self):
+        """The race the predicate exists for: two pods bound to one node
+        whose combined requests exceed allocatable — the second fails
+        terminally with OutOfcpu instead of running."""
+        store = Store()
+        clock = FakeClock()
+        node = make_node("n1", cpu="4", mem="32Gi")
+        store.create(node)
+        kubelet = Kubelet(store, node, clock=clock)
+        kubelet.register()
+        for name, cpu in (("a", "3"), ("b", "3")):
+            p = make_pod(name, cpu=cpu)
+            p.spec.node_name = "n1"
+            store.create(p)
+        kubelet.sync_loop_iteration()
+        kubelet.workers.drain()
+        phases = {k: store.get("Pod", f"default/{k}").status.phase
+                  for k in ("a", "b")}
+        assert sorted(phases.values()) == [FAILED, RUNNING]
+        failed = next(k for k, v in phases.items() if v == FAILED)
+        pod = store.get("Pod", f"default/{failed}")
+        assert any(c.reason == "OutOfcpu" for c in pod.status.conditions)
+
+
+class TestVolumeManager:
+    def test_bound_claim_mounts_and_unmounts(self):
+        store = Store()
+        store.create(make_pv("pv1"))
+        store.create(make_pvc("data", volume_name="pv1", bound=True))
+        vm = VolumeManager(store)
+        pod = with_pvc(make_pod("p"), "data")
+        ok, msg = vm.mount_pod(pod)
+        assert ok and vm.volumes_in_use() == ["pv1"]
+        vm.unmount_pod("default/p")
+        assert vm.volumes_in_use() == []
+
+    def test_shared_volume_detaches_after_last_pod(self):
+        store = Store()
+        store.create(make_pv("pv1", access_modes=("ReadWriteMany",)))
+        store.create(make_pvc("data", access_modes=("ReadWriteMany",),
+                              volume_name="pv1", bound=True))
+        vm = VolumeManager(store)
+        assert vm.mount_pod(with_pvc(make_pod("p1"), "data"))[0]
+        assert vm.mount_pod(with_pvc(make_pod("p2"), "data"))[0]
+        vm.unmount_pod("default/p1")
+        assert vm.volumes_in_use() == ["pv1"]
+        vm.unmount_pod("default/p2")
+        assert vm.volumes_in_use() == []
+
+    def test_unbound_claim_blocks(self):
+        store = Store()
+        store.create(make_pvc("data"))
+        vm = VolumeManager(store)
+        ok, msg = vm.mount_pod(with_pvc(make_pod("p"), "data"))
+        assert not ok and "not bound" in msg
+
+    def test_running_pod_keeps_volumes_after_claim_deleted(self):
+        """A mounted pod must NOT be demoted when its claim later vanishes
+        (real kubelet never unmounts behind a live pod)."""
+        store = Store()
+        store.create(make_pv("pv1"))
+        store.create(make_pvc("data", volume_name="pv1", bound=True))
+        vm = VolumeManager(store)
+        pod = with_pvc(make_pod("p"), "data")
+        assert vm.mount_pod(pod)[0]
+        store.delete("PersistentVolumeClaim", "default/data")
+        ok, _ = vm.mount_pod(pod)  # re-sync of the running pod
+        assert ok and vm.volumes_in_use() == ["pv1"]
+
+    def test_blocked_pod_reports_unmounted_volumes(self):
+        """The stall must be diagnosable: Ready=False carries the
+        unmounted-volumes message even before any sandbox exists."""
+        store = Store()
+        clock = FakeClock()
+        node = make_node("n1", cpu="8")
+        store.create(node)
+        kubelet = Kubelet(store, node, clock=clock)
+        kubelet.register()
+        store.create(make_pvc("data"))
+        pod = with_pvc(make_pod("p", cpu="1"), "data")
+        pod.spec.node_name = "n1"
+        store.create(pod)
+        kubelet.sync_loop_iteration()
+        kubelet.workers.drain()
+        got = store.get("Pod", "default/p")
+        ready = next(c for c in got.status.conditions if c.type == "Ready")
+        assert ready.status == "False"
+        assert "unmounted volumes" in ready.message
+        assert "not bound" in ready.message
+
+    def test_kubelet_blocks_containers_until_bound(self):
+        """WaitForAttachAndMount end-to-end: the pod waits (no containers)
+        while its claim is unbound; once bound, the next sync starts it."""
+        store = Store()
+        clock = FakeClock()
+        node = make_node("n1", cpu="8")
+        store.create(node)
+        kubelet = Kubelet(store, node, clock=clock)
+        kubelet.register()
+        store.create(make_pvc("data"))
+        pod = with_pvc(make_pod("p", cpu="1"), "data")
+        pod.spec.node_name = "n1"
+        store.create(pod)
+        kubelet.sync_loop_iteration()
+        kubelet.workers.drain()
+        assert store.get("Pod", "default/p").status.phase != RUNNING
+        assert kubelet.runtime.list_containers() == []
+        # bind the claim (PV controller's job) and retry via housekeeping
+        store.create(make_pv("pv1"))
+        pvc = store.get("PersistentVolumeClaim", "default/data")
+        pvc.spec.volume_name = "pv1"
+        from kubernetes_tpu.api.storage import CLAIM_BOUND
+
+        pvc.status.phase = CLAIM_BOUND
+        store.update(pvc, check_version=False)
+        for _ in range(3):
+            clock.step(1.0)
+            kubelet.sync_loop_iteration()
+            kubelet.workers.drain()
+        assert store.get("Pod", "default/p").status.phase == RUNNING
+        assert kubelet.volume_manager.volumes_in_use() == ["pv1"]

@@ -150,6 +150,19 @@ class TestTokens:
         with pytest.raises(AdmissionError):
             admit("UPDATE", changed)
 
+    def test_clearing_sa_name_carries_identity_forward(self):
+        """An update omitting serviceAccountName must not erase identity
+        (nor bypass immutability via the empty value)."""
+        store = self._store_with_sa()
+        admit = service_account_admission(store)
+        pod = make_pod("p")
+        admit("CREATE", pod)
+        store.create(pod)
+        update = store.get("Pod", "default/p")
+        update.spec.service_account_name = ""
+        admit("UPDATE", update)
+        assert update.spec.service_account_name == "default"
+
     def test_foreign_tokens_fall_through(self):
         store = self._store_with_sa()
         issuer = ServiceAccountIssuer(store)
