@@ -97,13 +97,31 @@ class DeviceClass:
 
 
 @dataclass(frozen=True)
-class DeviceRequest:
-    """One device request inside a claim (resource/v1 DeviceRequest)."""
+class DeviceSubRequest:
+    """One alternative inside a prioritized-list request (resource/v1
+    DeviceSubRequest, KEP-4816)."""
 
     name: str
     device_class_name: str = ""
     selectors: tuple[DeviceSelector, ...] = ()
     count: int = 1
+
+
+@dataclass(frozen=True)
+class DeviceRequest:
+    """One device request inside a claim (resource/v1 DeviceRequest).
+
+    Either the flat fields describe exactly one shape, or
+    `first_available` lists alternatives tried IN ORDER — the first
+    satisfiable subrequest wins (the prioritized-list feature: "give me an
+    H100, else any GPU"). When `first_available` is set the flat fields
+    are ignored (the reference's oneOf exactly/firstAvailable)."""
+
+    name: str
+    device_class_name: str = ""
+    selectors: tuple[DeviceSelector, ...] = ()
+    count: int = 1
+    first_available: tuple["DeviceSubRequest", ...] = ()
 
 
 @dataclass

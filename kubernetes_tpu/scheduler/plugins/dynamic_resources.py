@@ -51,9 +51,11 @@ class _ClaimState:
     # exact candidate order a full slice walk would produce
     inv_global: list = field(default_factory=list)
     inv_by_node: dict = field(default_factory=dict)
-    # claim key -> [(driver, selectors)] resolved once (DeviceClass lookups
-    # are node-independent; re-resolving per node deepcopied the class per
-    # (pod, node) at 500-node scale)
+    # claim key -> per-request variant lists, each entry
+    # (subrequest name, driver, selectors, count) tried in order — see
+    # Allocator._request_variants. Resolved once per cycle (DeviceClass
+    # lookups are node-independent; re-resolving per node deepcopied the
+    # class per (pod, node) at 500-node scale)
     requirements: dict = field(default_factory=dict)
     needs_allocation: bool = False
     # node name -> {claim key -> AllocationResult} computed by Filter
@@ -123,15 +125,31 @@ class Allocator:
         self.store = store
         self.manager = manager
 
-    def _class_requirements(self, request: DeviceRequest):
+    def _resolve_class(self, device_class_name: str, selectors):
         driver = ""
-        selectors = list(request.selectors)
-        if request.device_class_name:
-            dc = self.store.try_get("DeviceClass", request.device_class_name)
+        out = list(selectors)
+        if device_class_name:
+            dc = self.store.try_get("DeviceClass", device_class_name)
             if dc is not None:
                 driver = dc.driver
-                selectors.extend(dc.selectors)
-        return driver, selectors
+                out.extend(dc.selectors)
+        return driver, out
+
+    def _request_variants(self, request: DeviceRequest):
+        """[(subrequest name, driver, selectors, count)] tried in order —
+        a plain request is its own single variant; a prioritized-list
+        request (KEP-4816 firstAvailable) yields one variant per
+        alternative."""
+        if request.first_available:
+            return [
+                (sub.name, *self._resolve_class(sub.device_class_name,
+                                                sub.selectors), sub.count)
+                for sub in request.first_available
+            ]
+        driver, selectors = self._resolve_class(
+            request.device_class_name, request.selectors
+        )
+        return [("", driver, selectors, request.count)]
 
     @staticmethod
     def _merged_inventory(cycle_state, node_name: str):
@@ -199,28 +217,39 @@ class Allocator:
         picked: list[DeviceAllocationResult] = []
         newly: list[tuple[str, str, str]] = []
         for ri, request in enumerate(claim.spec.requests):
-            if reqs is not None:
-                driver, selectors = reqs[ri]
-            else:
-                driver, selectors = self._class_requirements(request)
-            need = request.count
-            for drv, pool, dev in inventory:
+            variants = (reqs[ri] if reqs is not None
+                        else self._request_variants(request))
+            satisfied = False
+            for sub_name, driver, selectors, count in variants:
+                picked_v: list[DeviceAllocationResult] = []
+                newly_v: list[tuple[str, str, str]] = []
+                need = count
+                # the allocation result names the winning alternative as
+                # <request>/<subrequest> (the reference's format)
+                result_name = (f"{request.name}/{sub_name}" if sub_name
+                               else request.name)
+                for drv, pool, dev in inventory:
+                    if need == 0:
+                        break
+                    if driver and drv != driver:
+                        continue
+                    key = (drv, pool, dev.name)
+                    if key in taken or key in newly or key in newly_v:
+                        continue
+                    if all(sel.matches(dev.attributes,
+                                       capacity=dev.capacity,
+                                       driver=drv, name=dev.name)
+                           for sel in selectors):
+                        picked_v.append(DeviceAllocationResult(
+                            result_name, drv, pool, dev.name))
+                        newly_v.append(key)
+                        need -= 1
                 if need == 0:
-                    break
-                if driver and drv != driver:
-                    continue
-                key = (drv, pool, dev.name)
-                if key in taken or key in newly:
-                    continue
-                if all(sel.matches(dev.attributes, capacity=dev.capacity,
-                                   driver=drv, name=dev.name)
-                       for sel in selectors):
-                    picked.append(
-                        DeviceAllocationResult(request.name, drv, pool, dev.name)
-                    )
-                    newly.append(key)
-                    need -= 1
-            if need > 0:
+                    picked.extend(picked_v)
+                    newly.extend(newly_v)
+                    satisfied = True
+                    break  # firstAvailable: the first full fit wins
+            if not satisfied:
                 return None
         taken.update(newly)
         return AllocationResult(devices=tuple(picked), node_name=node_name)
@@ -288,7 +317,7 @@ class DynamicResources(Plugin):
                     for dev in sl.devices:
                         lst.append((idx, sl.driver, pool, dev))
             s.requirements = {
-                c.meta.key: [self.allocator._class_requirements(r)
+                c.meta.key: [self.allocator._request_variants(r)
                              for r in c.spec.requests]
                 for c in s.claims
             }

@@ -180,3 +180,66 @@ class TestClaimStateClone:
         assert c.inv_global == s.inv_global
         assert c.inv_by_node == s.inv_by_node
         assert c.requirements == s.requirements
+
+
+class TestPrioritizedList:
+    def test_first_available_prefers_earlier_alternative(self):
+        """KEP-4816: alternatives are tried IN ORDER; the first fully
+        satisfiable subrequest wins and names the allocation
+        <request>/<subrequest>."""
+        from kubernetes_tpu.api.dra import DeviceSubRequest
+
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_slice("n1", n_devices=2, kind="big"))
+        req = DeviceRequest(name="accel", first_available=(
+            DeviceSubRequest(name="big", count=1, selectors=(
+                DeviceSelector(key="kind", operator="In", values=("big",)),)),
+            DeviceSubRequest(name="small", count=1, selectors=(
+                DeviceSelector(key="kind", operator="In", values=("small",)),)),
+        ))
+        store.create(make_claim("c1", requests=(req,)))
+        store.create(claim_pod(make_pod("p1", cpu="1"), "c1"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        claim = store.get("ResourceClaim", "default/c1")
+        assert claim.status.allocation.devices[0].request == "accel/big"
+
+    def test_first_available_falls_through_when_preferred_exhausted(self):
+        from kubernetes_tpu.api.dra import DeviceSubRequest
+
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_slice("n1", n_devices=4, kind="small"))
+        req = DeviceRequest(name="accel", first_available=(
+            DeviceSubRequest(name="big", count=1, selectors=(
+                DeviceSelector(key="kind", operator="In", values=("big",)),)),
+            DeviceSubRequest(name="small", count=2, selectors=(
+                DeviceSelector(key="kind", operator="In", values=("small",)),)),
+        ))
+        store.create(make_claim("c1", requests=(req,)))
+        store.create(claim_pod(make_pod("p1", cpu="1"), "c1"))
+        s = new_scheduler(store)
+        assert s.schedule_pending() == 1
+        claim = store.get("ResourceClaim", "default/c1")
+        devs = claim.status.allocation.devices
+        assert len(devs) == 2
+        assert all(d.request == "accel/small" for d in devs)
+
+    def test_all_alternatives_exhausted_unschedulable(self):
+        from kubernetes_tpu.api.dra import DeviceSubRequest
+
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(make_slice("n1", n_devices=1, kind="tiny"))
+        req = DeviceRequest(name="accel", first_available=(
+            DeviceSubRequest(name="big", count=1, selectors=(
+                DeviceSelector(key="kind", operator="In", values=("big",)),)),
+            DeviceSubRequest(name="small", count=1, selectors=(
+                DeviceSelector(key="kind", operator="In", values=("small",)),)),
+        ))
+        store.create(make_claim("c1", requests=(req,)))
+        store.create(claim_pod(make_pod("p1", cpu="1"), "c1"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        assert node_of(store, "p1") == ""
