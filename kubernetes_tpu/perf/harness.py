@@ -271,7 +271,8 @@ class WorkloadExecutor:
             i = self._node_seq
             self._node_seq += 1
             self.store.create(
-                node_from_manifest(template, f"node-{i}", zone=f"zone-{i % zones}")
+                node_from_manifest(template, f"node-{i}", zone=f"zone-{i % zones}"),
+                copy_return=False,
             )
         self.scheduler.pump()
 
@@ -297,7 +298,7 @@ class WorkloadExecutor:
                 self._attach_volume(pod, i, pvc_t, pv_t, namespace)
             if claims_spec is not None:
                 self._attach_claim(pod, i, claims_spec, namespace)
-            self.store.create(pod)
+            self.store.create(pod, copy_return=False)
         if collect:
             self._measured += n
         # steady-state scheduling after each creation op (the reference's
@@ -423,7 +424,7 @@ class WorkloadExecutor:
                 from ..api.types import SchedulingGroup
 
                 pod.spec.scheduling_group = SchedulingGroup(pod_group_name=name)
-                self.store.create(pod)
+                self.store.create(pod, copy_return=False)
         self._barrier()
 
     def _op_createDaemonSetPods(self, op: dict) -> None:
@@ -460,7 +461,7 @@ class WorkloadExecutor:
                     ),),
                 ),)),
             ))
-            self.store.create(pod)
+            self.store.create(pod, copy_return=False)
             n += 1
         if collect:
             self._measured += n

@@ -121,6 +121,7 @@ class SchedulingQueue:
         self._event_seq = itertools.count(1)
         self._event_log: list[tuple[int, ClusterEvent, Any, Any]] = []
         self._in_flight: dict[str, _InFlightPod] = {}
+        self._min_inflight_seq: int | None = None  # gc cache (monotonic)
         self._closed = False
         self.moved_count = 0  # schedulingCycle counter for AddUnschedulableIfNotPresent
         # nominator (backend/queue/nominator.go)
@@ -291,15 +292,36 @@ class SchedulingQueue:
 
     def done(self, key: str) -> None:
         with self._mu:
-            self._in_flight.pop(key, None)
-            self._gc_event_log_locked()
+            p = self._in_flight.pop(key, None)
+            self._gc_event_log_locked(p.event_seq if p is not None else None)
 
-    def _gc_event_log_locked(self) -> None:
+    def _gc_event_log_locked(self, removed_seq: int | None = None) -> None:
+        """Amortized: event seqs are monotonic, so the in-flight minimum
+        only moves when the CURRENT minimum leaves — recomputing it on
+        every done() made wave draining O(wave²) in in-flight scans."""
+        if not self._event_log:
+            if not self._in_flight:
+                self._min_inflight_seq = None
+            elif (removed_seq is not None
+                  and removed_seq == self._min_inflight_seq):
+                # the cached minimum just left while the log was empty: a
+                # stale cache would satisfy `removed_seq > min` for every
+                # later pod (seqs are monotonic) and disable GC forever
+                self._min_inflight_seq = None
+            return
         if not self._in_flight:
             self._event_log.clear()
+            self._min_inflight_seq = None
             return
-        min_seq = min(p.event_seq for p in self._in_flight.values())
-        self._event_log = [e for e in self._event_log if e[0] > min_seq]
+        if (self._min_inflight_seq is not None and removed_seq is not None
+                and removed_seq > self._min_inflight_seq):
+            return  # the min didn't change; the log can't shrink
+        self._min_inflight_seq = min(
+            p.event_seq for p in self._in_flight.values()
+        )
+        self._event_log = [
+            e for e in self._event_log if e[0] > self._min_inflight_seq
+        ]
 
     def add_unschedulable_if_not_present(self, qpi: QueuedPodInfo, pod_scheduling_cycle: int) -> None:
         """Return a pod after a failed attempt (scheduling_queue.go:905).
@@ -320,9 +342,10 @@ class SchedulingQueue:
             else:
                 qpi.unschedulable_count += 1
                 qpi.consecutive_errors_count = 0
+            removed_seq = inflight.event_seq if inflight is not None else None
             if qpi.gated:
                 self._unschedulable[key] = qpi
-                self._gc_event_log_locked()
+                self._gc_event_log_locked(removed_seq)
                 return
             requeue = False
             if inflight is not None:
@@ -332,7 +355,7 @@ class SchedulingQueue:
                     if self._is_worth_requeuing(qpi, ev, old, new):
                         requeue = True
                         break
-            self._gc_event_log_locked()
+            self._gc_event_log_locked(removed_seq)
             if not requeue and not qpi.unschedulable_plugins and not qpi.pending_plugins:
                 # rejected by no plugin (scheduler/bind error): retriable — go
                 # through backoff, never park (reference: backoffQ for errors)

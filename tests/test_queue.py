@@ -324,3 +324,31 @@ class TestNominator:
         assert q.nominated_node_for(p) == "n1"
         q.delete_nominated_pod_if_exists(p)
         assert q.nominated_pods_for_node("n1") == []
+
+
+class TestEventLogGC:
+    def test_min_cache_invalidated_when_min_leaves_on_empty_log(self):
+        """Regression: the cached in-flight minimum must not survive its
+        pod leaving while the log is empty — a stale cache would disable
+        event-log GC for the rest of the run (seqs are monotonic)."""
+        clock = FakeClock()
+        q = new_queue(clock)
+        qadd(q, make_pod("a"))
+        qadd(q, make_pod("b"))
+        qa = q.pop()
+        qb = q.pop()
+        # an event while both are in flight, then a failed return for b
+        # filters the log and caches min = a's seq
+        q.move_all_to_active_or_backoff(ClusterEvent(ev.WILDCARD, ev.ALL))
+        qb.unschedulable_plugins = set()  # error return -> error backoff
+        q.add_unschedulable_if_not_present(qb, q.moved_count)
+        # a (the cached minimum) completes while the log is empty
+        q.done(qa.key)
+        clock.step(1.1)  # error backoff expires
+        # pop b again so it's in flight, fire events, finish it: the log
+        # must GC back to empty (a stale min cache would keep them forever)
+        qb2 = q.pop(timeout=0.2)
+        assert qb2 is not None
+        q.move_all_to_active_or_backoff(ClusterEvent(ev.WILDCARD, ev.ALL))
+        q.done(qb2.key)
+        assert q._event_log == []
