@@ -61,18 +61,28 @@ class DeviceSelector:
 
 @dataclass(frozen=True)
 class Device:
-    """One allocatable device in a ResourceSlice (resource/v1 BasicDevice)."""
+    """One allocatable device in a ResourceSlice (resource/v1 BasicDevice).
+
+    consumes_counters makes the device a PARTITION of a physical device
+    (KEP-4815 partitionable devices): counter-set name → {counter →
+    amount} drawn from the slice's shared_counters; partitions of one
+    physical device can only be allocated while the shared budget holds."""
 
     name: str
     attributes: Mapping[str, object] = field(default_factory=dict)
     capacity: Mapping[str, int] = field(default_factory=dict)
+    consumes_counters: Mapping[str, Mapping[str, int]] = field(
+        default_factory=dict
+    )
 
 
 @dataclass
 class ResourceSlice:
     """Per-(node, driver, pool) device inventory published by a DRA driver
     (resource/v1 ResourceSlice). node_name == "" means network-attached
-    devices available to every node (all_nodes)."""
+    devices available to every node (all_nodes). shared_counters:
+    counter-set name → {counter → capacity} budgeting the slice's
+    partitionable devices (KEP-4815 CounterSet)."""
 
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     node_name: str = ""
@@ -80,6 +90,9 @@ class ResourceSlice:
     pool: str = "default"
     devices: tuple[Device, ...] = ()
     all_nodes: bool = False
+    shared_counters: Mapping[str, Mapping[str, int]] = field(
+        default_factory=dict
+    )
 
     kind = "ResourceSlice"
 

@@ -57,6 +57,12 @@ class _ClaimState:
     # lookups are node-independent; re-resolving per node deepcopied the
     # class per (pod, node) at 500-node scale)
     requirements: dict = field(default_factory=dict)
+    # partitionable devices (KEP-4815): counter budgets + per-device
+    # consumption, and the cluster-wide use already committed by existing
+    # allocations — all keyed (driver, scoped pool, counter-set name)
+    counter_caps: dict = field(default_factory=dict)
+    device_consumes: dict = field(default_factory=dict)
+    base_counter_use: dict = field(default_factory=dict)
     needs_allocation: bool = False
     # node name -> {claim key -> AllocationResult} computed by Filter
     allocations_per_node: dict[str, dict[str, AllocationResult]] = field(
@@ -77,6 +83,10 @@ class _ClaimState:
         c.inv_global = list(self.inv_global)
         c.inv_by_node = {n: list(v) for n, v in self.inv_by_node.items()}
         c.requirements = dict(self.requirements)
+        c.counter_caps = dict(self.counter_caps)
+        c.device_consumes = dict(self.device_consumes)
+        c.base_counter_use = dict(self.base_counter_use)  # inner dicts
+        # are read-only too: Filter copies them before mutating
         c.allocations_per_node = {
             n: dict(m) for n, m in self.allocations_per_node.items()
         }
@@ -195,11 +205,62 @@ class Allocator:
                     out.append((sl.driver, pool, dev))
         return out
 
+    @staticmethod
+    def _counters_ok(caps: dict, uses: list[dict], drv: str, pool: str,
+                     cons) -> bool:
+        """KEP-4815: every counter the partition consumes must fit what is
+        left of its set's budget after all use layers (committed + this
+        claim + this variant)."""
+        for set_name, cnts in cons.items():
+            cap = caps.get((drv, pool, set_name))
+            if cap is None:
+                return False  # partition without a published budget
+            for cname, amt in cnts.items():
+                used = sum(
+                    u.get((drv, pool, set_name), {}).get(cname, 0)
+                    for u in uses
+                )
+                if used + amt > cap.get(cname, 0):
+                    return False
+        return True
+
+    @staticmethod
+    def _bump_counters(use: dict, drv: str, pool: str, cons) -> None:
+        for set_name, cnts in cons.items():
+            u = use.setdefault((drv, pool, set_name), {})
+            for cname, amt in cnts.items():
+                u[cname] = u.get(cname, 0) + amt
+
+    @staticmethod
+    def _merge_use(dst: dict, src: dict) -> None:
+        for k, cnts in src.items():
+            u = dst.setdefault(k, {})
+            for cname, amt in cnts.items():
+                u[cname] = u.get(cname, 0) + amt
+
+    @staticmethod
+    def _counter_tables(slices) -> tuple[dict, dict]:
+        """(caps, consumes) keyed (driver, scoped pool, ...) from a raw
+        slice list — the legacy allocate() path must enforce KEP-4815
+        budgets exactly like the PreFilter-built cycle state does."""
+        caps: dict = {}
+        consumes: dict = {}
+        for sl in slices:
+            pool = sl.pool if sl.all_nodes else f"{sl.node_name}/{sl.pool}"
+            for set_name, c in (sl.shared_counters or {}).items():
+                caps[(sl.driver, pool, set_name)] = c
+            for dev in sl.devices:
+                if dev.consumes_counters:
+                    consumes[(sl.driver, pool, dev.name)] = \
+                        dev.consumes_counters
+        return caps, consumes
+
     def allocate(
         self, claim: ResourceClaim, node_name: str,
         taken: set[tuple[str, str, str]],
         slices: list | None = None,
         cycle_state=None,
+        counter_use: dict | None = None,
     ) -> AllocationResult | None:
         """Greedy per-request allocation; mutates `taken` on success so one
         Filter pass can allocate several claims without double-booking.
@@ -216,6 +277,21 @@ class Allocator:
             inventory = self.node_inventory(slices, node_name)
         picked: list[DeviceAllocationResult] = []
         newly: list[tuple[str, str, str]] = []
+        committed_use = counter_use if counter_use is not None else {}
+        claim_use: dict = {}
+        if cycle_state is not None:
+            consumes = cycle_state.device_consumes
+            caps = cycle_state.counter_caps
+        else:
+            caps, consumes = self._counter_tables(slices)
+            if counter_use is None and consumes:
+                # no precomputed committed use: derive it from the taken
+                # set so already-allocated partitions count against caps
+                for key in taken:
+                    cons = consumes.get(key)
+                    if cons:
+                        self._bump_counters(committed_use, key[0], key[1],
+                                            cons)
         for ri, request in enumerate(claim.spec.requests):
             variants = (reqs[ri] if reqs is not None
                         else self._request_variants(request))
@@ -223,6 +299,7 @@ class Allocator:
             for sub_name, driver, selectors, count in variants:
                 picked_v: list[DeviceAllocationResult] = []
                 newly_v: list[tuple[str, str, str]] = []
+                use_v: dict = {}
                 need = count
                 # the allocation result names the winning alternative as
                 # <request>/<subrequest> (the reference's format)
@@ -236,22 +313,34 @@ class Allocator:
                     key = (drv, pool, dev.name)
                     if key in taken or key in newly or key in newly_v:
                         continue
-                    if all(sel.matches(dev.attributes,
-                                       capacity=dev.capacity,
-                                       driver=drv, name=dev.name)
-                           for sel in selectors):
-                        picked_v.append(DeviceAllocationResult(
-                            result_name, drv, pool, dev.name))
-                        newly_v.append(key)
-                        need -= 1
+                    if not all(sel.matches(dev.attributes,
+                                           capacity=dev.capacity,
+                                           driver=drv, name=dev.name)
+                               for sel in selectors):
+                        continue
+                    cons = consumes.get(key)
+                    if cons is not None and not self._counters_ok(
+                        caps, [committed_use, claim_use, use_v],
+                        drv, pool, cons,
+                    ):
+                        continue  # partition budget exhausted
+                    picked_v.append(DeviceAllocationResult(
+                        result_name, drv, pool, dev.name))
+                    newly_v.append(key)
+                    if cons is not None:
+                        self._bump_counters(use_v, drv, pool, cons)
+                    need -= 1
                 if need == 0:
                     picked.extend(picked_v)
                     newly.extend(newly_v)
+                    self._merge_use(claim_use, use_v)
                     satisfied = True
                     break  # firstAvailable: the first full fit wins
             if not satisfied:
                 return None
         taken.update(newly)
+        if counter_use is not None:
+            self._merge_use(counter_use, claim_use)
         return AllocationResult(devices=tuple(picked), node_name=node_name)
 
 
@@ -308,14 +397,29 @@ class DynamicResources(Plugin):
             s.base_taken = self.manager.allocated_device_ids()
             s.slices = self.store.list_refs("ResourceSlice")
             for idx, sl in enumerate(s.slices):
-                if sl.all_nodes:
-                    for dev in sl.devices:
-                        s.inv_global.append((idx, sl.driver, sl.pool, dev))
-                else:
-                    pool = f"{sl.node_name}/{sl.pool}"
-                    lst = s.inv_by_node.setdefault(sl.node_name, [])
-                    for dev in sl.devices:
-                        lst.append((idx, sl.driver, pool, dev))
+                pool = (sl.pool if sl.all_nodes
+                        else f"{sl.node_name}/{sl.pool}")
+                for set_name, caps in (sl.shared_counters or {}).items():
+                    s.counter_caps[(sl.driver, pool, set_name)] = caps
+                target = (s.inv_global if sl.all_nodes
+                          else s.inv_by_node.setdefault(sl.node_name, []))
+                for dev in sl.devices:
+                    target.append((idx, sl.driver, pool, dev))
+                    if dev.consumes_counters:
+                        s.device_consumes[
+                            (sl.driver, pool, dev.name)
+                        ] = dev.consumes_counters
+            # counter use already committed by existing allocations
+            for key in s.base_taken:
+                cons = s.device_consumes.get(key)
+                if not cons:
+                    continue
+                for set_name, cnts in cons.items():
+                    u = s.base_counter_use.setdefault(
+                        (key[0], key[1], set_name), {}
+                    )
+                    for cname, amt in cnts.items():
+                        u[cname] = u.get(cname, 0) + amt
             s.requirements = {
                 c.meta.key: [self.allocator._request_variants(r)
                              for r in c.spec.requests]
@@ -330,6 +434,7 @@ class DynamicResources(Plugin):
             return Status()
         node_name = node_info.name
         taken = None  # per-node copy of the PreFilter-computed base set
+        counter_use: dict = {}
         node_allocs: dict[str, AllocationResult] = {}
         for claim in s.claims:
             alloc = self.manager.effective_allocation(claim)
@@ -349,8 +454,12 @@ class DynamicResources(Plugin):
                 continue
             if taken is None:
                 taken = set(s.base_taken)
+                counter_use = {
+                    k: dict(v) for k, v in s.base_counter_use.items()
+                }
             alloc = self.allocator.allocate(claim, node_name, taken,
-                                            cycle_state=s)
+                                            cycle_state=s,
+                                            counter_use=counter_use)
             if alloc is None:
                 return Status.unschedulable(ERR_CANNOT_ALLOCATE, plugin=self.name)
             node_allocs[claim.meta.key] = alloc

@@ -243,3 +243,70 @@ class TestPrioritizedList:
         s = new_scheduler(store)
         s.schedule_pending()
         assert node_of(store, "p1") == ""
+
+
+class TestPartitionableDevices:
+    def _mig_slice(self, node):
+        """One physical accelerator exposed as partitions drawing from a
+        shared memory counter (KEP-4815): two 20GiB halves and one 40GiB
+        whole — allocating the whole exhausts the halves and vice versa."""
+        return ResourceSlice(
+            meta=ObjectMeta(name=f"mig-{node}", namespace=""),
+            node_name=node,
+            driver="gpu.example.com",
+            pool="card0",
+            shared_counters={"mem": {"GiB": 40}},
+            devices=(
+                Device(name="half-a",
+                       consumes_counters={"mem": {"GiB": 20}}),
+                Device(name="half-b",
+                       consumes_counters={"mem": {"GiB": 20}}),
+                Device(name="whole",
+                       consumes_counters={"mem": {"GiB": 40}}),
+            ),
+        )
+
+    def test_partitions_share_the_counter_budget(self):
+        """Two half claims fit; a third claim (any partition) must not —
+        the physical budget is spent."""
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(self._mig_slice("n1"))
+        for i in range(3):
+            store.create(make_claim(f"c{i}"))
+            store.create(claim_pod(make_pod(f"p{i}", cpu="100m"), f"c{i}"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        placed = [i for i in range(3) if node_of(store, f"p{i}")]
+        assert len(placed) == 2
+        allocated = {
+            d.device
+            for i in placed
+            for d in store.get("ResourceClaim",
+                               f"default/c{i}").status.allocation.devices
+        }
+        assert allocated == {"half-a", "half-b"}  # the whole never fit
+
+    def test_whole_device_blocks_all_partitions(self):
+        store = Store()
+        store.create(make_node("n1"))
+        store.create(self._mig_slice("n1"))
+        store.create(make_claim("big", requests=(
+            DeviceRequest(name="gpu", selectors=(
+                DeviceSelector(key="nonexistent",
+                               operator="DoesNotExist"),),
+            ),)))
+        store.create(claim_pod(make_pod("pbig", cpu="100m"), "big"))
+        s = new_scheduler(store)
+        s.schedule_pending()
+        # first candidate in slice order is half-a; it consumes 20 GiB
+        alloc = store.get("ResourceClaim",
+                          "default/big").status.allocation
+        assert alloc.devices[0].device == "half-a"
+        # a claim needing TWO devices can only get the two halves... but
+        # half-a is taken: one half + the whole both overflow -> unschedulable
+        store.create(make_claim("two", requests=(
+            DeviceRequest(name="gpu", count=2),)))
+        store.create(claim_pod(make_pod("ptwo", cpu="100m"), "two"))
+        s.schedule_pending()
+        assert node_of(store, "ptwo") == ""
