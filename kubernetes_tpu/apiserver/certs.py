@@ -1,0 +1,36 @@
+"""Serving certificates: kubeadm's cert phase, openssl-binary form.
+
+Reference: kubeadm's `init` generates a self-signed CA and an apiserver
+serving certificate with localhost SANs (cmd/kubeadm/app/phases/certs);
+the apiserver serves TLS with it and clients verify against the CA from
+their kubeconfig. Here one self-signed certificate plays both roles (it
+IS its own CA), generated with the system openssl binary — no third-party
+Python crypto dependency.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+
+def generate_self_signed(common_name: str = "kube-apiserver",
+                         directory: str | None = None,
+                         days: int = 365) -> tuple[str, str]:
+    """(cert_path, key_path) for a self-signed serving cert with
+    localhost/127.0.0.1 SANs. The cert doubles as the client's CA."""
+    directory = directory or tempfile.mkdtemp(prefix="kube-tpu-certs-")
+    cert = os.path.join(directory, "apiserver.crt")
+    key = os.path.join(directory, "apiserver.key")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", key, "-out", cert, "-days", str(days),
+            "-subj", f"/CN={common_name}",
+            "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+        ],
+        check=True, capture_output=True,
+    )
+    os.chmod(key, 0o600)
+    return cert, key

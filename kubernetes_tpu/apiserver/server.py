@@ -946,9 +946,30 @@ class APIServer:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def serve(self, port: int = 0) -> int:
+    def serve(self, port: int = 0, tls_cert: str | None = None,
+              tls_key: str | None = None) -> int:
+        """Plain HTTP by default (insecure localhost, the in-tree trust
+        model); with tls_cert/tls_key the listener serves HTTPS (the
+        reference's secure serving — generate a pair with
+        apiserver/certs.generate_self_signed; the cert doubles as the
+        clients' CA)."""
+        if bool(tls_cert) != bool(tls_key):
+            raise ValueError(
+                "tls_cert and tls_key must be provided together — a "
+                "half-specified pair must not silently serve plaintext"
+            )
         self._http = ThreadingHTTPServer(("127.0.0.1", port), self._build_handler())
         self._http.daemon_threads = True
+        self._tls = False
+        if tls_cert and tls_key:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self._http.socket = ctx.wrap_socket(
+                self._http.socket, server_side=True
+            )
+            self._tls = True
         t = threading.Thread(target=self._http.serve_forever, daemon=True)
         t.start()
         self.port = self._http.server_port
@@ -956,7 +977,8 @@ class APIServer:
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if getattr(self, "_tls", False) else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
     def shutdown(self) -> None:
         if self._http is not None:

@@ -53,13 +53,15 @@ class RESTWatch:
     drop-in for store.Watch)."""
 
     def __init__(self, url: str, headers: dict[str, str] | None = None,
-                 binary: bool = False):
+                 binary: bool = False, ssl_context=None):
         self._events: deque[Event] = deque()
         self._cond = threading.Condition()
         self._stopped = False
         self._binary = binary
         req = urllib.request.Request(url, headers=headers or {})
-        self._resp = urllib.request.urlopen(req)  # noqa: S310 - loopback
+        self._resp = urllib.request.urlopen(  # noqa: S310 - loopback
+            req, context=ssl_context
+        )
         self._thread = threading.Thread(target=self._reader, daemon=True)
         self._thread.start()
 
@@ -145,14 +147,22 @@ class RESTStore:
     """Typed client over the API server; same surface as store.Store."""
 
     def __init__(self, base_url: str, timeout: float = 10.0,
-                 token: str = "", wire_format: str = "json"):
+                 token: str = "", wire_format: str = "json",
+                 ca_cert: str | None = None):
         """wire_format="cbor" negotiates the binary serializer both ways
         (request bodies, responses, and watch frames) — the protobuf role
-        in the reference's content-type negotiation."""
+        in the reference's content-type negotiation. ca_cert: PEM bundle
+        to verify an HTTPS apiserver against (rest.Config.TLSClientConfig
+        CAFile) — required for https:// base URLs."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token  # bearer credential (rest.Config.BearerToken)
         self.wire_format = wire_format
+        self._ssl = None
+        if ca_cert:
+            import ssl as _ssl
+
+            self._ssl = _ssl.create_default_context(cafile=ca_cert)
 
     # -- plumbing ------------------------------------------------------------
 
@@ -194,7 +204,8 @@ class RESTStore:
             headers=self._headers(),
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl) as resp:
                 return self._decode_body(
                     resp.read(), resp.headers.get("Content-Type") or ""
                 ), resp.status
@@ -270,7 +281,8 @@ class RESTStore:
         req = urllib.request.Request(
             f"{self.base_url}{path}", headers=self._headers())
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl) as resp:
                 return resp.read().decode()
         except urllib.error.HTTPError as e:
             _raise_for(e.code, e.read().decode(errors="replace"), "")
@@ -312,6 +324,7 @@ class RESTStore:
                 f"?watch=1&resourceVersion={from_revision}{sel}",
                 headers=self._headers(),
                 binary=self.wire_format == "cbor",
+                ssl_context=self._ssl,
             )
         except urllib.error.HTTPError as e:
             if e.code == 410:

@@ -25,13 +25,17 @@ from ..store.store import Store
 
 class ClusterBootstrap:
     def __init__(self, nodes: int = 3, secure: bool = False, clock=None,
-                 store: Store | None = None, backend: str = "host"):
+                 store: Store | None = None, backend: str = "host",
+                 tls: bool = False):
         from ..utils.clock import Clock
 
         self.clock = clock or Clock()
         self.store = store or Store()
         self.nodes = nodes
         self.secure = secure
+        self.tls = tls  # HTTPS serving (kubeadm's cert phase)
+        self.ca_cert: str | None = None
+        self._tls_key: str | None = None
         self.backend = backend
         self.admin_token = ""
         self.apiserver: APIServer | None = None
@@ -55,6 +59,10 @@ class ClusterBootstrap:
     def _phase_certs_and_auth(self) -> None:
         if self.secure:
             self.admin_token = secrets.token_urlsafe(16)
+        if self.tls:
+            from ..apiserver.certs import generate_self_signed
+
+            self.ca_cert, self._tls_key = generate_self_signed()
 
     def _phase_control_plane(self, serve_port: int) -> None:
         authn = authz = None
@@ -71,7 +79,11 @@ class ClusterBootstrap:
         self.apiserver = APIServer(self.store,
                                    admission=default_admission_chain(self.store),
                                    authenticator=authn, authorizer=authz)
-        self.apiserver.serve(serve_port)
+        if self.tls:
+            self.apiserver.serve(serve_port, tls_cert=self.ca_cert,
+                                 tls_key=self._tls_key)
+        else:
+            self.apiserver.serve(serve_port)
         from ..scheduler import Profile
 
         profiles = [Profile(backend=self.backend,
@@ -150,16 +162,20 @@ class ClusterBootstrap:
 
     def kubeconfig(self) -> dict:
         assert self.apiserver is not None
-        return {
+        cfg = {
             "server": self.apiserver.url,
             "token": self.admin_token,
         }
+        if self.ca_cert:
+            cfg["certificate-authority"] = self.ca_cert
+        return cfg
 
     def client(self):
         from ..client.rest import RESTStore
 
         cfg = self.kubeconfig()
-        return RESTStore(cfg["server"], token=cfg["token"])
+        return RESTStore(cfg["server"], token=cfg["token"],
+                         ca_cert=cfg.get("certificate-authority"))
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -176,9 +192,12 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description="cluster bootstrap (kubeadm init)")
     parser.add_argument("--nodes", type=int, default=3)
     parser.add_argument("--secure", action="store_true")
+    parser.add_argument("--tls", action="store_true",
+                        help="serve HTTPS with a generated self-signed cert")
     parser.add_argument("--port", type=int, default=6443)
     args = parser.parse_args(argv)
-    boot = ClusterBootstrap(nodes=args.nodes, secure=args.secure)
+    boot = ClusterBootstrap(nodes=args.nodes, secure=args.secure,
+                            tls=args.tls)
     cfg = boot.init(serve_port=args.port)
     boot.run()
     print(json.dumps(cfg))

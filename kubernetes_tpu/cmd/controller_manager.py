@@ -107,12 +107,15 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description="controller manager")
     parser.add_argument("--server", required=True, help="API server URL")
     parser.add_argument("--token", default="")
+    parser.add_argument("--cacert", default=None,
+                        help="CA bundle for an https:// server")
     parser.add_argument("--identity", default="kcm-0")
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--port", type=int, default=10257)
     args = parser.parse_args(argv)
     server = ControllerManagerServer(
-        RESTStore(args.server, token=args.token),
+        RESTStore(args.server, token=args.token,
+                  ca_cert=getattr(args, 'cacert', None)),
         identity=args.identity, leader_elect=args.leader_elect,
     )
     server.serve(args.port)

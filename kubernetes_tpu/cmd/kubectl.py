@@ -429,6 +429,8 @@ def cmd_events(client: RESTStore, args) -> int:
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="kubectl-tpu")
     parser.add_argument("--server", "-s", default=DEFAULT_SERVER)
+    parser.add_argument("--cacert", default=None,
+                        help="CA bundle for an https:// server")
     parser.add_argument("--namespace", "-n", default="default")
     sub = parser.add_subparsers(dest="verb", required=True)
 
@@ -499,7 +501,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    client = RESTStore(args.server)
+    client = RESTStore(args.server,
+                       ca_cert=getattr(args, 'cacert', None))
     verbs = {
         "get": cmd_get,
         "describe": cmd_describe,

@@ -125,6 +125,8 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description="node agent")
     parser.add_argument("--server", required=True, help="API server URL")
     parser.add_argument("--token", default="")
+    parser.add_argument("--cacert", default=None,
+                        help="CA bundle for an https:// server")
     parser.add_argument("--node-name", required=True)
     parser.add_argument("--cpu", default="8")
     parser.add_argument("--memory", default="32Gi")
@@ -133,7 +135,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--sync-period", type=float, default=0.5)
     parser.add_argument("--eviction-memory-min-bytes", type=int, default=0)
     args = parser.parse_args(argv)
-    store = RESTStore(args.server, token=args.token)
+    store = RESTStore(args.server, token=args.token,
+                      ca_cert=getattr(args, 'cacert', None))
     node = make_node(args.node_name, cpu=args.cpu, mem=args.memory,
                      zone=args.zone)
     thresholds = []

@@ -107,12 +107,15 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--node", default="")
     parser.add_argument("--server", required=True, help="API server URL")
     parser.add_argument("--token", default="", help="bearer token")
+    parser.add_argument("--cacert", default=None,
+                        help="CA bundle for an https:// server")
     parser.add_argument("--port", type=int, default=10256)
     parser.add_argument("--sync-period", type=float, default=1.0)
     args = parser.parse_args(argv)
     from ..client.rest import RESTStore
 
-    store = RESTStore(args.server, token=args.token)
+    store = RESTStore(args.server, token=args.token,
+                      ca_cert=getattr(args, 'cacert', None))
     server = ProxyServer(store, node_name=args.node,
                          sync_period_s=args.sync_period)
     server.serve(args.port)

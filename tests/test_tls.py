@@ -1,0 +1,80 @@
+"""TLS serving tests: cert generation, HTTPS apiserver, CA-verified client.
+
+Modeled on kubeadm's cert phase + the apiserver's secure serving: the
+bootstrap generates a self-signed serving certificate (doubling as the
+clients' CA), the server speaks HTTPS, and clients verify against the CA
+from their kubeconfig — including streaming watches."""
+
+import ssl
+import urllib.error
+
+import pytest
+
+from kubernetes_tpu.apiserver.certs import generate_self_signed
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTStore
+from kubernetes_tpu.cmd.bootstrap import ClusterBootstrap
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_pod
+
+
+class TestTLSServing:
+    def test_https_roundtrip_with_ca_verification(self):
+        cert, key = generate_self_signed()
+        store = Store()
+        server = APIServer(store)
+        server.serve(0, tls_cert=cert, tls_key=key)
+        try:
+            assert server.url.startswith("https://")
+            client = RESTStore(server.url, ca_cert=cert)
+            client.create(make_pod("p1"))
+            assert client.get("Pod", "default/p1").meta.name == "p1"
+            # streaming watch over TLS
+            _, rev = client.list("Pod")
+            w = client.watch("Pod", from_revision=rev)
+            client.create(make_pod("p2"))
+            ev = w.next(timeout=5)
+            assert ev is not None and ev.obj.meta.name == "p2"
+            w.stop()
+        finally:
+            server.shutdown()
+
+    def test_unverified_client_rejected(self):
+        """A client without the CA must fail the handshake — no silent
+        fallback to unverified TLS."""
+        cert, key = generate_self_signed()
+        store = Store()
+        server = APIServer(store)
+        server.serve(0, tls_cert=cert, tls_key=key)
+        try:
+            client = RESTStore(server.url)  # no ca_cert
+            with pytest.raises((ssl.SSLError, urllib.error.URLError)):
+                client.pods()
+        finally:
+            server.shutdown()
+
+    def test_bootstrap_tls_cluster_end_to_end(self):
+        """kubeadm-shaped flow: init with tls=True mints certs, serves
+        HTTPS, and the kubeconfig carries the CA; authn still applies."""
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        boot = ClusterBootstrap(nodes=2, secure=True, tls=True,
+                                clock=FakeClock())
+        cfg = boot.init()
+        try:
+            assert cfg["server"].startswith("https://")
+            assert cfg["certificate-authority"]
+            client = boot.client()
+            client.create(make_pod("web", cpu="500m"))
+            boot.converge()
+            assert client.get("Pod", "default/web").spec.node_name
+            # wrong token still 401s over TLS
+            from kubernetes_tpu.client.rest import RESTError
+
+            bad = RESTStore(cfg["server"], token="nope",
+                            ca_cert=cfg["certificate-authority"])
+            with pytest.raises(RESTError) as exc:
+                bad.pods()
+            assert exc.value.code == 401
+        finally:
+            boot.shutdown()
