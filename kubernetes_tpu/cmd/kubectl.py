@@ -3,8 +3,9 @@
 Reference: staging/src/k8s.io/kubectl + cmd/kubectl — the verb surface
 (get, describe, create -f, apply -f, delete, scale, cordon/uncordon) over
 client-go. Manifests use the api/serialization wire shape; `apply` is
-create-or-update (server-side apply's patch semantics collapse to full-object
-update against our store).
+SERVER-SIDE apply under the "kubectl" field manager (apiserver/apply.py
+fieldmanager: ownership tracking, conflict detection, dropped-field
+removal).
 """
 
 from __future__ import annotations
