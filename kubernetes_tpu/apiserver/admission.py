@@ -169,7 +169,7 @@ def crd_admission(store):
             kind = obj.spec.names.kind
             if any(c.spec.names.kind == kind
                    and c.meta.key != obj.meta.key
-                   for c in store.iter_kind("CustomResourceDefinition")):
+                   for c in store.list_refs("CustomResourceDefinition")):
                 raise AdmissionError(
                     f"kind {kind!r} is already served by another "
                     "CustomResourceDefinition", code=409)
@@ -180,8 +180,10 @@ def crd_admission(store):
             ]
             return
         if operation in ("CREATE", "UPDATE") and isinstance(obj, CustomObject):
+            # read-only scan (list_refs): iter_kind deepcopies every CRD,
+            # which puts O(CRDs) copies on the custom-object write path
             crd = next(
-                (c for c in store.iter_kind("CustomResourceDefinition")
+                (c for c in store.list_refs("CustomResourceDefinition")
                  if c.spec.names.kind == obj.kind), None,
             )
             if crd is None:

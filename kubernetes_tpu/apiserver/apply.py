@@ -66,6 +66,19 @@ def field_paths(doc: dict, prefix: str = "") -> set[str]:
     return out
 
 
+def _get_path(doc: dict, path: str) -> tuple:
+    """(value, present) at an RFC 6901-escaped '/' path."""
+    node = doc
+    for t in path.split("/"):
+        if not isinstance(node, dict):
+            return None, False
+        k = _unescape(t)
+        if k not in node:
+            return None, False
+        node = node[k]
+    return node, True
+
+
 def _delete_path(doc: dict, path: str) -> None:
     parts = [_unescape(t) for t in path.split("/")]
     node = doc
@@ -94,23 +107,52 @@ def apply_doc(stored: dict | None, applied: dict, manager: str,
     meta = (stored or {}).get("meta") or {}
     mf: list[dict] = [dict(e) for e in (meta.get("managed_fields") or ())]
 
+    # Prefix (ancestor/descendant) overlap is a conflict only when it would
+    # CLOBBER — an atomic (non-dict) value replacing the other side's
+    # subtree.  An empty-map leaf over another's children merges harmlessly
+    # (and is how a manager retreats from a map while others keep children).
+    # Only atomic new paths can clobber downward, so the prefix scan is
+    # restricted to them; exact matches use a set intersection so the
+    # common (no-overlap) case stays O(n).
+    atomic_new = sorted(
+        p for p in new_paths
+        if not isinstance(_get_path(applied, p)[0], dict)
+    )
+    new_sorted = sorted(new_paths)
+
+    def _stored_atomic(o: str) -> bool:
+        val, ok = _get_path(stored or {}, o)
+        return ok and not isinstance(val, dict)
+
     conflicts: list[tuple[str, str]] = []
-    for entry in mf:
+    contested: dict[int, set[str]] = {}
+    for i, entry in enumerate(mf):
         if entry.get("manager") == manager:
             continue
         owned = set(entry.get("fields") or ())
-        conflicts.extend(
-            (p, entry["manager"]) for p in sorted(new_paths & owned)
-        )
+        pairs = [(p, p) for p in new_paths & owned]
+        # downward clobber: an atomic new value replaces o's whole subtree
+        pairs += [(p, o) for p in atomic_new for o in owned
+                  if o.startswith(p + "/")]
+        # upward clobber: any new path landing UNDER an owned atomic value
+        # replaces it with a dict (includes empty-map leaves)
+        pairs += [(p, o) for p in new_sorted for o in owned
+                  if p.startswith(o + "/") and _stored_atomic(o)]
+        if pairs:
+            contested[i] = {o for _, o in pairs}
+            seen: set[str] = set()
+            for p, _ in sorted(pairs):
+                if p not in seen:
+                    seen.add(p)
+                    conflicts.append((p, entry["manager"]))
     if conflicts:
         if not force:
             raise ApplyConflict(conflicts)
         # force: ownership of the contested fields transfers to us
-        for entry in mf:
-            if entry.get("manager") != manager:
-                entry["fields"] = sorted(
-                    set(entry.get("fields") or ()) - new_paths
-                )
+        for i, hit in contested.items():
+            mf[i]["fields"] = sorted(
+                set(mf[i].get("fields") or ()) - hit
+            )
 
     prev = next((e for e in mf
                  if e.get("manager") == manager
@@ -126,10 +168,15 @@ def apply_doc(stored: dict | None, applied: dict, manager: str,
         for entry in mf:
             if entry is not prev:
                 others |= set(entry.get("fields") or ())
+        # our own new paths are protected too: reshaping an owned atomic
+        # path into a dict ("spec/affinity": "none" -> {"zone": ...}) drops
+        # the old leaf from our set while the new config lives UNDER it —
+        # deleting the ancestor would wipe the configuration just applied
+        protected = others | new_paths
         for path in sorted(set(prev.get("fields") or ()) - new_paths):
             subtree = path + "/"
-            if path not in others and not any(
-                o.startswith(subtree) for o in others
+            if path not in protected and not any(
+                o.startswith(subtree) for o in protected
             ):
                 _delete_path(merged, path)
 

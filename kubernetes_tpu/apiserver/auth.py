@@ -69,14 +69,17 @@ class ServiceAccountIssuer:
 
         from ..api.rbac import service_account_username
 
-        sa = self.store.try_get("ServiceAccount", f"{namespace}/{name}")
+        # a delete racing the TokenRequest must fail the request (store
+        # NotFoundError), not mint an instance-unbound token that would
+        # survive recreate
+        sa = self.store.get("ServiceAccount", f"{namespace}/{name}")
         payload = self._b64(json.dumps({
             "sub": service_account_username(namespace, name),
             "ns": namespace, "name": name,
             # the token binds to the account INSTANCE: delete + recreate
             # must not resurrect previously minted tokens
             # (pkg/serviceaccount claims carry the UID the same way)
-            "uid": sa.meta.uid if sa is not None else "",
+            "uid": sa.meta.uid,
             "exp": self._now() + expiration_seconds,
         }, sort_keys=True).encode())
         return f"sa.{payload}.{self._sign(payload)}"
@@ -107,7 +110,9 @@ class ServiceAccountIssuer:
             raise AuthenticationError(
                 "service account has been deleted"
             )
-        if claims.get("uid") and sa.meta.uid != claims["uid"]:
+        if sa.meta.uid != claims.get("uid"):
+            # covers both a stale uid AND an empty/absent uid claim — a
+            # token that can't prove its instance binding is rejected
             raise AuthenticationError(
                 "service account token predates the current account "
                 "instance"

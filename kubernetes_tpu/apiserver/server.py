@@ -509,8 +509,10 @@ class APIServer:
                         ev_type = ev.type
                         if selected is not None:
                             curr = selected(ev.obj)
-                            prev = (ev.prev_obj is not None
-                                    and selected(ev.prev_obj))
+                            # a MODIFIED without prev_obj degrades to a plain
+                            # MODIFIED (prev := curr), never a spurious ADDED
+                            prev = (selected(ev.prev_obj)
+                                    if ev.prev_obj is not None else curr)
                             if ev_type == "MODIFIED" and curr and not prev:
                                 ev_type = "ADDED"  # transitioned in
                             elif ev_type == "MODIFIED" and prev and not curr:
@@ -586,12 +588,6 @@ class APIServer:
                             self._error(400, "BadRequest",
                                         "token issuance not configured")
                             return
-                        if server.store.try_get(
-                            "ServiceAccount", key
-                        ) is None:
-                            self._error(404, "NotFound",
-                                        f"ServiceAccount {key}")
-                            return
                         exp = int(body.get("expirationSeconds", 3600))
                         if exp <= 0:
                             self._error(400, "BadRequest",
@@ -600,8 +596,16 @@ class APIServer:
                             return
                         exp = max(exp, 600)  # the reference floors at 10m
                         ns, _, name = key.partition("/")
+                        try:
+                            token = issuer.issue(ns, name, exp)
+                        except NotFoundError:
+                            # issue() itself is the existence check — the
+                            # SA is absent (or a delete raced the request)
+                            self._error(404, "NotFound",
+                                        f"ServiceAccount {key}")
+                            return
                         self._send_json(201, {
-                            "token": issuer.issue(ns, name, exp),
+                            "token": token,
                             "expirationSeconds": exp,
                         })
                         return

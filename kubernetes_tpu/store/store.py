@@ -203,6 +203,15 @@ class Store:
             cur = objs.get(key)
             if cur is None:
                 raise NotFoundError(f"{kind} {key}")
+            if obj is cur:
+                # a caller mutating a reference it got from list_refs()/an
+                # event and updating with it would defeat CAS (rv trivially
+                # matches) AND corrupt prev_obj (prev would alias the
+                # mutated object, hiding selector transitions)
+                raise ValueError(
+                    f"{kind} {key}: update() called with the stored object "
+                    "itself — store reads are read-only; update a copy"
+                )
             if check_version and obj.meta.resource_version != cur.meta.resource_version:
                 raise ConflictError(
                     f"{kind} {key}: rv {obj.meta.resource_version} != {cur.meta.resource_version}"

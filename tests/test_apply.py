@@ -210,3 +210,45 @@ class TestApplyOverHTTP:
             assert store.get("Pod", "default/p").meta.labels["app"] == "x"
         finally:
             server.shutdown()
+
+
+class TestAtomicOverlapConflicts:
+    """ADVICE r4: ancestor/descendant ownership overlap conflicts when the
+    overlap would clobber (atomic value over a subtree), while an
+    empty-map retreat stays conflict-free (covered above)."""
+
+    def test_atomic_value_over_owned_child_conflicts(self):
+        one = apply_doc(None, {"spec": {"affinity": {"zone": "us-a"}}},
+                        "mgr-a")
+        with pytest.raises(ApplyConflict):
+            apply_doc(one, {"spec": {"affinity": "none"}}, "mgr-b")
+        # force transfers the subtree
+        two = apply_doc(one, {"spec": {"affinity": "none"}}, "mgr-b",
+                        force=True)
+        assert two["spec"]["affinity"] == "none"
+        # mgr-a's only field transferred away -> its entry is dropped
+        assert not any(
+            "spec/affinity/zone" in e.get("fields", ())
+            for e in two["meta"]["managed_fields"]
+            if e["manager"] == "mgr-a"
+        )
+
+    def test_dict_under_owned_atomic_conflicts(self):
+        one = apply_doc(None, {"spec": {"affinity": "none"}}, "mgr-a")
+        with pytest.raises(ApplyConflict):
+            apply_doc(one, {"spec": {"affinity": {"zone": "us-a"}}},
+                      "mgr-b")
+
+    def test_empty_map_coexists_with_owned_child(self):
+        one = apply_doc(None, {"spec": {"affinity": {"zone": "us-a"}}},
+                        "mgr-a")
+        two = apply_doc(one, {"spec": {"affinity": {}}}, "mgr-b")
+        assert two["spec"]["affinity"]["zone"] == "us-a"
+
+    def test_same_manager_atomic_to_dict_reshape_keeps_new_config(self):
+        """Reshaping an owned atomic path into a dict must not delete the
+        just-applied children via dropped-field removal."""
+        one = apply_doc(None, {"spec": {"affinity": "none"}}, "mgr-a")
+        two = apply_doc(one, {"spec": {"affinity": {"zone": "us-a"}}},
+                        "mgr-a")
+        assert two["spec"]["affinity"] == {"zone": "us-a"}

@@ -315,3 +315,35 @@ class TestTokenRequestEndToEnd:
             assert exc.value.code == 403
         finally:
             server.shutdown()
+
+
+class TestTokenUIDBinding:
+    """ADVICE r4: a delete racing TokenRequest must not mint an
+    instance-unbound (uid-less) token that survives recreate."""
+
+    def test_issue_for_absent_sa_raises(self):
+        from kubernetes_tpu.store.store import NotFoundError
+
+        store = Store()
+        issuer = ServiceAccountIssuer(store)
+        with pytest.raises(NotFoundError):
+            issuer.issue("default", "ghost")
+
+    def test_empty_uid_claim_rejected(self):
+        """A forged/legacy token with uid:"" must not skip the
+        instance-binding check."""
+        import json as _json
+
+        store = Store()
+        sa = ServiceAccount()
+        sa.meta.name, sa.meta.namespace = "builder", "default"
+        store.create(sa)
+        issuer = ServiceAccountIssuer(store)
+        payload = issuer._b64(_json.dumps({
+            "sub": "system:serviceaccount:default:builder",
+            "ns": "default", "name": "builder",
+            "uid": "", "exp": issuer._now() + 600,
+        }, sort_keys=True).encode())
+        token = f"sa.{payload}.{issuer._sign(payload)}"
+        with pytest.raises(AuthenticationError):
+            issuer.authenticate(token)

@@ -180,3 +180,18 @@ class TestWatchGapFreeness:
         for key, obj in final.items():
             assert view[key].meta.labels.get("gen") == obj.meta.labels.get("gen"), key
             assert view[key].meta.resource_version == obj.meta.resource_version
+
+
+def test_update_with_stored_reference_raises():
+    """ADVICE r4: update() with the stored object itself (obtained via
+    list_refs/events) would defeat CAS and corrupt prev_obj — rejected."""
+    import pytest
+
+    from tests.wrappers import make_pod
+
+    store = Store()
+    store.create(make_pod("aliased"))
+    ref = store.list_refs("Pod")[0]
+    ref.meta.labels["x"] = "y"
+    with pytest.raises(ValueError):
+        store.update(ref)
