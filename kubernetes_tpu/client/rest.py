@@ -237,6 +237,11 @@ class RESTStore:
         except NotFoundError:
             return None
 
+    def contains(self, kind: str, key: str) -> bool:
+        """Existence check (Store.contains parity) — over the wire this is
+        a GET; the copy-free fast path only exists on the in-process store."""
+        return self.try_get(kind, key) is not None
+
     def update(self, obj, *, check_version: bool = True):
         suffix = "" if check_version else "?force=true"
         out = self._request(

@@ -524,12 +524,16 @@ class WorkloadExecutor:
         rides through backoff windows instead of declaring the queue drained
         the moment activeQ goes empty."""
         deadline = time.monotonic() + timeout
+        prof = self.scheduler.loop.phase_profile
         while True:
             self.scheduler.schedule_pending()
+            t0 = time.perf_counter()
             self.collector.pump()
             if not wait_all:
+                prof["harness"] += time.perf_counter() - t0
                 return  # skipWaitToCompletion: one pass, no drain
             active, backoff, _unsched = self.scheduler.queue.pending_pods()
+            prof["harness"] += time.perf_counter() - t0
             if active == 0 and backoff == 0:
                 return
             if time.monotonic() >= deadline:
@@ -543,6 +547,11 @@ class WorkloadExecutor:
 
     def _start_collecting(self) -> None:
         self._collecting = True
+        # snapshot phase/exec counters so the bench can attribute the
+        # MEASURED span alone (init-phase costs excluded)
+        self.profile_at_start = dict(self.scheduler.loop.phase_profile)
+        d = self.scheduler.api_dispatcher
+        self.exec_seconds_at_start = d.exec_seconds if d is not None else 0.0
         self.collector.start()
 
     def _stop_collecting(self) -> None:

@@ -64,6 +64,10 @@ class APIDispatcher:
         self._lock = threading.Lock()
         self._workers: list[threading.Thread] = []
         self._stop = threading.Event()
+        # worker busy-seconds: on a GIL'd single-core host this time is
+        # stolen from the scheduling thread, so the bench wall-coverage
+        # accounting must see it
+        self.exec_seconds = 0.0
 
     # -- enqueue -------------------------------------------------------------
 
@@ -178,10 +182,14 @@ class APIDispatcher:
 
     def _execute(self, call: APICall) -> None:
         err: Exception | None = None
+        t0 = time.perf_counter()
         try:
             call.execute()
         except Exception as e:  # noqa: BLE001 - surfaced via on_finish
             err = e
+        finally:
+            with self._lock:
+                self.exec_seconds += time.perf_counter() - t0
         call.error = err
         if self.metrics is not None:
             self.metrics.async_api_calls.inc(

@@ -316,9 +316,13 @@ class SchedulingQueue:
         if (self._min_inflight_seq is not None and removed_seq is not None
                 and removed_seq > self._min_inflight_seq):
             return  # the min didn't change; the log can't shrink
-        self._min_inflight_seq = min(
-            p.event_seq for p in self._in_flight.values()
-        )
+        # seqs are assigned monotonically at insert and dicts preserve
+        # insertion order, so the oldest in-flight pod is the FIRST entry —
+        # an O(1) read where min() over values made head-of-line done()
+        # calls (a draining wave) O(wave²)
+        self._min_inflight_seq = next(
+            iter(self._in_flight.values())
+        ).event_seq
         self._event_log = [
             e for e in self._event_log if e[0] > self._min_inflight_seq
         ]

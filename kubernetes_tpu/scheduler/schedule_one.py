@@ -315,7 +315,8 @@ class ScheduleOneLoop:
         # FrameworkExtensionPointDuration histograms (metrics.go:340)
         self.phase_profile = {
             "snapshot": 0.0, "kernel": 0.0, "finish": 0.0, "bind": 0.0,
-            "pump": 0.0, "waves": 0,
+            "pump": 0.0, "events": 0.0, "pop": 0.0, "harness": 0.0,
+            "drain": 0.0, "waves": 0,
         }
         # the launched-but-unprocessed batched wave: (algo, InflightWave).
         # While its kernel runs on device, the host processes the PREVIOUS
@@ -337,8 +338,7 @@ class ScheduleOneLoop:
         """skipPodSchedule:546 — deleted or already-assumed pods."""
         if pod.is_terminating:
             return True
-        cur = self.store.try_get("Pod", pod.meta.key)
-        if cur is None:
+        if not self.store.contains("Pod", pod.meta.key):
             return True
         if self.cache.is_assumed_pod(pod):
             return True
@@ -424,8 +424,11 @@ class ScheduleOneLoop:
         and go through the per-pod path, preserving queue order semantics.
 
         Returns the number of pods processed (0 = queue empty)."""
+        import time as _time
+
         from .tpu.backend import TPUSchedulingAlgorithm
 
+        t_pop = _time.perf_counter()
         wave: list[QueuedPodInfo] = []
         wave_algo = None
         trailer: QueuedPodInfo | None = None
@@ -457,6 +460,7 @@ class ScheduleOneLoop:
                 break
             wave_algo = algo
             wave.append(qpi)
+        self.phase_profile["pop"] += _time.perf_counter() - t_pop
 
         if not wave:
             processed = self._flush_wave_pipeline()

@@ -196,6 +196,12 @@ class NativeStore:
         except NotFoundError:
             return None
 
+    def contains(self, kind: str, key: str) -> bool:
+        """Existence check (Store.contains parity). The native core has no
+        head-only lookup, so this decodes like get — correctness first; the
+        hot-path caller (skipPodSchedule) runs against the Python store."""
+        return self.try_get(kind, key) is not None
+
     def update(self, obj, *, check_version: bool = True):
         with self._mu:
             kind, key = obj.kind, obj.meta.key

@@ -194,6 +194,12 @@ class Store:
             obj = self._objects.get(kind, {}).get(key)
             return copy.deepcopy(obj) if obj is not None else None
 
+    def contains(self, kind: str, key: str) -> bool:
+        """Copy-free existence check — the scheduler's skipPodSchedule runs
+        once per popped pod, where try_get's deepcopy is pure overhead."""
+        with self._mu:
+            return key in self._objects.get(kind, {})
+
     def update(self, obj: Any, *, check_version: bool = True) -> Any:
         """Optimistic-concurrency update; stamps a fresh resource_version."""
         with self._mu:
