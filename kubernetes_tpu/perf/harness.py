@@ -552,10 +552,17 @@ class WorkloadExecutor:
         self.profile_at_start = dict(self.scheduler.loop.phase_profile)
         d = self.scheduler.api_dispatcher
         self.exec_seconds_at_start = d.exec_seconds if d is not None else 0.0
+        self.collect_started_at = time.perf_counter()
         self.collector.start()
 
     def _stop_collecting(self) -> None:
         self._collecting = False
+        # end-of-measurement snapshot (pairs with _start_collecting's):
+        # profile deltas must cover the same span the wall clock does
+        self.profile_at_stop = dict(self.scheduler.loop.phase_profile)
+        d = self.scheduler.api_dispatcher
+        self.exec_seconds_at_stop = d.exec_seconds if d is not None else 0.0
+        self.collect_stopped_at = time.perf_counter()
         self.data_items.extend(self.collector.stop())
 
 

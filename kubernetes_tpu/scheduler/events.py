@@ -16,11 +16,13 @@ import time
 from dataclasses import dataclass, field
 
 from ..api.meta import ObjectMeta
+from ..api.serialization import register_kind
 
 EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
 
 
+@register_kind
 @dataclass
 class Event:
     """events.k8s.io/v1 Event (scheduling-relevant subset)."""
@@ -51,6 +53,18 @@ class EventRecorder:
                  max_buffer: int = 4096):
         self.store = store
         self.component = component
+        # probe each fast path INDEPENDENTLY (in-process Store has both;
+        # REST/native facades may grow one without the other) — a silent
+        # except-pass around a TypeError would drop every event
+        import inspect
+
+        try:
+            self._fast_create = (
+                "copy_return" in inspect.signature(store.create).parameters
+            )
+        except (TypeError, ValueError):
+            self._fast_create = False
+        self._fast_list = hasattr(store, "list_refs")
         self._mu = threading.Lock()
         # (involved, type, reason, message) -> pending Event
         self._pending: dict[tuple, Event] = {}
@@ -106,11 +120,14 @@ class EventRecorder:
                     existing.count += ev.count
                     existing.last_timestamp = ev.last_timestamp
                     self.store.update(existing, check_version=False)
-                else:
+                elif self._fast_create:
                     # copy_return=False: the returned copy was discarded, and
                     # at bench scale (one event per bound pod) the per-event
                     # deepcopy was a measurable slice of scheduling wall time
                     self.store.create(ev, copy_return=False)
+                else:
+                    # REST/native stores take no copy_return kwarg
+                    self.store.create(ev)
                 n += 1
             except Exception:  # noqa: BLE001 - events are best-effort
                 pass
@@ -128,10 +145,12 @@ class EventRecorder:
             # read-only scan (list_refs): a deepcopying list() here grew
             # O(stored-events) per sweep and dominated event-write cost at
             # bench scale (21 sweeps x 11k events)
-            expired = [
-                ev.meta.key for ev in self.store.list_refs("Event")
-                if ev.last_timestamp < cutoff
-            ]
+            if self._fast_list:
+                events = self.store.list_refs("Event")
+            else:
+                events, _ = self.store.list("Event")
+            expired = [ev.meta.key for ev in events
+                       if ev.last_timestamp < cutoff]
             for key in expired:
                 self.store.delete("Event", key)
         except Exception:  # noqa: BLE001

@@ -268,8 +268,7 @@ class SchedulingQueue:
             qpi.pending_plugins = set()
             if qpi.initial_attempt_timestamp is None:
                 qpi.initial_attempt_timestamp = self._clock.now()
-            seq = next(self._event_seq)
-            self._in_flight[qpi.key] = _InFlightPod(qpi.key, seq)
+            self._insert_in_flight_locked(qpi.key)
             return qpi
 
     def pop_specific(self, key: str) -> QueuedPodInfo | None:
@@ -287,8 +286,19 @@ class SchedulingQueue:
             qpi.pending_plugins = set()
             if qpi.initial_attempt_timestamp is None:
                 qpi.initial_attempt_timestamp = self._clock.now()
-            self._in_flight[qpi.key] = _InFlightPod(qpi.key, next(self._event_seq))
+            self._insert_in_flight_locked(qpi.key)
             return qpi
+
+    def _insert_in_flight_locked(self, key: str) -> None:
+        """Record a popped pod as in-flight. Delete-before-insert keeps the
+        dict ordered by seq even when a key is RE-popped while an earlier
+        incarnation is still in flight (delete+recreate racing an async
+        binding) — a plain assignment would keep the key's OLD position
+        with the NEW (largest) seq, and the O(1) first-entry min in
+        _gc_event_log_locked would then overstate the minimum and drop
+        event-log entries other in-flight pods still need."""
+        self._in_flight.pop(key, None)
+        self._in_flight[key] = _InFlightPod(key, next(self._event_seq))
 
     def done(self, key: str) -> None:
         with self._mu:

@@ -15,6 +15,7 @@ for correctness, golden-testing, and the sparse long-tail plugins.
 from __future__ import annotations
 
 import random
+import time as _time
 
 from ..api.types import Pod
 from .framework.cycle_state import CycleState
@@ -424,8 +425,6 @@ class ScheduleOneLoop:
         and go through the per-pod path, preserving queue order semantics.
 
         Returns the number of pods processed (0 = queue empty)."""
-        import time as _time
-
         from .tpu.backend import TPUSchedulingAlgorithm
 
         t_pop = _time.perf_counter()
@@ -490,8 +489,6 @@ class ScheduleOneLoop:
         """Launch this wave's kernel (non-blocking, chained on the device
         carry), then process the PREVIOUS wave's results while it runs.
         Returns pods fully processed this call (the previous wave's count)."""
-        import time as _time
-
         from ..ops import FallbackNeeded
         from .tpu.backend import NeedResync
 
@@ -561,8 +558,6 @@ class ScheduleOneLoop:
         """Block on a launched wave's results and run the host half of its
         scheduling cycles: assume/reserve/permit per pod, then the wave's
         batched binding (the host half of the pipeline)."""
-        import time as _time
-
         from ..ops import FallbackNeeded
 
         prof = self.phase_profile

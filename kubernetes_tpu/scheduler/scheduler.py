@@ -7,6 +7,7 @@ Run :536) + eventhandlers.go (addAllEventHandlers :481).
 from __future__ import annotations
 
 import random
+import time as _time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -382,8 +383,6 @@ class Scheduler:
 
     def pump(self) -> int:
         """Drain informer events (deterministic single-thread mode)."""
-        import time as _time
-
         t0 = _time.perf_counter()
         n = self.informers.pump_all()
         t1 = _time.perf_counter()
@@ -410,8 +409,6 @@ class Scheduler:
 
         Each cycle pumps informers first so bind results confirm assumes.
         """
-        import time as _time
-
         scheduled = 0
         idle_rounds = 0
         for _ in range(max_cycles):

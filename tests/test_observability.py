@@ -341,3 +341,32 @@ class TestSpanTracing:
             assert spans and spans[0].duration_s > 0
         finally:
             server.shutdown()
+
+
+def test_event_recorder_over_rest_store():
+    """The recorder must work against the REST facade too: Event is a
+    registered wire kind, creates land, repeats aggregate, gc no-ops
+    (round-5 review: capability probing must not silently drop events)."""
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.client.rest import RESTStore
+    from kubernetes_tpu.scheduler.events import EventRecorder
+    from kubernetes_tpu.store import Store
+    from tests.wrappers import make_pod
+
+    store = Store()
+    server = APIServer(store)
+    server.serve(0)
+    try:
+        client = RESTStore(server.url)
+        rec = EventRecorder(client)
+        pod = make_pod("evt")
+        rec.event(pod, "Normal", "Scheduled", "bound to node-1")
+        assert rec.flush() == 1
+        rec.event(pod, "Normal", "Scheduled", "bound to node-1")
+        rec.flush()
+        events, _ = client.list("Event")
+        assert len(events) == 1
+        assert events[0].count == 2
+        rec._gc()  # REST fallback path must not raise
+    finally:
+        server.shutdown()

@@ -352,3 +352,27 @@ class TestEventLogGC:
         q.move_all_to_active_or_backoff(ClusterEvent(ev.WILDCARD, ev.ALL))
         q.done(qb2.key)
         assert q._event_log == []
+
+
+def test_repopped_key_keeps_in_flight_seq_order():
+    """A pod deleted and recreated (same key) while its first incarnation
+    is still in flight must not break the in-flight dict's seq ordering —
+    the O(1) min read in the event-log GC depends on it (round-5 review)."""
+    from tests.wrappers import make_pod
+
+    q = new_queue()
+    for name in ("a", "b"):
+        qadd(q, make_pod(name))
+    qa = q.pop()       # a in flight (oldest seq)
+    qb = q.pop()       # b in flight
+    assert qa.key.endswith("/a") and qb.key.endswith("/b")
+    # "a" is deleted + recreated while incarnation 1 is still in flight
+    qadd(q, make_pod("a"))
+    qa2 = q.pop()      # re-pop of key "a": must move to the END
+    assert qa2.key == qa.key
+    seqs = [p.event_seq for p in q._in_flight.values()]
+    assert seqs == sorted(seqs), f"in-flight seqs out of order: {seqs}"
+    # the O(1) min must now be b's seq, not a's new one
+    q.done(qb.key)
+    assert (q._min_inflight_seq is None
+            or q._min_inflight_seq <= seqs[-1])
