@@ -1,0 +1,33 @@
+"""events.k8s.io/v1 Event — the API object component event recorders write.
+
+Reference: staging/src/k8s.io/api/events/v1/types.go. Lives in the api
+package (not the scheduler) so the wire scheme registers it for EVERY
+process: an apiserver that never imports the scheduler must still decode
+'Event' POSTs from a remote component's recorder.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .meta import ObjectMeta
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    """events.k8s.io/v1 Event (scheduling-relevant subset)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: str = ""  # "<kind>/<namespace>/<name>"
+    type: str = EVENT_TYPE_NORMAL
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+    reporting_controller: str = "default-scheduler"
+
+    kind = "Event"

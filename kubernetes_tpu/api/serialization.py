@@ -30,9 +30,19 @@ def kind_class(kind: str) -> type:
 
 def _register_all() -> None:
     """Populate the registry from the api modules (runtime.Scheme builders)."""
-    from . import coordination, dra, extensions, rbac, storage, types, workloads
+    from . import (
+        coordination,
+        dra,
+        events,
+        extensions,
+        rbac,
+        storage,
+        types,
+        workloads,
+    )
 
-    for mod in (types, storage, dra, coordination, workloads, rbac, extensions):
+    for mod in (types, storage, dra, coordination, workloads, rbac,
+                extensions, events):
         for name in dir(mod):
             obj = getattr(mod, name)
             if isinstance(obj, type) and hasattr(obj, "kind") and dataclasses.is_dataclass(obj):

@@ -13,31 +13,13 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
 
-from ..api.meta import ObjectMeta
-from ..api.serialization import register_kind
-
-EVENT_TYPE_NORMAL = "Normal"
-EVENT_TYPE_WARNING = "Warning"
-
-
-@register_kind
-@dataclass
-class Event:
-    """events.k8s.io/v1 Event (scheduling-relevant subset)."""
-
-    meta: ObjectMeta = field(default_factory=ObjectMeta)
-    involved_object: str = ""  # "<kind>/<namespace>/<name>"
-    type: str = EVENT_TYPE_NORMAL
-    reason: str = ""
-    message: str = ""
-    count: int = 1
-    first_timestamp: float = 0.0
-    last_timestamp: float = 0.0
-    reporting_controller: str = "default-scheduler"
-
-    kind = "Event"
+from ..api.events import (  # noqa: F401 - re-exported for compat
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    Event,
+)
+from ..api.meta import ObjectMeta  # noqa: F401 - public re-export
 
 
 class EventRecorder:

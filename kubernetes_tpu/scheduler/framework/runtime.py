@@ -78,6 +78,7 @@ class Framework:
         self.placement_generate_plugins = having("generate_placements")
         self.placement_score_plugins = having("score_placement")
         self._waiting_pods: dict[str, WaitingPod] = {}
+        self._metric_tick = 1  # 10% plugin-metric sampling LCG state
 
     # -- queue wiring -------------------------------------------------------
 
@@ -98,6 +99,15 @@ class Framework:
 
     def _timed(self, point: str, plugin: str, fn: Callable[[], Any]) -> Any:
         if self.metrics is None:
+            return fn()
+        # sample ~1-in-10 like the reference (pluginMetricsSamplePercent=10,
+        # schedule_one.go:50-51,130): two perf_counter calls + a histogram
+        # observe per plugin per node per pod is measurable at wave scale.
+        # LCG step, not a modulo tick — a deterministic tick aliases with
+        # fixed per-pod call patterns and would starve specific plugins of
+        # samples forever
+        self._metric_tick = (self._metric_tick * 1103515245 + 12345) & 0x7FFFFFFF
+        if self._metric_tick % 10:
             return fn()
         t0 = time.perf_counter()
         try:

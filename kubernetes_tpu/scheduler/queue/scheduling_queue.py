@@ -49,6 +49,7 @@ class QueuedPodInfo:
         "unschedulable_plugins",
         "pending_plugins",
         "backoff_expiry",
+        "inflight_token",
     )
 
     def __init__(self, pod_info: PodInfo, now: float):
@@ -63,6 +64,7 @@ class QueuedPodInfo:
         self.unschedulable_plugins: set[str] = set()
         self.pending_plugins: set[str] = set()
         self.backoff_expiry = 0.0
+        self.inflight_token = None  # _InFlightPod of the CURRENT attempt
 
     @property
     def pod(self) -> Pod:
@@ -268,7 +270,7 @@ class SchedulingQueue:
             qpi.pending_plugins = set()
             if qpi.initial_attempt_timestamp is None:
                 qpi.initial_attempt_timestamp = self._clock.now()
-            self._insert_in_flight_locked(qpi.key)
+            qpi.inflight_token = self._insert_in_flight_locked(qpi.key)
             return qpi
 
     def pop_specific(self, key: str) -> QueuedPodInfo | None:
@@ -286,24 +288,44 @@ class SchedulingQueue:
             qpi.pending_plugins = set()
             if qpi.initial_attempt_timestamp is None:
                 qpi.initial_attempt_timestamp = self._clock.now()
-            self._insert_in_flight_locked(qpi.key)
+            qpi.inflight_token = self._insert_in_flight_locked(qpi.key)
             return qpi
 
-    def _insert_in_flight_locked(self, key: str) -> None:
+    def _insert_in_flight_locked(self, key: str) -> "_InFlightPod":
         """Record a popped pod as in-flight. Delete-before-insert keeps the
         dict ordered by seq even when a key is RE-popped while an earlier
         incarnation is still in flight (delete+recreate racing an async
         binding) — a plain assignment would keep the key's OLD position
         with the NEW (largest) seq, and the O(1) first-entry min in
         _gc_event_log_locked would then overstate the minimum and drop
-        event-log entries other in-flight pods still need."""
-        self._in_flight.pop(key, None)
-        self._in_flight[key] = _InFlightPod(key, next(self._event_seq))
+        event-log entries other in-flight pods still need. The displaced
+        incarnation's seq is GC'd immediately: a stale cached minimum
+        pointing at a seq nobody holds would disable log GC until the
+        in-flight set empties."""
+        old = self._in_flight.pop(key, None)
+        if old is not None:
+            self._gc_event_log_locked(old.event_seq)
+        rec = _InFlightPod(key, next(self._event_seq))
+        self._in_flight[key] = rec
+        return rec
 
-    def done(self, key: str) -> None:
+    def done(self, key: str, token=None) -> None:
+        """Finish a pod's cycle. `token` (QueuedPodInfo.inflight_token) pins
+        the call to ONE incarnation: when a pod was deleted + recreated under
+        the same key while the first incarnation was mid-binding, the first
+        incarnation's done() must not pop the second's in-flight record (its
+        mid-flight events would then never replay)."""
         with self._mu:
-            p = self._in_flight.pop(key, None)
-            self._gc_event_log_locked(p.event_seq if p is not None else None)
+            p = self._in_flight.get(key)
+            if p is None:
+                self._gc_event_log_locked(None)
+                return
+            if token is not None and p is not token:
+                # a newer incarnation owns the record; ours was displaced
+                # (and GC'd) at its re-pop — nothing to do
+                return
+            del self._in_flight[key]
+            self._gc_event_log_locked(p.event_seq)
 
     def _gc_event_log_locked(self, removed_seq: int | None = None) -> None:
         """Amortized: event seqs are monotonic, so the in-flight minimum
@@ -346,7 +368,14 @@ class SchedulingQueue:
         """
         with self._mu:
             key = qpi.key
-            inflight = self._in_flight.pop(key, None)
+            inflight = self._in_flight.get(key)
+            if (inflight is not None and qpi.inflight_token is not None
+                    and inflight is not qpi.inflight_token):
+                # the record belongs to a NEWER incarnation of this key
+                # (delete+recreate raced our binding); leave it for them
+                inflight = None
+            elif inflight is not None:
+                del self._in_flight[key]
             qpi.timestamp = self._clock.now()
             # scheduling_queue.go:924-932 — rejected by no plugin means an
             # unexpected error (backoff counts errors); a plugin rejection

@@ -361,10 +361,10 @@ class ScheduleOneLoop:
         pod = qpi.pod
         fw = self.framework_for_pod(pod)
         if fw is None:
-            self.queue.done(qpi.key)
+            self.queue.done(qpi.key, qpi.inflight_token)
             return
         if self._skip_pod_schedule(fw, pod):
-            self.queue.done(qpi.key)
+            self.queue.done(qpi.key, qpi.inflight_token)
             return
         # whole-gang cycle (ScheduleOne, schedule_one.go:77: SchedulingGroup
         # + GenericWorkload gate routes to scheduleOnePodGroup)
@@ -438,10 +438,10 @@ class ScheduleOneLoop:
             pod = qpi.pod
             fw = self.framework_for_pod(pod)
             if fw is None:
-                self.queue.done(qpi.key)
+                self.queue.done(qpi.key, qpi.inflight_token)
                 continue
             if self._skip_pod_schedule(fw, pod):
-                self.queue.done(qpi.key)
+                self.queue.done(qpi.key, qpi.inflight_token)
                 continue
             algo = self.algorithms.get(fw.profile_name)
             # ORDER MATTERS: wave_eligible has side effects for claim pods
@@ -1053,7 +1053,7 @@ class ScheduleOneLoop:
         fw.run_post_bind_plugins(state, pod, host)
         # pod leaves the cycle for good: stop in-flight event tracking only now
         # (a done() before bind would drop events needed on bind failure)
-        self.queue.done(qpi.key)
+        self.queue.done(qpi.key, qpi.inflight_token)
         self.queue.delete_nominated_pod_if_exists(pod)
         if self.metrics is not None:
             self.metrics.pod_scheduled(qpi)

@@ -376,3 +376,43 @@ def test_repopped_key_keeps_in_flight_seq_order():
     q.done(qb.key)
     assert (q._min_inflight_seq is None
             or q._min_inflight_seq <= seqs[-1])
+
+
+def test_done_token_protects_newer_incarnation():
+    """Incarnation 1's done()/requeue must not pop incarnation 2's
+    in-flight record (delete+recreate racing an async binding), or
+    incarnation 2's mid-flight events would never replay (round-5 review)."""
+    q = new_queue()
+    qadd(q, make_pod("a"))
+    q1 = q.pop()
+    tok1 = q1.inflight_token
+    # delete + recreate + re-pop under the same key
+    qadd(q, make_pod("a"))
+    q2 = q.pop()
+    assert q2.inflight_token is not tok1
+    # incarnation 1 finishes its (doomed) binding: must be a no-op
+    q.done(q1.key, q1.inflight_token)
+    assert q._in_flight.get(q2.key) is q2.inflight_token
+    # incarnation 2 finishes normally
+    q.done(q2.key, q2.inflight_token)
+    assert q2.key not in q._in_flight
+
+
+def test_repop_gcs_displaced_incarnation_seq():
+    """Re-popping a key must GC the displaced incarnation's seq so the
+    cached min can't point at a seq nobody holds (which would disable
+    event-log GC until the in-flight set empties)."""
+    q = new_queue()
+    for name in ("a", "b"):
+        qadd(q, make_pod(name))
+    qa, qb = q.pop(), q.pop()
+    q.done(qa.key, qa.inflight_token)  # caches min = b's seq
+    # churn b: delete+recreate+re-pop while incarnation 1 is in flight
+    qadd(q, make_pod("b"))
+    qb2 = q.pop()
+    # log GC must still work: record an event, then finish b2
+    q.move_all_to_active_or_backoff(
+        ClusterEvent(ev.NODE, ev.ADD), None, None
+    )
+    q.done(qb2.key, qb2.inflight_token)
+    assert not q._event_log, "event log leaked after all pods finished"
