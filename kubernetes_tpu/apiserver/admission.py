@@ -202,6 +202,182 @@ def crd_admission(store):
     return admit
 
 
+def cel_policy_admission(store):
+    """ValidatingAdmissionPolicy (staging/src/k8s.io/apiserver/pkg/
+    admission/plugin/policy/validating): CEL expressions over `object` /
+    `oldObject` / `request`, evaluated for every bound policy whose match
+    rules cover the request. A false expression rejects with the
+    validation's message; an evaluation ERROR honors failurePolicy (Fail →
+    reject, Ignore → skip), mirroring the reference's error policy. No
+    webhook server involved — the policy engine runs in-process."""
+    from ..api.serialization import encode
+    from ..utils.cel import CELError, compile_expression
+
+    _EXEMPT = {"ValidatingAdmissionPolicy", "ValidatingAdmissionPolicyBinding"}
+
+    def admit(operation: str, obj) -> None:
+        kind = getattr(obj, "kind", "")
+        if kind in _EXEMPT:
+            return
+        bindings = store.list_refs("ValidatingAdmissionPolicyBinding")
+        if not bindings:
+            return
+        ctx = None
+        for b in bindings:
+            if b.namespaces and getattr(obj.meta, "namespace", "") not in b.namespaces:
+                continue
+            policy = store.try_get("ValidatingAdmissionPolicy",
+                                   b.policy_name)
+            if policy is None:
+                continue
+            if not any(r.matches(operation, kind)
+                       for r in policy.spec.match_rules):
+                continue
+            if ctx is None:
+                old = store.try_get(kind, obj.meta.key) \
+                    if operation == "UPDATE" else None
+                ctx = {
+                    "object": encode(obj),
+                    "oldObject": encode(old) if old is not None else None,
+                    "request": {"operation": operation, "kind": kind},
+                }
+            for v in policy.spec.validations:
+                try:
+                    ok = bool(compile_expression(v.expression)(ctx))
+                except (CELError, TypeError, KeyError, ValueError) as e:
+                    if policy.spec.failure_policy == "Ignore":
+                        continue
+                    raise AdmissionError(
+                        f"ValidatingAdmissionPolicy {policy.meta.name!r} "
+                        f"expression error: {e}", code=500,
+                    )
+                if not ok:
+                    raise AdmissionError(
+                        f"ValidatingAdmissionPolicy {policy.meta.name!r} "
+                        "denied the request: "
+                        + (v.message or f"failed expression: {v.expression}"),
+                        code=403,
+                    )
+
+    return admit
+
+
+class _WebhookCallError(Exception):
+    """Transport failure OR malformed AdmissionReview response — both are
+    webhook FAILURES that honor failurePolicy (the reference classifies an
+    unparseable response as an error, never as a denial)."""
+
+
+def _call_webhook(wh, payload: bytes) -> dict:
+    """POST one AdmissionReview to a webhook; returns the validated
+    `response` dict. Shared by the mutating and validating dispatchers so
+    transport/response handling cannot drift between them."""
+    import json as _json
+    from urllib import request as _urlreq
+    from urllib.error import URLError
+
+    try:
+        req = _urlreq.Request(
+            wh.url, data=payload, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with _urlreq.urlopen(req, timeout=wh.timeout_s) as r:
+            resp = _json.loads(r.read())
+    except (URLError, OSError, ValueError) as e:
+        raise _WebhookCallError(f"call failed: {e}")
+    if not isinstance(resp, dict) or not isinstance(
+        resp.get("response"), dict
+    ):
+        raise _WebhookCallError(
+            "malformed AdmissionReview response (missing 'response')"
+        )
+    return resp["response"]
+
+
+def _admission_review_payload(operation: str, kind: str, obj) -> bytes:
+    import json as _json
+
+    from ..api.serialization import encode
+
+    return _json.dumps({
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"operation": operation, "kind": kind,
+                    "object": encode(obj)},
+    }).encode()
+
+
+def mutating_webhook_admission(store):
+    """Out-of-process MUTATING admission (staging/src/k8s.io/apiserver/pkg/
+    admission/plugin/webhook/mutating): runs before the validating phase;
+    an allowed response with patchType=JSONPatch applies a base64 RFC 6902
+    patch to the object's wire form, and the mutated object is what every
+    later plugin (and the store) sees."""
+    import base64 as _b64
+    import dataclasses as _dc
+    import json as _json
+
+    from ..api.extensions import apply_json_patch
+    from ..api.serialization import decode, encode
+
+    _EXEMPT = {"MutatingWebhookConfiguration",
+               "ValidatingWebhookConfiguration"}
+
+    def admit(operation: str, obj) -> None:
+        kind = getattr(obj, "kind", "")
+        if kind in _EXEMPT:
+            return
+        for cfg in store.list_refs("MutatingWebhookConfiguration"):
+            for wh in cfg.webhooks:
+                if not any(r.matches(operation, kind) for r in wh.rules):
+                    continue
+                try:
+                    # re-encode per webhook: each sees its predecessors'
+                    # patches (the reference's sequential mutating dispatch)
+                    result = _call_webhook(
+                        wh, _admission_review_payload(operation, kind, obj)
+                    )
+                except _WebhookCallError as e:
+                    if wh.failure_policy == "Ignore":
+                        continue
+                    raise AdmissionError(
+                        f"mutating webhook {wh.name!r} {e}", code=500,
+                    )
+                if not result.get("allowed", False):
+                    msg = (result.get("status") or {}).get("message", "denied")
+                    raise AdmissionError(
+                        f"mutating webhook {wh.name!r} denied the request: "
+                        f"{msg}", code=403,
+                    )
+                if result.get("patch"):
+                    if result.get("patchType", "JSONPatch") != "JSONPatch":
+                        raise AdmissionError(
+                            f"mutating webhook {wh.name!r}: unsupported "
+                            f"patchType {result.get('patchType')!r}", code=500,
+                        )
+                    try:
+                        patch = _json.loads(_b64.b64decode(result["patch"]))
+                        patched = apply_json_patch(encode(obj), patch)
+                        # identity fields are not a webhook's to change
+                        # (the reference rejects patches touching them)
+                        patched.setdefault("meta", {})
+                        patched["meta"]["name"] = obj.meta.name
+                        patched["meta"]["namespace"] = obj.meta.namespace
+                        patched["kind"] = kind
+                        mutated = decode(patched)
+                    except (ValueError, TypeError, KeyError) as e:
+                        raise AdmissionError(
+                            f"mutating webhook {wh.name!r} returned an "
+                            f"unusable patch: {e}", code=500,
+                        )
+                    # mutate IN PLACE: later chain plugins and the store
+                    # hold this object reference
+                    for f in _dc.fields(obj):
+                        setattr(obj, f.name, getattr(mutated, f.name))
+
+    return admit
+
+
 def webhook_admission(store):
     """Out-of-process validating admission
     (staging/src/k8s.io/apiserver/pkg/admission/plugin/webhook): each
@@ -210,13 +386,11 @@ def webhook_admission(store):
     Ignore → skip). Webhook configurations themselves are exempt so a
     broken webhook can always be fixed (the reference's bootstrap
     safeguard)."""
-    import json as _json
-    from urllib import request as _urlreq
-    from urllib.error import URLError
 
     def admit(operation: str, obj) -> None:
         kind = getattr(obj, "kind", "")
-        if kind == "ValidatingWebhookConfiguration":
+        if kind in ("ValidatingWebhookConfiguration",
+                    "MutatingWebhookConfiguration"):
             return
         payload = None
         for cfg in store.iter_kind("ValidatingWebhookConfiguration"):
@@ -224,29 +398,15 @@ def webhook_admission(store):
                 if not any(r.matches(operation, kind) for r in wh.rules):
                     continue
                 if payload is None:
-                    from ..api.serialization import encode
-
-                    payload = _json.dumps({
-                        "apiVersion": "admission.k8s.io/v1",
-                        "kind": "AdmissionReview",
-                        "request": {"operation": operation, "kind": kind,
-                                    "object": encode(obj)},
-                    }).encode()
+                    payload = _admission_review_payload(operation, kind, obj)
                 try:
-                    req = _urlreq.Request(
-                        wh.url, data=payload, method="POST",
-                        headers={"Content-Type": "application/json"},
-                    )
-                    with _urlreq.urlopen(req, timeout=wh.timeout_s) as r:
-                        resp = _json.loads(r.read())
-                except (URLError, OSError, ValueError) as e:
+                    result = _call_webhook(wh, payload)
+                except _WebhookCallError as e:
                     if wh.failure_policy == "Ignore":
                         continue
                     raise AdmissionError(
-                        f"admission webhook {wh.name!r} call failed: {e}",
-                        code=500,
+                        f"admission webhook {wh.name!r} {e}", code=500,
                     )
-                result = resp.get("response", {})
                 if not result.get("allowed", False):
                     msg = (result.get("status") or {}).get("message", "denied")
                     raise AdmissionError(
@@ -258,12 +418,17 @@ def webhook_admission(store):
 
 
 def default_admission_chain(store) -> list:
-    """The plugins every control plane enables (mutating before
-    validating, as the reference orders its chain; webhooks run last,
-    as the reference's ValidatingAdmissionWebhook does)."""
+    """The plugins every control plane enables, in the reference's order:
+    built-in mutators → MutatingAdmissionWebhook (last mutator) →
+    built-in validators → ValidatingAdmissionPolicy (CEL) →
+    ValidatingAdmissionWebhook (cmd/kube-apiserver admission ordering)."""
     from ..controllers.quota import quota_admission
 
     return [cluster_scope_admission(), priority_admission(store),
             namespace_lifecycle_admission(store),
-            service_account_admission(store), crd_admission(store),
-            quota_admission(store), webhook_admission(store)]
+            service_account_admission(store),
+            mutating_webhook_admission(store),
+            crd_admission(store),
+            quota_admission(store),
+            cel_policy_admission(store),
+            webhook_admission(store)]

@@ -1,26 +1,26 @@
-"""CEL subset for DRA device selection expressions.
+"""CEL subset: device selection expressions + admission policy expressions.
 
-Reference: the scheduler allocates device claims by evaluating CEL
-expressions against each candidate device
-(pkg/scheduler/framework/plugins/dynamicresources/dynamicresources.go:637
-via staging/src/k8s.io/dynamic-resource-allocation/cel/compile.go). The
-expressions the API admits are attribute/capacity predicates over a
-`device` variable:
+Reference, two consumers:
+- DRA device selection (pkg/scheduler/framework/plugins/dynamicresources/
+  dynamicresources.go:637 via staging/src/k8s.io/dynamic-resource-
+  allocation/cel/compile.go): predicates over a `device` variable —
+      device.driver == "gpu.example.com"
+      device.capacity["memory"] >= quantity("40Gi")
+- ValidatingAdmissionPolicy (staging/src/k8s.io/apiserver/pkg/admission/
+  plugin/policy/validating): predicates over `object` / `oldObject` /
+  `request` —
+      object.spec.replicas <= 5
+      has(object.meta.labels) && object.meta.labels["env"] == "prod"
 
-    device.driver == "gpu.example.com"
-    device.attributes["gpu.example.com/model"] == "a100"
-    device.capacity["memory"] >= quantity("40Gi")
-    device.attributes["index"] in [0, 2, 4] && !(device.name == "dev-3")
-
-This module implements exactly that surface: a Pratt-style recursive
-descent parser producing a compiled closure, with ==, !=, <, <=, >, >=,
-&&, ||, !, `in` over list literals, parentheses, string/int/float/bool
-literals, the `quantity()` function (resource quantities to ints), and the
-`device.driver / device.name / device.attributes[...] /
-device.capacity[...]` paths. Compilation is cached per expression.
+This module implements exactly that surface: a recursive descent parser
+producing a compiled closure, with ==, !=, <, <=, >, >=, &&, ||, !, `in`
+over list literals, parentheses, string/int/float/bool literals, the
+`quantity()` / `size()` functions and the `has()` presence macro, and
+generic variable paths (`<root>(.field | [key])*`) walked over dict
+contexts. Compilation is cached per expression.
 
 Security note: expressions are parsed into closures over a fixed AST — no
-Python eval, no attribute access beyond the device context.
+Python eval, no attribute access beyond the provided context dicts.
 """
 
 from __future__ import annotations
@@ -172,6 +172,8 @@ class _Parser:
             return lambda ctx: True
         if name == "false":
             return lambda ctx: False
+        if name == "null":
+            return lambda ctx: None
         if name == "quantity":
             self.expect("op", "(")
             arg = self.parse_operand()
@@ -183,23 +185,70 @@ class _Parser:
                 return parse_quantity(str(arg(ctx)))
 
             return q
-        if name != "device":
-            raise CELError(f"unknown identifier {name!r}")
-        # device.driver | device.name | device.attributes["k"] | device.capacity["k"]
-        self.expect("op", ".")
-        field = self.expect("ident")[1]
-        if field in ("driver", "name"):
-            return lambda ctx, f=field: ctx[f]
-        if field in ("attributes", "capacity"):
-            self.expect("op", "[")
-            key = self.parse_operand()
-            self.expect("op", "]")
+        if name == "size":
+            self.expect("op", "(")
+            arg = self.parse_operand()
+            self.expect("op", ")")
 
-            def lookup(ctx, f=field, key=key):
-                return ctx[f].get(key(ctx))
+            def sz(ctx, arg=arg):
+                v = arg(ctx)
+                if v is None:
+                    raise CELError("size() of missing value")
+                return len(v)
 
-            return lookup
-        raise CELError(f"unknown device field {field!r}")
+            return sz
+        if name == "has":
+            # CEL's has() macro: field-presence test; a missing path (or
+            # any error walking it) is absence, never an evaluation error
+            self.expect("op", "(")
+            arg = self.parse_operand()
+            self.expect("op", ")")
+
+            def present(ctx, arg=arg):
+                try:
+                    return arg(ctx) is not None
+                except (CELError, TypeError, KeyError):
+                    return False
+
+            return present
+        # generic variable path: <root>(.field | [key])* over dict contexts
+        # (the reference compiles against declared variables — object,
+        # oldObject, request, device; an unknown ROOT is a runtime error so
+        # admission failurePolicy applies, a missing FIELD is None so
+        # comparisons read as non-matching)
+        steps: list = []
+        while True:
+            t = self.peek()
+            if t == ("op", "."):
+                self.next()
+                steps.append(("field", self.expect("ident")[1]))
+            elif t == ("op", "["):
+                self.next()
+                key = self.parse_operand()
+                self.expect("op", "]")
+                steps.append(("index", key))
+            else:
+                break
+
+        def walk(ctx, name=name, steps=tuple(steps)):
+            if name not in ctx:
+                raise CELError(f"unknown variable {name!r}")
+            cur = ctx[name]
+            for kind, step in steps:
+                if cur is None:
+                    return None
+                key = step if kind == "field" else step(ctx)
+                if isinstance(cur, dict):
+                    cur = cur.get(key)
+                elif isinstance(cur, (list, tuple)) and isinstance(key, int):
+                    cur = cur[key] if -len(cur) <= key < len(cur) else None
+                else:
+                    raise CELError(
+                        f"cannot access {key!r} on {type(cur).__name__}"
+                    )
+            return cur
+
+        return walk
 
 
 def _numeric(v) -> float:
@@ -233,12 +282,12 @@ def evaluate_device(src: str, *, driver: str = "", name: str = "",
     # no copies: this runs per candidate device inside the Filter hot loop,
     # and the compiled closures only ever .get() from these mappings
     _empty: dict = {}
-    ctx = {
+    ctx = {"device": {
         "driver": driver,
         "name": name,
         "attributes": attributes if attributes is not None else _empty,
         "capacity": capacity if capacity is not None else _empty,
-    }
+    }}
     try:
         return bool(compile_expression(src)(ctx))
     except (CELError, TypeError, KeyError, ValueError):

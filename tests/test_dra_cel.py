@@ -60,10 +60,19 @@ class TestCELEvaluator:
     def test_parse_errors_raise_at_compile(self):
         import pytest
 
-        for bad in ("device.unknown_field == 1", "attributes[x]", "1 +",
-                    'device.attributes["a" == 1'):
+        for bad in ("1 +", 'device.attributes["a" == 1', "&& device.name"):
             with pytest.raises(CELError):
                 compile_expression(bad)
+
+    def test_unknown_paths_are_runtime_non_matches(self):
+        """Since the admission-policy generalization, unknown FIELDS compile
+        and walk to None (non-match) and unknown ROOT variables raise at
+        runtime (so admission failurePolicy applies) — evaluate_device maps
+        both to False."""
+        from kubernetes_tpu.utils.cel import evaluate_device
+
+        assert evaluate_device("device.unknown_field == 1", driver="d") is False
+        assert evaluate_device("attributes == 1", driver="d") is False
 
     def test_compile_cache_reuses_closure(self):
         f1 = compile_expression('device.driver == "d"')
