@@ -190,7 +190,11 @@ def apply_json_patch(doc: dict, patch: list) -> dict:
     import copy as _copy
 
     out = _copy.deepcopy(doc)
+    if not isinstance(patch, list):
+        raise ValueError("patch must be a JSON array of operations")
     for op in patch:
+        if not isinstance(op, dict):
+            raise ValueError(f"patch operation must be an object: {op!r}")
         action = op.get("op")
         path = op.get("path", "")
         if not path.startswith("/"):
@@ -200,7 +204,12 @@ def apply_json_patch(doc: dict, patch: list) -> dict:
         node = out
         for p in parts[:-1]:
             if isinstance(node, list):
-                node = node[int(p)]
+                i = int(p)
+                if not (-len(node) <= i < len(node)):
+                    raise ValueError(
+                        f"patch path {path!r}: index {i} out of range"
+                    )
+                node = node[i]
             elif isinstance(node, dict):
                 if p not in node:
                     raise ValueError(
@@ -218,6 +227,13 @@ def apply_json_patch(doc: dict, patch: list) -> dict:
                     node.append(op.get("value"))
                 else:
                     i = int(leaf)
+                    # RFC 6902: add allows index == len (append); beyond
+                    # that is an error, NOT a silent clamp-insert
+                    limit = len(node) + (1 if action == "add" else 0)
+                    if not (0 <= i < limit):
+                        raise ValueError(
+                            f"{action} path {path!r}: index {i} out of range"
+                        )
                     if action == "add":
                         node.insert(i, op.get("value"))
                     else:
@@ -232,7 +248,12 @@ def apply_json_patch(doc: dict, patch: list) -> dict:
                 raise ValueError(f"patch path {path!r} targets a scalar")
         elif action == "remove":
             if isinstance(node, list):
-                node.pop(int(leaf))
+                i = int(leaf)
+                if not (0 <= i < len(node)):
+                    raise ValueError(
+                        f"remove path {path!r}: index {i} out of range"
+                    )
+                node.pop(i)
             elif leaf in node:
                 del node[leaf]
             else:

@@ -356,16 +356,28 @@ def mutating_webhook_admission(store):
                             f"patchType {result.get('patchType')!r}", code=500,
                         )
                     try:
+                        original = encode(obj)
                         patch = _json.loads(_b64.b64decode(result["patch"]))
-                        patched = apply_json_patch(encode(obj), patch)
-                        # identity fields are not a webhook's to change
-                        # (the reference rejects patches touching them)
+                        patched = apply_json_patch(original, patch)
+                        # identity AND system metadata are not a webhook's
+                        # to change (the reference rejects such patches):
+                        # uid/resourceVersion/managedFields forgeries would
+                        # break GC identity, CAS, and SSA ownership
                         patched.setdefault("meta", {})
+                        patched["kind"] = kind
                         patched["meta"]["name"] = obj.meta.name
                         patched["meta"]["namespace"] = obj.meta.namespace
-                        patched["kind"] = kind
+                        orig_meta = original.get("meta", {})
+                        for sysf in ("uid", "resource_version", "generation",
+                                     "creation_timestamp",
+                                     "deletion_timestamp", "managed_fields"):
+                            if sysf in orig_meta:
+                                patched["meta"][sysf] = orig_meta[sysf]
+                            else:
+                                patched["meta"].pop(sysf, None)
                         mutated = decode(patched)
-                    except (ValueError, TypeError, KeyError) as e:
+                    except (ValueError, TypeError, KeyError, IndexError,
+                            AttributeError) as e:
                         raise AdmissionError(
                             f"mutating webhook {wh.name!r} returned an "
                             f"unusable patch: {e}", code=500,

@@ -8,10 +8,21 @@ different manager gets a 409 conflict naming the owner, unless it forces
 from its configuration are REMOVED from the object (the semantic that
 distinguishes apply from a merge patch).
 
+Associative lists (structured-merge-diff listType=map): the list fields
+the reference keys — containers/initContainers/volumes/env by `name`,
+ports by `container_port`, tolerations by `key` — merge BY ELEMENT.  An
+element's paths are rooted at `<list>/k=<key-value>` (the fieldsV1
+`k:{...}` convention), so two appliers owning different containers of one
+pod never conflict, and dropping an element removes it without touching
+its siblings.  Merge-key leaves (`.../k=X/name`) are element identity, not
+content: co-owning them is never a conflict (every applier of an element
+must state its key).
+
 Subset notes (vs the reference's full set-theoretic fieldsV1):
-- field sets are dotted leaf paths; list-valued fields are atomic (no
-  associative-list merge keys), matching the reference's treatment of
-  atomic lists
+- a list field is treated as keyed only when every element is a dict
+  carrying the key field (a CRD's free-form `ports: [80, 443]` stays
+  atomic); keys are matched by FIELD NAME, the reference's effective
+  patchMergeKey convention
 - ownership is tracked for Apply operations; plain updates don't record
   per-field ownership (their writes win CAS like any update)
 - the wire trigger is the `fieldManager` query parameter on PATCH (the
@@ -24,6 +35,18 @@ from __future__ import annotations
 # identity/system metadata never owned by an applier
 _META_SYSTEM = {"name", "namespace", "uid", "resource_version", "generation",
                 "creation_timestamp", "deletion_timestamp", "managed_fields"}
+
+# list FIELD NAME -> merge key (the reference's patchMergeKey tags:
+# staging/src/k8s.io/api/core/v1/types.go Container/Volume/EnvVar `name`,
+# ContainerPort `containerPort`, Toleration `key`)
+_LIST_FIELD_KEYS = {
+    "containers": "name",
+    "init_containers": "name",
+    "volumes": "name",
+    "env": "name",
+    "ports": "container_port",
+    "tolerations": "key",
+}
 
 
 class ApplyConflict(Exception):
@@ -48,9 +71,21 @@ def _unescape(token: str) -> str:
     return token.replace("~1", "/").replace("~0", "~")
 
 
+def _list_key_field(field_name: str, value) -> str | None:
+    """The merge key for a list VALUE under `field_name`, or None when the
+    list is atomic (unknown field, empty, or elements without the key)."""
+    key = _LIST_FIELD_KEYS.get(field_name)
+    if key is None or not isinstance(value, (list, tuple)) or not value:
+        return None
+    if all(isinstance(e, dict) and e.get(key) is not None for e in value):
+        return key
+    return None
+
+
 def field_paths(doc: dict, prefix: str = "") -> set[str]:
-    """'/'-joined, RFC 6901-escaped leaf paths of an applied configuration;
-    lists are atomic leaves, identity/system metadata and the kind tag are
+    """'/'-joined, RFC 6901-escaped leaf paths of an applied configuration.
+    Keyed-list elements contribute their leaves under `<list>/k=<value>`;
+    atomic lists are leaves; identity/system metadata and the kind tag are
     excluded."""
     out: set[str] = set()
     for k, v in doc.items():
@@ -60,43 +95,127 @@ def field_paths(doc: dict, prefix: str = "") -> set[str]:
             continue
         path = f"{prefix}/{_escape(k)}" if prefix else _escape(k)
         if isinstance(v, dict) and v:
+            # dict recursion stays here so the meta-system exclusions apply
             out |= field_paths(v, path)
         else:
-            out.add(path)
+            out |= _value_paths(k, v, path)
     return out
 
 
-def _get_path(doc: dict, path: str) -> tuple:
-    """(value, present) at an RFC 6901-escaped '/' path."""
+def _value_paths(field_name: str, v, path: str) -> set[str]:
+    if isinstance(v, dict):
+        if not v:
+            return {path}
+        out: set[str] = set()
+        for k2, v2 in v.items():
+            out |= _value_paths(k2, v2, f"{path}/{_escape(k2)}")
+        return out
+    key = _list_key_field(field_name, v)
+    if key is not None:
+        out = set()
+        for e in v:
+            ep = f"{path}/k={_escape(str(e[key]))}"
+            sub: set[str] = set()
+            for k2, v2 in e.items():
+                sub |= _value_paths(k2, v2, f"{ep}/{_escape(k2)}")
+            out |= sub or {ep}
+        return out
+    return {path}
+
+
+def _walk(doc, parts: list[str], field_name: str = ""):
+    """Walk escaped path segments over dicts and keyed lists; returns the
+    node or a _MISSING sentinel."""
     node = doc
-    for t in path.split("/"):
-        if not isinstance(node, dict):
-            return None, False
-        k = _unescape(t)
-        if k not in node:
-            return None, False
-        node = node[k]
-    return node, True
+    for p in parts:
+        if p.startswith("k=") and isinstance(node, (list, tuple)):
+            kf = _LIST_FIELD_KEYS.get(field_name)
+            want = _unescape(p[2:])
+            node = next(
+                (e for e in node
+                 if isinstance(e, dict) and str(e.get(kf)) == want),
+                _MISSING,
+            )
+        elif isinstance(node, dict):
+            k = _unescape(p)
+            node = node[k] if k in node else _MISSING
+            field_name = k
+        else:
+            return _MISSING
+        if node is _MISSING:
+            return _MISSING
+    return node
+
+
+_MISSING = object()
+
+
+def _get_path(doc: dict, path: str) -> tuple:
+    """(value, present) at an RFC 6901-escaped '/' path (k= aware)."""
+    node = _walk(doc, path.split("/"))
+    return (None, False) if node is _MISSING else (node, True)
 
 
 def _delete_path(doc: dict, path: str) -> None:
-    parts = [_unescape(t) for t in path.split("/")]
-    node = doc
-    for p in parts[:-1]:
-        node = node.get(p)
-        if not isinstance(node, dict):
-            return
-    node.pop(parts[-1], None)
+    parts = path.split("/")
+    # parent field name for keyed-element resolution of the LEAF
+    parent_field = ""
+    for p in reversed(parts[:-1]):
+        if not p.startswith("k="):
+            parent_field = _unescape(p)
+            break
+    node = _walk(doc, parts[:-1])
+    if node is _MISSING:
+        return
+    leaf = parts[-1]
+    if leaf.startswith("k=") and isinstance(node, list):
+        kf = _LIST_FIELD_KEYS.get(parent_field)
+        want = _unescape(leaf[2:])
+        node[:] = [e for e in node
+                   if not (isinstance(e, dict) and str(e.get(kf)) == want)]
+    elif isinstance(node, dict):
+        node.pop(_unescape(leaf), None)
 
 
-def _merge(base, delta):
-    """Recursive dict merge; scalars and lists replace (atomic)."""
+def _merge(base, delta, field_name: str = ""):
+    """Recursive merge: dicts merge per key, keyed lists merge per element
+    (base order kept, new elements appended in applied order), everything
+    else replaces (atomic)."""
+    key = _list_key_field(field_name, delta)
+    if (key is not None and isinstance(base, (list, tuple))
+            and all(isinstance(e, dict) and e.get(key) is not None
+                    for e in base)):
+        delta_by_key = {e[key]: e for e in delta}
+        base_keys = {b[key] for b in base}
+        out = [
+            _merge(b, delta_by_key[b[key]]) if b[key] in delta_by_key else b
+            for b in base
+        ]
+        out.extend(e for e in delta if e[key] not in base_keys)
+        return out
     if not isinstance(delta, dict) or not isinstance(base, dict):
         return delta
     out = dict(base)
     for k, v in delta.items():
-        out[k] = _merge(out.get(k), v)
+        out[k] = _merge(out.get(k), v, k)
     return out
+
+
+def _is_merge_key_leaf(path: str) -> bool:
+    """Is this path a keyed element's identity field (`.../<list>/k=X/<kf>`)?
+    Identity is shared by every applier of the element — never contested."""
+    parts = path.split("/")
+    if len(parts) < 3 or not parts[-2].startswith("k="):
+        return False
+    kf = _LIST_FIELD_KEYS.get(_unescape(parts[-3]))
+    return kf is not None and _unescape(parts[-1]) == kf
+
+
+def _element_prefixes(path: str) -> list[str]:
+    """Every keyed-element prefix along a path (`a/b/k=X` for each k=)."""
+    parts = path.split("/")
+    return ["/".join(parts[: j + 1])
+            for j, p in enumerate(parts) if p.startswith("k=")]
 
 
 def apply_doc(stored: dict | None, applied: dict, manager: str,
@@ -130,7 +249,8 @@ def apply_doc(stored: dict | None, applied: dict, manager: str,
         if entry.get("manager") == manager:
             continue
         owned = set(entry.get("fields") or ())
-        pairs = [(p, p) for p in new_paths & owned]
+        pairs = [(p, p) for p in new_paths & owned
+                 if not _is_merge_key_leaf(p)]
         # downward clobber: an atomic new value replaces o's whole subtree
         pairs += [(p, o) for p in atomic_new for o in owned
                   if o.startswith(p + "/")]
@@ -173,12 +293,32 @@ def apply_doc(stored: dict | None, applied: dict, manager: str,
         # the old leaf from our set while the new config lives UNDER it —
         # deleting the ancestor would wipe the configuration just applied
         protected = others | new_paths
+        emptied: set[str] = set()
         for path in sorted(set(prev.get("fields") or ()) - new_paths):
             subtree = path + "/"
-            if path not in protected and not any(
+            if path in protected or any(
                 o.startswith(subtree) for o in protected
             ):
-                _delete_path(merged, path)
+                continue
+            if _is_merge_key_leaf(path):
+                # the element's identity survives as long as ANY manager
+                # keeps content in the element; with nothing protected the
+                # WHOLE element goes (dropping just the key first would
+                # make the element unaddressable for later deletions)
+                elem = path.rsplit("/", 1)[0]
+                if any(o.startswith(elem + "/") for o in protected):
+                    continue
+                _delete_path(merged, elem)
+                continue
+            _delete_path(merged, path)
+            emptied.update(_element_prefixes(path))
+        # only the SPECIFIC elements whose leaves we just deleted are
+        # swept when fully emptied — a user's literal {} in an atomic list
+        # is data, not debris (deepest first so nested empties collapse)
+        for ep in sorted(emptied, key=len, reverse=True):
+            val, ok = _get_path(merged, ep)
+            if ok and val == {}:
+                _delete_path(merged, ep)
 
     mf = [e for e in mf
           if not (e.get("manager") == manager

@@ -202,6 +202,14 @@ class NativeStore:
         hot-path caller (skipPodSchedule) runs against the Python store."""
         return self.try_get(kind, key) is not None
 
+    def list_refs(self, kind: str):
+        """Store.list_refs parity. The native core serializes every read, so
+        there are no shared references to hand out — this is list() minus
+        the revision, kept so read-only scanners (admission plugins, the
+        event GC) work unchanged over this facade."""
+        objs, _rev = self.list(kind)
+        return objs
+
     def update(self, obj, *, check_version: bool = True):
         with self._mu:
             kind, key = obj.kind, obj.meta.key

@@ -23,17 +23,18 @@ def server_pair():
 
 
 class TestFieldPaths:
-    def test_leaves_lists_atomic_identity_excluded(self):
+    def test_leaves_keyed_lists_identity_excluded(self):
         doc = {
             "kind": "Pod", "apiVersion": "v1",
             "meta": {"name": "p", "namespace": "default",
                      "labels": {"app": "web", "tier": "fe"}},
             "spec": {"priority": 5, "tolerations": [{"key": "k"}],
-                     "affinity": {}},
+                     "affinity": {}, "args": [1, 2]},
         }
         assert field_paths(doc) == {
             "meta/labels/app", "meta/labels/tier",
-            "spec/priority", "spec/tolerations", "spec/affinity",
+            "spec/priority", "spec/tolerations/k=k/key", "spec/affinity",
+            "spec/args",  # unknown list field stays atomic
         }
 
     def test_dotted_and_slashed_keys_unambiguous(self):
@@ -252,3 +253,158 @@ class TestAtomicOverlapConflicts:
         two = apply_doc(one, {"spec": {"affinity": {"zone": "us-a"}}},
                         "mgr-a")
         assert two["spec"]["affinity"] == {"zone": "us-a"}
+
+
+class TestAssociativeLists:
+    """Golden cases modeled on the reference fieldmanager's listType=map
+    behavior (staging/src/k8s.io/apiserver/pkg/endpoints/handlers/
+    fieldmanager TestApplyManagedFields / structured-merge-diff merge
+    semantics): per-element ownership, cross-applier element coexistence,
+    element-granular conflicts, drop-removes-element."""
+
+    def test_two_appliers_own_different_containers(self):
+        """VERDICT r4 task 6 done-criterion."""
+        one = apply_doc(None, {
+            "kind": "Pod", "meta": {"name": "p"},
+            "spec": {"containers": [
+                {"name": "app", "image": "app:v1"},
+            ]},
+        }, "mgr-a")
+        two = apply_doc(one, {
+            "spec": {"containers": [
+                {"name": "sidecar", "image": "proxy:v2"},
+            ]},
+        }, "mgr-b")  # NO conflict, NO force
+        names = [c["name"] for c in two["spec"]["containers"]]
+        assert names == ["app", "sidecar"]
+        images = {c["name"]: c["image"] for c in two["spec"]["containers"]}
+        assert images == {"app": "app:v1", "sidecar": "proxy:v2"}
+
+    def test_same_container_field_conflicts(self):
+        one = apply_doc(None, {
+            "spec": {"containers": [{"name": "app", "image": "app:v1"}]},
+        }, "mgr-a")
+        with pytest.raises(ApplyConflict) as exc:
+            apply_doc(one, {
+                "spec": {"containers": [{"name": "app", "image": "app:v2"}]},
+            }, "mgr-b")
+        assert "mgr-a" in str(exc.value)
+        assert "image" in str(exc.value)
+        forced = apply_doc(one, {
+            "spec": {"containers": [{"name": "app", "image": "app:v2"}]},
+        }, "mgr-b", force=True)
+        assert forced["spec"]["containers"][0]["image"] == "app:v2"
+
+    def test_merge_key_leaf_is_never_contested(self):
+        """Both appliers must state the element's name to address it —
+        identity co-ownership is not a conflict (reference: the key is the
+        element's path, not its content)."""
+        one = apply_doc(None, {
+            "spec": {"containers": [{"name": "app", "image": "a:1"}]},
+        }, "mgr-a")
+        # mgr-b owns a DIFFERENT field of the same element; shares `name`
+        two = apply_doc(one, {
+            "spec": {"containers": [
+                {"name": "app", "env": [{"name": "DEBUG", "value": "1"}]},
+            ]},
+        }, "mgr-b")
+        c = two["spec"]["containers"][0]
+        assert c["image"] == "a:1"
+        assert c["env"] == [{"name": "DEBUG", "value": "1"}]
+
+    def test_dropped_element_removed_others_kept(self):
+        one = apply_doc(None, {
+            "spec": {"containers": [
+                {"name": "app", "image": "a:1"},
+                {"name": "extra", "image": "x:1"},
+            ]},
+        }, "mgr-a")
+        two = apply_doc(one, {
+            "spec": {"containers": [{"name": "app", "image": "a:1"}]},
+        }, "mgr-a")
+        assert [c["name"] for c in two["spec"]["containers"]] == ["app"]
+
+    def test_dropped_element_kept_when_other_manager_owns_content(self):
+        one = apply_doc(None, {
+            "spec": {"containers": [
+                {"name": "app", "image": "a:1"},
+                {"name": "shared", "image": "s:1"},
+            ]},
+        }, "mgr-a")
+        two = apply_doc(one, {
+            "spec": {"containers": [
+                {"name": "shared", "env": [{"name": "X", "value": "1"}]},
+            ]},
+        }, "mgr-b")
+        # mgr-a retreats from "shared"; mgr-b still owns env in it
+        three = apply_doc(two, {
+            "spec": {"containers": [{"name": "app", "image": "a:1"}]},
+        }, "mgr-a")
+        by_name = {c["name"]: c for c in three["spec"]["containers"]}
+        assert set(by_name) == {"app", "shared"}
+        # mgr-a's image on "shared" is gone, mgr-b's env stays, and the
+        # element's identity (name) survives
+        assert "image" not in by_name["shared"]
+        assert by_name["shared"]["env"] == [{"name": "X", "value": "1"}]
+
+    def test_env_and_ports_merge_within_container(self):
+        one = apply_doc(None, {
+            "spec": {"containers": [{
+                "name": "app",
+                "env": [{"name": "A", "value": "1"}],
+                "ports": [{"container_port": 80, "protocol": "TCP"}],
+            }]},
+        }, "mgr-a")
+        two = apply_doc(one, {
+            "spec": {"containers": [{
+                "name": "app",
+                "env": [{"name": "B", "value": "2"}],
+                "ports": [{"container_port": 443, "protocol": "TCP"}],
+            }]},
+        }, "mgr-b")
+        c = two["spec"]["containers"][0]
+        assert [e["name"] for e in c["env"]] == ["A", "B"]
+        assert [p["container_port"] for p in c["ports"]] == [80, 443]
+
+    def test_tolerations_keyed_by_key(self):
+        one = apply_doc(None, {
+            "spec": {"tolerations": [
+                {"key": "gpu", "operator": "Exists"},
+            ]},
+        }, "mgr-a")
+        two = apply_doc(one, {
+            "spec": {"tolerations": [
+                {"key": "spot", "operator": "Exists"},
+            ]},
+        }, "mgr-b")
+        assert [t["key"] for t in two["spec"]["tolerations"]] == \
+            ["gpu", "spot"]
+
+    def test_unkeyed_list_still_atomic(self):
+        one = apply_doc(None, {"spec": {"finalizer_list": ["a"]}}, "mgr-a")
+        with pytest.raises(ApplyConflict):
+            apply_doc(one, {"spec": {"finalizer_list": ["b"]}}, "mgr-b")
+
+    def test_http_end_to_end_pod_containers(self):
+        """Through the real PATCH path: two appliers, one pod, different
+        containers; decode back into the typed Pod."""
+        store, server = server_pair()
+        try:
+            client = RESTStore(server.url)
+            client.apply("Pod", "default/web", {
+                "kind": "Pod",
+                "meta": {"name": "web", "namespace": "default"},
+                "spec": {"containers": [
+                    {"name": "app", "image": "app:v1"},
+                ]},
+            }, "kubectl")
+            client.apply("Pod", "default/web", {
+                "spec": {"containers": [
+                    {"name": "mesh", "image": "proxy:v3"},
+                ]},
+            }, "mesh-injector")
+            pod = store.get("Pod", "default/web")
+            assert [c.name for c in pod.spec.containers] == ["app", "mesh"]
+            assert pod.spec.containers[1].image == "proxy:v3"
+        finally:
+            server.shutdown()
