@@ -36,13 +36,14 @@ def _register_all() -> None:
         events,
         extensions,
         rbac,
+        registration,
         storage,
         types,
         workloads,
     )
 
     for mod in (types, storage, dra, coordination, workloads, rbac,
-                extensions, events):
+                extensions, events, registration):
         for name in dir(mod):
             obj = getattr(mod, name)
             if isinstance(obj, type) and hasattr(obj, "kind") and dataclasses.is_dataclass(obj):

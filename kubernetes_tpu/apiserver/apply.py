@@ -185,13 +185,17 @@ def _merge(base, delta, field_name: str = ""):
     if (key is not None and isinstance(base, (list, tuple))
             and all(isinstance(e, dict) and e.get(key) is not None
                     for e in base)):
-        delta_by_key = {e[key]: e for e in delta}
-        base_keys = {b[key] for b in base}
+        # STRINGIFIED keys, exactly like field_paths/_walk build k= paths:
+        # a YAML-quoted "80" and an int 80 must address the same element
+        # for ownership tracking and merging alike
+        delta_by_key = {str(e[key]): e for e in delta}
+        base_keys = {str(b[key]) for b in base}
         out = [
-            _merge(b, delta_by_key[b[key]]) if b[key] in delta_by_key else b
+            _merge(b, delta_by_key[str(b[key])])
+            if str(b[key]) in delta_by_key else b
             for b in base
         ]
-        out.extend(e for e in delta if e[key] not in base_keys)
+        out.extend(e for e in delta if str(e[key]) not in base_keys)
         return out
     if not isinstance(delta, dict) or not isinstance(base, dict):
         return delta

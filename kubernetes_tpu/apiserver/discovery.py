@@ -22,7 +22,11 @@ CLUSTER_SCOPED = {"Node", "Namespace", "CSINode", "PodGroup", "ClusterRole",
                   "ClusterRoleBinding", "PriorityClass", "ResourceSlice",
                   "DeviceClass", "StorageClass", "PersistentVolume",
                   "CustomResourceDefinition",
-                  "ValidatingWebhookConfiguration"}
+                  "ValidatingWebhookConfiguration",
+                  "MutatingWebhookConfiguration",
+                  "ValidatingAdmissionPolicy",
+                  "ValidatingAdmissionPolicyBinding",
+                  "APIService"}
 
 _VERBS = ["create", "delete", "get", "list", "update", "watch"]
 

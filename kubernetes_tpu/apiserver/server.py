@@ -375,9 +375,96 @@ class APIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _handle_aggregated(self) -> None:
+                """The aggregation layer (kube-aggregator's role, the FIRST
+                server in the reference's delegation chain): everything
+                under /apis/ resolves through APIService objects — group
+                discovery is merged here, resource requests proxy verbatim
+                to the registered delegate, and delegate reachability is
+                surfaced as the Available condition (503 when down)."""
+                from urllib.error import URLError
+
+                from . import aggregator
+                from .auth import ANONYMOUS
+
+                # drain the request body FIRST: every early-exit response
+                # below would otherwise desync a keep-alive connection
+                # (unread body bytes parse as the next request line)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                user = self._authenticate()
+                if user is None:
+                    return
+                uname = "" if user is _TRUSTED else user.name
+                if user is not _TRUSTED and user.name == ANONYMOUS:
+                    self._error(403, "Forbidden",
+                                "discovery requires authentication")
+                    return
+                parsed = urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                if len(parts) == 1:  # GET /apis
+                    self._send_json(
+                        200, aggregator.api_group_list(server.store))
+                    return
+                group = parts[1]
+                if len(parts) == 2:  # GET /apis/<group>
+                    doc = aggregator.api_group_list(server.store)
+                    g = next((x for x in doc["groups"]
+                              if x["name"] == group), None)
+                    if g is None:
+                        self._error(404, "NotFound",
+                                    f"no APIService serves group {group!r}")
+                        return
+                    self._send_json(200, {"kind": "APIGroup", **g})
+                    return
+                version = parts[2]
+                # RBAC runs HERE, before the proxy: the delegate trusts the
+                # forwarded identity, so an unauthorized verb must never
+                # reach it (verb mapping mirrors the native routes; the
+                # resource attribute is the aggregated group)
+                verb = {"GET": "get", "POST": "create", "PUT": "update",
+                        "PATCH": "patch", "DELETE": "delete"}.get(
+                            self.command, self.command.lower())
+                if verb == "get" and len(parts) == 4:
+                    verb = "list"
+                if not self._authorized(verb, group, "/".join(parts[3:])):
+                    return
+                svc = aggregator.find_apiservice(server.store, group, version)
+                if svc is None:
+                    self._error(404, "NotFound",
+                                f"no APIService for {group}/{version}")
+                    return
+                if not svc.spec.service_url:
+                    self._error(503, "ServiceUnavailable",
+                                f"APIService {svc.meta.name} has no service"
+                                " reference")
+                    return
+                try:
+                    code, ctype, data = aggregator.proxy_request(
+                        svc, self.command, parsed.path, parsed.query, body,
+                        self.headers.get("Content-Type", ""), uname,
+                    )
+                except (URLError, OSError, ValueError) as e:
+                    aggregator.set_available_condition(
+                        server.store, svc, False, str(e))
+                    self._error(503, "ServiceUnavailable",
+                                f"APIService {svc.meta.name} is unavailable:"
+                                f" {e}")
+                    return
+                aggregator.set_available_condition(
+                    server.store, svc, True, "delegate reachable")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):
                 if self.path == "/healthz" or self.path == "/readyz":
                     self._send_json(200, {"status": "ok"})
+                    return
+                if self.path == "/apis" or self.path.startswith("/apis/"):
+                    self._handle_aggregated()
                     return
                 if self.path == "/version":
                     self._send_json(200, {"gitVersion": "v1.36.0-tpu",
@@ -534,6 +621,9 @@ class APIServer:
                     watch.stop()
 
             def do_POST(self):
+                if self.path.startswith("/apis/"):
+                    self._handle_aggregated()
+                    return
                 route = self._route()
                 if route is None:
                     self._error(404, "NotFound", "unknown path")
@@ -654,6 +744,9 @@ class APIServer:
                     self._error(400, "BadRequest", f"undecodable body: {e}")
 
             def do_PATCH(self):
+                if self.path.startswith("/apis/"):
+                    self._handle_aggregated()
+                    return
                 """RFC 7386 JSON merge patch against the stored object
                 (the reference's application/merge-patch+json strategy:
                 objects merge recursively, null deletes a key, anything
@@ -717,6 +810,9 @@ class APIServer:
                     self._error(400, "BadRequest", f"unmergeable patch: {e}")
 
             def do_PUT(self):
+                if self.path.startswith("/apis/"):
+                    self._handle_aggregated()
+                    return
                 route = self._route()
                 if route is None:
                     self._error(404, "NotFound", "unknown path")
@@ -838,6 +934,9 @@ class APIServer:
                     self._error(400, "BadRequest", f"undecodable body: {e}")
 
             def do_DELETE(self):
+                if self.path.startswith("/apis/"):
+                    self._handle_aggregated()
+                    return
                 # drain the body first: DELETE rarely carries one, but
                 # unconsumed bytes desync the next keep-alive request
                 self._read_body()

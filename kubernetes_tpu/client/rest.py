@@ -222,6 +222,12 @@ class RESTStore:
                 message = raw.decode(errors="replace")
             _raise_for(e.code, message, reason)
 
+    def raw_get(self, path: str) -> dict:
+        """GET an arbitrary server path (aggregated APIs under /apis/...,
+        discovery documents) — the typed surface below covers only core-v1
+        kinds the scheme decodes."""
+        return self._request("GET", path)
+
     # -- store surface -------------------------------------------------------
 
     def create(self, obj):

@@ -76,8 +76,75 @@ def _status_of(obj) -> str:
     return ""
 
 
+def _aggregated_resource(client: RESTStore, resource: str):
+    """Resolve a resource name through aggregated-API discovery: walk
+    /apis (merged APIGroupList), then each group/version's proxied
+    APIResourceList, matching name or kind (kubectl's RESTMapper over
+    discovery). Returns (groupVersion, resource-name, namespaced)."""
+    try:
+        groups = client.raw_get("/apis").get("groups", [])
+    except Exception:  # noqa: BLE001 - no aggregation layer configured
+        return None
+    want = resource.lower()
+    for g in groups:
+        for v in g.get("versions", []):
+            gv = v["groupVersion"]
+            try:
+                rl = client.raw_get(f"/apis/{gv}")
+            except Exception:  # noqa: BLE001 - delegate down; keep looking
+                continue
+            for r in rl.get("resources", []):
+                if want in (r.get("name", "").lower(),
+                            r.get("kind", "").lower(),
+                            r.get("kind", "").lower() + "s"):
+                    return gv, r["name"], bool(r.get("namespaced"))
+    return None
+
+
+def _get_aggregated(client: RESTStore, args) -> int:
+    """kubectl get over an aggregated resource: fetch through the MAIN
+    server (which proxies to the APIService delegate) and print the
+    unstructured items."""
+    found = _aggregated_resource(client, args.resource)
+    if found is None:
+        print(f"Error: the server doesn't have a resource type "
+              f"{args.resource!r}", file=sys.stderr)
+        return 1
+    gv, rname, namespaced = found
+    if namespaced and not args.all_namespaces:
+        path = f"/apis/{gv}/namespaces/{args.namespace}/{rname}"
+    else:
+        path = f"/apis/{gv}/{rname}"
+    if args.name:
+        path += f"/{args.name}"
+    try:
+        doc = client.raw_get(path)
+    except Exception as e:  # noqa: BLE001 - surfaced to the user
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(doc, indent=2))
+        return 0
+    items = doc.get("items", [doc])
+    print("NAME\tUSAGE")
+    for item in items:
+        meta = item.get("metadata", {})
+        usage = item.get("usage") or {}
+        if not usage and item.get("containers"):
+            usage = item["containers"][0].get("usage", {})
+        usage_s = ",".join(f"{k}={v}" for k, v in sorted(usage.items()))
+        print(f"{meta.get('name', '?')}\t{usage_s}")
+    return 0
+
+
 def cmd_get(client: RESTStore, args) -> int:
     kind = _kind(args.resource)
+    from ..api.serialization import _KINDS, _register_all
+
+    _register_all()
+    if kind not in _KINDS:
+        # not a core kind: try the aggregation layer's discovery
+        return _get_aggregated(client, args)
     if args.name:
         try:
             obj = client.get(kind, _key(kind, args.name, args.namespace))

@@ -1,0 +1,220 @@
+"""Aggregation layer e2e: APIService delegation through the main server.
+
+Modeled on the reference's kube-aggregator integration tests
+(staging/src/k8s.io/kube-aggregator, test/integration/apiserver): an
+APIService mounts an out-of-process group under /apis/<group>/<version>,
+requests proxy to the delegate, discovery merges the group, delegate
+outages surface as 503 + Available=False, and kubectl get resolves the
+aggregated resource through discovery.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.registration import APIService, APIServiceSpec
+from kubernetes_tpu.apiserver.aggregator import (
+    METRICS_GROUP,
+    METRICS_VERSION,
+    MetricsAPIServer,
+    register_metrics_apiservice,
+)
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.rest import RESTError, RESTStore
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+@pytest.fixture
+def cluster():
+    store = Store()
+    server = APIServer(store)
+    server.serve(0)
+    delegate = MetricsAPIServer(store)
+    delegate.serve(0)
+    yield store, server, delegate
+    delegate.shutdown()
+    server.shutdown()
+
+
+def _seed(store):
+    store.create(make_node("n1", cpu="4", mem="8Gi"))
+    store.create(make_node("n2", cpu="4", mem="8Gi"))
+    pod = make_pod("p1", cpu="500m", mem="1Gi")
+    pod.spec.node_name = "n1"
+    store.create(pod)
+
+
+class TestAggregation:
+    def test_apiservice_proxies_group_through_main_server(self, cluster):
+        store, server, delegate = cluster
+        _seed(store)
+        register_metrics_apiservice(store, delegate)
+        client = RESTStore(server.url)
+        doc = client.raw_get(
+            f"/apis/{METRICS_GROUP}/{METRICS_VERSION}/nodes")
+        assert doc["kind"] == "NodeMetricsList"
+        by_name = {i["metadata"]["name"]: i["usage"] for i in doc["items"]}
+        assert set(by_name) == {"n1", "n2"}
+        assert by_name["n1"]["cpu"] == "500m"
+        assert by_name["n2"]["cpu"] == "0m"
+
+    def test_discovery_merges_group(self, cluster):
+        store, server, delegate = cluster
+        register_metrics_apiservice(store, delegate)
+        client = RESTStore(server.url)
+        groups = client.raw_get("/apis")["groups"]
+        assert any(g["name"] == METRICS_GROUP for g in groups)
+        g = client.raw_get(f"/apis/{METRICS_GROUP}")
+        assert g["kind"] == "APIGroup"
+        # the group/version resource list is served BY THE DELEGATE,
+        # through the main server
+        rl = client.raw_get(f"/apis/{METRICS_GROUP}/{METRICS_VERSION}")
+        names = {r["name"] for r in rl["resources"]}
+        assert names == {"nodes", "pods"}
+
+    def test_unregistered_group_404(self, cluster):
+        from kubernetes_tpu.store.store import NotFoundError
+
+        store, server, delegate = cluster
+        client = RESTStore(server.url)
+        with pytest.raises(NotFoundError):
+            client.raw_get("/apis/metrics.k8s.io/v1beta1/nodes")
+
+    def test_dead_delegate_503_and_available_false(self, cluster):
+        store, server, delegate = cluster
+        store.create(APIService(
+            meta=ObjectMeta(name="v1.broken.example", namespace=""),
+            spec=APIServiceSpec(group="broken.example", version="v1",
+                                service_url="http://127.0.0.1:1"),
+        ))
+        client = RESTStore(server.url)
+        with pytest.raises(RESTError) as exc:
+            client.raw_get("/apis/broken.example/v1/things")
+        assert exc.value.code == 503
+        svc = store.get("APIService", "v1.broken.example")
+        conds = svc.status["conditions"]
+        assert conds[0]["type"] == "Available"
+        assert conds[0]["status"] == "False"
+
+    def test_available_condition_recovers(self, cluster):
+        store, server, delegate = cluster
+        _seed(store)
+        svc = register_metrics_apiservice(store, delegate)
+        client = RESTStore(server.url)
+        client.raw_get(f"/apis/{METRICS_GROUP}/{METRICS_VERSION}/nodes")
+        cur = store.get("APIService", svc.meta.key)
+        assert cur.status["conditions"][0]["status"] == "True"
+
+    def test_kubectl_get_aggregated_resource(self, cluster, capsys):
+        """VERDICT r4 task 7 done-criterion: kubectl get on an aggregated
+        resource served by the delegate through the main server."""
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+
+        store, server, delegate = cluster
+        _seed(store)
+        register_metrics_apiservice(store, delegate)
+        rc = kubectl(["--server", server.url, "get", "nodemetrics"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "n1" in out and "cpu=500m" in out
+        # single-object get resolves by discovery kind too
+        rc = kubectl(["--server", server.url, "get", "NodeMetrics", "n1"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "n1" in out
+
+    def test_pod_metrics_namespaced(self, cluster):
+        store, server, delegate = cluster
+        _seed(store)
+        register_metrics_apiservice(store, delegate)
+        client = RESTStore(server.url)
+        doc = client.raw_get(
+            f"/apis/{METRICS_GROUP}/{METRICS_VERSION}/pods")
+        assert doc["kind"] == "PodMetricsList"
+        assert doc["items"][0]["metadata"]["name"] == "p1"
+        assert doc["items"][0]["containers"][0]["usage"]["cpu"] == "500m"
+
+
+class TestAggregationHardening:
+    def test_kubectl_get_namespaced_podmetrics(self, cluster, capsys):
+        from kubernetes_tpu.cmd.kubectl import main as kubectl
+
+        store, server, delegate = cluster
+        _seed(store)
+        register_metrics_apiservice(store, delegate)
+        rc = kubectl(["--server", server.url, "get", "podmetrics", "p1"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "p1" in out
+        # namespace scoping: nothing in team-a
+        rc = kubectl(["--server", server.url, "-n", "team-a",
+                      "get", "podmetrics"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "p1" not in out
+
+    def test_rbac_enforced_before_proxy(self, cluster):
+        from kubernetes_tpu.api.rbac import (
+            ClusterRole, ClusterRoleBinding, PolicyRule, RoleRef, Subject)
+        from kubernetes_tpu.apiserver.auth import (
+            RBACAuthorizer, TokenAuthenticator, User)
+        from kubernetes_tpu.apiserver.server import APIServer as _S
+
+        store, _server, delegate = cluster
+        _seed(store)
+        register_metrics_apiservice(store, delegate)
+        authn = TokenAuthenticator({
+            "admin": User("admin", ("system:masters",)),
+            "peon": User("peon", ()),
+        })
+        secured = _S(store, authenticator=authn,
+                     authorizer=RBACAuthorizer(store))
+        secured.serve(0)
+        try:
+            path = f"/apis/{METRICS_GROUP}/{METRICS_VERSION}/nodes"
+            admin = RESTStore(secured.url, token="admin")
+            assert admin.raw_get(path)["kind"] == "NodeMetricsList"
+            peon = RESTStore(secured.url, token="peon")
+            with pytest.raises(RESTError) as exc:
+                peon.raw_get(path)
+            assert exc.value.code == 403
+            # a grant on the GROUP resource opens it
+            store.create(ClusterRole(
+                meta=ObjectMeta(name="metrics-reader", namespace=""),
+                rules=(PolicyRule(("get", "list"), (METRICS_GROUP,)),),
+            ))
+            store.create(ClusterRoleBinding(
+                meta=ObjectMeta(name="peon-metrics", namespace=""),
+                subjects=(Subject("User", "peon"),),
+                role_ref=RoleRef("ClusterRole", "metrics-reader"),
+            ))
+            assert peon.raw_get(path)["kind"] == "NodeMetricsList"
+        finally:
+            secured.shutdown()
+
+    def test_empty_service_url_is_503_not_crash(self, cluster):
+        store, server, delegate = cluster
+        store.create(APIService(
+            meta=ObjectMeta(name="v1.hollow.example", namespace=""),
+            spec=APIServiceSpec(group="hollow.example", version="v1",
+                                service_url=""),
+        ))
+        client = RESTStore(server.url)
+        with pytest.raises(RESTError) as exc:
+            client.raw_get("/apis/hollow.example/v1/things")
+        assert exc.value.code == 503
+
+    def test_kubelet_published_usage_wins_over_requests(self, cluster):
+        from kubernetes_tpu.api.workloads import PodMetrics
+
+        store, server, delegate = cluster
+        _seed(store)
+        register_metrics_apiservice(store, delegate)
+        store.create(PodMetrics(
+            meta=ObjectMeta(name="p1", namespace="default"),
+            cpu_usage_milli=111, memory_usage_bytes=64 << 20,
+        ))
+        client = RESTStore(server.url)
+        doc = client.raw_get(
+            f"/apis/{METRICS_GROUP}/{METRICS_VERSION}/nodes")
+        by_name = {i["metadata"]["name"]: i["usage"] for i in doc["items"]}
+        assert by_name["n1"]["cpu"] == "111m"
+        assert by_name["n1"]["memory"] == "64Mi"
