@@ -31,6 +31,7 @@ def kind_class(kind: str) -> type:
 def _register_all() -> None:
     """Populate the registry from the api modules (runtime.Scheme builders)."""
     from . import (
+        certificates,
         coordination,
         dra,
         events,
@@ -43,7 +44,7 @@ def _register_all() -> None:
     )
 
     for mod in (types, storage, dra, coordination, workloads, rbac,
-                extensions, events, registration):
+                extensions, events, registration, certificates):
         for name in dir(mod):
             obj = getattr(mod, name)
             if isinstance(obj, type) and hasattr(obj, "kind") and dataclasses.is_dataclass(obj):

@@ -193,3 +193,45 @@ def pod_claim_names(pod) -> list[str]:
         if name:
             out.append(name)
     return out
+
+
+@dataclass
+class VolumeAttachmentSpec:
+    """storage.k8s.io/v1 VolumeAttachmentSpec (attach_detach_controller +
+    the external CSI attacher's contract): which PV is being attached to
+    which node by which attacher."""
+
+    attacher: str = ""  # CSI driver name ("" = in-tree, attach is a no-op)
+    node_name: str = ""
+    pv_name: str = ""  # source.persistentVolumeName
+
+
+@dataclass
+class VolumeAttachment:
+    """storage.k8s.io/v1 VolumeAttachment: the attach INTENT between PV
+    binding and kubelet mount. The attach-detach controller creates these
+    for scheduled pods' CSI volumes; the attacher (in-process here) flips
+    status["attached"]; the kubelet's volume manager WAITS on that before
+    mounting (WaitForAttachAndMount's attach half). Cluster-scoped.
+
+    Reference: pkg/controller/volume/attachdetach/attach_detach_controller.go
+    + staging/src/k8s.io/api/storage/v1/types.go VolumeAttachment."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: VolumeAttachmentSpec = field(default_factory=VolumeAttachmentSpec)
+    # {"attached": bool, "attach_error": str}
+    status: dict = field(default_factory=dict)
+
+    kind = "VolumeAttachment"
+
+    @staticmethod
+    def expected_name(pv_name: str, node_name: str) -> str:
+        """Deterministic, COLLISION-FREE name per (volume, node) pair —
+        hashed like the reference's csi-<sha> (a readable join would
+        collide: pv 'data-1'+node 'a' vs pv 'data'+node '1-a')."""
+        import hashlib
+
+        digest = hashlib.sha1(
+            f"{pv_name}\x00{node_name}".encode()
+        ).hexdigest()[:16]
+        return f"attach-{digest}"

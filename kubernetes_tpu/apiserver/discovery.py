@@ -26,7 +26,8 @@ CLUSTER_SCOPED = {"Node", "Namespace", "CSINode", "PodGroup", "ClusterRole",
                   "MutatingWebhookConfiguration",
                   "ValidatingAdmissionPolicy",
                   "ValidatingAdmissionPolicyBinding",
-                  "APIService"}
+                  "APIService", "VolumeAttachment",
+                  "CertificateSigningRequest"}
 
 _VERBS = ["create", "delete", "get", "list", "update", "watch"]
 

@@ -43,6 +43,9 @@ class ClusterBootstrap:
         self.controller_manager: ControllerManager | None = None
         self.kubelets: list[HollowKubelet] = []
         self.proxiers: list[Proxier] = []
+        # node name -> (client key path, CA-signed client cert PEM) minted
+        # by the CSR join flow (TLS mode)
+        self.node_credentials: dict[str, tuple[str, str]] = {}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -92,7 +95,10 @@ class ClusterBootstrap:
                                    clock=self.clock)
         self.scheduler.start()  # sync informers before any pods arrive
         self.controller_manager = ControllerManager(
-            self.store, default_controllers(self.store, clock=self.clock)
+            self.store, default_controllers(
+                self.store, clock=self.clock,
+                ca_cert=self.ca_cert or "", ca_key=self._tls_key or "",
+            )
         )
 
     def _phase_bootstrap_policy(self) -> None:
@@ -108,9 +114,14 @@ class ClusterBootstrap:
 
     def add_node(self, name: str, cpu: str = "8", mem: str = "32Gi",
                  zone: str = "zone-0") -> HollowKubelet:
-        """kubeadm join: register a kubelet + per-node proxy."""
+        """kubeadm join: register a kubelet + per-node proxy. With TLS on,
+        the node's client identity is MINTED through the CSR flow first
+        (kubelet bootstrap: CSR → auto-approve → CA-signed cert), not
+        pre-shared."""
         from ..testing.wrappers import make_node
 
+        if self.tls:
+            self.join_certificate(name)
         kubelet = HollowKubelet(self.store, make_node(name, cpu=cpu, mem=mem,
                                                       zone=zone),
                                 clock=self.clock)
@@ -118,6 +129,46 @@ class ClusterBootstrap:
         self.kubelets.append(kubelet)
         self.proxiers.append(Proxier(self.store, node_name=name))
         return kubelet
+
+    def join_certificate(self, node_name: str) -> tuple[str, str]:
+        """The kubelet TLS-bootstrap half of kubeadm join
+        (pkg/kubelet/certificate/bootstrap): generate a key + CSR with the
+        node identity (CN=system:node:<name>, O=system:nodes), submit a
+        CertificateSigningRequest, drive the approval + signing
+        controllers, and return (key_path, signed cert PEM) chained to the
+        cluster CA."""
+        from ..api.certificates import CertificateSigningRequest, CSRSpec
+        from ..api.meta import ObjectMeta
+        from ..apiserver.certs import new_key_and_csr
+
+        from ..store.store import NotFoundError
+
+        assert self.controller_manager is not None
+        key_path, csr_pem = new_key_and_csr(
+            f"system:node:{node_name}", org="system:nodes")
+        csr_name = f"node-csr-{node_name}"
+        # a re-join replaces any prior CSR: the fresh key needs its OWN
+        # signature — returning a cert minted for an older key would hand
+        # the node a mismatched key/cert pair
+        try:
+            self.store.delete("CertificateSigningRequest", csr_name)
+        except NotFoundError:
+            pass
+        self.store.create(CertificateSigningRequest(
+            meta=ObjectMeta(name=csr_name, namespace=""),
+            spec=CSRSpec(request=csr_pem,
+                         username=f"system:node:{node_name}"),
+        ))
+        # drive approver + signer to quiescence (threaded mode picks the
+        # CSR up on its own; the deterministic path reconciles inline)
+        self.controller_manager.sync_once()
+        csr = self.store.get("CertificateSigningRequest", csr_name)
+        cert = csr.status.get("certificate", "")
+        if not cert:
+            raise RuntimeError(
+                f"CSR {csr_name} was not signed: {csr.status}")
+        self.node_credentials[node_name] = (key_path, cert)
+        return key_path, cert
 
     # -- convergence ---------------------------------------------------------
 
@@ -178,11 +229,19 @@ class ClusterBootstrap:
                          ca_cert=cfg.get("certificate-authority"))
 
     def shutdown(self) -> None:
+        import os
+        import shutil
+
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
         if self.apiserver is not None:
             self.apiserver.shutdown()
+        # private-key material minted by the CSR join flow must not
+        # outlive the cluster (each join created one temp dir)
+        for key_path, _cert in self.node_credentials.values():
+            shutil.rmtree(os.path.dirname(key_path), ignore_errors=True)
+        self.node_credentials.clear()
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -25,14 +25,26 @@ from .workloads import (
 )
 
 
-def default_controllers(store, clock=None) -> list[Controller]:
+def default_controllers(store, clock=None, ca_cert: str = "",
+                        ca_key: str = "") -> list[Controller]:
     """The controller set kube-controller-manager starts by default, all on
     ONE shared informer factory (SharedInformerFactory semantics — each kind
-    gets a single watch + cache, fanned out to every controller)."""
+    gets a single watch + cache, fanned out to every controller). The CSR
+    signing controller joins only when the cluster CA is provided (the
+    reference gates it on --cluster-signing-cert-file the same way)."""
     from ..client.informer import InformerFactory
+    from .attachdetach import AttachDetachController
+    from .certificates import CSRApprovingController, CSRSigningController
 
     informers = InformerFactory(store)
-    return [
+    out = [
+        AttachDetachController(store, informers),
+        CSRApprovingController(store, informers),
+    ]
+    if ca_cert:
+        out.append(CSRSigningController(store, informers,
+                                        ca_cert=ca_cert, ca_key=ca_key))
+    return out + [
         DeploymentController(store, informers),
         ReplicaSetController(store, informers),
         JobController(store, informers, clock=clock),

@@ -68,7 +68,7 @@ class Kubelet(NodeAgentBase):
         from .volumemanager import VolumeManager
 
         self.container_manager = ContainerManager(node)
-        self.volume_manager = VolumeManager(store)
+        self.volume_manager = VolumeManager(store, node_name=self.node_name)
 
     RESTART_BACKOFF_BASE_S = 10.0   # kubelet.go MaxContainerBackOff family
     RESTART_BACKOFF_MAX_S = 300.0

@@ -15,10 +15,32 @@ from __future__ import annotations
 
 
 class VolumeManager:
-    def __init__(self, store):
+    def __init__(self, store, node_name: str = ""):
         self.store = store
+        self.node_name = node_name
         self.attached: set[str] = set()  # PV names attached to this node
         self.mounts: dict[str, set[str]] = {}  # pod key -> mounted PV names
+
+    def _attach_blocked(self, pv) -> str:
+        """CSI volumes wait on the attach-detach controller's
+        VolumeAttachment reaching attached=True before mount (the attach
+        half of WaitForAttachAndMount; reference: volumemanager waits on
+        the actual_state_of_world the attacher populates). In-tree volumes
+        ('' csi_driver) attach implicitly."""
+        if not pv.spec.csi_driver or not self.node_name:
+            return ""
+        from ..api.storage import VolumeAttachment
+
+        name = VolumeAttachment.expected_name(pv.meta.name, self.node_name)
+        va = self.store.try_get("VolumeAttachment", name)
+        if va is None:
+            return (f'volume "{pv.meta.name}" is not attached to node '
+                    f'"{self.node_name}" (no VolumeAttachment)')
+        if not va.status.get("attached"):
+            return (f'volume "{pv.meta.name}" attachment is pending'
+                    + (f': {va.status.get("attach_error")}'
+                       if va.status.get("attach_error") else ""))
+        return ""
 
     def mount_pod(self, pod) -> tuple[bool, str]:
         """WaitForAttachAndMount: resolve every claim-backed volume to its
@@ -55,6 +77,9 @@ class VolumeManager:
                     f'unmounted volumes=[{v.name}]: volume '
                     f'"{pvc.spec.volume_name}" not found'
                 )
+            blocked = self._attach_blocked(pv)
+            if blocked:
+                return False, f"unmounted volumes=[{v.name}]: {blocked}"
             wanted.append(pv.meta.name)
         for name in wanted:
             self.attached.add(name)
