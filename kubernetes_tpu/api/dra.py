@@ -59,6 +59,53 @@ class DeviceSelector:
         return False
 
 
+# taint effects shared with node taints (api/types.py is the source)
+from .types import NO_EXECUTE, NO_SCHEDULE  # noqa: E402,F401
+
+
+@dataclass(frozen=True)
+class DeviceTaint:
+    """A taint on one device (KEP-5055 device taints,
+    resource/v1 DeviceTaint + pkg/controller/devicetainteviction):
+    NoSchedule keeps new allocations off the device; NoExecute
+    additionally evicts pods whose claims hold it."""
+
+    key: str
+    value: str = ""
+    effect: str = NO_SCHEDULE  # NoSchedule | NoExecute
+
+
+@dataclass(frozen=True)
+class DeviceToleration:
+    """resource/v1 DeviceToleration: lets a claim's request accept
+    matching device taints (Exists ignores the value; Equal compares)."""
+
+    key: str = ""  # "" + Exists tolerates everything
+    operator: str = "Exists"  # Exists | Equal
+    value: str = ""
+    effect: str = ""  # "" matches every effect
+
+    def tolerates(self, taint: DeviceTaint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if not self.key:
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        return self.operator == "Exists" or self.value == taint.value
+
+
+def untolerated_taints(taints, tolerations,
+                       effects=(NO_SCHEDULE, NO_EXECUTE)):
+    """The device taints (of the given effects) no toleration covers —
+    non-empty blocks allocation (and NoExecute evicts)."""
+    return [
+        t for t in taints
+        if t.effect in effects
+        and not any(tol.tolerates(t) for tol in tolerations)
+    ]
+
+
 @dataclass(frozen=True)
 class Device:
     """One allocatable device in a ResourceSlice (resource/v1 BasicDevice).
@@ -74,6 +121,7 @@ class Device:
     consumes_counters: Mapping[str, Mapping[str, int]] = field(
         default_factory=dict
     )
+    taints: tuple[DeviceTaint, ...] = ()
 
 
 @dataclass
@@ -118,6 +166,7 @@ class DeviceSubRequest:
     device_class_name: str = ""
     selectors: tuple[DeviceSelector, ...] = ()
     count: int = 1
+    tolerations: tuple[DeviceToleration, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -135,6 +184,7 @@ class DeviceRequest:
     selectors: tuple[DeviceSelector, ...] = ()
     count: int = 1
     first_available: tuple["DeviceSubRequest", ...] = ()
+    tolerations: tuple[DeviceToleration, ...] = ()
 
 
 @dataclass

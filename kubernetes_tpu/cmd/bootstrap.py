@@ -159,14 +159,30 @@ class ClusterBootstrap:
             spec=CSRSpec(request=csr_pem,
                          username=f"system:node:{node_name}"),
         ))
-        # drive approver + signer to quiescence (threaded mode picks the
-        # CSR up on its own; the deterministic path reconciles inline)
-        self.controller_manager.sync_once()
-        csr = self.store.get("CertificateSigningRequest", csr_name)
-        cert = csr.status.get("certificate", "")
+        # drive approver + signer and WAIT for the certificate: in threaded
+        # mode a worker may hold the CSR key mid-reconcile while our
+        # sync_once sees an empty queue — polling covers both modes
+        import os
+        import shutil
+        import time as _t
+
+        cert = ""
+        deadline = _t.monotonic() + 10.0
+        while _t.monotonic() < deadline:
+            self.controller_manager.sync_once()
+            csr = self.store.get("CertificateSigningRequest", csr_name)
+            cert = csr.status.get("certificate", "")
+            if cert:
+                break
+            _t.sleep(0.02)
         if not cert:
             raise RuntimeError(
                 f"CSR {csr_name} was not signed: {csr.status}")
+        old = self.node_credentials.get(node_name)
+        if old is not None:
+            # a re-join replaces the key: the superseded key material must
+            # not linger on disk
+            shutil.rmtree(os.path.dirname(old[0]), ignore_errors=True)
         self.node_credentials[node_name] = (key_path, cert)
         return key_path, cert
 

@@ -35,11 +35,13 @@ def default_controllers(store, clock=None, ca_cert: str = "",
     from ..client.informer import InformerFactory
     from .attachdetach import AttachDetachController
     from .certificates import CSRApprovingController, CSRSigningController
+    from .devicetainteviction import DeviceTaintEvictionController
 
     informers = InformerFactory(store)
     out = [
         AttachDetachController(store, informers),
         CSRApprovingController(store, informers),
+        DeviceTaintEvictionController(store, informers),
     ]
     if ca_cert:
         out.append(CSRSigningController(store, informers,

@@ -28,13 +28,27 @@ class CSRApprovingController(Controller):
     name = "csrapproving"
     watches = ("CertificateSigningRequest",)
 
+    # the exact usage set sarapprove requires for kubelet client certs
+    # (kubeletClientUsages, approver/sarapprove.go) — "key encipherment"
+    # is optional there too
+    _ALLOWED_USAGES = frozenset(
+        {"digital signature", "key encipherment", "client auth"})
+
     def reconcile(self, key: str) -> None:
         csr = self.store.try_get("CertificateSigningRequest", key)
         if csr is None or csr.status.get("conditions"):
             return  # gone, or already approved/denied
         if csr.spec.signer_name != KUBELET_CLIENT_SIGNER:
             return
+        usages = set(csr.spec.usages)
+        if "client auth" not in usages or usages - self._ALLOWED_USAGES:
+            return  # a serving-cert (or over-broad) request never auto-approves
         if not self._node_identity(csr):
+            return
+        if (csr.spec.username
+                and not csr.spec.username.startswith("system:node:")):
+            # the requestor-identity half of sarapprove: only a node (or
+            # the bootstrap flow acting as one) may request its own cert
             return
         csr.status.setdefault("conditions", []).append({
             "type": CONDITION_APPROVED,

@@ -146,20 +146,21 @@ class Allocator:
         return driver, out
 
     def _request_variants(self, request: DeviceRequest):
-        """[(subrequest name, driver, selectors, count)] tried in order —
-        a plain request is its own single variant; a prioritized-list
-        request (KEP-4816 firstAvailable) yields one variant per
-        alternative."""
+        """[(subrequest name, driver, selectors, count, tolerations)]
+        tried in order — a plain request is its own single variant; a
+        prioritized-list request (KEP-4816 firstAvailable) yields one
+        variant per alternative."""
         if request.first_available:
             return [
                 (sub.name, *self._resolve_class(sub.device_class_name,
-                                                sub.selectors), sub.count)
+                                                sub.selectors), sub.count,
+                 sub.tolerations)
                 for sub in request.first_available
             ]
         driver, selectors = self._resolve_class(
             request.device_class_name, request.selectors
         )
-        return [("", driver, selectors, request.count)]
+        return [("", driver, selectors, request.count, request.tolerations)]
 
     @staticmethod
     def _merged_inventory(cycle_state, node_name: str):
@@ -292,11 +293,13 @@ class Allocator:
                     if cons:
                         self._bump_counters(committed_use, key[0], key[1],
                                             cons)
+        from ...api.dra import untolerated_taints
+
         for ri, request in enumerate(claim.spec.requests):
             variants = (reqs[ri] if reqs is not None
                         else self._request_variants(request))
             satisfied = False
-            for sub_name, driver, selectors, count in variants:
+            for sub_name, driver, selectors, count, tolerations in variants:
                 picked_v: list[DeviceAllocationResult] = []
                 newly_v: list[tuple[str, str, str]] = []
                 use_v: dict = {}
@@ -317,6 +320,11 @@ class Allocator:
                                            capacity=dev.capacity,
                                            driver=drv, name=dev.name)
                                for sel in selectors):
+                        continue
+                    if dev.taints and untolerated_taints(dev.taints,
+                                                         tolerations):
+                        # KEP-5055: NoSchedule AND NoExecute taints keep
+                        # new allocations off the device unless tolerated
                         continue
                     cons = consumes.get(key)
                     if cons is not None and not self._counters_ok(

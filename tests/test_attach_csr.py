@@ -298,3 +298,34 @@ class TestHardening:
                 assert modulus("rsa", key2) == modulus("x509", f.name)
         finally:
             boot.shutdown()
+
+
+class TestApproverUsages:
+    def test_serving_usages_not_auto_approved(self):
+        """sarapprove's usage check: a server-auth CSR with a node subject
+        must not be auto-approved for the kubelet CLIENT signer."""
+        from kubernetes_tpu.apiserver.certs import new_key_and_csr
+
+        store = Store()
+        _k, csr_pem = new_key_and_csr("system:node:n1", org="system:nodes")
+        store.create(CertificateSigningRequest(
+            meta=ObjectMeta(name="serving", namespace=""),
+            spec=CSRSpec(request=csr_pem,
+                         usages=("digital signature", "server auth")),
+        ))
+        CSRApprovingController(store).sync_once()
+        assert not store.get("CertificateSigningRequest",
+                             "serving").approved
+
+    def test_foreign_requestor_not_auto_approved(self):
+        from kubernetes_tpu.apiserver.certs import new_key_and_csr
+
+        store = Store()
+        _k, csr_pem = new_key_and_csr("system:node:n1", org="system:nodes")
+        store.create(CertificateSigningRequest(
+            meta=ObjectMeta(name="foreign", namespace=""),
+            spec=CSRSpec(request=csr_pem, username="random-user"),
+        ))
+        CSRApprovingController(store).sync_once()
+        assert not store.get("CertificateSigningRequest",
+                             "foreign").approved
