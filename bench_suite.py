@@ -11,13 +11,20 @@ and prints one JSON line per row:
 
 plus a final summary line. Exit 0 iff every row meets its floor.
 
-Reference rows (test/integration/scheduler_perf/*/performance-config.yaml):
+Reference rows, AT REFERENCE WORKLOAD SHAPE
+(test/integration/scheduler_perf/*/performance-config.yaml):
   SchedulingBasic 5000Nodes_10000Pods         >= 270   misc:71-80
-  SchedulingDaemonset 5000Nodes               >= 390   misc:146-160
-  PreemptionAsync 500Nodes                    >= 160   misc:292-325
+  SchedulingDaemonset 15000Nodes (30k pods)   >= 390   misc:146-160
+  PreemptionAsync 5000Nodes (20k victims,
+                             5k preemptors)   >= 160   misc:292-325
   TopologySpreading 5000Nodes_5000Pods        >= 85    topology_spreading:67-76
-  SchedulingWFFCVolumes 5000Nodes_2000Pods    >= 90    volumes:121-130
-  SchedulingWithResourceClaims 500Nodes       >= 40    dra:133-136
+  SchedulingSecrets 5000Nodes_10000Pods       >= 260   volumes:61,70
+  SchedulingInTreePVs 5000Nodes_2000Pods      >= 90    volumes:110-135
+  SchedulingMigratedInTreePVs 5000N_5000P     >= 35    volumes:136-204
+  SchedulingCSIPVs 5000Nodes_5000Pods         >= 48    volumes:205-266
+  SchedulingWFFCVolumes 5000Nodes_2000Pods    >= 90    (WFFC variant)
+  SchedulingWithResourceClaims
+                   5000pods_500nodes          >= 40    dra:129-141
   GangScheduling 500Nodes                     >= 100   (fork feature; floor
                                                         from our own r04 run)
 
@@ -39,13 +46,23 @@ WAVE_SIZE = 512
 # YAML is the floor; keep the table here limited to naming
 ROWS = [
     ("misc.yaml", "SchedulingBasic", "5000Nodes_10000Pods", "basic_5000"),
-    ("misc.yaml", "SchedulingDaemonset", "5000Nodes", "daemonset_5000"),
-    ("misc.yaml", "PreemptionAsync", "500Nodes", "preemption_async_500"),
+    ("misc.yaml", "SchedulingDaemonset", "15000Nodes", "daemonset_15000"),
+    ("misc.yaml", "PreemptionAsync", "5000Nodes_AsyncAPICallsEnabled",
+     "preemption_async_5000"),
     ("topology_spreading.yaml", "TopologySpreading", "5000Nodes_5000Pods",
      "topology_spreading_5000"),
+    ("volumes.yaml", "SchedulingSecrets", "5000Nodes_10000Pods",
+     "secrets_5000"),
+    ("volumes.yaml", "SchedulingInTreePVs", "5000Nodes_2000Pods",
+     "intree_pvs_5000"),
+    ("volumes.yaml", "SchedulingMigratedInTreePVs", "5000Nodes_5000Pods",
+     "migrated_pvs_5000"),
+    ("volumes.yaml", "SchedulingCSIPVs", "5000Nodes_5000Pods",
+     "csi_pvs_5000"),
     ("volumes.yaml", "SchedulingWFFCVolumes", "5000Nodes_2000Pods",
      "wffc_volumes_5000"),
-    ("dra.yaml", "SchedulingWithResourceClaims", "500Nodes", "dra_500"),
+    ("dra.yaml", "SchedulingWithResourceClaims", "5000pods_500nodes",
+     "dra_5000pods_500nodes"),
     ("gang.yaml", "GangScheduling", "500Nodes", "gang_500"),
 ]
 

@@ -174,6 +174,16 @@ class NodeToStatus:
     def set(self, node_name: str, status: Status) -> None:
         self.node_to_status[node_name] = status
 
+    def aggregate_reasons(self) -> dict[str, int]:
+        """reason string -> node count (FitError's message body). Subclasses
+        backed by dense kernel rows aggregate vectorized instead of
+        materializing a Status per node."""
+        reasons: dict[str, int] = {}
+        for st in self.node_to_status.values():
+            for r in st.reasons:
+                reasons[r] = reasons.get(r, 0) + 1
+        return reasons
+
     def nodes_with_code(self, code: int, snapshot) -> list:
         out = []
         for ni in snapshot.list_nodes():
@@ -189,13 +199,16 @@ class FitError(Exception):
         self.pod = pod
         self.num_all_nodes = num_all_nodes
         self.diagnosis = diagnosis
-        super().__init__(self.error_message())
+        # message building is LAZY (__str__): a preemption-heavy workload
+        # raises a FitError per pod per attempt, and walking every node's
+        # status to format a message nobody may read was a top cost
+        super().__init__()
+
+    def __str__(self) -> str:
+        return self.error_message()
 
     def error_message(self) -> str:
-        reasons: dict[str, int] = {}
-        for st in self.diagnosis.node_to_status.node_to_status.values():
-            for r in st.reasons:
-                reasons[r] = reasons.get(r, 0) + 1
+        reasons = self.diagnosis.node_to_status.aggregate_reasons()
         parts = [f"{n} {r}" for r, n in sorted(reasons.items())]
         return (
             f"0/{self.num_all_nodes} nodes are available: {', '.join(parts) or 'none'}"

@@ -127,6 +127,9 @@ class DefaultPreemption(Plugin):
         self.names = names
         self.handle = handle
         self._offset = 0  # rotating candidate offset (fairness)
+        # (node name, node generation, preemptor priority) -> sorted
+        # lower-priority PodInfos (see _batch_select_victims)
+        self._victim_cache: dict = {}
 
     def set_handle(self, handle) -> None:
         self.handle = handle
@@ -347,6 +350,161 @@ class DefaultPreemption(Plugin):
                                      pi.pod.meta.creation_timestamp))
         return victims, num_violations
 
+    # -- batched victim search (HOT LOOP #3 as dense arrays) -----------------
+
+    def _batch_select_victims(self, state, pod: Pod, nodes: list,
+                              statuses) -> dict:
+        """One numpy pass replacing per-node _select_victims_on_node for
+        the nodes where only resources can decide (preemption.go:408
+        DryRunPreemption's dominant case, round-3 task: the candidate ×
+        victim dry-run as dense victim-removal deltas instead of a python
+        loop per candidate).
+
+        Eligible nodes: the pod is _resource_only-safe, the node carries no
+        required anti-affinity pods, its failure verdict came from
+        NodeResourcesFit, and it HAS lower-priority pods. The greedy
+        reprieve (priority desc, earlier start first) runs as a V-step
+        vector scan over every eligible node at once — step v asks "does
+        re-adding victim v still fit?" for ALL nodes in one [C, R]
+        comparison, byte-identical to the sequential loop's arithmetic.
+
+        Returns {node name: (victims, 0) | None}; nodes it does not decide
+        are absent (caller falls back per node). PDBs present → batch off
+        (the reprieve ORDER depends on per-victim PDB budgets)."""
+        import numpy as np
+
+        fitp = self._fit_plugin()
+        if fitp is None:
+            return {}
+        req_vec = fitp._pod_info(state, pod).request
+        if not self._pod_resource_only(pod):
+            return {}
+        eligible: list = []
+        victim_lists: list[list[PodInfo]] = []
+        vmax = 0
+        prio = pod.spec.priority
+        cache = self._victim_cache
+        bulk_fit = getattr(statuses, "fit_verdict_names", None)
+        fit_names = bulk_fit() if bulk_fit is not None else None
+        for ni in nodes:
+            if ni.pods_with_required_anti_affinity:
+                continue
+            if fit_names is not None:
+                if ni.name not in fit_names:
+                    continue
+            elif statuses.get(ni.name).plugin != fitp.name:
+                continue
+            # sorted victim lists are stable per (node generation, preemptor
+            # priority): consecutive preemptors of one priority class reuse
+            # them instead of re-walking + re-sorting every node's pods
+            ck = (ni.name, ni.generation, prio)
+            lower = cache.get(ck)
+            if lower is None:
+                lower = [pi for pi in ni.iter_pods()
+                         if pi.pod.spec.priority < prio]
+                # MoreImportantPod order: reprieve tries high priority first
+                lower.sort(key=lambda pi: (-pi.pod.spec.priority,
+                                           pi.pod.meta.creation_timestamp))
+                if len(cache) > 20000:
+                    cache.clear()
+                cache[ck] = lower
+            if not lower:
+                continue
+            eligible.append(ni)
+            victim_lists.append(lower)
+            vmax = max(vmax, len(lower))
+        if not eligible:
+            return {}
+        C = len(eligible)
+        width = max(
+            max(len(ni.allocatable.v) for ni in eligible),
+            len(req_vec.v),
+        )
+        from ...api.resource import PODS
+
+        def vec(v):
+            return list(v) + [0] * (width - len(v))
+
+        req = np.asarray(vec(req_vec.v), dtype=np.int64)
+        # ignored resources and the PODS column are excluded from the
+        # per-resource comparison (exactly _fits_resources)
+        active = req > 0
+        for i in range(width):
+            name = (fitp.names.names[i] if i < fitp.names.width
+                    else f"res{i}")
+            if name in fitp.ignored:
+                active[i] = False
+        active[PODS] = False
+        alloc = np.asarray([vec(ni.allocatable.v) for ni in eligible],
+                           dtype=np.int64)
+        used = np.asarray([vec(ni.requested.v) for ni in eligible],
+                          dtype=np.int64)
+        vreq = np.zeros((C, vmax, width), dtype=np.int64)
+        vactive = np.zeros((C, vmax), dtype=bool)
+        for c, lower in enumerate(victim_lists):
+            for v, pi in enumerate(lower):
+                vreq[c, v] = vec(pi.request.v)
+                vactive[c, v] = True
+        # maximal removal: all lower-priority pods gone
+        used = used - vreq.sum(axis=1)
+        kept = np.asarray([len(ni.pods) - len(lv)
+                           for ni, lv in zip(eligible, victim_lists)],
+                          dtype=np.int64)
+        pods_cap = alloc[:, PODS]
+
+        req_a = req[active][None, :]
+        alloc_a = alloc[:, active]
+
+        def fits(u, k):
+            res_ok = (req_a <= alloc_a - u[:, active]).all(axis=1)
+            return res_ok & (k + 1 <= pods_cap)
+
+        feasible = fits(used, kept)
+        # greedy reprieve scan: step v re-adds victim v where it fits
+        victim_mask = np.zeros((C, vmax), dtype=bool)
+        for v in range(vmax):
+            trial = used + vreq[:, v]
+            ok = fits(trial, kept + 1) & vactive[:, v] & feasible
+            used = np.where(ok[:, None], trial, used)
+            kept = kept + ok
+            victim_mask[:, v] = vactive[:, v] & ~ok & feasible
+        out: dict = {}
+        for c, (ni, lower) in enumerate(zip(eligible, victim_lists)):
+            if not feasible[c]:
+                out[ni.name] = None
+                continue
+            victims = [pi for v, pi in enumerate(lower)
+                       if victim_mask[c, v]]
+            if not victims:
+                out[ni.name] = None
+                continue
+            victims.sort(key=lambda pi: (-pi.pod.spec.priority,
+                                         pi.pod.meta.creation_timestamp))
+            out[ni.name] = (victims, 0)
+        return out
+
+    def _pod_resource_only(self, pod: Pod) -> bool:
+        """The pod-level half of _resource_only (node-independent)."""
+        from ...api.storage import pod_claim_names
+
+        aff = pod.spec.affinity
+        if aff is not None and (aff.pod_affinity is not None
+                                or aff.pod_anti_affinity is not None):
+            return False
+        if any(p.host_port > 0 for c in pod.spec.containers
+               for p in c.ports):
+            return False
+        if any(c.when_unsatisfiable == "DoNotSchedule"
+               for c in pod.spec.topology_spread_constraints):
+            return False
+        if pod_claim_names(pod) or pod.spec.resource_claims:
+            return False
+        from .node_declared_features import infer_required_features
+
+        if infer_required_features(pod):
+            return False
+        return True
+
     # -- candidate sampling + ranking ----------------------------------------
 
     def _num_candidates(self, num_nodes: int) -> int:
@@ -391,16 +549,46 @@ class DefaultPreemption(Plugin):
         # rotating offset (the reference randomizes; a rotating cursor gives
         # the same fairness deterministically)
         start = self._offset % num_all if num_all else 0
-        scanned = 0
-        for i in range(num_all):
-            ni = nodes[(start + i) % num_all]
-            scanned += 1
-            if node_to_status.get(ni.name).code != UNSCHEDULABLE:
-                continue  # UnschedulableAndUnresolvable can't be fixed by eviction
-            found = self._select_victims_on_node(
-                state, pod, ni, pdbs,
-                status_plugin=node_to_status.get(ni.name).plugin,
+        rotation = [nodes[(start + i) % num_all] for i in range(num_all)]
+        # batched dry-run for the resource-only nodes (one numpy pass over
+        # every candidate); outcomes match the per-node path exactly, so
+        # scan order / early exit / offset bookkeeping below are unchanged.
+        # PDBs present → reprieve order depends on per-victim budgets, so
+        # everything takes the per-node path.
+        # bulk UNSCHEDULABLE mask when the statuses are kernel-backed (one
+        # vectorized pass instead of a Status per scanned node)
+        bulk = getattr(node_to_status, "unschedulable_name_set", None)
+        unsched_names = bulk() if bulk is not None else None
+
+        def _retriable(name: str) -> bool:
+            if unsched_names is not None:
+                return name in unsched_names
+            return node_to_status.get(name).code == UNSCHEDULABLE
+
+        batched: dict = {}
+        if not pdbs:
+            # the sequential scan stops at `want` candidates, so batching
+            # more than ~want nodes is wasted work (nearly every node is a
+            # candidate in preemption-heavy workloads); the tail past the
+            # cap falls back per node in the rare under-supply case
+            cap = min(num_all, 2 * want)
+            batched = self._batch_select_victims(
+                state, pod,
+                [ni for ni in rotation[:cap] if _retriable(ni.name)],
+                node_to_status,
             )
+        scanned = 0
+        for ni in rotation:
+            scanned += 1
+            if not _retriable(ni.name):
+                continue  # UnschedulableAndUnresolvable can't be fixed by eviction
+            if ni.name in batched:
+                found = batched[ni.name]
+            else:
+                found = self._select_victims_on_node(
+                    state, pod, ni, pdbs,
+                    status_plugin=node_to_status.get(ni.name).plugin,
+                )
             if found is not None:
                 victims, violations = found
                 candidates.append(_Candidate(ni.name, victims, violations))

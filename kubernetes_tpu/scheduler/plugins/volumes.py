@@ -810,16 +810,20 @@ class NodeVolumeLimits(Plugin):
         ]
 
     def _driver_of(self, pvc_key: str) -> tuple[str, str] | None:
-        """Resolve a claim to (driver, volume identity) or None if driverless."""
-        pvc = self.store.try_get("PersistentVolumeClaim", pvc_key)
+        """Resolve a claim to (driver, volume identity) or None if
+        driverless. Copy-free reads (get_ref): this runs per attached claim
+        per node in the Filter hot loop, where try_get's deepcopies were
+        the dominant cost of the whole CSI scheduling cycle."""
+        read = getattr(self.store, "get_ref", self.store.try_get)
+        pvc = read("PersistentVolumeClaim", pvc_key)
         if pvc is None:
             return None
         if pvc.spec.volume_name:
-            pv = self.store.try_get("PersistentVolume", pvc.spec.volume_name)
+            pv = read("PersistentVolume", pvc.spec.volume_name)
             if pv is not None and pv.spec.csi_driver:
                 return pv.spec.csi_driver, pv.meta.name
             return None
-        sc = self.store.try_get("StorageClass", pvc.spec.storage_class_name)
+        sc = read("StorageClass", pvc.spec.storage_class_name)
         if sc is not None and sc.provisioner != NO_PROVISIONER:
             # to-be-provisioned volume counts toward its driver's limit
             return sc.provisioner, pvc_key
@@ -850,7 +854,8 @@ class NodeVolumeLimits(Plugin):
         new_by_driver = state.read(self.STATE_KEY)
         if not new_by_driver:
             return Status()
-        csi_node = self.store.try_get("CSINode", node_info.name)
+        read = getattr(self.store, "get_ref", self.store.try_get)
+        csi_node = read("CSINode", node_info.name)
         if csi_node is None or not csi_node.drivers:
             return Status()
         memo: dict = state.read(self.MEMO_KEY) or {}

@@ -200,6 +200,14 @@ class Store:
         with self._mu:
             return key in self._objects.get(kind, {})
 
+    def get_ref(self, kind: str, key: str) -> Any | None:
+        """The stored object WITHOUT a copy (read-only by the list_refs
+        convention) — for per-(pod, node) hot-loop lookups like the CSI
+        attach-limit filter, where try_get's deepcopy dominated the whole
+        scheduling cycle."""
+        with self._mu:
+            return self._objects.get(kind, {}).get(key)
+
     def update(self, obj: Any, *, check_version: bool = True) -> Any:
         """Optimistic-concurrency update; stamps a fresh resource_version."""
         with self._mu:
