@@ -38,6 +38,12 @@ WAVE_SIZE = 512
 # bounded below by drain time (~expected_pods/throughput) — 20 s demands
 # both throughput AND a wave composition that doesn't starve stragglers.
 SLI_P99_TARGET_S = 20.0
+# p50 target (round-4 verdict task 8): the workload creates its 10k
+# measure pods in ONE burst, so p50 is mathematically bounded below by
+# ~(measurePods/2)/throughput — 4 s demands ~1250+ pods/s. Reported per
+# run (sli_p50_ok) so the gap is visible; the run does not fail on it
+# while the CPU fallback sits below that throughput.
+SLI_P50_TARGET_S = 4.0
 
 _PROBE_SRC = (
     "import jax; ds = jax.devices(); print('PLATFORM=' + ds[0].platform)"
@@ -163,6 +169,9 @@ def main() -> None:
         "device": platform,
         "scheduled": result.scheduled,
         "sli_p50_s": sli.get("Perc50"),
+        "sli_p50_target_s": SLI_P50_TARGET_S,
+        "sli_p50_ok": (sli.get("Perc50") is not None
+                       and sli["Perc50"] <= SLI_P50_TARGET_S),
         "sli_p99_s": sli.get("Perc99"),
         "sli_p99_target_s": SLI_P99_TARGET_S,
         "sli_p99_ok": (sli.get("Perc99") is not None

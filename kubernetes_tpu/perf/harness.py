@@ -561,7 +561,10 @@ class WorkloadExecutor:
             # reference-scale barriers legitimately run for minutes (20k
             # victims at a few hundred pods/s); scale the guard with the
             # backlog instead of shipping a fixed 30s that only fits the
-            # integration-test shapes
+            # integration-test shapes. Pump FIRST: just-created pods sit in
+            # informer buffers, not the queue — sampling before the pump
+            # would always read ~0 and floor the timeout
+            self.scheduler.pump()
             active, backoff, unsched = self.scheduler.queue.pending_pods()
             timeout = max(60.0, 2.0 * (active + backoff + unsched))
         deadline = time.monotonic() + timeout

@@ -221,40 +221,19 @@ class DefaultPreemption(Plugin):
                 return False
         return True
 
-    @staticmethod
-    def _resource_only(pod: Pod, node_info: NodeInfo) -> bool:
+    @classmethod
+    def _resource_only(cls, pod: Pod, node_info: NodeInfo) -> bool:
         """True when re-ADDING a victim can only break NodeResourcesFit:
         the preemptor carries no inter-pod (anti)affinity, host ports,
-        hard spread constraints, or claims, and no pod on the node carries
-        required anti-affinity (a reprieved victim's anti term could
-        otherwise reject the preemptor). Static plugins (taints/affinity/
-        name/unschedulable) are victim-independent and already vetted by
-        the full-chain maximal-removal check."""
-        from ...api.storage import pod_claim_names
-
-        aff = pod.spec.affinity
-        if aff is not None and (aff.pod_affinity is not None
-                                or aff.pod_anti_affinity is not None):
-            return False
-        if node_info.pods_with_required_anti_affinity:
-            return False
-        if any(p.host_port > 0 for c in pod.spec.containers
-               for p in c.ports):
-            return False
-        if any(c.when_unsatisfiable == "DoNotSchedule"
-               for c in pod.spec.topology_spread_constraints):
-            return False
-        if pod_claim_names(pod) or pod.spec.resource_claims:
-            return False
-        from .node_declared_features import infer_required_features
-
-        # NodeDeclaredFeatures sits BEFORE NodeResourcesFit in the host
-        # chain but has no kernel row — a kernel NodeResourcesFit verdict
-        # cannot prove it passed, so a features-requiring pod must take
-        # the full-chain path
-        if infer_required_features(pod):
-            return False
-        return True
+        hard spread constraints, or claims (_pod_resource_only), and no
+        pod on the node carries required anti-affinity (a reprieved
+        victim's anti term could otherwise reject the preemptor). Static
+        plugins (taints/affinity/name/unschedulable) are victim-independent
+        and already vetted by the full-chain maximal-removal check.
+        ONE predicate shared with the batched path — a divergence here
+        would let the batch skip filters the sequential path runs."""
+        return (cls._pod_resource_only(pod)
+                and not node_info.pods_with_required_anti_affinity)
 
     def _select_victims_on_node(self, state, pod: Pod, node_info: NodeInfo,
                                 pdbs: list, status_plugin: str = ""):
@@ -483,8 +462,13 @@ class DefaultPreemption(Plugin):
             out[ni.name] = (victims, 0)
         return out
 
-    def _pod_resource_only(self, pod: Pod) -> bool:
-        """The pod-level half of _resource_only (node-independent)."""
+    @staticmethod
+    def _pod_resource_only(pod: Pod) -> bool:
+        """The pod-level half of _resource_only (node-independent).
+        NodeDeclaredFeatures sits BEFORE NodeResourcesFit in the host chain
+        but has no kernel row — a kernel NodeResourcesFit verdict cannot
+        prove it passed, so a features-requiring pod must take the
+        full-chain path."""
         from ...api.storage import pod_claim_names
 
         aff = pod.spec.affinity
