@@ -1,0 +1,13 @@
+# Repo tooling (native/Makefile builds the C++ cores; this drives checks)
+
+PY ?= python
+
+.PHONY: lint test
+
+# kubesched-lint: AST invariant checker (rule IDs in README "Invariants");
+# exits non-zero on any unsuppressed finding
+lint:
+	$(PY) -m kubernetes_tpu.analysis kubernetes_tpu/
+
+test:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

@@ -1,0 +1,40 @@
+"""kubesched-lint: AST-based invariant checker for the TPU scheduler.
+
+Mechanically enforces the contracts the paper's bit-compat claim rests on:
+jit purity (JIT01-JIT04), lock discipline in the threaded scheduler modules
+(LOCK01-LOCK03), snapshot immutability outside the cache layer (SNAP01),
+and kernel/registry constant sync (REG01-REG02).
+
+CLI: `python -m kubernetes_tpu.analysis [paths]` (exit 1 on findings);
+suppress a single line with `# kubesched-lint: disable=RULE`.
+"""
+
+from .core import (
+    Checker,
+    Finding,
+    ModuleContext,
+    ProjectChecker,
+    check_file,
+    default_checkers,
+    known_rules,
+    run_paths,
+)
+from .jit_purity import JitPurityChecker
+from .lock_discipline import LockDisciplineChecker
+from .registry_sync import RegistrySyncChecker
+from .snapshot_immutability import SnapshotImmutabilityChecker
+
+__all__ = [
+    "Checker",
+    "Finding",
+    "JitPurityChecker",
+    "LockDisciplineChecker",
+    "ModuleContext",
+    "ProjectChecker",
+    "RegistrySyncChecker",
+    "SnapshotImmutabilityChecker",
+    "check_file",
+    "default_checkers",
+    "known_rules",
+    "run_paths",
+]

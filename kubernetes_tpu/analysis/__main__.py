@@ -1,0 +1,49 @@
+"""CLI: python -m kubernetes_tpu.analysis [paths...]
+
+Exit status 0 when clean, 1 when any unsuppressed finding remains, 2 on
+usage errors. Default path is the kubernetes_tpu package itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import default_checkers, known_rules, run_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.analysis",
+        description="kubesched-lint: invariant checker for the TPU scheduler",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the kubernetes_tpu "
+             "package)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print every rule id and description, then exit",
+    )
+    args = parser.parse_args(argv)
+
+    checkers = default_checkers()
+    if args.list_rules:
+        for rule, desc in sorted(known_rules(checkers).items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    paths = args.paths or [str(Path(__file__).resolve().parent.parent)]
+    findings = run_paths(paths, checkers)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

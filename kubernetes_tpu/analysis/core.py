@@ -1,0 +1,216 @@
+"""kubesched-lint core: findings, suppressions, checker registry, file runner.
+
+The framework is deliberately small: a checker is a class with a `rules`
+dict (rule id -> one-line description) and a `check_module(ctx)` hook that
+yields `Finding`s for one parsed file; project-scoped checkers (registry
+sync) instead implement `check_project(root)`. The runner parses each file
+once, hands the shared `ModuleContext` to every checker, then filters the
+merged findings through `# kubesched-lint: disable=RULE` line suppressions.
+
+Suppression semantics (mirrors pylint's `# pylint: disable=` but scoped to
+one physical line): a comment `# kubesched-lint: disable=RULE[,RULE2]` on
+line N silences findings with those rule ids anchored to line N only. A
+rule name no checker owns is itself reported (LINT00) so typo'd
+suppressions can't silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Iterable, Iterator
+
+SUPPRESS_RE = re.compile(r"#\s*kubesched-lint:\s*disable=([A-Za-z0-9_,\s-]+)")
+
+# Rule owned by the framework itself: a suppression naming an unknown rule.
+LINT00 = "LINT00"
+LINT01 = "LINT01"
+FRAMEWORK_RULES = {
+    LINT00: "suppression names a rule no checker owns (typo'd disable)",
+    LINT01: "file could not be parsed (syntax error or unreadable)",
+}
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation anchored to a file/line."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class ModuleContext:
+    """One parsed source file, shared by every module-scoped checker."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        # line number -> set of rule ids disabled on that line
+        self.suppressions: dict[int, set[str]] = _parse_suppressions(source)
+
+    @property
+    def posix_path(self) -> str:
+        return Path(self.path).as_posix()
+
+
+def _parse_suppressions(source: str) -> dict[int, set[str]]:
+    """Map line -> rule ids named in a kubesched-lint disable comment.
+
+    Uses the tokenizer (not a per-line regex) so a '#' inside a string
+    literal can never be misread as a suppression comment.
+    """
+    out: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenizeError:
+        pass
+    return out
+
+
+class Checker:
+    """Base class: module-scoped checkers override check_module."""
+
+    # rule id -> one-line description; the CLI's --list-rules prints these
+    rules: dict[str, str] = {}
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        return ()
+
+
+class ProjectChecker(Checker):
+    """Checkers that need to cross-parse several files (registry sync)."""
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        return ()
+
+
+def default_checkers() -> list[Checker]:
+    from .jit_purity import JitPurityChecker
+    from .lock_discipline import LockDisciplineChecker
+    from .registry_sync import RegistrySyncChecker
+    from .snapshot_immutability import SnapshotImmutabilityChecker
+
+    return [
+        JitPurityChecker(),
+        LockDisciplineChecker(),
+        SnapshotImmutabilityChecker(),
+        RegistrySyncChecker(),
+    ]
+
+
+def known_rules(checkers: Iterable[Checker]) -> dict[str, str]:
+    rules = dict(FRAMEWORK_RULES)
+    for ch in checkers:
+        rules.update(ch.rules)
+    return rules
+
+
+def iter_python_files(paths: Iterable[str | Path]) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def _apply_suppressions(
+    findings: list[Finding],
+    ctx: ModuleContext,
+    rules: dict[str, str],
+) -> list[Finding]:
+    """Drop suppressed findings; report unknown rule names in suppressions."""
+    kept = [
+        f
+        for f in findings
+        if f.rule not in ctx.suppressions.get(f.line, ())
+    ]
+    for line, names in sorted(ctx.suppressions.items()):
+        for name in sorted(names):
+            if name not in rules:
+                kept.append(
+                    Finding(
+                        ctx.posix_path,
+                        line,
+                        0,
+                        LINT00,
+                        f"unknown rule {name!r} in suppression "
+                        f"(known: {', '.join(sorted(rules))})",
+                    )
+                )
+    return kept
+
+
+def check_file(
+    path: str | Path, checkers: list[Checker] | None = None
+) -> list[Finding]:
+    """All module-scoped findings for one file, suppressions applied."""
+    if checkers is None:
+        checkers = default_checkers()
+    p = Path(path)
+    try:
+        source = p.read_text()
+        ctx = ModuleContext(p.as_posix(), source)
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        return [Finding(p.as_posix(), 1, 0, "LINT01", f"unparseable: {e}")]
+    findings: list[Finding] = []
+    for ch in checkers:
+        findings.extend(ch.check_module(ctx))
+    return _apply_suppressions(findings, ctx, known_rules(checkers))
+
+
+def run_paths(
+    paths: Iterable[str | Path],
+    checkers: list[Checker] | None = None,
+    project_root: str | Path | None = None,
+) -> list[Finding]:
+    """Lint every .py under `paths` plus project-scoped cross-file checks.
+
+    `project_root` anchors the registry-sync checker; when None it is
+    inferred as the `kubernetes_tpu` package directory containing (or
+    contained by) the first path.
+    """
+    if checkers is None:
+        checkers = default_checkers()
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(check_file(f, checkers))
+    root = _infer_package_root(paths, project_root)
+    if root is not None:
+        for ch in checkers:
+            if isinstance(ch, ProjectChecker):
+                findings.extend(ch.check_project(root))
+    return sorted(set(findings))
+
+
+def _infer_package_root(
+    paths: Iterable[str | Path], explicit: str | Path | None
+) -> Path | None:
+    if explicit is not None:
+        return Path(explicit)
+    for p in paths:
+        p = Path(p).resolve()
+        for cand in (p, *p.parents):
+            if cand.name == "kubernetes_tpu" and cand.is_dir():
+                return cand
+    return None

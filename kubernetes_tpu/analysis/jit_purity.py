@@ -1,0 +1,343 @@
+"""JIT purity / host-sync / bit-compat dtype rules (JIT01-JIT04).
+
+The bit-compat contract (SURVEY.md §7, ops/kernels.py module docstring) says
+the dense kernels' score math is int32/float32 with a fixed op order, traced
+once and replayed. Four things quietly break that:
+
+- JIT01: host syncs — `.item()`, or `float()`/`int()`/`bool()` applied to a
+  traced value — force a device round-trip per call and fail under jit.
+- JIT02: `np.*` calls on traced values escape the trace (numpy computes on
+  the concrete tracer-backed host buffer at trace time, freezing one
+  input's values into the compiled program).
+- JIT03: Python `for`/`while` driven by a traced array unrolls the loop at
+  trace time (or raises TracerBoolConversionError) instead of lowering to
+  `lax` control flow.
+- JIT04: 64-bit dtypes (`float64`/`int64`/`uint64`/`complex128`) or enabling
+  `jax_enable_x64` inside the bit-compat modules widen the score math and
+  desync the TPU path from the host plugin fan-out.
+
+Traced scope = functions decorated `@jax.jit` / `@functools.partial(jax.jit,
+...)` (plus vmap/pmap), every function referenced from a traced body (the
+kernel helpers `filter_masks`/`scores`/`_assign_step` are reached this way),
+and defs nested inside traced bodies (shard_map bodies). Params at declared
+`static_argnums` positions — and conventionally-static names like `cfg` /
+`layout` / `comm` — are not traced values; neither are `.shape`/`.dtype`
+/`len()` projections of traced arrays, which are static under jit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, ModuleContext
+
+JIT01 = "JIT01"
+JIT02 = "JIT02"
+JIT03 = "JIT03"
+JIT04 = "JIT04"
+
+# modules whose score math carries the bit-compat contract (JIT04 scope)
+BIT_COMPAT_SUFFIXES = ("ops/kernels.py", "scheduler/tpu/backend.py")
+
+WIDE_DTYPES = ("float64", "int64", "uint64", "complex128")
+
+# params that hold static config by convention even without static_argnums
+STATIC_PARAM_NAMES = {"self", "cls", "cfg", "config", "layout", "comm",
+                      "mesh", "names", "axis_name"}
+
+# attribute projections of a traced array that are static under jit
+STATIC_PROJECTIONS = {"shape", "ndim", "dtype", "size", "aval"}
+
+_JIT_DECORATORS = {"jit", "vmap", "pmap"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """a.b.c attribute chain as a string, None for anything fancier."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d is not None and d.split(".")[-1] in _JIT_DECORATORS
+
+
+def _decorator_static_argnums(dec: ast.expr) -> tuple[bool, set[int]]:
+    """(is_jit_decorator, static positional indices)."""
+    if _is_jit_ref(dec):
+        return True, set()
+    if isinstance(dec, ast.Call):
+        d = _dotted(dec.func)
+        if d is not None and d.split(".")[-1] == "partial":
+            if dec.args and _is_jit_ref(dec.args[0]):
+                static: set[int] = set()
+                for kw in dec.keywords:
+                    if kw.arg in ("static_argnums", "static_argnames"):
+                        static |= _const_ints(kw.value)
+                return True, static
+        elif _is_jit_ref(dec.func):
+            static = set()
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    static |= _const_ints(kw.value)
+            return True, static
+    return False, set()
+
+
+def _const_ints(node: ast.expr) -> set[int]:
+    out: set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.add(el.value)
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names.extend(p.arg for p in a.kwonlyargs)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class _TracedFn:
+    def __init__(self, fn: ast.FunctionDef, static_params: set[str]):
+        self.fn = fn
+        self.static_params = static_params
+
+
+def _collect_traced(tree: ast.Module) -> list[_TracedFn]:
+    """jit-decorated roots + closure over referenced module-level defs +
+    defs nested inside traced bodies (shard_map / scan bodies)."""
+    module_defs: dict[str, ast.FunctionDef] = {
+        n.name: n
+        for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    roots: list[_TracedFn] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            is_jit, static_idx = _decorator_static_argnums(dec)
+            if is_jit:
+                params = _param_names(node)
+                static = {params[i] for i in static_idx if i < len(params)}
+                roots.append(_TracedFn(node, static))
+                break
+
+    traced: dict[ast.FunctionDef, _TracedFn] = {t.fn: t for t in roots}
+    work = list(roots)
+    while work:
+        t = work.pop()
+        for node in ast.walk(t.fn):
+            # module-level helpers referenced from a traced body are traced
+            if isinstance(node, ast.Name) and node.id in module_defs:
+                fn = module_defs[node.id]
+                if fn not in traced and fn is not t.fn:
+                    nt = _TracedFn(fn, set())
+                    traced[fn] = nt
+                    work.append(nt)
+            # nested defs (shard_map bodies) run inside the trace
+            elif (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node is not t.fn
+                and node not in traced
+            ):
+                nt = _TracedFn(node, set())
+                traced[node] = nt
+                work.append(nt)
+    return list(traced.values())
+
+
+class _TracedNames:
+    """Param-seeded traced-value names for one function, with one level of
+    local propagation (y = f(traced) makes y traced)."""
+
+    def __init__(self, t: _TracedFn):
+        self.names = {
+            p
+            for p in _param_names(t.fn)
+            if p not in t.static_params and p not in STATIC_PARAM_NAMES
+        }
+        for _ in range(10):
+            grew = False
+            for node in ast.walk(t.fn):
+                if isinstance(node, ast.Assign) and self.expr_traced(node.value):
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name) and n.id not in self.names:
+                                self.names.add(n.id)
+                                grew = True
+            if not grew:
+                break
+
+    def expr_traced(self, node: ast.AST) -> bool:
+        """Does this expression involve a traced value (ignoring static
+        .shape/.dtype projections and len())?"""
+        if isinstance(node, ast.Attribute) and node.attr in STATIC_PROJECTIONS:
+            return False
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d == "len":
+                return False
+            if d is not None and d.split(".")[0] in ("jnp", "jax"):
+                return True
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        return any(
+            self.expr_traced(child) for child in ast.iter_child_nodes(node)
+        )
+
+
+class JitPurityChecker(Checker):
+    rules = {
+        JIT01: "host sync inside a traced function "
+               "(.item() / float() / int() / bool() on a traced value)",
+        JIT02: "np.* call on a traced value inside a traced function "
+               "(escapes the trace; use jnp)",
+        JIT03: "Python for/while driven by a traced array "
+               "(unrolls at trace time; use lax control flow)",
+        JIT04: "64-bit dtype in a bit-compat module "
+               "(score math contract is int32/float32, fixed op order)",
+    }
+
+    def __init__(self, bit_compat_suffixes: tuple[str, ...] = BIT_COMPAT_SUFFIXES):
+        self.bit_compat_suffixes = bit_compat_suffixes
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        if ctx.posix_path.endswith(self.bit_compat_suffixes):
+            findings.extend(self._check_64bit(ctx))
+        for t in _collect_traced(ctx.tree):
+            findings.extend(self._check_traced_body(ctx, t))
+        return findings
+
+    # -- JIT04 ---------------------------------------------------------
+    def _check_64bit(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and node.attr in WIDE_DTYPES:
+                yield Finding(
+                    ctx.posix_path, node.lineno, node.col_offset, JIT04,
+                    f"64-bit dtype {_dotted(node) or node.attr} in "
+                    "bit-compat module (contract: int32/float32)",
+                )
+            elif (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value in WIDE_DTYPES
+            ):
+                yield Finding(
+                    ctx.posix_path, node.lineno, node.col_offset, JIT04,
+                    f"64-bit dtype string {node.value!r} in bit-compat module",
+                )
+            elif (
+                isinstance(node, ast.Constant)
+                and node.value == "jax_enable_x64"
+            ):
+                yield Finding(
+                    ctx.posix_path, node.lineno, node.col_offset, JIT04,
+                    "jax_enable_x64 would widen the whole module to 64-bit",
+                )
+
+    # -- JIT01/02/03 ---------------------------------------------------
+    def _check_traced_body(
+        self, ctx: ModuleContext, t: _TracedFn
+    ) -> Iterable[Finding]:
+        tn = _TracedNames(t)
+        fname = t.fn.name
+
+        def walk(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                # nested defs get their own _TracedFn pass
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                yield child
+                yield from walk(child)
+
+        for node in walk(t.fn):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                # .item() on anything inside a trace is a host sync
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                ):
+                    yield Finding(
+                        ctx.posix_path, node.lineno, node.col_offset, JIT01,
+                        f".item() inside traced function {fname!r} forces a "
+                        "host sync",
+                    )
+                elif d in ("float", "int", "bool") and any(
+                    tn.expr_traced(a) for a in node.args
+                ):
+                    yield Finding(
+                        ctx.posix_path, node.lineno, node.col_offset, JIT01,
+                        f"{d}() on a traced value inside {fname!r} forces a "
+                        "host sync",
+                    )
+                elif (
+                    d is not None
+                    and d.split(".")[0] in ("np", "numpy")
+                    and len(d.split(".")) > 1
+                    and any(tn.expr_traced(a) for a in node.args)
+                ):
+                    yield Finding(
+                        ctx.posix_path, node.lineno, node.col_offset, JIT02,
+                        f"{d}() on a traced value inside {fname!r} escapes "
+                        "the trace (use jnp)",
+                    )
+            elif isinstance(node, ast.For) and self._iter_is_traced_array(
+                tn, node
+            ):
+                yield Finding(
+                    ctx.posix_path, node.lineno, node.col_offset, JIT03,
+                    f"Python for-loop over a traced array inside {fname!r} "
+                    "unrolls at trace time",
+                )
+            elif isinstance(node, ast.While) and tn.expr_traced(node.test):
+                yield Finding(
+                    ctx.posix_path, node.lineno, node.col_offset, JIT03,
+                    f"while-loop condition on a traced value inside "
+                    f"{fname!r} cannot lower (use lax.while_loop)",
+                )
+
+    @staticmethod
+    def _iter_is_traced_array(tn: _TracedNames, loop: ast.For) -> bool:
+        """Flag iterating the array itself, not static structure around it:
+        bare traced name, subscript of one, or a jnp/jax call result.
+        `planes.items()` / `range(x.shape[0])` / `enumerate(names)` stay
+        legal, as does `for k in planes:` dict-keys iteration — detected by
+        the loop target serving as a subscript key in the body."""
+        it = loop.iter
+        if isinstance(it, ast.Name):
+            if it.id not in tn.names:
+                return False
+            targets = {
+                n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)
+            }
+            for node in ast.walk(loop):
+                if isinstance(node, ast.Subscript):
+                    for n in ast.walk(node.slice):
+                        if isinstance(n, ast.Name) and n.id in targets:
+                            return False  # keys iteration over a dict plane
+            return True
+        if isinstance(it, ast.Subscript):
+            return tn.expr_traced(it.value)
+        if isinstance(it, ast.Call):
+            d = _dotted(it.func)
+            return d is not None and d.split(".")[0] in ("jnp", "jax")
+        return False

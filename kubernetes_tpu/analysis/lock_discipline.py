@@ -1,0 +1,323 @@
+"""Lock discipline rules (LOCK01-LOCK03) for the threaded modules.
+
+The threaded scheduler components (api_dispatcher, cache, scheduling_queue,
+pod_workers, controllers) follow client-go's convention: every shared
+attribute is guarded by one `threading.Lock`/`RLock`/`Condition` held via
+`with`. Three drift patterns this checker catches:
+
+- LOCK01: an attribute mutated both under `with self._lock:` and outside it
+  — the unlocked site is a data race. `__init__` is exempt (the object is
+  not yet published), and attrs holding their own synchronization
+  (queue.Queue, threading.Event) are exempt.
+- LOCK02: raw `.acquire()`/`.release()` on a lock attribute — an exception
+  between them leaks the lock; the repo style is `with`.
+- LOCK03: a blocking call (`time.sleep`, `Queue.get`, `future.result()`,
+  `.join()`, `.wait()` on a non-lock object) while holding a lock stalls
+  every other thread on that lock. `self._cv.wait()` on the held Condition
+  is the sanctioned idiom and is not flagged.
+
+Held contexts are `with self.<lock>:` bodies, whole methods whose names end
+in `_locked` (the cache.py convention), and private methods whose
+intra-class call sites are all themselves held (fixpoint) — this keeps
+helpers like cache.py's `_move_to_head`, only ever called under the lock,
+from producing false LOCK01 positives.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+from .core import Checker, Finding, ModuleContext
+
+LOCK01 = "LOCK01"
+LOCK02 = "LOCK02"
+LOCK03 = "LOCK03"
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+# attrs that synchronize themselves; mutating them unlocked is by design
+_SELF_SYNC_FACTORIES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                        "Event", "Barrier"}
+_QUEUE_FACTORIES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+_MUTATORS = {"append", "appendleft", "add", "discard", "remove", "pop",
+             "popitem", "popleft", "clear", "update", "extend", "insert",
+             "setdefault", "put", "put_nowait"}
+
+_CTOR_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'X' for an expression `self.X`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _factory_name(value: ast.expr) -> str | None:
+    """'Lock' for `threading.Lock(...)` / `Lock(...)`, else None."""
+    if isinstance(value, ast.Call):
+        d = _dotted(value.func)
+        if d is not None:
+            return d.split(".")[-1]
+    return None
+
+
+@dataclasses.dataclass
+class _Event:
+    kind: str          # "mut" | "acquire" | "blocking" | "call_self"
+    name: str          # attr, or callee method, or blocking description
+    held: bool         # with-block status at the site (pre-fixpoint)
+    method: str
+    line: int
+    col: int
+    detail: str = ""
+
+
+class _ClassScan:
+    """One pass over a ClassDef: lock attrs, safe attrs, per-site events."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.lock_attrs: set[str] = set()
+        self.self_sync_attrs: set[str] = set()
+        self.queue_attrs: set[str] = set()
+        self.methods: dict[str, ast.FunctionDef] = {}
+        self.events: list[_Event] = []
+        self._find_attr_types()
+        for m in cls.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[m.name] = m
+        for name, m in self.methods.items():
+            self._walk(m.body, name, held=False, in_nested=False)
+
+    def _find_attr_types(self) -> None:
+        for node in ast.walk(self.cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            fac = _factory_name(node.value)
+            if fac is None:
+                continue
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                if fac in _LOCK_FACTORIES:
+                    self.lock_attrs.add(attr)
+                elif fac in _SELF_SYNC_FACTORIES:
+                    self.self_sync_attrs.add(attr)
+                    if fac in _QUEUE_FACTORIES:
+                        self.queue_attrs.add(attr)
+
+    # -- event collection ------------------------------------------------
+    def _walk(self, stmts, method: str, held: bool, in_nested: bool) -> None:
+        for node in stmts:
+            self._visit(node, method, held, in_nested)
+
+    def _visit(self, node: ast.AST, method: str, held: bool, in_nested: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def bodies run later, outside the enclosing with
+            self._walk(node.body, method, held=False, in_nested=True)
+            return
+        if isinstance(node, ast.With):
+            locks_here = any(
+                _self_attr(item.context_expr) in self.lock_attrs
+                for item in node.items
+            )
+            for item in node.items:
+                self._visit_expr(item.context_expr, method, held)
+            self._walk(node.body, method, held or locks_here, in_nested)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for tgt in targets:
+                self._record_store(tgt, method, held)
+            value = node.value
+            if value is not None:
+                self._visit_expr(value, method, held)
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                self._record_store(tgt, method, held)
+            return
+        if isinstance(node, ast.Expr):
+            self._visit_expr(node.value, method, held)
+            return
+        # generic statement: visit expressions, recurse into bodies
+        for field in ast.iter_child_nodes(node):
+            if isinstance(field, ast.expr):
+                self._visit_expr(field, method, held)
+            elif isinstance(field, ast.stmt):
+                self._visit(field, method, held, in_nested)
+            elif isinstance(field, (ast.excepthandler, ast.match_case)):
+                self._visit(field, method, held, in_nested)
+
+    def _record_store(self, tgt: ast.AST, method: str, held: bool) -> None:
+        attr = _self_attr(tgt)
+        if attr is None and isinstance(tgt, ast.Subscript):
+            attr = _self_attr(tgt.value)
+        if attr is not None:
+            self.events.append(
+                _Event("mut", attr, held, method, tgt.lineno, tgt.col_offset)
+            )
+
+    def _visit_expr(self, node: ast.AST, method: str, held: bool) -> None:
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            func = n.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            recv_attr = _self_attr(func.value)
+            d = _dotted(func)
+            # LOCK02: raw acquire/release on a lock attribute
+            if func.attr in ("acquire", "release") and recv_attr in self.lock_attrs:
+                self.events.append(
+                    _Event("acquire", recv_attr, held, method,
+                           n.lineno, n.col_offset, detail=func.attr)
+                )
+            # mutating method call on a self attribute
+            elif func.attr in _MUTATORS and recv_attr is not None:
+                self.events.append(
+                    _Event("mut", recv_attr, held, method,
+                           n.lineno, n.col_offset)
+                )
+            # intra-class call (for inferred-held fixpoint)
+            elif recv_attr is not None and func.attr in self.methods:
+                pass  # handled below as call_self via dotted check
+            # blocking calls
+            if d == "time.sleep":
+                self.events.append(
+                    _Event("blocking", "time.sleep", held, method,
+                           n.lineno, n.col_offset)
+                )
+            elif func.attr in ("result", "join") and not n.args:
+                # zero positional args: future.result()/thread.join();
+                # str.join always takes one, so it never matches
+                self.events.append(
+                    _Event("blocking", f".{func.attr}()", held, method,
+                           n.lineno, n.col_offset)
+                )
+            elif func.attr in ("wait", "wait_for"):
+                if recv_attr not in self.lock_attrs:
+                    self.events.append(
+                        _Event("blocking", f".{func.attr}()", held, method,
+                               n.lineno, n.col_offset)
+                    )
+            elif func.attr == "get" and recv_attr in self.queue_attrs:
+                self.events.append(
+                    _Event("blocking", f"self.{recv_attr}.get()", held,
+                           method, n.lineno, n.col_offset)
+                )
+            # record self.method() call sites
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and func.attr in self.methods
+            ):
+                self.events.append(
+                    _Event("call_self", func.attr, held, method,
+                           n.lineno, n.col_offset)
+                )
+
+    # -- held inference --------------------------------------------------
+    def held_methods(self) -> set[str]:
+        """_locked-suffix methods + private methods all of whose intra-class
+        call sites are held (fixpoint)."""
+        held = {m for m in self.methods if m.endswith("_locked")}
+        sites: dict[str, list[_Event]] = {}
+        for ev in self.events:
+            if ev.kind == "call_self":
+                sites.setdefault(ev.name, []).append(ev)
+        changed = True
+        while changed:
+            changed = False
+            for name in self.methods:
+                if name in held or not name.startswith("_"):
+                    continue
+                if name.startswith("__"):
+                    continue
+                evs = sites.get(name)
+                if evs and all(e.held or e.method in held for e in evs):
+                    held.add(name)
+                    changed = True
+        return held
+
+
+class LockDisciplineChecker(Checker):
+    rules = {
+        LOCK01: "attribute mutated both under and outside the lock "
+                "(unlocked site is a data race)",
+        LOCK02: "raw lock .acquire()/.release() — use `with` so exceptions "
+                "can't leak the lock",
+        LOCK03: "blocking call while holding a lock stalls every thread "
+                "contending on it",
+    }
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(
+        self, ctx: ModuleContext, cls: ast.ClassDef
+    ) -> Iterable[Finding]:
+        scan = _ClassScan(cls)
+        if not scan.lock_attrs:
+            return
+        held_methods = scan.held_methods()
+
+        def is_held(ev: _Event) -> bool:
+            return ev.held or ev.method in held_methods
+
+        # LOCK02 first: raw acquire/release anywhere in the class
+        for ev in scan.events:
+            if ev.kind == "acquire":
+                yield Finding(
+                    ctx.posix_path, ev.line, ev.col, LOCK02,
+                    f"{cls.name}.{ev.method} calls self.{ev.name}."
+                    f"{ev.detail}() directly; use `with self.{ev.name}:`",
+                )
+            elif ev.kind == "blocking" and is_held(ev):
+                yield Finding(
+                    ctx.posix_path, ev.line, ev.col, LOCK03,
+                    f"{cls.name}.{ev.method} makes blocking call "
+                    f"{ev.name} while holding a lock",
+                )
+
+        # LOCK01: attr mutated both under and outside the lock
+        exempt = scan.lock_attrs | scan.self_sync_attrs
+        locked_attrs = {
+            ev.name
+            for ev in scan.events
+            if ev.kind == "mut" and is_held(ev) and ev.name not in exempt
+        }
+        for ev in scan.events:
+            if (
+                ev.kind == "mut"
+                and not is_held(ev)
+                and ev.method not in _CTOR_METHODS
+                and ev.name in locked_attrs
+                and ev.name not in exempt
+            ):
+                yield Finding(
+                    ctx.posix_path, ev.line, ev.col, LOCK01,
+                    f"{cls.name}.{ev.method} mutates self.{ev.name} outside "
+                    "the lock, but other sites mutate it under the lock",
+                )

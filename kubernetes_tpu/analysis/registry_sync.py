@@ -1,0 +1,260 @@
+"""Registry-order / bit-compat constant sync rules (REG01, REG02).
+
+The dense kernels hard-code two things the host plugin registry also owns:
+
+- the filter-mask row order (`ops/kernels.py FILTER_NAMES`) — first-failure
+  priority must equal the host plugin iteration order, or the reconstructed
+  "0/N nodes are available" messages diverge from the reference;
+- the score weights (`KernelConfig.weights`) — must match the registry's
+  `DEFAULT_WEIGHTS`, and the backend's `KERNEL_SCORE_PLUGINS` /
+  `KERNEL_FILTER_PLUGINS` handoff sets must cover exactly the kernelized
+  plugins, or a plugin runs twice (host + device) or not at all.
+
+Nothing imports across these modules for the constants (kernels.py must
+stay importable without the scheduler package), so the only enforcement
+possible is cross-parsing — this checker reads all three files and compares.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding, ProjectChecker
+
+REG01 = "REG01"
+REG02 = "REG02"
+
+KERNELS = "ops/kernels.py"
+REGISTRY = "scheduler/plugins/registry.py"
+BACKEND = "scheduler/tpu/backend.py"
+
+# registry weight name -> plugin class name where they differ
+_CLASS_ALIASES = {"NodeResourcesBalancedAllocation": "BalancedAllocation"}
+
+# mask rows appended after the FILTER_NAMES block (per-constraint PTS rows,
+# then the inter-pod affinity rows) — part of the kernel filter set but not
+# of the fixed-order prefix
+_APPENDED_FILTER_ROWS = {"PodTopologySpread", "InterPodAffinity"}
+
+
+def _call_name(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Call):
+        f = node.func
+        while isinstance(f, ast.Attribute):
+            f = f.value
+        if isinstance(f, ast.Name):
+            # return the last attribute component if any
+            g = node.func
+            return g.attr if isinstance(g, ast.Attribute) else f.id
+    return None
+
+
+def _str_elts(node: ast.expr) -> list[tuple[str, int]] | None:
+    """[(value, line)] for a tuple/list/set of string constants."""
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+                return None
+            out.append((el.value, el.lineno))
+        return out
+    return None
+
+
+class _Parsed:
+    def __init__(self, path: Path):
+        self.path = path
+        self.ok = path.is_file()
+        self.tree = ast.parse(path.read_text(), filename=str(path)) if self.ok else None
+
+    def module_str_seq(self, name: str) -> tuple[list[tuple[str, int]], int] | None:
+        """Tuple-of-strings module constant -> ([(str, line)], assign line)."""
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name for t in node.targets
+            ):
+                value = node.value
+                # frozenset({...}) / tuple / list / set
+                if isinstance(value, ast.Call) and value.args:
+                    value = value.args[0]
+                elts = _str_elts(value)
+                if elts is not None:
+                    return elts, node.lineno
+        return None
+
+    def module_str_dict(self, name: str) -> tuple[dict[str, int], list[str], int] | None:
+        """str->int module dict -> (mapping, declaration order, line)."""
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name for t in node.targets
+            ):
+                if not isinstance(node.value, ast.Dict):
+                    return None
+                mapping, order = {}, []
+                for k, v in zip(node.value.keys, node.value.values):
+                    if not (
+                        isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant) and isinstance(v.value, int)
+                    ):
+                        return None
+                    mapping[k.value] = v.value
+                    order.append(k.value)
+                return mapping, order, node.lineno
+        return None
+
+    def class_weights(self, cls_name: str, attr: str) -> tuple[list[tuple[str, int]], int] | None:
+        """KernelConfig.weights default -> ([(name, weight)], line)."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef) and node.name == cls_name:
+                for stmt in node.body:
+                    if (
+                        isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)
+                        and stmt.target.id == attr
+                        and isinstance(stmt.value, (ast.Tuple, ast.List))
+                    ):
+                        pairs = []
+                        for el in stmt.value.elts:
+                            if not (
+                                isinstance(el, (ast.Tuple, ast.List))
+                                and len(el.elts) == 2
+                                and isinstance(el.elts[0], ast.Constant)
+                                and isinstance(el.elts[1], ast.Constant)
+                            ):
+                                return None
+                            pairs.append((el.elts[0].value, el.elts[1].value))
+                        return pairs, stmt.lineno
+        return None
+
+    def plugin_order(self, fn_name: str, var: str) -> tuple[list[str], int] | None:
+        """Class-name order of the `plugins = [...]` list in default_plugins."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == fn_name:
+                for stmt in ast.walk(node):
+                    if (
+                        isinstance(stmt, ast.Assign)
+                        and any(isinstance(t, ast.Name) and t.id == var for t in stmt.targets)
+                        and isinstance(stmt.value, ast.List)
+                    ):
+                        names = [_call_name(el) for el in stmt.value.elts]
+                        return [n for n in names if n], stmt.lineno
+        return None
+
+
+class RegistrySyncChecker(ProjectChecker):
+    rules = {
+        REG01: "kernel filter-mask row order out of sync with the plugin "
+               "registry order (first-failure priority contract)",
+        REG02: "kernel score weights / plugin handoff sets out of sync "
+               "with plugins/registry.py DEFAULT_WEIGHTS",
+    }
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        kernels = _Parsed(root / KERNELS)
+        registry = _Parsed(root / REGISTRY)
+        backend = _Parsed(root / BACKEND)
+        if not (kernels.ok and registry.ok and backend.ok):
+            return  # partial tree (fixture dirs) — nothing to cross-check
+        rel = lambda p: p.path.as_posix()
+
+        filter_names = kernels.module_str_seq("FILTER_NAMES")
+        weights = kernels.class_weights("KernelConfig", "weights")
+        default_weights = registry.module_str_dict("DEFAULT_WEIGHTS")
+        order = registry.plugin_order("default_plugins", "plugins")
+        k_filter = backend.module_str_seq("KERNEL_FILTER_PLUGINS")
+        k_score = backend.module_str_seq("KERNEL_SCORE_PLUGINS")
+
+        for got, what, path in (
+            (filter_names, "FILTER_NAMES", kernels),
+            (weights, "KernelConfig.weights", kernels),
+            (default_weights, "DEFAULT_WEIGHTS", registry),
+            (order, "default_plugins() plugins list", registry),
+            (k_filter, "KERNEL_FILTER_PLUGINS", backend),
+            (k_score, "KERNEL_SCORE_PLUGINS", backend),
+        ):
+            if got is None:
+                yield Finding(
+                    rel(path), 1, 0, REG01,
+                    f"could not parse {what} for cross-checking — keep it a "
+                    "literal constant",
+                )
+        if None in (filter_names, weights, default_weights, order, k_filter, k_score):
+            return
+
+        # -- REG01: filter order ----------------------------------------
+        fnames = [n for n, _ in filter_names[0]]
+        reg_order, _ = order
+        pos = {n: i for i, n in enumerate(reg_order)}
+        last = -1
+        for name, line in filter_names[0]:
+            if name not in pos:
+                yield Finding(
+                    rel(kernels), line, 0, REG01,
+                    f"filter row {name!r} is not a registry plugin",
+                )
+            elif pos[name] < last:
+                yield Finding(
+                    rel(kernels), line, 0, REG01,
+                    f"filter row {name!r} breaks registry order — mask row "
+                    "order must match host plugin iteration order "
+                    f"(registry has it before {reg_order[last]!r})",
+                )
+            else:
+                last = pos[name]
+        want_filter = set(fnames) | _APPENDED_FILTER_ROWS
+        have_filter = {n for n, _ in k_filter[0]}
+        if have_filter != want_filter:
+            extra = have_filter - want_filter
+            missing = want_filter - have_filter
+            yield Finding(
+                rel(backend), k_filter[1], 0, REG01,
+                "KERNEL_FILTER_PLUGINS out of sync with kernels.py mask "
+                f"rows (extra: {sorted(extra)}, missing: {sorted(missing)})",
+            )
+
+        # -- REG02: score weights ---------------------------------------
+        dw, dw_order, _ = default_weights
+        w_line = weights[1]
+        last = -1
+        for name, w in weights[0]:
+            if name not in dw:
+                yield Finding(
+                    rel(kernels), w_line, 0, REG02,
+                    f"kernel weight for {name!r} has no registry "
+                    "DEFAULT_WEIGHTS entry",
+                )
+                continue
+            if dw[name] != w:
+                yield Finding(
+                    rel(kernels), w_line, 0, REG02,
+                    f"kernel weight {name}={w} != registry "
+                    f"DEFAULT_WEIGHTS[{name!r}]={dw[name]}",
+                )
+            cls = _CLASS_ALIASES.get(name, name)
+            if cls not in reg_order:
+                yield Finding(
+                    rel(kernels), w_line, 0, REG02,
+                    f"kernel-scored plugin {name!r} ({cls}) is not in "
+                    "default_plugins()",
+                )
+            idx = dw_order.index(name)
+            if idx < last:
+                yield Finding(
+                    rel(kernels), w_line, 0, REG02,
+                    f"kernel weight {name!r} breaks DEFAULT_WEIGHTS "
+                    "declaration order (fixed-op-order contract)",
+                )
+            else:
+                last = idx
+        want_score = {n for n, _ in weights[0]}
+        have_score = {n for n, _ in k_score[0]}
+        if have_score != want_score:
+            extra = have_score - want_score
+            missing = want_score - have_score
+            yield Finding(
+                rel(backend), k_score[1], 0, REG02,
+                "KERNEL_SCORE_PLUGINS out of sync with KernelConfig.weights "
+                f"(extra: {sorted(extra)}, missing: {sorted(missing)})",
+            )

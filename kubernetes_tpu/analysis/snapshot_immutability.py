@@ -1,0 +1,255 @@
+"""Snapshot/NodeInfo immutability rule (SNAP01).
+
+The cache layer (scheduler/cache/) owns cluster state: everyone else sees a
+`Snapshot` — a point-in-time, cycle-stable view (snapshot.py docstring) —
+and per-node `NodeInfo` records reached through it. If a plugin or the
+scheduling loop mutates either in place, two pods scheduled in the same
+cycle disagree about the cluster, and the TPU plane builder's incremental
+sync (generation counters) silently diverges from the host path. The
+sanctioned pattern everywhere outside `scheduler/cache/` is
+`ni = node_info.clone()` before any mutation, or routing the change through
+the cache/snapshot fork API.
+
+Tracking is name-based and per-function: parameters named/annotated
+Snapshot/NodeInfo, `self.snapshot`-style attributes, values pulled out of a
+snapshot (`snapshot.get(n)`, `snapshot.node_info_map[k]`, iteration over
+`snapshot.list_nodes()`), minus anything reassigned — `ni = x.clone()`
+yields a private copy and untracks the name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, ModuleContext
+
+SNAP01 = "SNAP01"
+
+# path fragment that owns mutation rights
+CACHE_LAYER = "scheduler/cache/"
+
+SNAP_PARAM_NAMES = {"snapshot", "snap"}
+SNAP_ATTR_NAMES = {"snapshot", "_snapshot"}
+NI_PARAM_NAMES = {"node_info", "nodeinfo", "ni"}
+
+NODEINFO_MUTATORS = {"add_pod", "remove_pod", "set_node"}
+SNAPSHOT_MUTATORS = {
+    "assume_pod", "forget_pod", "assume_placement", "forget_placement",
+    "note_change", "note_membership", "rebuild_derived_lists",
+    "refresh_list_index",
+}
+CONTAINER_MUTATORS = {"append", "appendleft", "add", "discard", "remove",
+                      "pop", "popitem", "popleft", "clear", "update",
+                      "extend", "insert", "setdefault"}
+NI_LIST_PRODUCERS = {"list_nodes", "list_all", "values"}
+
+
+def _annotation_names(ann: ast.expr | None) -> set[str]:
+    if ann is None:
+        return set()
+    out: set[str] = set()
+    for n in ast.walk(ann):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+class _FnState:
+    def __init__(self, fn: ast.FunctionDef):
+        self.snap: set[str] = set()
+        self.ni: set[str] = set()
+        a = fn.args
+        for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+            anns = _annotation_names(p.annotation)
+            if p.arg in SNAP_PARAM_NAMES or "Snapshot" in anns:
+                self.snap.add(p.arg)
+            elif p.arg in NI_PARAM_NAMES or "NodeInfo" in anns:
+                self.ni.add(p.arg)
+
+    def is_snap(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.snap
+        if isinstance(node, ast.Attribute):
+            return node.attr in SNAP_ATTR_NAMES
+        return False
+
+    def is_ni(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.ni
+        # snapshot.node_info_map[k]
+        if isinstance(node, ast.Subscript):
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr == "node_info_map":
+                return self.is_snap(v.value)
+        # snapshot.get(k) used inline
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "get" and self.is_snap(node.func.value):
+                return True
+        return False
+
+    def is_tracked(self, node: ast.AST) -> bool:
+        return self.is_snap(node) or self.is_ni(node)
+
+    # -- assignment effects ---------------------------------------------
+    def assign(self, target: ast.expr, value: ast.expr | None) -> None:
+        if not isinstance(target, ast.Name):
+            return
+        name = target.id
+        # any rebind clears old tracking first
+        self.snap.discard(name)
+        self.ni.discard(name)
+        if value is None:
+            return
+        # x = something.clone() -> private copy, stays untracked
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr == "clone"
+        ):
+            return
+        if self.is_ni(value):
+            self.ni.add(name)
+        elif self.is_snap(value):
+            self.snap.add(name)
+
+    def track_loop_target(self, target: ast.expr, it: ast.expr) -> None:
+        """for ni in snapshot.list_nodes() / .node_info_map.values():"""
+        produces_ni = False
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute):
+            if it.func.attr in NI_LIST_PRODUCERS:
+                base = it.func.value
+                if self.is_snap(base):
+                    produces_ni = True
+                elif (
+                    isinstance(base, ast.Attribute)
+                    and base.attr == "node_info_map"
+                    and self.is_snap(base.value)
+                ):
+                    produces_ni = True
+            elif it.func.attr == "items" and isinstance(it.func.value, ast.Attribute):
+                if it.func.value.attr == "node_info_map" and self.is_snap(
+                    it.func.value.value
+                ):
+                    # for name, ni in snap.node_info_map.items()
+                    if isinstance(target, ast.Tuple) and len(target.elts) == 2:
+                        self.assign_tracked_ni(target.elts[1])
+                    return
+        if produces_ni:
+            self.assign_tracked_ni(target)
+
+    def assign_tracked_ni(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.ni.add(target.id)
+
+
+class SnapshotImmutabilityChecker(Checker):
+    rules = {
+        SNAP01: "Snapshot/NodeInfo mutated outside scheduler/cache/ "
+                "(clone() first, or go through the cache API)",
+    }
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if CACHE_LAYER in ctx.posix_path:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(ctx, node)
+
+    def _check_fn(
+        self, ctx: ModuleContext, fn: ast.FunctionDef
+    ) -> Iterable[Finding]:
+        st = _FnState(fn)
+        yield from self._walk(ctx, st, fn.body)
+
+    def _walk(self, ctx, st: _FnState, stmts) -> Iterable[Finding]:
+        for node in stmts:
+            yield from self._stmt(ctx, st, node)
+
+    def _stmt(self, ctx, st: _FnState, node: ast.stmt) -> Iterable[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs get their own pass from check_module
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            if node.value is not None:
+                yield from self._expr(ctx, st, node.value)
+            for tgt in targets:
+                yield from self._check_store(ctx, st, tgt, aug=isinstance(node, ast.AugAssign))
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                for tgt in targets:
+                    st.assign(tgt, node.value)
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                yield from self._check_store(ctx, st, tgt)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            yield from self._expr(ctx, st, node.iter)
+            st.track_loop_target(node.target, node.iter)
+            yield from self._walk(ctx, st, node.body)
+            yield from self._walk(ctx, st, node.orelse)
+            return
+        # generic: expressions then sub-statements, in order
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                yield from self._expr(ctx, st, child)
+            elif isinstance(child, ast.stmt):
+                yield from self._stmt(ctx, st, child)
+            elif isinstance(child, (ast.excepthandler, ast.match_case, ast.withitem)):
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.expr):
+                        yield from self._expr(ctx, st, sub)
+                    elif isinstance(sub, ast.stmt):
+                        yield from self._stmt(ctx, st, sub)
+
+    def _check_store(
+        self, ctx, st: _FnState, tgt: ast.expr, aug: bool = False
+    ) -> Iterable[Finding]:
+        """attribute / subscript stores on tracked objects."""
+        base = None
+        if isinstance(tgt, ast.Attribute):
+            base = tgt.value
+        elif isinstance(tgt, ast.Subscript):
+            v = tgt.value
+            base = v.value if isinstance(v, ast.Attribute) else v
+        if base is not None and st.is_tracked(base):
+            kind = "Snapshot" if st.is_snap(base) else "NodeInfo"
+            yield Finding(
+                ctx.posix_path, tgt.lineno, tgt.col_offset, SNAP01,
+                f"store into {kind} outside {CACHE_LAYER} "
+                "(clone() first, or go through the cache API)",
+            )
+
+    def _expr(self, ctx, st: _FnState, node: ast.expr) -> Iterable[Finding]:
+        for n in ast.walk(node):
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)):
+                continue
+            attr = n.func.attr
+            recv = n.func.value
+            if attr in SNAPSHOT_MUTATORS and st.is_snap(recv):
+                yield Finding(
+                    ctx.posix_path, n.lineno, n.col_offset, SNAP01,
+                    f"Snapshot.{attr}() outside {CACHE_LAYER} mutates the "
+                    "shared cycle view",
+                )
+            elif attr in NODEINFO_MUTATORS and st.is_ni(recv):
+                yield Finding(
+                    ctx.posix_path, n.lineno, n.col_offset, SNAP01,
+                    f"NodeInfo.{attr}() outside {CACHE_LAYER} mutates "
+                    "shared cluster state (clone() first)",
+                )
+            elif (
+                attr in CONTAINER_MUTATORS
+                and isinstance(recv, ast.Attribute)
+                and st.is_tracked(recv.value)
+            ):
+                kind = "Snapshot" if st.is_snap(recv.value) else "NodeInfo"
+                yield Finding(
+                    ctx.posix_path, n.lineno, n.col_offset, SNAP01,
+                    f"{kind}.{recv.attr}.{attr}() outside {CACHE_LAYER} "
+                    "mutates shared cluster state",
+                )

@@ -34,6 +34,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+# jax >= 0.5 promotes shard_map to jax.shard_map (replication check renamed
+# check_vma); 0.4.x only has the experimental entry point with check_rep
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SHARD_MAP_CHECK_KW = "check_vma"
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_CHECK_KW = "check_rep"
+
 from ..ops.kernels import (
     ZERO_TIE_WORDS,
     AxisComm,
@@ -169,11 +179,11 @@ def _sharded_assign_jit(cfg: KernelConfig, mesh: Mesh, planes: dict, layout,
                 "ipa_pref": P(NODE_AXIS)} if cfg.ipa_active else {}),
         },
     )
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh,
         in_specs=(plane_specs, P(), P()),
         out_specs=out_specs,
-        check_vma=False,
+        **{_SHARD_MAP_CHECK_KW: False},
     )(planes, packed_f, tie_words)
 
 

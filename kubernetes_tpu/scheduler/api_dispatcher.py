@@ -133,14 +133,27 @@ class APIDispatcher:
         when a wave bind (queued under its own synthetic key) makes per-pod
         status patches moot (api_calls.go relevance ordering: a binding
         replaces a queued status patch for the same pod)."""
+        dropped: list[APICall] = []
         with self._lock:
             for key in keys:
                 pending = self._queued.get(key)
                 if pending is not None and pending.relevance < relevance:
                     del self._queued[key]
-                    pending.done.set()
+                    dropped.append(pending)
                     if self.metrics is not None:
                         self.metrics.async_api_pending.set(len(self._queued))
+        # outside the lock (on_finish may re-enter the dispatcher): a
+        # superseded call never ran, so its waiters must observe
+        # CallSkippedError — done.set() alone would read as success
+        for pending in dropped:
+            err = CallSkippedError(
+                f"{pending.call_type} for {pending.object_key} superseded "
+                f"by relevance {relevance}"
+            )
+            pending.error = err
+            if pending.on_finish is not None:
+                pending.on_finish(err)
+            pending.done.set()
 
     def run(self) -> None:
         for i in range(self.parallelism):

@@ -789,9 +789,14 @@ class ScheduleOneLoop:
             # podGroupSchedulingPlacementAlgorithm:520 — dry-run per
             # placement, score the ones that fit, run the real algorithm
             # under the winner
+            # SNAP01 suppressions here and in the group-algorithm helpers
+            # below: assume/forget on the cycle snapshot is the sanctioned
+            # gang-scheduling fork API (schedule_one.go:1113-1118) — the
+            # scheduling cycle is single-threaded and every assume is
+            # reverted on the finally/revert path.
             best = None
             for pl in placements:
-                self.snapshot.assume_placement(pl)
+                self.snapshot.assume_placement(pl)  # kubesched-lint: disable=SNAP01
                 try:
                     ok = self._pod_group_dry_run(fw, qpis)
                     if ok:
@@ -799,13 +804,13 @@ class ScheduleOneLoop:
                         if best is None or score > best[0]:
                             best = (score, pl)
                 finally:
-                    self.snapshot.forget_placement()
+                    self.snapshot.forget_placement()  # kubesched-lint: disable=SNAP01
             if best is not None:
-                self.snapshot.assume_placement(best[1])
+                self.snapshot.assume_placement(best[1])  # kubesched-lint: disable=SNAP01
                 try:
                     return self._pod_group_default_algorithm(fw, gk, qpis)
                 finally:
-                    self.snapshot.forget_placement()
+                    self.snapshot.forget_placement()  # kubesched-lint: disable=SNAP01
             if required:
                 return ("unschedulable", qpis[0], Status.unschedulable(
                     "no topology domain can hold the whole pod group",
@@ -831,10 +836,10 @@ class ScheduleOneLoop:
                 ok = False
                 break
             pi = PodInfo(q.pod, self.names)
-            self.snapshot.assume_pod(pi, result.suggested_host)
+            self.snapshot.assume_pod(pi, result.suggested_host)  # kubesched-lint: disable=SNAP01
             placed.append((q.pod.meta.key, result.suggested_host))
         for key, host in reversed(placed):
-            self.snapshot.forget_pod(key, host)
+            self.snapshot.forget_pod(key, host)  # kubesched-lint: disable=SNAP01
         algo.rng.setstate(rng_state)
         return ok
 
@@ -858,7 +863,7 @@ class ScheduleOneLoop:
                 self._revert_pod_group(fw, gk, placed)
                 return ("error", q, Status.as_error(e))
             pi = PodInfo(q.pod, self.names)
-            self.snapshot.assume_pod(pi, result.suggested_host)
+            self.snapshot.assume_pod(pi, result.suggested_host)  # kubesched-lint: disable=SNAP01
             if gsnap is not None:
                 gsnap.unscheduled.discard(q.pod.meta.key)
                 gsnap.assumed.add(q.pod.meta.key)
@@ -880,7 +885,7 @@ class ScheduleOneLoop:
         for q, state, result, pi in reversed(placed):
             fw.run_reserve_plugins_unreserve(state, q.pod, result.suggested_host)
             fw.remove_waiting_pod(q.pod.meta.key)
-            self.snapshot.forget_pod(pi.key, result.suggested_host)
+            self.snapshot.forget_pod(pi.key, result.suggested_host)  # kubesched-lint: disable=SNAP01
             if gsnap is not None:
                 gsnap.assumed.discard(q.pod.meta.key)
                 gsnap.unscheduled.add(q.pod.meta.key)

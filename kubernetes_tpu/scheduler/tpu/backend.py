@@ -72,8 +72,11 @@ def _mt_stream(rng_state) -> np.random.RandomState:
 def clone_tie_words(rng, n_words: int) -> np.ndarray:
     """The rng's next n_words getrandbits(32) outputs, without advancing it."""
     rs = _mt_stream(rng.getstate())
+    # host-side MT19937 stream cloning, never traced: randint needs uint64
+    # to cover the closed [0, 2^32) range; the kernel only ever sees the
+    # down-cast uint32 words
     return rs.randint(0, 2**32, size=n_words,
-                      dtype=np.uint64).astype(np.uint32)
+                      dtype=np.uint64).astype(np.uint32)  # kubesched-lint: disable=JIT04
 
 
 def advance_rng(rng, n_words: int) -> None:
@@ -83,7 +86,8 @@ def advance_rng(rng, n_words: int) -> None:
         return
     version, _mt, gauss = rng.getstate()
     rs = _mt_stream(rng.getstate())
-    rs.randint(0, 2**32, size=n_words, dtype=np.uint64)
+    # same host-only uint64 as clone_tie_words: state transplant, not math
+    rs.randint(0, 2**32, size=n_words, dtype=np.uint64)  # kubesched-lint: disable=JIT04
     s = rs.get_state()
     rng.setstate((version, tuple(int(x) for x in s[1]) + (int(s[2]),), gauss))
 

@@ -175,13 +175,15 @@ class Registry:
             elif isinstance(m, Histogram):
                 for labels, st in sorted(m.values.items()):
                     for bound, n in zip(m.bounds, st.buckets):
+                        le = 'le="%s"' % bound
                         lines.append(
                             f"{m.name}_bucket"
-                            f"{fmt_labels(m.label_names, labels, [f'le=\"{bound}\"'])} {n}"
+                            f"{fmt_labels(m.label_names, labels, [le])} {n}"
                         )
+                    inf = 'le="+Inf"'
                     lines.append(
                         f"{m.name}_bucket"
-                        f"{fmt_labels(m.label_names, labels, ['le=\"+Inf\"'])} {st.count}"
+                        f"{fmt_labels(m.label_names, labels, [inf])} {st.count}"
                     )
                     lines.append(f"{m.name}_sum{fmt_labels(m.label_names, labels)} {st.total}")
                     lines.append(f"{m.name}_count{fmt_labels(m.label_names, labels)} {st.count}")

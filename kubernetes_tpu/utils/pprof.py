@@ -39,7 +39,9 @@ def take_profile(seconds: float = 1.0, hz: int = 100,
             seen: set[str] = set()
             while frame is not None:
                 code = frame.f_code
-                loc = f"{code.co_qualname} ({code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno})"
+                # co_qualname is 3.11+; co_name loses the class prefix only
+                qn = getattr(code, "co_qualname", code.co_name)
+                loc = f"{qn} ({code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno})"
                 if first:
                     self_hits[loc] += 1
                     first = False
@@ -53,8 +55,14 @@ def take_profile(seconds: float = 1.0, hz: int = 100,
         f"{'self':>6} {'self%':>7} {'cum':>6} {'cum%':>7}  location",
     ]
     total = max(ticks, 1)
-    for loc, n in self_hits.most_common(top):
-        c = cum_hits[loc]
+    # every sampled frame gets a cum hit, so cum_hits is the full row set;
+    # callers with 0 self time (all samples in callees) still rank by cum —
+    # dropping them would hide the hot call path's entry points
+    entries = sorted(
+        ((self_hits.get(loc, 0), cum_hits[loc], loc) for loc in cum_hits),
+        key=lambda e: (-e[0], -e[1], e[2]),
+    )[:top]
+    for n, c, loc in entries:
         lines.append(
             f"{n:>6} {100 * n / total:>6.1f}% {c:>6} {100 * c / total:>6.1f}%  {loc}"
         )

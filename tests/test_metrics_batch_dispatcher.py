@@ -4,14 +4,18 @@ Modeled on pkg/scheduler/framework/runtime/batch_test.go,
 backend/api_dispatcher tests, and component-base/metrics behavior.
 """
 
+import threading
 import time
 
 from kubernetes_tpu.scheduler import Scheduler
 from kubernetes_tpu.scheduler.api_dispatcher import (
     APICall,
     APIDispatcher,
+    CallSkippedError,
     POD_BINDING,
+    POD_DELETE,
     POD_STATUS_PATCH,
+    RELEVANCES,
 )
 from kubernetes_tpu.scheduler.framework.batch import BatchCache
 from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
@@ -122,6 +126,81 @@ class TestAPIDispatcher:
         d.add(APICall(POD_BINDING, "default/p", lambda: None))
         with pytest.raises(CallSkippedError):
             d.add(APICall(POD_STATUS_PATCH, "default/p", lambda: None))
+
+    def test_relevance_merge_invariant_under_concurrency(self):
+        """api_calls.go Relevances contract, raced for real: 16 threads
+        released by a barrier all add() for the same object while 4 workers
+        drain. Every submitter must get exactly one outcome (merged call,
+        CallSkippedError at add, or a superseded call resolving with
+        CallSkippedError), at most one call per object may execute at a
+        time, and nothing may be left queued or in-flight."""
+        d = APIDispatcher(parallelism=4)
+        d.run()
+        try:
+            n = 16
+            call_types = [POD_STATUS_PATCH, POD_BINDING, POD_DELETE,
+                          POD_STATUS_PATCH] * (n // 4)
+            barrier = threading.Barrier(n)
+            results: list = [None] * n
+            state = {"active": 0, "overlap": False}
+            mu = threading.Lock()
+
+            def execute():
+                with mu:
+                    state["active"] += 1
+                    if state["active"] > 1:
+                        state["overlap"] = True
+                with mu:
+                    state["active"] -= 1
+
+            def submit(i, ct):
+                barrier.wait()
+                try:
+                    results[i] = ("ok", d.add(APICall(ct, "default/p", execute)))
+                except CallSkippedError as e:
+                    results[i] = ("skipped", e)
+
+            threads = [
+                threading.Thread(target=submit, args=(i, ct))
+                for i, ct in enumerate(call_types)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=5)
+            d.drain()
+
+            assert all(r is not None for r in results)
+            assert not state["overlap"], "two calls for one object ran at once"
+            for tag, val in results:
+                if tag == "ok":
+                    # accepted calls resolve: success, or skip if a later
+                    # more-relevant add replaced them before they ran
+                    assert val.done.wait(5)
+                    assert val.error is None or isinstance(
+                        val.error, CallSkippedError
+                    )
+                else:
+                    assert isinstance(val, CallSkippedError)
+            assert not d._queued and not d._inflight
+        finally:
+            d.close()
+
+    def test_supersede_reports_skip_to_waiters(self):
+        # a call dropped by supersede() never ran: waiters must observe
+        # CallSkippedError and on_finish must fire — done.set() alone would
+        # read as success (regression: supersede left error=None)
+        d = APIDispatcher(parallelism=0)
+        executed, finished = [], []
+        call = d.add(APICall(POD_STATUS_PATCH, "default/p",
+                             lambda: executed.append(1),
+                             on_finish=finished.append))
+        d.supersede(["default/p"], RELEVANCES[POD_BINDING])
+        assert call.done.is_set()
+        assert isinstance(call.error, CallSkippedError)
+        assert len(finished) == 1 and isinstance(finished[0], CallSkippedError)
+        d.drain()
+        assert executed == []  # the dropped patch must not execute later
 
     def test_async_binding_e2e(self):
         store = Store()

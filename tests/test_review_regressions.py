@@ -108,3 +108,26 @@ def test_affinity_tables_rebuilt_on_group_growth_within_bucket():
     feasible = np.flatnonzero(out["feasible"][: planes2.n])
     feasible_names = {planes2.node_names[int(i)] for i in feasible}
     assert "n0" not in feasible_names  # no longer disk=ssd
+
+
+def test_superseded_dispatcher_call_reports_skip():
+    """kubesched-lint review fix: APIDispatcher.supersede() dropped queued
+    calls with done.set() but error=None and no on_finish — waiters read the
+    drop as success; it must surface CallSkippedError like add()'s replace."""
+    from kubernetes_tpu.scheduler.api_dispatcher import (
+        APICall,
+        APIDispatcher,
+        CallSkippedError,
+        POD_BINDING,
+        POD_STATUS_PATCH,
+        RELEVANCES,
+    )
+
+    d = APIDispatcher(parallelism=0)
+    outcomes = []
+    call = d.add(APICall(POD_STATUS_PATCH, "default/p", lambda: None,
+                         on_finish=outcomes.append))
+    d.supersede(["default/p"], RELEVANCES[POD_BINDING])
+    assert isinstance(call.error, CallSkippedError)
+    assert outcomes and isinstance(outcomes[0], CallSkippedError)
+    assert call.done.is_set()
