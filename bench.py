@@ -178,6 +178,15 @@ def main() -> None:
                        and sli["Perc99"] <= SLI_P99_TARGET_S),
         "kernel_pods": algo.kernel_count,
         "fallback_pods": algo.fallback_count,
+        # signature dedup (PR 2): fraction of kernel pods that paid the full
+        # pods×nodes score pass — the rest rode the cheap clone tier. The
+        # host-side grouping cost is wave_profile_s["dedup"].
+        "distinct_signature_ratio": (
+            round(dedup["signatures"] / dedup["pods"], 4)
+            if (dedup := getattr(algo.backend, "dedup_stats", None))
+            and dedup["pods"] else None
+        ),
+        "dedup_waves": (dedup or {}).get("waves"),
         "wall_s": round(wall_s, 2),
         "measured_span_s": round(span_s, 2),
         "async_exec_s": round(async_exec, 2),

@@ -108,6 +108,7 @@ def default_checkers() -> list[Checker]:
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
     from .registry_sync import RegistrySyncChecker
+    from .signature_sync import SignatureSyncChecker
     from .snapshot_immutability import SnapshotImmutabilityChecker
 
     return [
@@ -115,6 +116,7 @@ def default_checkers() -> list[Checker]:
         LockDisciplineChecker(),
         SnapshotImmutabilityChecker(),
         RegistrySyncChecker(),
+        SignatureSyncChecker(),
     ]
 
 

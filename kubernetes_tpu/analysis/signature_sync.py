@@ -1,0 +1,186 @@
+"""Signature-fragment rules (SIG01) for OpportunisticBatching / dedup.
+
+The wave-dedup kernel groups pods by packed feature-row BYTES, so kernel
+soundness never depends on the per-plugin `sign(pod)` fragments — but the
+host-side `BatchCache` hint export (schedule_one.py) and the reference's
+KEP-5598 equivalence classes DO: a fragment that reads a clock, an RNG, a
+process-randomized `hash()`, or a traced jax value produces signatures
+that drift between identical pods, silently zeroing the cache hit rate
+(or worse, merging non-identical pods). Two mechanical checks:
+
+- purity: a `sign()` method on a plugin class (or `Framework.sign_pod`)
+  may not call into clock/RNG/jax sources — `time.*`, `random.*`,
+  `uuid.*`, `secrets.*`, `datetime.*`, `os.urandom`, bare `hash()` /
+  `id()` (PYTHONHASHSEED / address randomization: stable in-process,
+  different every process — a restart would orphan every persisted hint),
+  and `jax.*` / `jnp.*` (fragments are host code; a traced value here
+  means a device sync per pod on the signing path);
+- coverage: every kernel filter row in `ops/kernels.py FILTER_NAMES`
+  either has a plugin `sign` fragment or an entry in `_SIGN_EXEMPT`
+  below with a written justification — a new kernelized filter without a
+  fragment makes pods differing ONLY in that dimension sign identically,
+  and the BatchCache hint would then steer a non-clone onto a stale node
+  list (caught later by the full filter re-check, but wasting the hint).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding, ModuleContext, ProjectChecker
+
+SIG01 = "SIG01"
+
+KERNELS = "ops/kernels.py"
+PLUGINS_DIR = "scheduler/plugins"
+RUNTIME = "scheduler/framework/runtime.py"
+
+# filter rows with no signature fragment, each with its justification —
+# additions here are code review decisions, not escape hatches
+_SIGN_EXEMPT = {
+    # node-side only: the filter reads node.spec.unschedulable and pod
+    # tolerations of the unschedulable taint; the TaintToleration fragment
+    # already keys the toleration list, so every pod adds no information
+    "NodeUnschedulable": "node-side filter; tolerations signed by "
+                         "TaintToleration's fragment",
+    # spec.nodeName-pinned pods bypass batching entirely (the hint path
+    # only serves schedulable pods); an unpinned pod contributes nothing
+    "NodeName": "pinned pods never take the batch-hint path",
+}
+
+# call roots that make a fragment host-impure (clock / rng / traced)
+_BANNED_ROOTS = {
+    "time", "random", "uuid", "secrets", "datetime", "jax", "jnp",
+}
+_BANNED_BARE = {"hash", "id"}
+_BANNED_ATTRS = {"urandom"}  # os.urandom and friends
+
+
+def _dotted(func: ast.expr) -> tuple[str | None, str | None]:
+    """(root name, last attribute) of a call target, e.g. time.monotonic ->
+    ("time", "monotonic"); bare hash() -> ("hash", None)."""
+    last = None
+    node = func
+    while isinstance(node, ast.Attribute):
+        if last is None:
+            last = node.attr
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, last
+    return None, last
+
+
+def _impure_calls(fn: ast.FunctionDef) -> Iterable[tuple[int, str]]:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        root, last = _dotted(node.func)
+        if root in _BANNED_ROOTS:
+            yield node.lineno, f"{root}.{last}" if last else root
+        elif root in _BANNED_BARE and last is None:
+            yield node.lineno, root
+        elif last in _BANNED_ATTRS:
+            yield node.lineno, f"{root}.{last}" if root else last
+
+
+def _class_plugin_name(cls: ast.ClassDef) -> str | None:
+    """The `name = "..."` class attribute of a plugin class."""
+    for stmt in cls.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and any(isinstance(t, ast.Name) and t.id == "name"
+                    for t in stmt.targets)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        ):
+            return stmt.value.value
+    return None
+
+
+class SignatureSyncChecker(ProjectChecker):
+    rules = {
+        SIG01: "signature fragment impure (clock/rng/hash/traced value) or "
+               "a kernel filter row has no sign fragment / exemption",
+    }
+
+    # -- purity (module-scoped) ------------------------------------------
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        p = ctx.posix_path
+        in_plugins = f"/{PLUGINS_DIR}/" in p or p.startswith(f"{PLUGINS_DIR}/")
+        in_runtime = p.endswith(RUNTIME)
+        if not (in_plugins or in_runtime):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if not (isinstance(stmt, ast.FunctionDef)
+                        and stmt.name in ("sign", "sign_pod")):
+                    continue
+                for line, what in _impure_calls(stmt):
+                    yield Finding(
+                        p, line, 0, SIG01,
+                        f"signature fragment {node.name}.{stmt.name} calls "
+                        f"{what}() — fragments must be pure functions of "
+                        "the pod spec (clock/rng/hash drift breaks "
+                        "equivalence-class reuse)",
+                    )
+
+    # -- coverage (project-scoped) ---------------------------------------
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        kernels = root / KERNELS
+        plugins_dir = root / PLUGINS_DIR
+        if not (kernels.is_file() and plugins_dir.is_dir()):
+            return  # partial tree (fixture dirs) — nothing to cross-check
+        try:
+            ktree = ast.parse(kernels.read_text(), filename=str(kernels))
+        except SyntaxError:
+            return
+        filter_names: list[tuple[str, int]] = []
+        fn_line = 1
+        for node in ktree.body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "FILTER_NAMES"
+                for t in node.targets
+            ) and isinstance(node.value, (ast.Tuple, ast.List)):
+                fn_line = node.lineno
+                for el in node.value.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        filter_names.append((el.value, el.lineno))
+        if not filter_names:
+            return
+
+        signed: set[str] = set()
+        for pf in sorted(plugins_dir.glob("*.py")):
+            try:
+                tree = ast.parse(pf.read_text(), filename=str(pf))
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                has_sign = any(
+                    isinstance(s, ast.FunctionDef) and s.name == "sign"
+                    for s in node.body
+                )
+                if has_sign:
+                    pname = _class_plugin_name(node)
+                    if pname:
+                        signed.add(pname)
+
+        for name, line in filter_names:
+            if name in signed:
+                continue
+            if name in _SIGN_EXEMPT:
+                continue
+            yield Finding(
+                kernels.as_posix(), line or fn_line, 0, SIG01,
+                f"kernel filter row {name!r} has no plugin sign fragment "
+                "and no _SIGN_EXEMPT justification in "
+                "analysis/signature_sync.py — unsigned dimensions merge "
+                "non-identical pods in the BatchCache hint path",
+            )

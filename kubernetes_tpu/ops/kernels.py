@@ -195,7 +195,8 @@ class KernelConfig:
 # --------------------------------------------------------------------------
 
 
-def _pts_domain_stats(cfg, planes, mask, key_i, sel_i, comm=LOCAL_COMM):
+def _pts_domain_stats(cfg, planes, mask, key_i, sel_i, comm=LOCAL_COMM,
+                      capture_dseg=0):
     """Per-constraint domain stats: (has_key [Nb], count_at_node [Nb],
     min_count scalar, ndom scalar — number of domains with a participant).
 
@@ -209,6 +210,12 @@ def _pts_domain_stats(cfg, planes, mask, key_i, sel_i, comm=LOCAL_COMM):
     no scatter), giant non-singleton vocabs fall back to segment_sum.
     count_at_node is only meaningful where mask & has_key; callers gate on
     that, so the singleton path may return the raw per-node count everywhere.
+
+    With capture_dseg > 0 also returns the selected key's per-domain
+    (segment-count, participant-count) tables padded to capture_dseg — the
+    signature-dedup scan carries these so clone steps can re-rank without
+    redoing the segment reductions. Singleton keys capture zeros (their
+    "table" is the per-node count itself).
     """
     dom_all = planes["domain"]
     if len(cfg.topo_domains) != dom_all.shape[1]:
@@ -224,10 +231,13 @@ def _pts_domain_stats(cfg, planes, mask, key_i, sel_i, comm=LOCAL_COMM):
     count_o = jnp.zeros(nb, jnp.int32)
     min_o = jnp.int32(0)
     ndom_o = jnp.int32(0)
+    seg_o = jnp.zeros(max(capture_dseg, 1), jnp.int32)
+    pc_o = jnp.zeros(max(capture_dseg, 1), jnp.int32)
     for k, dk in enumerate(cfg.topo_domains):
         dom = dom_all[:, k]
         has_key = dom >= 0
         part = mask & has_key
+        seg_cap = pc_cap = None
         if dk == 0:
             # singleton: domain ↔ node, so the segment sum is the identity
             count = cnt
@@ -247,33 +257,46 @@ def _pts_domain_stats(cfg, planes, mask, key_i, sel_i, comm=LOCAL_COMM):
                 oh, jnp.where(part, cnt, 0).astype(jnp.float32),
                 precision=jax.lax.Precision.HIGHEST,
             ).astype(jnp.int32))
-            present = comm.seg(jnp.matmul(
+            pcf = comm.seg(jnp.matmul(
                 oh, part.astype(jnp.float32),
                 precision=jax.lax.Precision.HIGHEST,
-            )) > 0.5
+            ))
+            present = pcf > 0.5
             count = jnp.take(seg, dom_c)
             min_c = jnp.where(
                 present.any(), jnp.min(jnp.where(present, seg, big)), 0
             )
             ndom = present.sum().astype(jnp.int32)
+            seg_cap, pc_cap = seg, pcf.astype(jnp.int32)
         else:
             dom_c = jnp.clip(dom, 0, dk - 1)
             seg = comm.seg(jax.ops.segment_sum(
                 jnp.where(part, cnt, 0), dom_c, num_segments=dk
             ))
-            present = comm.seg(jax.ops.segment_sum(
+            pc = comm.seg(jax.ops.segment_sum(
                 jnp.where(part, 1, 0), dom_c, num_segments=dk
-            )) > 0
+            ))
+            present = pc > 0
             count = jnp.take(seg, dom_c)
             min_c = jnp.where(
                 present.any(), jnp.min(jnp.where(present, seg, big)), 0
             )
             ndom = present.sum().astype(jnp.int32)
+            seg_cap, pc_cap = seg, pc
         sel = key_i == k
         has_key_o = jnp.where(sel, has_key, has_key_o)
         count_o = jnp.where(sel, count, count_o)
         min_o = jnp.where(sel, min_c, min_o)
         ndom_o = jnp.where(sel, ndom, ndom_o)
+        if capture_dseg and seg_cap is not None:
+            pad = capture_dseg - seg_cap.shape[0]
+            if pad > 0:
+                seg_cap = jnp.pad(seg_cap, (0, pad))
+                pc_cap = jnp.pad(pc_cap, (0, pad))
+            seg_o = jnp.where(sel, seg_cap, seg_o)
+            pc_o = jnp.where(sel, pc_cap, pc_o)
+    if capture_dseg:
+        return has_key_o, count_o, min_o, ndom_o, seg_o, pc_o
     return has_key_o, count_o, min_o, ndom_o
 
 
@@ -587,24 +610,10 @@ def _node_affinity_score(planes, f, feasible, comm=LOCAL_COMM):
     return jnp.where(has_pref, normed, 0)
 
 
-def _pts_score(cfg: KernelConfig, planes, f, feasible, comm=LOCAL_COMM):
-    """podtopologyspread scoring.go:118-305 — per-domain counts weighted by
-    log(domains+2) float32, inverted min/max over the feasible set."""
-    nb = planes["valid"].shape[0]
-    cost = jnp.zeros(nb, jnp.float32)
-    if cfg.n_soft == 0:
-        return jnp.zeros(nb, jnp.int32)
-    any_active = f["soft_active"].any()
-    for c in range(min(cfg.max_constraints, cfg.n_soft)):
-        active = f["soft_active"][c]
-        has_key, count, _, nd = _pts_domain_stats(
-            cfg, planes, feasible, f["soft_key"][c], f["soft_sel"][c], comm
-        )
-        w = jnp.log((nd + 2).astype(jnp.float32))
-        cost = cost + jnp.where(
-            active & has_key, count.astype(jnp.float32) * w, jnp.float32(0)
-        )
-    raw = cost.astype(jnp.int32)
+def _pts_normalize(raw, any_active, feasible, comm=LOCAL_COMM):
+    """scoring.go:266-305 inverted min/max normalization over the feasible
+    set — shared by the full and carried (clone-replay) PTS scorers so the
+    op sequence is one definition, not two that could drift."""
     big = jnp.iinfo(jnp.int32).max
     mx = comm.vmax(jnp.where(feasible, raw, -big))
     mn = comm.vmin(jnp.where(feasible, raw, big))
@@ -615,6 +624,93 @@ def _pts_score(cfg: KernelConfig, planes, f, feasible, comm=LOCAL_COMM):
         (mx - raw) * MAX_NODE_SCORE // jnp.maximum(spread, 1),
     )
     return jnp.where(any_active, normed, 0)
+
+
+def _pts_score_core(cfg: KernelConfig, planes, f, feasible, comm=LOCAL_COMM,
+                    capture_shape=None):
+    """podtopologyspread scoring.go:118-305 — per-domain counts weighted by
+    log(domains+2) float32, inverted min/max over the feasible set.
+
+    capture_shape=(C, Dseg): additionally return the per-constraint domain
+    segment/participant tables (zeros for singleton-key constraints) for the
+    signature-dedup scan carry."""
+    nb = planes["valid"].shape[0]
+    segs = pcs = None
+    if capture_shape is not None:
+        segs = jnp.zeros(capture_shape, jnp.int32)
+        pcs = jnp.zeros(capture_shape, jnp.int32)
+    cost = jnp.zeros(nb, jnp.float32)
+    if cfg.n_soft == 0:
+        return jnp.zeros(nb, jnp.int32), segs, pcs
+    any_active = f["soft_active"].any()
+    for c in range(min(cfg.max_constraints, cfg.n_soft)):
+        active = f["soft_active"][c]
+        if capture_shape is None:
+            has_key, count, _, nd = _pts_domain_stats(
+                cfg, planes, feasible, f["soft_key"][c], f["soft_sel"][c],
+                comm
+            )
+        else:
+            has_key, count, _, nd, seg_c, pc_c = _pts_domain_stats(
+                cfg, planes, feasible, f["soft_key"][c], f["soft_sel"][c],
+                comm, capture_dseg=capture_shape[1]
+            )
+            segs = segs.at[c].set(seg_c)
+            pcs = pcs.at[c].set(pc_c)
+        w = jnp.log((nd + 2).astype(jnp.float32))
+        cost = cost + jnp.where(
+            active & has_key, count.astype(jnp.float32) * w, jnp.float32(0)
+        )
+    raw = cost.astype(jnp.int32)
+    return _pts_normalize(raw, any_active, feasible, comm), segs, pcs
+
+
+def _pts_score(cfg: KernelConfig, planes, f, feasible, comm=LOCAL_COMM):
+    return _pts_score_core(cfg, planes, f, feasible, comm)[0]
+
+
+def _pts_score_carried(cfg: KernelConfig, planes, f, feasible, sel_counts,
+                       segs, pcs, comm=LOCAL_COMM):
+    """PTS score for a clone step from the carried per-domain tables: the
+    segment reductions of _pts_score_core become gathers into segs/pcs
+    (patched after each placement), and singleton keys read the carried
+    sel_counts elementwise. Against the same feasible set this is
+    bit-identical to the full scorer — counts are the same int32 values,
+    the log weight sees the same scalar, and the cost/normalize op order is
+    shared (_pts_normalize)."""
+    nb = planes["valid"].shape[0]
+    if cfg.n_soft == 0:
+        return jnp.zeros(nb, jnp.int32)
+    dseg = segs.shape[1]
+    cost = jnp.zeros(nb, jnp.float32)
+    any_active = f["soft_active"].any()
+    for c in range(min(cfg.max_constraints, cfg.n_soft)):
+        active = f["soft_active"][c]
+        key_i = f["soft_key"][c]
+        cnt = jnp.take(sel_counts, f["soft_sel"][c], axis=1)
+        has_key_o = jnp.zeros(nb, bool)
+        count_o = jnp.zeros(nb, jnp.int32)
+        nd_o = jnp.int32(0)
+        for k, dk in enumerate(cfg.topo_domains):
+            dom = planes["domain"][:, k]
+            has_key = dom >= 0
+            if dk == 0:
+                count = cnt
+                nd = comm.vsum((feasible & has_key).astype(jnp.int32))
+            else:
+                count = jnp.take(segs[c], jnp.clip(dom, 0, dseg - 1))
+                nd = (pcs[c] > 0).sum().astype(jnp.int32)
+            sel = key_i == k
+            has_key_o = jnp.where(sel, has_key, has_key_o)
+            count_o = jnp.where(sel, count, count_o)
+            nd_o = jnp.where(sel, nd, nd_o)
+        w = jnp.log((nd_o + 2).astype(jnp.float32))
+        cost = cost + jnp.where(
+            active & has_key_o, count_o.astype(jnp.float32) * w,
+            jnp.float32(0)
+        )
+    raw = cost.astype(jnp.int32)
+    return _pts_normalize(raw, any_active, feasible, comm)
 
 
 def _image_score(planes, f):
@@ -787,62 +883,12 @@ def _pts_hard_carried(cfg: KernelConfig, planes, sel_counts, dom_counts,
     return has_key_o, count_o, min_o
 
 
-def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
-                 carry, inp):
-    """One greedy step: carry-dependent filter+score only (static parts come
-    precomputed via the scan xs), pick the best node with the HOST tie-break
-    (seeded-rng draw over max-score winners in snapshot node order, fed by
-    the precomputed tie_words stream), apply the pod's deltas. Score math is
-    identical to filter_masks+scores — just partitioned by carry-dependence.
-
-    Under shard_map (comm=AxisComm) the per-step cross-shard traffic is
-    exactly: the scalar normalizations (pmax/pmin), one [shards] tie-count
-    gather, and two scalar psums publishing the winner — the per-shard
-    top-k → global argmax design of SURVEY §7."""
-    f, sp = inp
-    used, nonzero_used, sel_counts, dom_counts, ipa, cursor, overflow = carry
-    p = dict(planes)
-    p["used"], p["nonzero_used"], p["sel_counts"] = used, nonzero_used, sel_counts
-    if ipa is not None:
-        p["ipa_counts"], p["ipa_anti"], p["ipa_pref"] = ipa
-
-    # dynamic filters: NodeResourcesFit + PodTopologySpread hard constraints
-    free = p["alloc"] - used
-    insufficient = (f["req"][None, :] > 0) & (f["req"][None, :] > free)
-    insufficient = insufficient.at[:, PODS].set(False)
-    too_many = used[:, PODS] + 1 > p["alloc"][:, PODS]
-    f_fit = insufficient.any(axis=1) | too_many
-    pts_fail = jnp.zeros_like(f_fit)
-    for c in range(min(cfg.max_constraints, cfg.n_hard)):
-        active = f["hard_active"][c]
-        if dom_counts is not None:
-            has_key, count, min_count = _pts_hard_carried(
-                cfg, p, sel_counts, dom_counts, present,
-                f["hard_key"][c], f["hard_sel"][c], comm
-            )
-        else:
-            has_key, count, min_count, _ = _pts_domain_stats(
-                cfg, p, p["valid"], f["hard_key"][c], f["hard_sel"][c], comm
-            )
-        skew = count + f["hard_self"][c] - min_count
-        pts_fail = pts_fail | (active & ~has_key) | (
-            active & has_key & (skew > f["hard_skew"][c])
-        )
-    if cfg.ipa_active:
-        ipa1, ipa2, ipa3 = _ipa_filters(cfg, p, f, comm)
-        ipa_fail = ipa1 | ipa2 | ipa3
-    else:
-        ipa_fail = jnp.zeros_like(f_fit)
-    feasible = sp["static_ok"] & ~f_fit & ~pts_fail & ~ipa_fail
-
-    # dynamic scores + static raws normalized over the live feasible set
-    total = (
-        _fit_score(cfg, p, f) * cfg.weight("NodeResourcesFit")
-        + _balanced_score(cfg, p, f) * cfg.weight("NodeResourcesBalancedAllocation")
-        + _pts_score(cfg, p, f, feasible, comm) * cfg.weight("PodTopologySpread")
-        + _ipa_score(cfg, p, f, feasible, comm) * cfg.weight("InterPodAffinity")
-        + sp["img"] * cfg.weight("ImageLocality")
-    )
+def _finish_total(cfg: KernelConfig, ew, pts, f, sp, feasible,
+                  comm=LOCAL_COMM):
+    """Assemble the weighted total from the fit+balanced partial (ew), the
+    PTS score and the static per-pod raws (taint counts, affinity prefs,
+    image) normalized over the live feasible set. int32 addition is exact,
+    so this grouping is value-identical to the pre-refactor flat sum."""
     max_tc = comm.vmax(jnp.where(feasible, sp["taint_cnt"], 0))
     taint = jnp.where(
         max_tc > 0,
@@ -855,11 +901,139 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
         sp["aff_raw"] * MAX_NODE_SCORE // jnp.maximum(mx_aff, 1),
         sp["aff_raw"],
     )
-    total = (
-        total
+    return (
+        ew
+        + pts * cfg.weight("PodTopologySpread")
+        + sp["img"] * cfg.weight("ImageLocality")
         + taint * cfg.weight("TaintToleration")
         + jnp.where(sp["aff_has_pref"], aff_normed, 0) * cfg.weight("NodeAffinity")
     )
+
+
+def _fit_filter_row(cfg: KernelConfig, alloc_row, used_row, f):
+    """NodeResourcesFit filter for ONE node row — the scalar analogue of the
+    [Nb] block in _assign_step, used to patch the dedup carry after a
+    placement (elementwise int compares: bit-identical to the full pass)."""
+    free = alloc_row - used_row
+    insuff = (f["req"] > 0) & (f["req"] > free)
+    insuff = insuff.at[PODS].set(False)
+    too_many = used_row[PODS] + 1 > alloc_row[PODS]
+    return insuff.any() | too_many
+
+
+def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
+                 carry, inp, static_rows=None, fast=False):
+    """One greedy step: carry-dependent filter+score only (static parts come
+    precomputed via the scan xs), pick the best node with the HOST tie-break
+    (seeded-rng draw over max-score winners in snapshot node order, fed by
+    the precomputed tie_words stream), apply the pod's deltas. Score math is
+    identical to filter_masks+scores — just partitioned by carry-dependence.
+
+    Signature dedup (static_rows is not None): the step reads its static
+    per-pod parts by gathering row sig_id from the per-SIGNATURE table
+    instead of receiving them via xs. With fast=True (no hard constraints,
+    no IPA, single shard) the step is two-tier: a slot whose sig_id equals
+    its predecessor's replays the predecessor's score row from the dyn
+    carry (ew + feasible + PTS domain tables) and only pays the re-rank +
+    tie-draw; the first slot of each signature run takes the full pass and
+    refreshes the carry. After every placement the dyn carry is patched at
+    the winner row only — in fast mode a placement can change feasibility
+    and fit/balanced scores at exactly that row, which is what makes the
+    replay bit-identical to a full recompute.
+
+    Under shard_map (comm=AxisComm) the per-step cross-shard traffic is
+    exactly: the scalar normalizations (pmax/pmin), one [shards] tie-count
+    gather, and two scalar psums publishing the winner — the per-shard
+    top-k → global argmax design of SURVEY §7."""
+    (used, nonzero_used, sel_counts, dom_counts, ipa, cursor, overflow,
+     dyn, sig_scores) = carry
+    if static_rows is None:
+        f, sp = inp
+        sid = same = None
+    else:
+        f, sid, same = inp
+        sp = jax.tree_util.tree_map(lambda a: a[sid], static_rows)
+    p = dict(planes)
+    p["used"], p["nonzero_used"], p["sel_counts"] = used, nonzero_used, sel_counts
+    if ipa is not None:
+        p["ipa_counts"], p["ipa_anti"], p["ipa_pref"] = ipa
+
+    if fast:
+        capture_shape = dyn[3].shape
+
+        def _full_tier(dyn_in):
+            del dyn_in
+            # dynamic filter reduces to NodeResourcesFit (fast mode has no
+            # hard spread constraints and no IPA by construction)
+            free = p["alloc"] - used
+            insufficient = (f["req"][None, :] > 0) & (f["req"][None, :] > free)
+            insufficient = insufficient.at[:, PODS].set(False)
+            too_many = used[:, PODS] + 1 > p["alloc"][:, PODS]
+            f_fit = insufficient.any(axis=1) | too_many
+            feasible = sp["static_ok"] & ~f_fit
+            ew = (
+                _fit_score(cfg, p, f) * cfg.weight("NodeResourcesFit")
+                + _balanced_score(cfg, p, f)
+                * cfg.weight("NodeResourcesBalancedAllocation")
+            )
+            pts, segs, pcs = _pts_score_core(
+                cfg, p, f, feasible, comm, capture_shape=capture_shape
+            )
+            total = _finish_total(cfg, ew, pts, f, sp, feasible, comm)
+            return total, (ew, f_fit, feasible, segs, pcs)
+
+        def _cheap_tier(dyn_in):
+            ew, f_fit, feasible, segs, pcs = dyn_in
+            pts = _pts_score_carried(
+                cfg, p, f, feasible, sel_counts, segs, pcs, comm
+            )
+            total = _finish_total(cfg, ew, pts, f, sp, feasible, comm)
+            return total, dyn_in
+
+        total, dyn = jax.lax.cond(same, _cheap_tier, _full_tier, dyn)
+        feasible = dyn[2]
+    else:
+        # dynamic filters: NodeResourcesFit + PodTopologySpread hard
+        # constraints
+        free = p["alloc"] - used
+        insufficient = (f["req"][None, :] > 0) & (f["req"][None, :] > free)
+        insufficient = insufficient.at[:, PODS].set(False)
+        too_many = used[:, PODS] + 1 > p["alloc"][:, PODS]
+        f_fit = insufficient.any(axis=1) | too_many
+        pts_fail = jnp.zeros_like(f_fit)
+        for c in range(min(cfg.max_constraints, cfg.n_hard)):
+            active = f["hard_active"][c]
+            if dom_counts is not None:
+                has_key, count, min_count = _pts_hard_carried(
+                    cfg, p, sel_counts, dom_counts, present,
+                    f["hard_key"][c], f["hard_sel"][c], comm
+                )
+            else:
+                has_key, count, min_count, _ = _pts_domain_stats(
+                    cfg, p, p["valid"], f["hard_key"][c], f["hard_sel"][c],
+                    comm
+                )
+            skew = count + f["hard_self"][c] - min_count
+            pts_fail = pts_fail | (active & ~has_key) | (
+                active & has_key & (skew > f["hard_skew"][c])
+            )
+        if cfg.ipa_active:
+            ipa1, ipa2, ipa3 = _ipa_filters(cfg, p, f, comm)
+            ipa_fail = ipa1 | ipa2 | ipa3
+        else:
+            ipa_fail = jnp.zeros_like(f_fit)
+        feasible = sp["static_ok"] & ~f_fit & ~pts_fail & ~ipa_fail
+
+        # dynamic scores + static raws normalized over the live feasible set
+        ew = (
+            _fit_score(cfg, p, f) * cfg.weight("NodeResourcesFit")
+            + _balanced_score(cfg, p, f)
+            * cfg.weight("NodeResourcesBalancedAllocation")
+        )
+        total = _finish_total(
+            cfg, ew, _pts_score(cfg, p, f, feasible, comm), f, sp, feasible,
+            comm
+        ) + _ipa_score(cfg, p, f, feasible, comm) * cfg.weight("InterPodAffinity")
 
     # winner selection = selectHost (schedule_one.go:1080-1134): uniform
     # seeded draw among max-score feasible nodes in snapshot node order.
@@ -903,6 +1077,7 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
     # touches one node's row, so the step shouldn't write whole planes;
     # non-owner shards add zero
     gate = owner.astype(jnp.int32)
+    sel_prev = sel_counts
     used = used.at[win].add(gate * f["req"])
     nonzero_used = nonzero_used.at[win].add(gate * f["nz_req"])
     sel_counts = sel_counts.at[win].add(gate * f["sig_match"])
@@ -926,24 +1101,110 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
             ipa_anti.at[win].add(gate * f["ipa_anti_add"]),
             ipa_pref.at[win].add(gate * f["ipa_pref_add"]),
         )
+    if fast:
+        # patch the dyn carry at the winner row: in fast mode a placement
+        # changes f_fit/feasible/fit/balanced at EXACTLY that row (only its
+        # used/nonzero_used moved), plus the winner's domain segment in each
+        # soft constraint's carried table. All patches gate on `placed` so a
+        # no-placement step is a carry no-op.
+        ew, f_fit_c, feas_c, segs, pcs = dyn
+        placed = owner
+        rp = {
+            "alloc": planes["alloc"][win][None],
+            "used": used[win][None],
+            "nonzero_used": nonzero_used[win][None],
+            "valid": planes["valid"][win][None],
+        }
+        ew_w = (
+            _fit_score(cfg, rp, f)[0] * cfg.weight("NodeResourcesFit")
+            + _balanced_score(cfg, rp, f)[0]
+            * cfg.weight("NodeResourcesBalancedAllocation")
+        )
+        f_fit_w = _fit_filter_row(cfg, planes["alloc"][win], used[win], f)
+        feas_w = sp["static_ok"][win] & ~f_fit_w
+        feas_old_w = feas_c[win]
+        ew = ew.at[win].set(jnp.where(placed, ew_w, ew[win]))
+        f_fit_c = f_fit_c.at[win].set(jnp.where(placed, f_fit_w, f_fit_c[win]))
+        feas_c = feas_c.at[win].set(jnp.where(placed, feas_w, feas_old_w))
+        dseg = segs.shape[1]
+        for c in range(min(cfg.max_constraints, cfg.n_soft)):
+            key_c = f["soft_key"][c]
+            sel_c = f["soft_sel"][c]
+            cnt_old_w = sel_prev[win, sel_c]
+            cnt_new_w = sel_counts[win, sel_c]
+            for k, dk in enumerate(cfg.topo_domains):
+                if dk == 0:
+                    continue  # singleton keys replay from sel_counts directly
+                dom_w = planes["domain"][win, k]
+                in_k = placed & (key_c == k) & (dom_w >= 0)
+                d_idx = jnp.clip(dom_w, 0, dseg - 1)
+                before = jnp.where(feas_old_w, cnt_old_w, 0)
+                after = jnp.where(feas_w, cnt_new_w, 0)
+                segs = segs.at[c, d_idx].add(
+                    jnp.where(in_k, after - before, 0)
+                )
+                pcs = pcs.at[c, d_idx].add(jnp.where(
+                    in_k,
+                    feas_w.astype(jnp.int32) - feas_old_w.astype(jnp.int32),
+                    0,
+                ))
+        dyn = (ew, f_fit_c, feas_c, segs, pcs)
+        # per-signature score row export (host BatchCache warm-up): the
+        # FIRST slot of each run stores its feasibility-gated totals; pad
+        # slots always replay (same=True) so they never store
+        sig_scores = sig_scores.at[sid].set(jnp.where(
+            same, sig_scores[sid], jnp.where(feasible, total, -1)
+        ))
     # publish the winner's GLOBAL row id (scalar psum; -1 when unplaced)
     nb = mask.shape[0]
     winner = comm.vsum(gate * (comm.index() * nb + win + 1)) - 1
     return (used, nonzero_used, sel_counts, dom_counts, ipa, cursor,
-            overflow), winner
+            overflow, dyn, sig_scores), winner
+
+
+def dedup_fast_capable(cfg: KernelConfig, comm=LOCAL_COMM) -> bool:
+    """Whether the two-tier clone-replay scan is valid for this config: the
+    carry patch covers exactly the dynamic state of NodeResourcesFit +
+    soft spread. Hard spread constraints and IPA mutate cross-node state a
+    single-row patch can't track, and the replicated dyn carry is only
+    maintained single-shard — those waves take full steps (still dedup's
+    static-pass savings, just no per-step shortcut)."""
+    return cfg.n_hard == 0 and not cfg.ipa_active and comm.n_shards == 1
 
 
 def _batched_assign_core(cfg: KernelConfig, planes: dict, packed_f,
                          layout, tie_words, cursor_init, frame_shift,
-                         comm=LOCAL_COMM):
+                         comm=LOCAL_COMM, sig_ids=None, uniq_idx=None,
+                         dedup=False):
     from .planes import unpack_features
 
     # ONE host→device transfer carries the whole wave's features; the
     # unpack slices fuse away under XLA (see planes.pack_features)
     batched_f = unpack_features(packed_f, layout)
-    static = jax.vmap(
-        lambda f: _static_pod_parts(cfg, planes, f, comm)
-    )(batched_f)
+    dedup = dedup and sig_ids is not None  # static arg: resolved at trace
+    fast = dedup and dedup_fast_capable(cfg, comm)
+    nb = planes["valid"].shape[0]
+    if dedup:
+        # static per-pod parts ONCE PER SIGNATURE: the vmap runs over the
+        # first-occurrence rows only; steps gather their row by sig_id
+        uniq_f = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, uniq_idx, axis=0), batched_f
+        )
+        static_rows = jax.vmap(
+            lambda f: _static_pod_parts(cfg, planes, f, comm)
+        )(uniq_f)
+        # a slot replays its predecessor iff they share a signature; slot 0
+        # and every run head take the full tier
+        same = jnp.concatenate(
+            [jnp.zeros(1, bool), sig_ids[1:] == sig_ids[:-1]]
+        )
+        xs = (batched_f, sig_ids, same)
+    else:
+        static_rows = None
+        static = jax.vmap(
+            lambda f: _static_pod_parts(cfg, planes, f, comm)
+        )(batched_f)
+        xs = (batched_f, static)
     dom_counts, present = _dom_counts_init(cfg, planes, comm)
     ipa = ((planes["ipa_counts"], planes["ipa_anti"], planes["ipa_pref"])
            if cfg.ipa_active else None)
@@ -954,12 +1215,22 @@ def _batched_assign_core(cfg: KernelConfig, planes: dict, packed_f,
     # eager scalar op (each eager dispatch costs a device round trip)
     cursor0 = (jnp.asarray(cursor_init, jnp.int32)
                - jnp.asarray(frame_shift, jnp.int32))
+    if fast:
+        ct = max(1, min(cfg.max_constraints, cfg.n_soft))
+        dmax = max((dk for dk in cfg.topo_domains if dk > 0), default=1)
+        dyn0 = (jnp.zeros(nb, jnp.int32), jnp.zeros(nb, bool),
+                jnp.zeros(nb, bool), jnp.zeros((ct, dmax), jnp.int32),
+                jnp.zeros((ct, dmax), jnp.int32))
+        sig_scores0 = jnp.full((uniq_idx.shape[0], nb), -1, jnp.int32)
+    else:
+        dyn0 = None
+        sig_scores0 = None
     init = (planes["used"], planes["nonzero_used"], planes["sel_counts"],
-            dom_counts, ipa, cursor0, jnp.bool_(False))
+            dom_counts, ipa, cursor0, jnp.bool_(False), dyn0, sig_scores0)
     step = functools.partial(_assign_step, cfg, planes, present, tie_words,
-                             comm)
-    (used, nonzero_used, sel_counts, _, ipa_out, cursor, overflow), winners = \
-        jax.lax.scan(step, init, (batched_f, static), unroll=4)
+                             comm, static_rows=static_rows, fast=fast)
+    (used, nonzero_used, sel_counts, _, ipa_out, cursor, overflow, _,
+     sig_scores), winners = jax.lax.scan(step, init, xs, unroll=4)
     # single-transfer result: winners ++ [tie_consumed, tie_overflow] — the
     # host reads everything it needs in ONE device→host round trip (the
     # tunnel's per-transfer latency dominates small fetches)
@@ -970,20 +1241,26 @@ def _batched_assign_core(cfg: KernelConfig, planes: dict, packed_f,
     out = {"used": used, "nonzero_used": nonzero_used,
            "sel_counts": sel_counts, "tie_consumed": cursor,
            "tie_overflow": overflow, "packed": packed}
+    if sig_scores is not None:
+        out["sig_scores"] = sig_scores
     if ipa_out is not None:
         out["ipa_counts"], out["ipa_anti"], out["ipa_pref"] = ipa_out
     return winners, out
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3, 9))
 def _batched_assign_jit(cfg: KernelConfig, planes: dict, packed_f,
-                        layout, tie_words, cursor_init, frame_shift):
+                        layout, tie_words, cursor_init, frame_shift,
+                        sig_ids, uniq_idx, dedup):
     return _batched_assign_core(cfg, planes, packed_f, layout, tie_words,
-                                cursor_init, frame_shift, LOCAL_COMM)
+                                cursor_init, frame_shift, LOCAL_COMM,
+                                sig_ids=sig_ids, uniq_idx=uniq_idx,
+                                dedup=dedup)
 
 
 def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
-                   tie_words=None, cursor_init=0, frame_shift=0):
+                   tie_words=None, cursor_init=0, frame_shift=0,
+                   sig_ids=None, uniq_idx=None):
     """Greedy multi-pod assignment: lax.scan over the pod axis; pod i+1 sees
     pod i's assumed deltas (the in-kernel analogue of the cache assume in
     schedule_one.go:320-333 and of the gang default algorithm, and the
@@ -996,6 +1273,14 @@ def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
     the live rng. Without tie_words every draw resolves to the first
     max-score winner (deterministic first-index).
 
+    Signature dedup: sig_ids [P] int32 groups slots whose packed feature
+    rows are byte-identical (backend.group_signatures); uniq_idx [G] holds
+    each group's first-occurrence slot. The scan then runs the static pass
+    once per signature and — where dedup_fast_capable — replays score rows
+    across consecutive clones. Decisions (winners, tie stream, planes) are
+    bit-identical to the non-dedup scan; `sig_scores` in the result holds
+    each signature's feasibility-gated score row for host cache export.
+
     Returns (winners [P] int32 node index or -1, dict with updated
     used/nonzero_used/sel_counts planes + tie_consumed/tie_overflow)."""
     from .planes import pack_features
@@ -1003,6 +1288,10 @@ def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
     if tie_words is None:
         tie_words = ZERO_TIE_WORDS
     packed, layout = pack_features(batched_f)
+    dedup = sig_ids is not None and uniq_idx is not None
     return _batched_assign_jit(cfg, planes, packed, layout, tie_words,
                                np.int32(cursor_init) if isinstance(cursor_init, int) else cursor_init,
-                               np.int32(frame_shift))
+                               np.int32(frame_shift),
+                               np.asarray(sig_ids, np.int32) if dedup else None,
+                               np.asarray(uniq_idx, np.int32) if dedup else None,
+                               dedup)

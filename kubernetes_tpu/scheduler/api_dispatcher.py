@@ -215,8 +215,10 @@ class APIDispatcher:
     def drain(self, timeout: float = 5.0) -> None:
         """Synchronously execute everything still queued (tests/shutdown);
         respects the one-executing-call-per-object invariant."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        # monotonic: a wall-clock step backwards must not extend the drain
+        # window (or forwards, cut it short) — this is a duration, not a time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             with self._lock:
                 key = next(
                     (k for k in self._queued if k not in self._inflight), None

@@ -30,6 +30,17 @@ class EventRecorder:
     EVENT_TTL_S = 3600.0
     # sweep the stored events after this many writes since the last sweep
     GC_EVERY_WRITES = 512
+    # ... but never sweep more often than this (the sweep walks every stored
+    # event; it belongs on a slow timer, not the scheduling hot loop)
+    GC_MIN_INTERVAL_S = 30.0
+    # correlation spill threshold (the reference correlator's
+    # EventAggregator, defaultAggregateMaxEvents=10): the first N events
+    # sharing a correlation key stay individual; the rest collapse into ONE
+    # aggregate object whose count keeps climbing
+    AGGREGATE_SPILL = 10
+    # maybe_flush cadence: the scheduler pump calls it every iteration, but
+    # store writes happen at most this often
+    FLUSH_INTERVAL_S = 0.25
 
     def __init__(self, store, component: str = "default-scheduler",
                  max_buffer: int = 4096):
@@ -53,27 +64,55 @@ class EventRecorder:
         self._seq = 0
         self._max_buffer = max_buffer
         self._writes_since_gc = 0
+        # events recorded per (correlation, type, reason) since last flush
+        self._corr_counts: dict[tuple, int] = {}
+        # optional APIDispatcher: maybe_flush routes the store writes
+        # through its workers so the scheduling thread never pays them
+        self.dispatcher = None
+        self._flush_seq = 0
+        self._last_flush = float("-inf")  # monotonic
+        self._last_gc = time.monotonic()
 
-    def event(self, obj, etype: str, reason: str, message: str) -> None:
+    def event(self, obj, etype: str, reason: str, message: str,
+              correlation: str | None = None) -> None:
         """Record one event (schedule_one.go:1174 "Scheduled",
-        :1273 "FailedScheduling"). Repeats aggregate into a count."""
+        :1273 "FailedScheduling"). Repeats aggregate into a count.
+
+        correlation groups similar-but-not-identical events (e.g. one wave's
+        per-pod "Scheduled" events, whose messages differ by node): past
+        AGGREGATE_SPILL events per key, the remainder becomes a single
+        aggregate object ("combined from similar events"), exactly the
+        reference correlator's spam-vs-signal compromise."""
         involved = f"{obj.kind}/{obj.meta.key}"
-        key = (involved, etype, reason, message)
         now = time.time()
         flush_now = False
         with self._mu:
+            aggregated = False
+            if correlation is not None:
+                ckey = (correlation, etype, reason)
+                seen = self._corr_counts.get(ckey, 0) + 1
+                self._corr_counts[ckey] = seen
+                aggregated = seen > self.AGGREGATE_SPILL
+            if aggregated:
+                key = (correlation, etype, reason, None)
+                message = f"(combined from similar events): {message}"
+                involved = correlation
+            else:
+                key = (involved, etype, reason, message)
             ev = self._pending.get(key)
             if ev is not None:
                 ev.count += 1
                 ev.last_timestamp = now
+                if aggregated:
+                    ev.message = message  # latest representative
             else:
-                # deterministic name per (involved, type, reason, message):
-                # repeats aggregate into the SAME stored object across
-                # flushes (EventSeries semantics), never a new one per flush
+                # deterministic name per key: repeats aggregate into the
+                # SAME stored object across flushes (EventSeries semantics),
+                # never a new one per flush
                 import hashlib
 
                 digest = hashlib.sha1(
-                    "|".join(key).encode()
+                    "|".join(k or "aggregated" for k in key).encode()
                 ).hexdigest()[:12]
                 name = f"{obj.meta.name}.{digest}"
                 self._pending[key] = Event(
@@ -90,10 +129,33 @@ class EventRecorder:
         if flush_now:
             self.flush()
 
+    def maybe_flush(self) -> int:
+        """Hot-loop entry point: flush at most every FLUSH_INTERVAL_S, and
+        through the async dispatcher when one is wired — either way the
+        per-iteration cost in the scheduling loop is a clock read."""
+        now = time.monotonic()
+        if now - self._last_flush < self.FLUSH_INTERVAL_S:
+            return 0
+        with self._mu:
+            if not self._pending:
+                return 0
+        self._last_flush = now
+        if self.dispatcher is not None:
+            from .api_dispatcher import APICall
+
+            self._flush_seq += 1
+            self.dispatcher.add(APICall(
+                "event_flush", f"__events__/{self._flush_seq}",
+                self.flush,
+            ))
+            return 0
+        return self.flush()
+
     def flush(self) -> int:
         """Write buffered events to the store; returns how many landed."""
         with self._mu:
             pending, self._pending = self._pending, {}
+            self._corr_counts.clear()
         n = 0
         for ev in pending.values():
             try:
@@ -101,6 +163,7 @@ class EventRecorder:
                 if existing is not None:
                     existing.count += ev.count
                     existing.last_timestamp = ev.last_timestamp
+                    existing.message = ev.message
                     self.store.update(existing, check_version=False)
                 elif self._fast_create:
                     # copy_return=False: the returned copy was discarded, and
@@ -114,8 +177,11 @@ class EventRecorder:
             except Exception:  # noqa: BLE001 - events are best-effort
                 pass
         self._writes_since_gc += n
-        if self._writes_since_gc >= self.GC_EVERY_WRITES:
+        now_m = time.monotonic()
+        if (self._writes_since_gc >= self.GC_EVERY_WRITES
+                and now_m - self._last_gc >= self.GC_MIN_INTERVAL_S):
             self._writes_since_gc = 0
+            self._last_gc = now_m
             self._gc()
         return n
 

@@ -10,7 +10,9 @@ A signature's cached sorted score list answers "where would an identical pod
 go" without re-running Score. The hinted node is re-Filtered (cheap, one
 node); while it keeps passing, the whole run of identical pods binds there —
 when it fills up, the hint advances down the list. Entries expire after
-500 ms and on node-shape cluster events.
+500 ms and on node-shape cluster events. Freshness uses time.monotonic():
+a wall-clock jump (NTP step, suspend/resume) must not make entries
+immortal or instantly stale.
 
 TPU note: the device kernel subsumes this for kernel-eligible pods (a wave of
 identical pods is one batched lax.scan — SURVEY.md §2.9.5); this host cache
@@ -33,7 +35,7 @@ EXHAUSTED = "exhausted"
 @dataclass
 class _BatchEntry:
     ordered_nodes: list[str]  # node names, best score first
-    created: float
+    created: float  # time.monotonic() — never wall clock (see module doc)
     next_index: int = 0  # current hint position
 
 
@@ -53,7 +55,7 @@ class BatchCache:
         if entry is None:
             self._record(MISS)
             return False
-        if time.time() - entry.created > self.max_age:
+        if time.monotonic() - entry.created > self.max_age:
             del self.entries[signature]
             self._record(STALE)
             return False
@@ -69,7 +71,7 @@ class BatchCache:
             if entry is None:
                 self._record(MISS)
                 return None
-            if time.time() - entry.created > self.max_age:
+            if time.monotonic() - entry.created > self.max_age:
                 del self.entries[signature]
                 self._record(STALE)
                 return None
@@ -92,7 +94,7 @@ class BatchCache:
         """batch.go StoreScheduleResults:97 — cache the sorted node list from
         a full scoring pass."""
         t0 = time.perf_counter()
-        self.entries[signature] = _BatchEntry(list(ordered_nodes), time.time())
+        self.entries[signature] = _BatchEntry(list(ordered_nodes), time.monotonic())
         if self.metrics is not None:
             self.metrics.store_schedule_results_duration.observe(
                 time.perf_counter() - t0

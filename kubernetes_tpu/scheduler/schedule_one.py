@@ -331,6 +331,10 @@ class ScheduleOneLoop:
         import collections
 
         self._wave_completions: "collections.deque[tuple]" = collections.deque()
+        # correlation tokens for per-wave event aggregation: one token per
+        # bound wave so the recorder can fold the wave's Scheduled spam into
+        # a single aggregate past its spill threshold
+        self._wave_event_seq = 0
 
     def framework_for_pod(self, pod: Pod) -> Framework | None:
         return self.profiles.get(pod.spec.scheduler_name)
@@ -581,6 +585,7 @@ class ScheduleOneLoop:
         t1 = _time.perf_counter()
         prof["kernel"] += t1 - t0
         algo.kernel_count += len(wave)
+        self._export_wave_signatures(algo, fl, planes)
         invalidated = False
         batch: list[tuple] = []
         for qpi, host in zip(wave, hosts):
@@ -633,6 +638,38 @@ class ScheduleOneLoop:
         self._bind_wave(batch)
         prof["bind"] += _time.perf_counter() - t2
         return len(wave)
+
+    def _export_wave_signatures(self, algo, fl, planes) -> None:
+        """Warm the host BatchCache from the kernel's per-signature score
+        rows: each distinct wave signature exports its ordered feasible node
+        list, so long-tail pods that later take the host path ride
+        GetNodeHint (one re-Filter) instead of a full Filter+Score pass —
+        kernel work also feeds OpportunisticBatching's cache."""
+        batch = getattr(algo, "batch", None)
+        sig_scores = fl.info.get("sig_scores")
+        if batch is None or sig_scores is None or fl.sig_ids is None:
+            return
+        import numpy as np
+
+        rows = np.asarray(sig_scores)
+        seen: set[int] = set()
+        for pod, gid in zip(fl.pods, fl.sig_ids):
+            gid = int(gid)
+            if gid in seen:
+                continue
+            seen.add(gid)
+            fw = self.framework_for_pod(pod)
+            signature = fw.sign_pod(pod)
+            if signature is None:
+                continue
+            row = rows[gid]
+            # stable argsort on -score = score-descending, snapshot node
+            # order within ties (matching select_host's ordered list);
+            # -1 rows (infeasible / plane padding) drop out
+            order = np.argsort(-row, kind="stable")
+            names = [planes.node_names[i] for i in order if row[i] >= 0]
+            if names:
+                batch.store_schedule_results(signature, names)
 
     def _poison_successor(self, algo) -> None:
         """Mark the in-flight wave's results unusable and drop the carry —
@@ -703,6 +740,11 @@ class ScheduleOneLoop:
     def _apply_wave_bind_results(self, ready: list[tuple], results, err) -> None:
         from ..store.store import ConflictError
 
+        # one correlation token per wave: a 512-pod wave's Scheduled events
+        # collapse to ~spill-threshold individual events + one aggregate,
+        # instead of one store object per pod
+        self._wave_event_seq += 1
+        corr = f"wave/{self._wave_event_seq}"
         for entry, status in zip(ready, results or ["conflict"] * len(ready)):
             state, fw, qpi, result = entry
             if err is not None or status != "bound":
@@ -718,7 +760,8 @@ class ScheduleOneLoop:
                     state, fw, qpi, result.suggested_host, Status.as_error(e)
                 )
                 continue
-            self._finish_binding(state, fw, qpi, result.suggested_host)
+            self._finish_binding(state, fw, qpi, result.suggested_host,
+                                 correlation=corr)
 
     # -- pod-group (gang) cycle ---------------------------------------------------
 
@@ -1052,7 +1095,8 @@ class ScheduleOneLoop:
 
         self._finish_binding(state, fw, qpi, host)
 
-    def _finish_binding(self, state, fw: Framework, qpi: QueuedPodInfo, host: str) -> None:
+    def _finish_binding(self, state, fw: Framework, qpi: QueuedPodInfo, host: str,
+                        correlation: str | None = None) -> None:
         """Post-bind tail shared by the per-pod cycle and the wave batch."""
         pod = qpi.pod
         fw.run_post_bind_plugins(state, pod, host)
@@ -1063,7 +1107,9 @@ class ScheduleOneLoop:
         if self.metrics is not None:
             self.metrics.pod_scheduled(qpi)
         if self.event_recorder is not None:
-            self.event_recorder.event(pod, "Normal", "Scheduled", f"bound to {host}")
+            self.event_recorder.event(pod, "Normal", "Scheduled",
+                                      f"bound to {host}",
+                                      correlation=correlation)
         _log.v2("Successfully bound pod to node", pod=qpi.key, node=host,
                 evaluatedNodes=getattr(qpi, "evaluated_nodes", None))
         gk = self._group_key(pod)

@@ -187,6 +187,10 @@ class Scheduler:
             self.api_dispatcher = APIDispatcher(parallelism, metrics=metrics)
             self.api_dispatcher.run()
             self.api_cacher = APICacher(store, self.api_dispatcher)
+            # event flushes ride the dispatcher too: maybe_flush enqueues the
+            # store writes for a worker instead of paying them on the
+            # scheduling thread (explicit flush() stays synchronous)
+            self.event_recorder.dispatcher = self.api_dispatcher
 
         # wire handles into stateful plugins
         self.handle = Handle(store, self.cache, self.queue, self.snapshot,
@@ -393,7 +397,10 @@ class Scheduler:
             self._last_leftover_flush = now
             self.queue.flush_unschedulable_leftover()
         if self.event_recorder is not None:
-            self.event_recorder.flush()
+            # cadence-gated (and dispatcher-offloaded when async API calls
+            # are on): the per-iteration cost here is a clock read, not a
+            # store write per buffered event
+            self.event_recorder.maybe_flush()
         if self.metrics is not None and hasattr(self.metrics, "update_queue_gauges"):
             active, backoff, unsched = self.queue.pending_pods()
             self.metrics.update_queue_gauges(active, backoff, unsched)

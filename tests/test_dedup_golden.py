@@ -1,0 +1,196 @@
+"""Golden bit-compat tests for signature-dedup wave scoring (PR 2).
+
+The dedup kernel's contract: grouping a wave's pods by packed feature-row
+bytes and replaying clones from the carried per-signature score row
+produces BYTE-IDENTICAL results to the always-full-pass scan — winners,
+carries, tie-draw consumption, overflow flags, rng stream position, and
+the failure diagnoses of unschedulable clones. These tests pin that
+contract on a mixed interleaved wave whose nodes fill mid-run (so clone
+feasibility genuinely changes between steps of one signature run).
+"""
+
+import random
+
+import numpy as np
+
+from kubernetes_tpu.api.resource import ResourceNames
+from kubernetes_tpu.ops import batched_assign, stack_features
+from kubernetes_tpu.ops.kernels import MAX_TIE_DRAWS, dedup_fast_capable
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.cache.cache import Cache
+from kubernetes_tpu.scheduler.cache.snapshot import Snapshot
+from kubernetes_tpu.scheduler.tpu.backend import (
+    TPUBackend,
+    clone_tie_words,
+    group_feature_rows,
+)
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+def make_cluster(n_nodes=8, cpu="4", mem="8Gi"):
+    names = ResourceNames()
+    cache = Cache(names)
+    for i in range(n_nodes):
+        cache.add_node(
+            make_node(f"n{i}", cpu=cpu, mem=mem, zone=f"z{i % 2}")
+        )
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    return names, cache, snap
+
+
+def mixed_pods(n):
+    """Three signatures, interleaved A B C A B C ... — every clone run is
+    split across other signatures' steps, so the dedup scan must re-enter
+    the cheap tier mid-wave, not just ride one contiguous run."""
+    pods = []
+    for i in range(n):
+        kind = i % 3
+        if kind == 0:
+            pods.append(make_pod(f"a{i:02d}", cpu="1", mem="1Gi",
+                                 labels={"app": "a"}))
+        elif kind == 1:
+            pods.append(make_pod(f"b{i:02d}", cpu="900m", mem="900Mi",
+                                 labels={"app": "b"}))
+        else:
+            pods.append(make_pod(f"c{i:02d}", cpu="800m", mem="800Mi",
+                                 labels={"app": "c"}))
+    return pods
+
+
+class TestKernelGolden:
+    """batched_assign with sig_ids/uniq_idx vs without: every output array
+    byte-equal, including the tie-draw count the backend uses to advance
+    the host rng."""
+
+    def _wave(self, dedup, n_pods=39):
+        # 39 mixed pods demand ~35 cpu on a 32-cpu cluster: the tail of
+        # each clone run fails after its signature's feasible nodes fill
+        names, _, snap = make_cluster(n_nodes=8)
+        backend = TPUBackend(names)
+        pods = mixed_pods(n_pods)
+        for p in pods:
+            backend.extractor.register(p)
+        planes = backend.sync(snap)
+        feats = stack_features(
+            [backend.extractor.features_cached(p, planes) for p in pods]
+        )
+        dev = backend.device_inputs(planes)
+        cfg = backend.kernel_config(planes, feats)
+        tw = clone_tie_words(random.Random(7),
+                             n_pods * MAX_TIE_DRAWS + MAX_TIE_DRAWS)
+        if dedup:
+            sig_ids, uniq = backend._group_wave(feats, n_pods)
+            assert int(sig_ids.max()) + 1 == 3
+            assert dedup_fast_capable(cfg)
+            return batched_assign(cfg, dev, feats, tw,
+                                  sig_ids=sig_ids, uniq_idx=uniq)
+        return batched_assign(cfg, dev, feats, tw)
+
+    def test_mixed_wave_outputs_byte_identical(self):
+        _, info_off = self._wave(dedup=False)
+        _, info_on = self._wave(dedup=True)
+        p_off = np.asarray(info_off["packed"])
+        p_on = np.asarray(info_on["packed"])
+        # packed = winners + tie_consumed + overflow in one array
+        assert np.array_equal(p_off, p_on)
+        winners = p_off[:-2]
+        assert (winners >= 0).any() and (winners < 0).any(), \
+            "scenario must place some pods AND fail some clones"
+        for key in ("used", "nonzero_used", "sel_counts"):
+            assert np.array_equal(np.asarray(info_off[key]),
+                                  np.asarray(info_on[key])), key
+
+    def test_group_feature_rows_first_appearance_order(self):
+        packed = np.array([[1, 2], [3, 4], [1, 2], [5, 6], [3, 4]],
+                          dtype=np.int32)
+        ids, uniq = group_feature_rows(packed)
+        assert ids.tolist() == [0, 1, 0, 2, 1]
+        assert uniq.tolist() == [0, 1, 3]
+
+
+class TestFullPipelineGolden:
+    """Scheduler end-to-end, dedup on vs off: identical bindings, identical
+    PodScheduled failure diagnoses for the clones that no longer fit, and
+    an identical rng stream position afterwards."""
+
+    @staticmethod
+    def _run(dedup):
+        store = Store()
+        for i in range(6):
+            store.create(make_node(f"n{i}", cpu="4", mem="8Gi",
+                                   zone=f"z{i % 2}"))
+        # 30 mixed pods demand 27 cpu on a 24-cpu cluster: nodes fill
+        # mid-run and the last clones of each signature fail
+        for p in mixed_pods(30):
+            store.create(p)
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
+                      seed=11)
+        algo = s.algorithms["default-scheduler"]
+        algo.backend.dedup_enabled = dedup
+        s.start()
+        s.schedule_pending()
+        s.event_recorder.flush()
+        placed = {p.meta.name: p.spec.node_name for p in store.pods()}
+        diags = {}
+        for p in store.pods():
+            for c in p.status.conditions:
+                if c.type == "PodScheduled" and c.status == "False":
+                    diags[p.meta.name] = f"{c.reason}: {c.message}"
+        rng_state = algo.rng.getstate() if algo.rng is not None else None
+        stats = dict(algo.backend.dedup_stats)
+        return placed, diags, rng_state, stats
+
+    def test_dedup_on_off_schedule_identically(self):
+        placed_off, diags_off, rng_off, stats_off = self._run(dedup=False)
+        placed_on, diags_on, rng_on, stats_on = self._run(dedup=True)
+        assert placed_on == placed_off
+        assert diags_on == diags_off
+        assert rng_on == rng_off
+        # the scenario must exercise both outcomes
+        assert sum(1 for v in placed_on.values() if v) > 0
+        assert diags_on, "some clones must fail with a diagnosis"
+        assert any("Insufficient" in d for d in diags_on.values())
+        # and dedup must have actually grouped (not silently disabled)
+        assert stats_off["waves"] == 0
+        assert stats_on["waves"] > 0
+        assert 0 < stats_on["signatures"] < stats_on["pods"]
+
+
+class TestBatchCacheExport:
+    def test_wave_exports_per_signature_node_hints(self):
+        """With OpportunisticBatching on, a completed wave exports each
+        signature's score-ordered node list into the host BatchCache — the
+        long-tail fallback pods then get hints without a scoring pass."""
+        store = Store()
+        node_names = set()
+        for i in range(4):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi",
+                                   zone=f"z{i % 2}"))
+            node_names.add(f"n{i}")
+        pods = mixed_pods(12)
+        for p in pods:
+            store.create(p)
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=6)],
+                      feature_gates={"OpportunisticBatching": True}, seed=3)
+        s.start()
+        s.schedule_pending()
+        assert s.batch_cache is not None
+        assert s.batch_cache.entries, "wave must export signature hints"
+        fw = s.frameworks["default-scheduler"]
+        sig = fw.sign_pod(pods[0])
+        assert sig is not None and sig in s.batch_cache.entries
+        for entry in s.batch_cache.entries.values():
+            assert entry.ordered_nodes
+            assert set(entry.ordered_nodes) <= node_names
+
+    def test_no_export_without_gate(self):
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        for p in mixed_pods(6):
+            store.create(p)
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=6)])
+        s.start()
+        s.schedule_pending()
+        assert s.batch_cache is None

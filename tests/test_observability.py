@@ -343,6 +343,83 @@ class TestSpanTracing:
             server.shutdown()
 
 
+class TestWaveEventCorrelation:
+    """Per-wave correlation aggregation (PR 2): a wave's Scheduled events
+    past the spill threshold collapse into one aggregate object, so a
+    512-pod wave writes ~11 store objects, not 512."""
+
+    def _recorder(self):
+        from kubernetes_tpu.scheduler.events import EventRecorder
+
+        store = Store()
+        return store, EventRecorder(store)
+
+    def test_correlated_events_spill_into_aggregate(self):
+        store, rec = self._recorder()
+        n = 25
+        for i in range(n):
+            pod = make_pod(f"p{i:02d}")
+            rec.event(pod, "Normal", "Scheduled", f"bound to n{i}",
+                      correlation="wave/1")
+        rec.flush()
+        events, _ = store.list("Event")
+        scheduled = [e for e in events if e.reason == "Scheduled"]
+        agg = [e for e in scheduled
+               if "(combined from similar events)" in e.message]
+        spill = rec.AGGREGATE_SPILL
+        assert len(agg) == 1
+        assert agg[0].count == n - spill
+        assert agg[0].involved_object == "wave/1"
+        assert len(scheduled) == spill + 1  # individuals + one aggregate
+
+    def test_uncorrelated_events_stay_individual(self):
+        store, rec = self._recorder()
+        for i in range(15):
+            rec.event(make_pod(f"q{i:02d}"), "Normal", "Scheduled",
+                      f"bound to n{i}")
+        rec.flush()
+        events, _ = store.list("Event")
+        assert len([e for e in events if e.reason == "Scheduled"]) == 15
+
+    def test_correlation_counters_reset_at_flush(self):
+        # a NEW wave (new token) after a flush starts a fresh window
+        store, rec = self._recorder()
+        for i in range(rec.AGGREGATE_SPILL):
+            rec.event(make_pod(f"r{i:02d}"), "Normal", "Scheduled",
+                      f"bound to n{i}", correlation="wave/1")
+        rec.flush()
+        for i in range(rec.AGGREGATE_SPILL):
+            rec.event(make_pod(f"s{i:02d}"), "Normal", "Scheduled",
+                      f"bound to n{i}", correlation="wave/2")
+        rec.flush()
+        events, _ = store.list("Event")
+        scheduled = [e for e in events if e.reason == "Scheduled"]
+        assert len(scheduled) == 2 * rec.AGGREGATE_SPILL
+        assert not any("(combined" in e.message for e in scheduled)
+
+    def test_maybe_flush_cadence_gated(self):
+        store, rec = self._recorder()
+        rec.event(make_pod("m0"), "Normal", "Scheduled", "bound to n0")
+        assert rec.maybe_flush() == 1  # first call flushes immediately
+        rec.event(make_pod("m1"), "Normal", "Scheduled", "bound to n1")
+        assert rec.maybe_flush() == 0  # within the cadence window: deferred
+        assert rec.flush() == 1  # explicit flush stays synchronous
+        events, _ = store.list("Event")
+        assert len(events) == 2
+
+    def test_maybe_flush_routes_through_dispatcher(self):
+        from kubernetes_tpu.scheduler.api_dispatcher import APIDispatcher
+
+        store, rec = self._recorder()
+        dispatcher = APIDispatcher(parallelism=2)
+        rec.dispatcher = dispatcher
+        rec.event(make_pod("d0"), "Normal", "Scheduled", "bound to n0")
+        assert rec.maybe_flush() == 0  # enqueued, not written inline
+        dispatcher.drain()
+        events, _ = store.list("Event")
+        assert len(events) == 1
+
+
 def test_event_recorder_over_rest_store():
     """The recorder must work against the REST facade too: Event is a
     registered wire kind, creates land, repeats aggregate, gc no-ops

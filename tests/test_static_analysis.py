@@ -15,6 +15,7 @@ from kubernetes_tpu.analysis import (
     JitPurityChecker,
     LockDisciplineChecker,
     RegistrySyncChecker,
+    SignatureSyncChecker,
     SnapshotImmutabilityChecker,
     check_file,
     known_rules,
@@ -537,6 +538,87 @@ class TestRegistrySync:
         assert "REG02" in rules(fs)
 
 
+# ------------------------------------------------------------------ SIG01
+
+
+SIGN_PLUGIN_SRC = """\
+class Covered:
+    name = "CoveredPlugin"
+
+    def sign(self, pod):
+        return ",".join(str(p) for p in pod.ports)
+"""
+
+
+def write_sig_tree(root, filter_names, plugin=SIGN_PLUGIN_SRC):
+    (root / "ops").mkdir(parents=True, exist_ok=True)
+    (root / "ops/kernels.py").write_text(
+        f"FILTER_NAMES = {filter_names!r}\n"
+    )
+    p = root / "scheduler/plugins/fixture_plugin.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(plugin)
+    return root
+
+
+class TestSignatureSync:
+    def test_clock_in_sign_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import time
+
+            class MyPlugin:
+                name = "MyPlugin"
+
+                def sign(self, pod):
+                    return f"{pod.name}@{time.monotonic()}"
+        """, name="scheduler/plugins/myplugin.py")
+        assert rules(fs) == ["SIG01"]
+        assert "time.monotonic" in fs[0].message
+
+    def test_hash_and_random_in_sign_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import random
+
+            class MyPlugin:
+                name = "MyPlugin"
+
+                def sign(self, pod):
+                    return str(hash(pod.labels)) + str(random.random())
+        """, name="scheduler/plugins/myplugin.py")
+        assert rules(fs) == ["SIG01", "SIG01"]
+
+    def test_pure_fragment_ok(self, tmp_path):
+        fs = lint(tmp_path, SIGN_PLUGIN_SRC,
+                  name="scheduler/plugins/fixture_plugin.py")
+        assert fs == []
+
+    def test_sign_outside_plugin_modules_ok(self, tmp_path):
+        # a sign() method in unrelated code is not a fragment
+        fs = lint(tmp_path, """
+            import time
+
+            class Ledger:
+                def sign(self, doc):
+                    return time.time()
+        """, name="billing/ledger.py")
+        assert fs == []
+
+    def test_uncovered_filter_row_flagged(self, tmp_path):
+        write_sig_tree(tmp_path, ("CoveredPlugin", "UncoveredPlugin"))
+        fs = list(SignatureSyncChecker().check_project(tmp_path))
+        assert rules(fs) == ["SIG01"]
+        assert "UncoveredPlugin" in fs[0].message
+
+    def test_exempt_row_ok(self, tmp_path):
+        # NodeUnschedulable / NodeName carry written justifications
+        write_sig_tree(tmp_path,
+                       ("NodeUnschedulable", "NodeName", "CoveredPlugin"))
+        assert list(SignatureSyncChecker().check_project(tmp_path)) == []
+
+    def test_partial_tree_is_silent(self, tmp_path):
+        assert list(SignatureSyncChecker().check_project(tmp_path)) == []
+
+
 # ----------------------------------------------------------- suppressions
 
 
@@ -600,7 +682,7 @@ class TestCli:
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rule in ("JIT01", "JIT02", "JIT03", "JIT04", "LOCK01", "LOCK02",
-                     "LOCK03", "SNAP01", "REG01", "REG02", "LINT00"):
+                     "LOCK03", "SNAP01", "REG01", "REG02", "SIG01", "LINT00"):
             assert rule in out
 
     def test_rule_ids_documented_in_readme(self):
