@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test
+.PHONY: lint test obs
 
 # kubesched-lint: AST invariant checker (rule IDs in README "Invariants");
 # exits non-zero on any unsuppressed finding
@@ -11,3 +11,9 @@ lint:
 
 test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# flight-recorder CLI smoke: synthetic multi-wave run (no device, no jax),
+# exercises ring buffer + watchdog + post-mortem formatting
+obs:
+	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --demo
+	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --schema

@@ -137,14 +137,21 @@ def main() -> None:
             "error": f"only {result.scheduled}/{expected} pods scheduled",
         }))
         sys.exit(1)
-    # phase profile for the MEASURED span only (start→stop snapshot deltas),
-    # plus wall-coverage accounting: wall = first→last bind timestamp; the
-    # sum of attributed phases + async-dispatcher busy time over that span
-    # must explain ≥95% of it or the profile is lying (round-4 weak #3)
+    # phase profile for the MEASURED span only (start→stop snapshot deltas
+    # of the wave flight recorder's stopwatches — the harness snapshots are
+    # recorder-sourced, bench.py owns no timers), plus wall-coverage
+    # accounting: wall = first→last bind timestamp; the sum of attributed
+    # phases + async-dispatcher busy time over that span must explain ≥95%
+    # of it or the profile is lying (round-4 weak #3)
+    recorder = executor.scheduler.flight_recorder
     prof_start = getattr(executor, "profile_at_start", {})
     prof_stop = getattr(executor, "profile_at_stop",
-                        executor.scheduler.loop.phase_profile)
+                        recorder.phase_snapshot())
     prof = {k: v - prof_start.get(k, 0) for k, v in prof_stop.items()}
+    wave_start = getattr(executor, "wave_profile_at_start", {})
+    wave_stop = getattr(executor, "wave_profile_at_stop",
+                        recorder.wave_snapshot())
+    wave_prof = {k: v - wave_start.get(k, 0) for k, v in wave_stop.items()}
     async_exec = (getattr(executor, "exec_seconds_at_stop", 0.0)
                   - getattr(executor, "exec_seconds_at_start", 0.0))
     times = sorted(executor.collector.bind_times.values())
@@ -197,10 +204,14 @@ def main() -> None:
             for k, v in prof.items()
         },
         # where the "kernel" phase actually goes: host prep (sync/features/
-        # tie), dispatch, device wait, full re-uploads
+        # tie), dispatch, device wait, full re-uploads — recorder-sourced,
+        # measured span only
         "wave_profile_s": {
-            k: round(v, 2) for k, v in algo.backend.perf.items()
+            k: round(v, 2) for k, v in wave_prof.items()
         },
+        # per-wave flight records (ring buffer): post-mortems via
+        # `python -m kubernetes_tpu.scheduler.tpu.flightrecorder`
+        "flight": recorder.summary(),
     }
     if fallback_reason:
         line["fallback_reason"] = fallback_reason

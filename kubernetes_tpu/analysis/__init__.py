@@ -3,8 +3,9 @@
 Mechanically enforces the contracts the paper's bit-compat claim rests on:
 jit purity (JIT01-JIT04), lock discipline in the threaded scheduler modules
 (LOCK01-LOCK03), snapshot immutability outside the cache layer (SNAP01),
-kernel/registry constant sync (REG01-REG02), and signature-fragment
-purity/coverage for the batching hint path (SIG01).
+kernel/registry constant sync (REG01-REG02), signature-fragment
+purity/coverage for the batching hint path (SIG01), and host-side-only
+telemetry — no recorder/tracer/metrics calls inside traced code (OBS01).
 
 CLI: `python -m kubernetes_tpu.analysis [paths]` (exit 1 on findings);
 suppress a single line with `# kubesched-lint: disable=RULE`.
@@ -22,6 +23,7 @@ from .core import (
 )
 from .jit_purity import JitPurityChecker
 from .lock_discipline import LockDisciplineChecker
+from .obs_purity import ObservabilityPurityChecker
 from .registry_sync import RegistrySyncChecker
 from .signature_sync import SignatureSyncChecker
 from .snapshot_immutability import SnapshotImmutabilityChecker
@@ -32,6 +34,7 @@ __all__ = [
     "JitPurityChecker",
     "LockDisciplineChecker",
     "ModuleContext",
+    "ObservabilityPurityChecker",
     "ProjectChecker",
     "RegistrySyncChecker",
     "SignatureSyncChecker",

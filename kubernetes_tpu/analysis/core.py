@@ -107,6 +107,7 @@ class ProjectChecker(Checker):
 def default_checkers() -> list[Checker]:
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
+    from .obs_purity import ObservabilityPurityChecker
     from .registry_sync import RegistrySyncChecker
     from .signature_sync import SignatureSyncChecker
     from .snapshot_immutability import SnapshotImmutabilityChecker
@@ -117,6 +118,7 @@ def default_checkers() -> list[Checker]:
         SnapshotImmutabilityChecker(),
         RegistrySyncChecker(),
         SignatureSyncChecker(),
+        ObservabilityPurityChecker(),
     ]
 
 

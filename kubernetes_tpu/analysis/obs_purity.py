@@ -1,0 +1,71 @@
+"""OBS01: telemetry stays host-side — never inside a jit/vmap/pmap graph.
+
+The wave flight recorder's contract (scheduler/tpu/flightrecorder.py) is
+that recording happens post-`collect`, on the host: a recorder/tracer/
+metrics call inside a traced function would either fail at trace time
+(locks, deques, perf_counter aren't traceable) or — worse — run once at
+trace time and silently freeze a single observation into the compiled
+program, while also perturbing the traced op sequence the bit-compat
+goldens pin. This rule walks the same traced-function closure JIT01-JIT03
+use (jit/vmap/pmap roots + referenced helpers + nested defs) and flags any
+call whose dotted name touches a telemetry surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, ModuleContext
+from .jit_purity import _collect_traced, _dotted, _TracedFn
+
+OBS01 = "OBS01"
+
+# dotted-name segments (lowercased) that identify a telemetry surface:
+# recorder/tracer objects, span helpers, metrics facades, profile capture
+TELEMETRY_SEGMENTS = {
+    "recorder", "flight_recorder", "flightrecorder", "tracer", "metrics",
+    "span", "wave_phase", "begin_wave", "end_wave", "take_profile", "pprof",
+}
+
+
+class ObservabilityPurityChecker(Checker):
+    rules = {
+        OBS01: "telemetry/recorder call inside a jit/vmap/pmap call graph "
+               "(flight recording is host-side only, post-collect)",
+    }
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for t in _collect_traced(ctx.tree):
+            findings.extend(self._check_traced_body(ctx, t))
+        return findings
+
+    def _check_traced_body(
+        self, ctx: ModuleContext, t: _TracedFn
+    ) -> Iterable[Finding]:
+        fname = t.fn.name
+
+        def walk(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                # nested defs get their own _TracedFn pass (jit_purity
+                # collects them as separate traced functions)
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                yield child
+                yield from walk(child)
+
+        for node in walk(t.fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            segments = {seg.lower() for seg in d.split(".")}
+            hit = segments & TELEMETRY_SEGMENTS
+            if hit:
+                yield Finding(
+                    ctx.posix_path, node.lineno, node.col_offset, OBS01,
+                    f"telemetry call {d}() inside traced function {fname!r} "
+                    "(recording is host-side only — move it after collect)",
+                )

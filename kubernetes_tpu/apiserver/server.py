@@ -179,16 +179,20 @@ class AdmissionError(Exception):
 class APIServer:
     def __init__(self, store: Store, admission: list[AdmissionFn] | None = None,
                  authenticator=None, authorizer=None, tracer=None,
-                 audit: AuditLog | None = None):
+                 audit: AuditLog | None = None, metrics=None):
         """authenticator/authorizer None = the chain stage is skipped
         (insecure localhost serving, the in-tree trust model); passing a
         TokenAuthenticator + RBACAuthorizer (apiserver/auth.py) turns on
         the generic server's authn→authz handler-chain stages. tracer (a
         utils.tracing.Tracer) emits one span per request — the request-
         filter spans of component-base/tracing. Every API request is
-        audit-logged (who/verb/resource/outcome) to `audit`."""
+        audit-logged (who/verb/resource/outcome) to `audit`. metrics (a
+        utils.metrics.Registry or any object with expose()) serves its text
+        exposition at /metrics next to /debug/pprof/profile — the
+        routes.DefaultMetrics + routes.Profiling debug surface."""
         self.store = store
         self.tracer = tracer
+        self.metrics = metrics
         self.audit = audit or AuditLog()
         self.admission = list(admission or [])
         self.authenticator = authenticator
@@ -459,9 +463,39 @@ class APIServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _send_text(self, code: int, text: str,
+                           ctype: str = "text/plain") -> None:
+                data = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):
                 if self.path == "/healthz" or self.path == "/readyz":
                     self._send_json(200, {"status": "ok"})
+                    return
+                # debug routes (routes.DefaultMetrics / routes.Profiling{}
+                # .Install): text exposition + on-demand sampling profile
+                if self.path == "/metrics":
+                    if server.metrics is None:
+                        self._error(404, "NotFound", "no metrics registry")
+                        return
+                    self._send_text(200, server.metrics.expose(),
+                                    "text/plain; version=0.0.4")
+                    return
+                if self.path.split("?")[0] == "/debug/pprof/profile":
+                    from ..utils.pprof import take_profile
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        secs = min(float(q.get("seconds", ["1"])[0]), 30.0)
+                    except ValueError:
+                        self._error(400, "BadRequest",
+                                    "seconds must be a number")
+                        return
+                    self._send_text(200, take_profile(seconds=secs))
                     return
                 if self.path == "/apis" or self.path.startswith("/apis/"):
                     self._handle_aggregated()

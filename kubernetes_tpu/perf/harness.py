@@ -592,8 +592,12 @@ class WorkloadExecutor:
     def _start_collecting(self) -> None:
         self._collecting = True
         # snapshot phase/exec counters so the bench can attribute the
-        # MEASURED span alone (init-phase costs excluded)
-        self.profile_at_start = dict(self.scheduler.loop.phase_profile)
+        # MEASURED span alone (init-phase costs excluded); the flight
+        # recorder owns the stopwatches (loop.phase_profile aliases its
+        # phase_totals), so these snapshots ARE recorder-sourced
+        rec = self.scheduler.flight_recorder
+        self.profile_at_start = rec.phase_snapshot()
+        self.wave_profile_at_start = rec.wave_snapshot()
         d = self.scheduler.api_dispatcher
         self.exec_seconds_at_start = d.exec_seconds if d is not None else 0.0
         self.collect_started_at = time.perf_counter()
@@ -603,7 +607,9 @@ class WorkloadExecutor:
         self._collecting = False
         # end-of-measurement snapshot (pairs with _start_collecting's):
         # profile deltas must cover the same span the wall clock does
-        self.profile_at_stop = dict(self.scheduler.loop.phase_profile)
+        rec = self.scheduler.flight_recorder
+        self.profile_at_stop = rec.phase_snapshot()
+        self.wave_profile_at_stop = rec.wave_snapshot()
         d = self.scheduler.api_dispatcher
         self.exec_seconds_at_stop = d.exec_seconds if d is not None else 0.0
         self.collect_stopped_at = time.perf_counter()

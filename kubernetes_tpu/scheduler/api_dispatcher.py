@@ -55,9 +55,10 @@ class APICall:
 class APIDispatcher:
     """Queue + workers (api_dispatcher.go APIDispatcher)."""
 
-    def __init__(self, parallelism: int = 16, metrics=None):
+    def __init__(self, parallelism: int = 16, metrics=None, tracer=None):
         self.parallelism = parallelism
         self.metrics = metrics
+        self.tracer = tracer  # optional utils.tracing.Tracer: span per call
         self._queued: dict[str, APICall] = {}  # object key -> pending call
         self._inflight: set[str] = set()  # keys a worker is executing now
         self._order: _queue.Queue = _queue.Queue()
@@ -197,7 +198,14 @@ class APIDispatcher:
         err: Exception | None = None
         t0 = time.perf_counter()
         try:
-            call.execute()
+            if self.tracer is not None:
+                # worker threads get their own span stacks (thread-local),
+                # so each api/<type> call exports as its own root span
+                with self.tracer.span(f"api/{call.call_type}",
+                                      object_key=call.object_key):
+                    call.execute()
+            else:
+                call.execute()
         except Exception as e:  # noqa: BLE001 - surfaced via on_finish
             err = e
         finally:

@@ -69,6 +69,9 @@ class EventRecorder:
         # optional APIDispatcher: maybe_flush routes the store writes
         # through its workers so the scheduling thread never pays them
         self.dispatcher = None
+        # optional SchedulerMetrics: aggregation/spill/GC were previously
+        # silent — with a metrics facade wired, every disposition is counted
+        self.metrics = None
         self._flush_seq = 0
         self._last_flush = float("-inf")  # monotonic
         self._last_gc = time.monotonic()
@@ -126,6 +129,8 @@ class EventRecorder:
                     reporting_controller=self.component,
                 )
             flush_now = len(self._pending) >= self._max_buffer
+        if self.metrics is not None and hasattr(self.metrics, "event_recorded"):
+            self.metrics.event_recorded(aggregated)
         if flush_now:
             self.flush()
 
@@ -185,10 +190,12 @@ class EventRecorder:
             self._gc()
         return n
 
-    def _gc(self) -> None:
+    def _gc(self) -> int:
         """Expire stored events past the TTL — the store has no apiserver
-        event TTL, so unbounded churny runs would otherwise leak objects."""
+        event TTL, so unbounded churny runs would otherwise leak objects.
+        Returns how many series it pruned (previously discarded silently)."""
         cutoff = time.time() - self.EVENT_TTL_S
+        pruned = 0
         try:
             # read-only scan (list_refs): a deepcopying list() here grew
             # O(stored-events) per sweep and dominated event-write cost at
@@ -201,5 +208,9 @@ class EventRecorder:
                        if ev.last_timestamp < cutoff]
             for key in expired:
                 self.store.delete("Event", key)
+                pruned += 1
         except Exception:  # noqa: BLE001
             pass
+        if self.metrics is not None and hasattr(self.metrics, "events_pruned"):
+            self.metrics.events_pruned(pruned)
+        return pruned

@@ -124,7 +124,52 @@ class SchedulerMetrics:
             "Pods scheduled by the device kernel vs host fallback",
             labels=("path",),
         )
+        # wave flight recorder (new: per-wave telemetry, README "Observability")
+        self.wave_phase_duration = r.histogram(
+            "scheduler_tpu_wave_phase_duration_seconds",
+            "Batched-wave latency by pipeline phase",
+            labels=("phase",),
+        )
+        self.wave_duration = r.histogram(
+            "scheduler_tpu_wave_duration_seconds",
+            "End-to-end batched-wave latency (launch to bind)",
+        )
+        self.wave_dedup_ratio = r.gauge(
+            "scheduler_tpu_wave_dedup_ratio",
+            "distinct_signature_ratio of the most recent deduped wave",
+        )
+        self.signature_cache_hits = r.counter(
+            "scheduler_tpu_signature_cache_hits_total",
+            "Pods that rode a duplicate signature instead of a full score pass",
+        )
+        self.wave_fallbacks = r.counter(
+            "scheduler_tpu_wave_fallbacks_total",
+            "Waves that fell back to per-pod host scheduling, by reason",
+            labels=("reason",),
+        )
+        self.slow_wave_captures_total = r.counter(
+            "scheduler_tpu_slow_wave_captures_total",
+            "Watchdog profile captures of waves exceeding their deadline",
+        )
+        self.sli_quantiles = r.gauge(
+            "scheduler_pod_scheduling_sli_quantile_seconds",
+            "Recorded p50/p99 of pod scheduling SLI duration",
+            labels=("quantile",), stability="BETA",
+        )
+        # event recorder (satellite: spill/aggregation visibility)
+        self.events_total = r.counter(
+            "scheduler_events_total",
+            "Events emitted, by disposition (recorded|aggregated)",
+            labels=("disposition",),
+        )
+        self.events_gc_pruned = r.counter(
+            "scheduler_events_gc_pruned_total",
+            "Event correlation series pruned by TTL garbage collection",
+        )
         self._first_attempt: dict[str, float] = {}
+        # exact SLI samples for the recorded-quantile gauges (bounded window;
+        # the histogram's bucket interpolation is too coarse for a p99 SLO)
+        self._sli_samples: list[float] = []
         self._attempt_counts: dict[str, int] = {}
         # plugin -> currently-unschedulable pod keys (true gauge semantics)
         self._unsched_by_plugin: dict[str, set[str]] = {}
@@ -152,9 +197,11 @@ class SchedulerMetrics:
         self.schedule_attempts.inc(SCHEDULED, self.profile)
         self.pod_scheduling_attempts.observe(attempts)
         if start is not None:
-            self.pod_scheduling_sli_duration.observe(
-                time.time() - start, str(min(attempts, 16))
-            )
+            sli = time.time() - start
+            self.pod_scheduling_sli_duration.observe(sli, str(min(attempts, 16)))
+            self._sli_samples.append(sli)
+            if len(self._sli_samples) > 4096:
+                del self._sli_samples[:2048]
         self._clear_unschedulable(key)
 
     def pod_unschedulable(self, qpi) -> None:
@@ -195,6 +242,46 @@ class SchedulerMetrics:
         self.cache_size.set(nodes, "nodes")
         self.cache_size.set(pods, "pods")
         self.cache_size.set(assumed, "assumed_pods")
+
+    # -- wave flight recorder call sites -------------------------------------
+
+    def observe_wave_phase(self, phase: str, seconds: float) -> None:
+        self.wave_phase_duration.observe(seconds, phase)
+
+    def wave_completed(self, record) -> None:
+        """Land a finished WaveRecord's series (flightrecorder.end_wave)."""
+        self.wave_duration.observe(record.duration_s)
+        for phase, seconds in record.phases.items():
+            self.wave_phase_duration.observe(seconds, phase)
+        if record.distinct_signature_ratio is not None:
+            self.wave_dedup_ratio.set(record.distinct_signature_ratio)
+        if record.clones:
+            self.signature_cache_hits.inc(by=record.clones)
+        if record.fallback_reason:
+            # reason cardinality is bounded: strip per-wave detail after ':'
+            self.wave_fallbacks.inc(record.fallback_reason.split(":")[0])
+
+    def slow_wave_captured(self) -> None:
+        self.slow_wave_captures_total.inc()
+
+    def update_sli_quantiles(self) -> None:
+        """Record exact p50/p99 over the recent-sample window (the SLO the
+        bench gates on; cheap — called once per wave, not per pod)."""
+        samples = sorted(self._sli_samples)
+        if not samples:
+            return
+        n = len(samples)
+        self.sli_quantiles.set(samples[min(n - 1, int(0.50 * n))], "p50")
+        self.sli_quantiles.set(samples[min(n - 1, int(0.99 * n))], "p99")
+
+    # -- event recorder call sites -------------------------------------------
+
+    def event_recorded(self, aggregated: bool) -> None:
+        self.events_total.inc("aggregated" if aggregated else "recorded")
+
+    def events_pruned(self, n: int) -> None:
+        if n:
+            self.events_gc_pruned.inc(by=n)
 
     def expose(self) -> str:
         return self.registry.expose()

@@ -295,8 +295,12 @@ class ScheduleOneLoop:
         names=None,
         api_cacher=None,
         pod_group_cycles: bool = True,
+        recorder=None,
     ):
         from ..api.resource import ResourceNames
+        # lazy: the tpu package import pulls in the backend (which imports
+        # this module); the recorder module itself is dependency-free
+        from .tpu.flightrecorder import FlightRecorder
 
         self.names = names or ResourceNames()
         self.cache = cache
@@ -313,12 +317,15 @@ class ScheduleOneLoop:
         self._binding_threads: list = []
         # wall-clock seconds per pipeline phase (batched wave path), reported
         # by bench.py — the in-process analogue of the reference's
-        # FrameworkExtensionPointDuration histograms (metrics.go:340)
-        self.phase_profile = {
-            "snapshot": 0.0, "kernel": 0.0, "finish": 0.0, "bind": 0.0,
-            "pump": 0.0, "events": 0.0, "pop": 0.0, "harness": 0.0,
-            "drain": 0.0, "waves": 0,
-        }
+        # FrameworkExtensionPointDuration histograms (metrics.go:340).
+        # The wave flight recorder owns the stopwatches; phase_profile
+        # aliases its phase_totals dict (same object), so the harness's
+        # snapshot-delta protocol and direct accumulation sites both read
+        # and write recorder-sourced numbers.
+        self.recorder = recorder if recorder is not None else FlightRecorder(
+            metrics=metrics
+        )
+        self.phase_profile = self.recorder.phase_totals
         # the launched-but-unprocessed batched wave: (algo, InflightWave).
         # While its kernel runs on device, the host processes the PREVIOUS
         # wave's results — the TPU-native form of the reference's
@@ -431,39 +438,41 @@ class ScheduleOneLoop:
         Returns the number of pods processed (0 = queue empty)."""
         from .tpu.backend import TPUSchedulingAlgorithm
 
-        t_pop = _time.perf_counter()
         wave: list[QueuedPodInfo] = []
         wave_algo = None
         trailer: QueuedPodInfo | None = None
-        while len(wave) < max_pods:
-            qpi = self.queue.pop(timeout=timeout if not wave and not trailer else 0.0)
-            if qpi is None:
-                break
-            pod = qpi.pod
-            fw = self.framework_for_pod(pod)
-            if fw is None:
-                self.queue.done(qpi.key, qpi.inflight_token)
-                continue
-            if self._skip_pod_schedule(fw, pod):
-                self.queue.done(qpi.key, qpi.inflight_token)
-                continue
-            algo = self.algorithms.get(fw.profile_name)
-            # ORDER MATTERS: wave_eligible has side effects for claim pods
-            # (binder assume + plan stash), so every other precondition —
-            # including the same-profile check — must pass first, or a
-            # trailer pod would leak an assumed PV with no revert path
-            eligible = (
-                isinstance(algo, TPUSchedulingAlgorithm)
-                and pod.spec.scheduling_group is None
-                and (wave_algo is None or algo is wave_algo)
-                and algo.wave_eligible(pod)
-            )
-            if not eligible:
-                trailer = qpi
-                break
-            wave_algo = algo
-            wave.append(qpi)
-        self.phase_profile["pop"] += _time.perf_counter() - t_pop
+        with self.recorder.phase("pop"):
+            while len(wave) < max_pods:
+                qpi = self.queue.pop(
+                    timeout=timeout if not wave and not trailer else 0.0
+                )
+                if qpi is None:
+                    break
+                pod = qpi.pod
+                fw = self.framework_for_pod(pod)
+                if fw is None:
+                    self.queue.done(qpi.key, qpi.inflight_token)
+                    continue
+                if self._skip_pod_schedule(fw, pod):
+                    self.queue.done(qpi.key, qpi.inflight_token)
+                    continue
+                algo = self.algorithms.get(fw.profile_name)
+                # ORDER MATTERS: wave_eligible has side effects for claim
+                # pods (binder assume + plan stash), so every other
+                # precondition — including the same-profile check — must
+                # pass first, or a trailer pod would leak an assumed PV with
+                # no revert path
+                eligible = (
+                    isinstance(algo, TPUSchedulingAlgorithm)
+                    and pod.spec.scheduling_group is None
+                    and (wave_algo is None or algo is wave_algo)
+                    and algo.wave_eligible(pod)
+                )
+                if not eligible:
+                    trailer = qpi
+                    break
+                wave_algo = algo
+                wave.append(qpi)
 
         if not wave:
             processed = self._flush_wave_pipeline()
@@ -496,7 +505,6 @@ class ScheduleOneLoop:
         from ..ops import FallbackNeeded
         from .tpu.backend import NeedResync
 
-        prof = self.phase_profile
         processed = self._drain_wave_completions()
         infl = self._inflight_wave
         if infl is not None and (
@@ -507,45 +515,39 @@ class ScheduleOneLoop:
             # or a poisoned carry): drain before launching
             processed += self._flush_wave_pipeline()
 
-        t0 = _time.perf_counter()
-        self.cache.update_snapshot(self.snapshot)
-        prof["snapshot"] += _time.perf_counter() - t0
+        with self.recorder.phase("snapshot"):
+            self.cache.update_snapshot(self.snapshot)
         pods = [qpi.pod for qpi in wave]
         fl = None
         for attempt in (0, 1):
-            t1 = _time.perf_counter()
             try:
-                fl = algo.backend.launch_batched(
-                    pods, self.snapshot, rng=algo.rng, pad_to=pad_to
-                )
-                prof["kernel"] += _time.perf_counter() - t1
+                with self.recorder.phase("kernel"):
+                    fl = algo.backend.launch_batched(
+                        pods, self.snapshot, rng=algo.rng, pad_to=pad_to
+                    )
                 break
             except NeedResync:
-                prof["kernel"] += _time.perf_counter() - t1
                 # drain the pipeline (its phases self-account), re-upload
                 # from host truth, retry once
                 processed += self._flush_wave_pipeline()
                 algo.backend.invalidate_carry()
-                t0 = _time.perf_counter()
-                self.cache.update_snapshot(self.snapshot)
-                prof["snapshot"] += _time.perf_counter() - t0
+                with self.recorder.phase("snapshot"):
+                    self.cache.update_snapshot(self.snapshot)
             except FallbackNeeded:
-                prof["kernel"] += _time.perf_counter() - t1
                 break
         if fl is None:
             # not kernelizable (stale vocab etc.): strict queue order —
             # whatever is in flight precedes these pods
             processed += self._flush_wave_pipeline()
             algo.fallback_count += len(wave)
-            t3 = _time.perf_counter()
-            for qpi in wave:
-                algo.revert_wave_plan(qpi.pod)
-                self.schedule_pod_info(qpi)
-            prof["finish"] += _time.perf_counter() - t3
+            with self.recorder.phase("finish"):
+                for qpi in wave:
+                    algo.revert_wave_plan(qpi.pod)
+                    self.schedule_pod_info(qpi)
             return processed + len(wave)
         fl.qpis = wave
         prev, self._inflight_wave = self._inflight_wave, (algo, fl)
-        prof["waves"] += 1
+        self.recorder.count_wave()
         if prev is not None:
             processed += self._complete_wave(*prev)
         return processed
@@ -564,95 +566,113 @@ class ScheduleOneLoop:
         batched binding (the host half of the pipeline)."""
         from ..ops import FallbackNeeded
 
-        prof = self.phase_profile
+        rec = self.recorder
         wave = fl.qpis
-        t0 = _time.perf_counter()
-        try:
-            hosts, planes = algo.backend.collect(fl, rng=algo.rng)
-        except FallbackNeeded:
-            # tie-draw overflow or poisoned carry: results discarded, pods
-            # re-run per-pod against live state; a successor launched on the
-            # bad carry is poisoned too
-            prof["kernel"] += _time.perf_counter() - t0
-            self._poison_successor(algo)
-            algo.fallback_count += len(wave)
-            t1 = _time.perf_counter()
-            for qpi in wave:
-                algo.revert_wave_plan(qpi.pod)
-                self.schedule_pod_info(qpi)
-            prof["finish"] += _time.perf_counter() - t1
-            return len(wave)
-        t1 = _time.perf_counter()
-        prof["kernel"] += t1 - t0
-        algo.kernel_count += len(wave)
-        self._export_wave_signatures(algo, fl, planes)
-        invalidated = False
-        batch: list[tuple] = []
-        for qpi, host in zip(wave, hosts):
-            if invalidated or host is None:
-                # host=None re-runs reproduce the FitError (no rng draws, no
-                # state change — safe under a live successor); invalidated
-                # pods re-run because the carry diverged
-                algo.revert_wave_plan(qpi.pod)
-                self.schedule_pod_info(qpi)
-                continue
-            fw = self.framework_for_pod(qpi.pod)
-            state = CycleState()
-            vol_plan = algo.take_wave_plan(qpi.pod.meta.key)
-            if vol_plan is not None:
-                # node-neutral volume decision made at wave admission:
-                # seed the cycle state so Reserve/PreBind run the normal
-                # VolumeBinding flow against the selected host
-                from .plugins.volumes import (
-                    VolumeBinding,
-                    _BindingState,
-                    _ClaimsToBind,
-                )
-
-                bs = _BindingState(_ClaimsToBind())
-                bs.per_node[host] = vol_plan
-                state.write(VolumeBinding.STATE_KEY, bs)
-            result = ScheduleResult(
-                suggested_host=host, evaluated_nodes=planes.n, feasible_nodes=1
-            )
-            result, status = self._finish_scheduling_cycle(
-                state, fw, qpi, result, from_wave=True
-            )
-            if not status.is_success:
-                if vol_plan is not None:
-                    algo.safe_revert_volumes(vol_plan)
-                self._handle_scheduling_failure(
-                    fw, qpi, status, self.queue.moved_count
-                )
-                # the kernel placed this pod but the host reverted it: the
-                # carry (and any successor computed from it) is wrong
+        record = fl.record
+        # one root span per wave: collect/finish/bind phases nest under it
+        # (launch-side phases were children of the launching call's spans)
+        with rec.tracer.span(
+            f"wave/{record.wave_id if record is not None else 0}",
+            pods=len(wave),
+        ):
+            try:
+                with rec.phase("kernel"):
+                    hosts, planes = algo.backend.collect(fl, rng=algo.rng)
+            except FallbackNeeded:
+                # tie-draw overflow or poisoned carry: results discarded,
+                # pods re-run per-pod against live state; a successor
+                # launched on the bad carry is poisoned too. The backend
+                # already closed the flight record with the fallback reason.
                 self._poison_successor(algo)
-                invalidated = True
-                continue
-            if fw.waiting_pod(qpi.pod.meta.key) is not None or not self._default_bind_only(fw):
-                self._dispatch_binding(state, fw, qpi, result)
-            else:
-                batch.append((state, fw, qpi, result))
-        t2 = _time.perf_counter()
-        prof["finish"] += t2 - t1
-        self._bind_wave(batch)
-        prof["bind"] += _time.perf_counter() - t2
+                algo.fallback_count += len(wave)
+                with rec.phase("finish"):
+                    for qpi in wave:
+                        algo.revert_wave_plan(qpi.pod)
+                        self.schedule_pod_info(qpi)
+                return len(wave)
+            algo.kernel_count += len(wave)
+            with rec.phase("finish", record):
+                exported = self._export_wave_signatures(algo, fl, planes)
+                if record is not None:
+                    record.cache_exports = exported
+                invalidated = False
+                batch: list[tuple] = []
+                for qpi, host in zip(wave, hosts):
+                    if invalidated or host is None:
+                        # host=None re-runs reproduce the FitError (no rng
+                        # draws, no state change — safe under a live
+                        # successor); invalidated pods re-run because the
+                        # carry diverged
+                        algo.revert_wave_plan(qpi.pod)
+                        self.schedule_pod_info(qpi)
+                        continue
+                    fw = self.framework_for_pod(qpi.pod)
+                    state = CycleState()
+                    vol_plan = algo.take_wave_plan(qpi.pod.meta.key)
+                    if vol_plan is not None:
+                        # node-neutral volume decision made at wave
+                        # admission: seed the cycle state so Reserve/PreBind
+                        # run the normal VolumeBinding flow against the
+                        # selected host
+                        from .plugins.volumes import (
+                            VolumeBinding,
+                            _BindingState,
+                            _ClaimsToBind,
+                        )
+
+                        bs = _BindingState(_ClaimsToBind())
+                        bs.per_node[host] = vol_plan
+                        state.write(VolumeBinding.STATE_KEY, bs)
+                    result = ScheduleResult(
+                        suggested_host=host, evaluated_nodes=planes.n,
+                        feasible_nodes=1,
+                    )
+                    result, status = self._finish_scheduling_cycle(
+                        state, fw, qpi, result, from_wave=True
+                    )
+                    if not status.is_success:
+                        if vol_plan is not None:
+                            algo.safe_revert_volumes(vol_plan)
+                        self._handle_scheduling_failure(
+                            fw, qpi, status, self.queue.moved_count
+                        )
+                        # the kernel placed this pod but the host reverted
+                        # it: the carry (and any successor computed from it)
+                        # is wrong
+                        self._poison_successor(algo)
+                        invalidated = True
+                        continue
+                    if (fw.waiting_pod(qpi.pod.meta.key) is not None
+                            or not self._default_bind_only(fw)):
+                        self._dispatch_binding(state, fw, qpi, result)
+                    else:
+                        batch.append((state, fw, qpi, result))
+            with rec.phase("bind", record):
+                self._bind_wave(batch)
+        if record is not None:
+            rec.end_wave(
+                record,
+                fallback_reason="host revert: carry poisoned"
+                if invalidated else None,
+            )
         return len(wave)
 
-    def _export_wave_signatures(self, algo, fl, planes) -> None:
+    def _export_wave_signatures(self, algo, fl, planes) -> int:
         """Warm the host BatchCache from the kernel's per-signature score
         rows: each distinct wave signature exports its ordered feasible node
         list, so long-tail pods that later take the host path ride
         GetNodeHint (one re-Filter) instead of a full Filter+Score pass —
-        kernel work also feeds OpportunisticBatching's cache."""
+        kernel work also feeds OpportunisticBatching's cache. Returns the
+        number of signatures exported (the flight record's cache_exports)."""
         batch = getattr(algo, "batch", None)
         sig_scores = fl.info.get("sig_scores")
         if batch is None or sig_scores is None or fl.sig_ids is None:
-            return
+            return 0
         import numpy as np
 
         rows = np.asarray(sig_scores)
         seen: set[int] = set()
+        exported = 0
         for pod, gid in zip(fl.pods, fl.sig_ids):
             gid = int(gid)
             if gid in seen:
@@ -670,6 +690,8 @@ class ScheduleOneLoop:
             names = [planes.node_names[i] for i in order if row[i] >= 0]
             if names:
                 batch.store_schedule_results(signature, names)
+                exported += 1
+        return exported
 
     def _poison_successor(self, algo) -> None:
         """Mark the in-flight wave's results unusable and drop the carry —

@@ -7,7 +7,6 @@ Run :536) + eventhandlers.go (addAllEventHandlers :481).
 from __future__ import annotations
 
 import random
-import time as _time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -85,13 +84,20 @@ class Scheduler:
         parallelism: int = 16,
         event_recorder=None,
         extenders: list | None = None,
+        tracer=None,
     ):
         from ..utils.clock import Clock
+        from .tpu.flightrecorder import FlightRecorder
 
         self.store = store
         self.names = names or ResourceNames()
         self.clock = clock or Clock()
         self.metrics = metrics
+        self.tracer = tracer
+        # one wave flight recorder shared by the loop, every TPU backend,
+        # and the perf harness/bench: all phase stopwatches, per-wave
+        # records, and the slow-wave watchdog live here
+        self.flight_recorder = FlightRecorder(tracer=tracer, metrics=metrics)
         if event_recorder is None:
             # every scheduler emits Scheduled/FailedScheduling events
             # (schedule_one.go:1174,1273); the recorder buffers + aggregates
@@ -100,6 +106,10 @@ class Scheduler:
 
             event_recorder = EventRecorder(store)
         self.event_recorder = event_recorder
+        if metrics is not None and getattr(event_recorder, "metrics", None) is None:
+            # spill/aggregation/GC visibility (events are otherwise silently
+            # folded): the recorder lands counters on the shared registry
+            event_recorder.metrics = metrics
         self.cache = Cache(self.names)
         self.snapshot = Snapshot()
         self.feature_gates = dict(feature_gates or {})
@@ -141,7 +151,8 @@ class Scheduler:
             if prof.backend == "tpu":
                 from .tpu.backend import TPUBackend, TPUSchedulingAlgorithm
 
-                backend = TPUBackend(self.names, plugin_args=prof.plugin_args)
+                backend = TPUBackend(self.names, plugin_args=prof.plugin_args,
+                                     recorder=self.flight_recorder)
                 fw.tpu_backend = backend
                 self.algorithms[prof.name] = TPUSchedulingAlgorithm(
                     fw, backend, rng=random.Random(seed),
@@ -184,7 +195,8 @@ class Scheduler:
         if async_api_calls:
             from .api_dispatcher import APICacher, APIDispatcher
 
-            self.api_dispatcher = APIDispatcher(parallelism, metrics=metrics)
+            self.api_dispatcher = APIDispatcher(parallelism, metrics=metrics,
+                                                tracer=tracer)
             self.api_dispatcher.run()
             self.api_cacher = APICacher(store, self.api_dispatcher)
             # event flushes ride the dispatcher too: maybe_flush enqueues the
@@ -214,6 +226,7 @@ class Scheduler:
             names=self.names,
             api_cacher=self.api_cacher,
             pod_group_cycles=self.feature_gates.get("GenericWorkload", True),
+            recorder=self.flight_recorder,
         )
 
         self._last_leftover_flush = self.clock.now()
@@ -387,28 +400,28 @@ class Scheduler:
 
     def pump(self) -> int:
         """Drain informer events (deterministic single-thread mode)."""
-        t0 = _time.perf_counter()
-        n = self.informers.pump_all()
-        t1 = _time.perf_counter()
-        self.loop.phase_profile["pump"] += t1 - t0
-        # periodic safety net (reference: 30s ticker -> 5 min leftover flush)
-        now = self.clock.now()
-        if now - self._last_leftover_flush > 30.0:
-            self._last_leftover_flush = now
-            self.queue.flush_unschedulable_leftover()
-        if self.event_recorder is not None:
-            # cadence-gated (and dispatcher-offloaded when async API calls
-            # are on): the per-iteration cost here is a clock read, not a
-            # store write per buffered event
-            self.event_recorder.maybe_flush()
-        if self.metrics is not None and hasattr(self.metrics, "update_queue_gauges"):
-            active, backoff, unsched = self.queue.pending_pods()
-            self.metrics.update_queue_gauges(active, backoff, unsched)
+        with self.flight_recorder.phase("pump"):
+            n = self.informers.pump_all()
         # event-recorder flush + leftover sweep + gauges: accounted apart
         # from informer pumping — at bench scale the recorder's store writes
         # were the single largest unattributed wall-time slice (round-4
         # verdict weak #3)
-        self.loop.phase_profile["events"] += _time.perf_counter() - t1
+        with self.flight_recorder.phase("events"):
+            # periodic safety net (reference: 30s ticker -> 5 min leftover
+            # flush)
+            now = self.clock.now()
+            if now - self._last_leftover_flush > 30.0:
+                self._last_leftover_flush = now
+                self.queue.flush_unschedulable_leftover()
+            if self.event_recorder is not None:
+                # cadence-gated (and dispatcher-offloaded when async API
+                # calls are on): the per-iteration cost here is a clock
+                # read, not a store write per buffered event
+                self.event_recorder.maybe_flush()
+            if self.metrics is not None and hasattr(self.metrics,
+                                                    "update_queue_gauges"):
+                active, backoff, unsched = self.queue.pending_pods()
+                self.metrics.update_queue_gauges(active, backoff, unsched)
         return n
 
     def schedule_pending(self, max_cycles: int = 100_000) -> int:
@@ -430,11 +443,8 @@ class Scheduler:
                     # flush queued async binds so their events confirm
                     # assumes (and may unblock gated/waiting pods) before
                     # declaring the queue drained
-                    t0 = _time.perf_counter()
-                    self.api_dispatcher.drain(timeout=1.0)
-                    self.loop.phase_profile["drain"] += (
-                        _time.perf_counter() - t0
-                    )
+                    with self.flight_recorder.phase("drain"):
+                        self.api_dispatcher.drain(timeout=1.0)
                 if idle_rounds > 2:
                     break
                 continue

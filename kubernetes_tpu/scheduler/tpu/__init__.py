@@ -1,3 +1,16 @@
-from .backend import TPUBackend, TPUSchedulingAlgorithm
+"""TPU scheduling backend package.
+
+Lazy re-exports (PEP 562): `python -m kubernetes_tpu.scheduler.tpu.
+flightrecorder` and other telemetry-only importers must not pay the
+backend's jax import (or require a device) just to read flight records.
+"""
 
 __all__ = ["TPUBackend", "TPUSchedulingAlgorithm"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from . import backend
+
+        return getattr(backend, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -51,6 +51,7 @@ from ..framework.interface import (
     Status,
 )
 from ..schedule_one import SchedulingAlgorithm, num_feasible_nodes_to_find
+from .flightrecorder import FlightRecorder
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_rows_jit(dev: dict, rows: dict, idx):
@@ -148,10 +149,11 @@ class InflightWave:
     """A launched-but-uncollected batched wave: device handles only."""
 
     __slots__ = ("pods", "qpis", "planes", "info", "pad", "cursor_base_host",
-                 "frame_shift", "poisoned", "sig_ids")
+                 "frame_shift", "poisoned", "sig_ids", "record")
 
     def __init__(self, pods, planes, info, pad, frame_shift, sig_ids=None):
         self.pods = pods
+        self.record = None  # flight record riding along, closed after bind
         # per-slot signature group ids when the wave ran deduplicated (host
         # export maps kernel sig_scores rows back to pods through these)
         self.sig_ids = sig_ids
@@ -174,7 +176,7 @@ class TPUBackend:
     """Planes + features + device-state bookkeeping for one cluster."""
 
     def __init__(self, names: ResourceNames, plugin_args: dict | None = None,
-                 system_default_spread: bool = True):
+                 system_default_spread: bool = True, recorder=None):
         import jax
 
         args = (plugin_args or {}).get("NodeResourcesFit", {})
@@ -222,9 +224,10 @@ class TPUBackend:
         # fine-grained wave-path timing (seconds), surfaced by the perf
         # harness next to the coarse phase profile: where does "kernel"
         # wall time actually go — host feature prep, dispatch, device wait?
-        self.perf = {"sync": 0.0, "features": 0.0, "tie": 0.0,
-                     "dispatch": 0.0, "upload": 0.0, "wait": 0.0,
-                     "dedup": 0.0}
+        # The flight recorder owns the stopwatches; `perf` aliases its
+        # wave_totals dict (same object) so existing consumers keep reading.
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        self.perf = self.recorder.wave_totals
         # signature-dedup wave scoring (ISSUE 2): group byte-identical
         # feature rows so the kernel scores each distinct signature once and
         # replays clones from the carry. Decisions are bit-identical either
@@ -509,6 +512,8 @@ class TPUBackend:
     def invalidate_carry(self) -> None:
         """Drop the device-resident carry; the next device_inputs re-uploads
         every plane from host truth."""
+        if self._carry is not None:
+            self.recorder.carry_invalidated()
         self._carry = None
         self._carry_rows = set()
         self._carry_anti = self._carry_pref = False
@@ -539,82 +544,88 @@ class TPUBackend:
         Raises NeedResync when the carry can't absorb host-side changes
         (external dirty rows / bucket reshape) — caller drains the pipeline
         and retries — and FallbackNeeded for non-kernelizable pods."""
-        import time as _time
-
         from ...ops import pad_features
         from ...ops.kernels import MAX_TIE_DRAWS
 
         self._rerun_carry = None  # a new launch closes any re-run window
-        t0 = _time.perf_counter()
-        for pod in pods:
-            self.extractor.register(pod)
-        planes = self.sync(snapshot)
-        t1 = _time.perf_counter()
-        self.perf["sync"] += t1 - t0
-        feats = stack_features(
-            [self.extractor.features_cached(p, planes) for p in pods]
-        )
-        if pad_to > len(pods):
-            feats = pad_features(feats, pad_to)
-        pad = max(pad_to, len(pods))
-        self.perf["features"] += _time.perf_counter() - t1
+        rec = self.recorder.begin_wave(pods=len(pods))
+        with self.recorder.wave_phase("sync", rec):
+            for pod in pods:
+                self.extractor.register(pod)
+            planes = self.sync(snapshot)
+        with self.recorder.wave_phase("features", rec):
+            feats = stack_features(
+                [self.extractor.features_cached(p, planes) for p in pods]
+            )
+            if pad_to > len(pods):
+                feats = pad_features(feats, pad_to)
+            pad = max(pad_to, len(pods))
+        rec.pad = pad
 
         prev = self._inflight
-        if prev is not None and self._carry is None:
-            # a single-pod cycle (or divergence) dropped the carry while a
-            # wave is still in flight: host planes lack that wave's
-            # placements, so a host re-upload here would double-book nodes
-            raise NeedResync("carry dropped while a wave is in flight")
-        if self._carry is not None:
-            if self._carry_external:
-                raise NeedResync("external event touched cluster state")
-            if self._device_buckets != planes.bucket_sizes:
-                raise NeedResync("plane buckets changed under the carry")
-            if self._pending_dirty is None:
-                raise NeedResync("full plane rebuild required")
-            external = self._pending_dirty - self._carry_rows
-            if external:
-                raise NeedResync(f"{len(external)} externally-dirtied rows")
-            # remaining dirty rows are our own collected binds — the carry
-            # already holds their exact values (same int updates), so the
-            # host-truth scatter is redundant
-            self._pending_dirty = set()
-            self._device_version = planes.version
-            self._refresh_tables(planes)
-            self._fresh_term_key(planes)
-            dev = {**self._device_planes, **self._carry, **self._device_tables}
-        else:
-            t_up = _time.perf_counter()
-            dev = self.device_inputs(planes)
-            self.perf["upload"] += _time.perf_counter() - t_up
+        try:
+            if prev is not None and self._carry is None:
+                # a single-pod cycle (or divergence) dropped the carry while
+                # a wave is still in flight: host planes lack that wave's
+                # placements, so a host re-upload here would double-book nodes
+                raise NeedResync("carry dropped while a wave is in flight")
+            if self._carry is not None:
+                if self._carry_external:
+                    raise NeedResync("external event touched cluster state")
+                if self._device_buckets != planes.bucket_sizes:
+                    raise NeedResync("plane buckets changed under the carry")
+                if self._pending_dirty is None:
+                    raise NeedResync("full plane rebuild required")
+                external = self._pending_dirty - self._carry_rows
+                if external:
+                    raise NeedResync(f"{len(external)} externally-dirtied rows")
+                # remaining dirty rows are our own collected binds — the carry
+                # already holds their exact values (same int updates), so the
+                # host-truth scatter is redundant
+                self._pending_dirty = set()
+                self._device_version = planes.version
+                self._refresh_tables(planes)
+                self._fresh_term_key(planes)
+                dev = {**self._device_planes, **self._carry,
+                       **self._device_tables}
+            else:
+                with self.recorder.wave_phase("upload", rec):
+                    dev = self.device_inputs(planes)
+        except NeedResync as e:
+            # caller drains and retries; this attempt's record closes here
+            self.recorder.end_wave(rec, fallback_reason=f"resync: {e}")
+            raise
 
         cfg = self.kernel_config(planes, feats)
-        t_sig = _time.perf_counter()
-        sig_ids, uniq = self._group_wave(feats, len(pods))
-        self.perf["dedup"] += _time.perf_counter() - t_sig
+        with self.recorder.wave_phase("dedup", rec):
+            sig_ids, uniq = self._group_wave(feats, len(pods))
+        self.recorder.note_launch(
+            rec,
+            signatures=(int(sig_ids[: len(pods)].max()) + 1
+                        if sig_ids is not None else 0),
+            dedup=sig_ids is not None,
+        )
         tie_words = None
         # np.int32, not a python int: a weak-typed scalar would give the
         # first launch a different jit signature than chained ones (whose
         # cursor rides in as a device array) — one full recompile
         cursor_init: object = np.int32(0)
         frame_shift = self._advanced_since_launch
-        t_tie = _time.perf_counter()
-        if rng is not None:
-            # frame covers a full predecessor + this wave (static shape per
-            # pad): the predecessor may consume up to pad*MAX words first
-            tie_words = clone_tie_words(rng, (2 * pad + 1) * MAX_TIE_DRAWS)
-            if prev is not None:
-                # predecessor's final cursor, shifted into this frame inside
-                # the next kernel's trace — no host sync, no eager op
-                cursor_init = prev.info["tie_consumed"]
-        t_disp = _time.perf_counter()
-        self.perf["tie"] += t_disp - t_tie
-        _winners_dev, info = batched_assign(
-            cfg, dev, feats, tie_words, cursor_init,
-            frame_shift if prev is not None else 0,
-            sig_ids=sig_ids, uniq_idx=uniq,
-        )
-        self.perf["dispatch"] += _time.perf_counter() - t_disp
+        with self.recorder.wave_phase("tie", rec):
+            if rng is not None:
+                # frame covers a full predecessor + this wave (static shape
+                # per pad): the predecessor may consume up to pad*MAX words
+                tie_words = clone_tie_words(rng, (2 * pad + 1) * MAX_TIE_DRAWS)
+                if prev is not None:
+                    # predecessor's final cursor, shifted into this frame
+                    # inside the next kernel's trace — no host sync/eager op
+                    cursor_init = prev.info["tie_consumed"]
+        with self.recorder.wave_phase("dispatch", rec):
+            _winners_dev, info = batched_assign(
+                cfg, dev, feats, tie_words, cursor_init,
+                frame_shift if prev is not None else 0,
+                sig_ids=sig_ids, uniq_idx=uniq,
+            )
         # next launch chains on these outputs
         self._carry = {k: info[k] for k in
                        ("used", "nonzero_used", "sel_counts")}
@@ -625,6 +636,7 @@ class TPUBackend:
         self._carry_pref = self._carry_pref or bool(feats["ipa_pref_add"].any())
         fl = InflightWave(pods, planes, info, pad, frame_shift,
                           sig_ids=sig_ids)
+        fl.record = rec
         if prev is None:
             fl.cursor_base_host = 0
         self._inflight = fl
@@ -639,20 +651,24 @@ class TPUBackend:
         Raises FallbackNeeded on tie-draw overflow (results discarded, rng
         untouched, carry invalidated — the successor launch, if any, must be
         poisoned by the caller)."""
-        import time as _time
-
-        t0 = _time.perf_counter()
-        packed = np.asarray(fl.info["packed"])
-        self.perf["wait"] += _time.perf_counter() - t0
+        rec = fl.record
+        with self.recorder.wave_phase("wait", rec):
+            packed = np.asarray(fl.info["packed"])
         winners = packed[: len(fl.pods)]
         final_abs, overflow = int(packed[-2]), bool(packed[-1])
         if self._inflight is fl:
             self._inflight = None
         if fl.poisoned:
             self.invalidate_carry()
+            if rec is not None:
+                self.recorder.end_wave(
+                    rec, fallback_reason="poisoned: predecessor diverged")
             raise FallbackNeeded("predecessor wave diverged host-side")
         if rng is not None and overflow:
             self.invalidate_carry()
+            if rec is not None:
+                self.recorder.end_wave(
+                    rec, fallback_reason="overflow: tie-break draw overflow")
             raise FallbackNeeded("tie-break draw overflow")
         if rng is not None:
             if fl.cursor_base_host is None:

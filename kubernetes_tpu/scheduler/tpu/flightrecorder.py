@@ -1,0 +1,404 @@
+"""Wave flight recorder: tracing, metrics, and post-mortem telemetry for
+the batched TPU scheduling pipeline.
+
+Every batched wave is self-describing: the loop and backend time their
+phases through this recorder (which doubles each stopwatch as a child span
+on the shared `utils.tracing.Tracer`), and each wave leaves a structured
+`WaveRecord` in a bounded ring buffer — pod/clone counts, dedup tier,
+pad/occupancy, carry invalidations, fallback reason, per-phase durations —
+queryable after the fact via `python -m
+kubernetes_tpu.scheduler.tpu.flightrecorder` or the SIGUSR1 dump hook
+(the `cache/debugger.py` pattern).
+
+A slow-wave watchdog arms a timer per open wave; if the wave is still in
+flight past the deadline it captures a `utils.pprof.take_profile` sample
+of all threads and attaches it to the flight record — the post-mortem for
+"why was wave 1723 slow" ships with the wave.
+
+All recording is HOST-SIDE ONLY: phases close after device results are
+collected, nothing here runs inside jitted code (mechanically enforced by
+kubesched-lint rule OBS01), so the seeded tie-break stream and the golden
+bit-compat contract are byte-identical with the recorder on or off.
+With no tracer exporter installed the span side costs one attribute
+lookup per phase (the no-op tracer fast path); the ring buffer append is
+a dict build + deque append per wave, not per pod.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from ...utils.tracing import Tracer
+
+# loop-level pipeline phases (the phase_profile bench.py reports)
+LOOP_PHASES = ("snapshot", "kernel", "finish", "bind", "pump", "events",
+               "pop", "harness", "drain")
+# backend wave-path phases (the wave_profile bench.py reports)
+WAVE_PHASES = ("sync", "features", "tie", "dispatch", "upload", "wait",
+               "dedup")
+
+# watchdog defaults; env knobs so production runs can tune without code
+DEFAULT_CAPACITY = int(os.environ.get("KUBE_TPU_FLIGHT_CAPACITY", "256"))
+# None/0 = watchdog off (the default: CPU-fallback bench waves legitimately
+# run long, and profile capture is not free)
+_deadline_env = os.environ.get("KUBE_TPU_SLOW_WAVE_S", "")
+DEFAULT_SLOW_WAVE_S = float(_deadline_env) if _deadline_env else None
+DEFAULT_PROFILE_S = float(os.environ.get("KUBE_TPU_SLOW_WAVE_PROFILE_S",
+                                         "0.25"))
+
+
+@dataclass
+class WaveRecord:
+    """One batched wave's flight record (see README "Observability")."""
+
+    wave_id: int
+    started_at: float  # wall clock, for post-mortem correlation
+    pods: int = 0
+    pad: int = 0  # padded program slots (pow2 bucket)
+    signatures: int = 0  # distinct feature signatures (0 = dedup off)
+    clones: int = 0  # pods that rode the cheap carry-replay tier
+    distinct_signature_ratio: float | None = None
+    dedup_tier: str = "off"  # "dedup" | "off"
+    occupancy: float = 0.0  # pods / pad
+    carry_invalidations: int = 0  # invalidations during this wave's flight
+    cache_exports: int = 0  # signature hints exported to the BatchCache
+    fallback_reason: str | None = None  # resync/fallback diagnosis, if any
+    phases: dict = field(default_factory=dict)  # phase -> seconds
+    duration_s: float = 0.0
+    profile: str | None = None  # watchdog pprof capture, when triggered
+    # internal bookkeeping (not serialized)
+    _t0: float = 0.0
+    _inv_base: int = 0
+
+    def to_dict(self) -> dict:
+        d = {
+            "wave_id": self.wave_id,
+            "started_at": self.started_at,
+            "duration_s": round(self.duration_s, 6),
+            "pods": self.pods,
+            "pad": self.pad,
+            "occupancy": round(self.occupancy, 4),
+            "signatures": self.signatures,
+            "clones": self.clones,
+            "distinct_signature_ratio": self.distinct_signature_ratio,
+            "dedup_tier": self.dedup_tier,
+            "carry_invalidations": self.carry_invalidations,
+            "cache_exports": self.cache_exports,
+            "fallback_reason": self.fallback_reason,
+            "phases": {k: round(v, 6) for k, v in self.phases.items()},
+        }
+        if self.profile is not None:
+            d["profile"] = self.profile
+        return d
+
+
+class FlightRecorder:
+    """Shared phase stopwatches + per-wave ring buffer + watchdog.
+
+    One instance is shared by the ScheduleOneLoop, every TPUBackend, and
+    the bench/harness: `phase_totals` IS the loop's phase_profile dict and
+    `wave_totals` IS the backend's perf dict (same objects), so every
+    consumer reads recorder-sourced numbers."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, tracer=None,
+                 metrics=None,
+                 slow_wave_deadline_s: float | None = DEFAULT_SLOW_WAVE_S,
+                 profile_seconds: float = DEFAULT_PROFILE_S):
+        self.tracer = tracer or Tracer("flight-recorder")  # no-op by default
+        self.metrics = metrics
+        self.slow_wave_deadline_s = slow_wave_deadline_s or None
+        self.profile_seconds = profile_seconds
+        # cumulative phase stopwatches (the dicts bench.py diffs)
+        self.phase_totals: dict = {k: 0.0 for k in LOOP_PHASES}
+        self.phase_totals["waves"] = 0
+        self.wave_totals: dict = {k: 0.0 for k in WAVE_PHASES}
+        self._records: "collections.deque[WaveRecord]" = collections.deque(
+            maxlen=capacity
+        )
+        self._lock = threading.Lock()
+        self._wave_seq = 0
+        self.invalidations = 0  # cumulative carry invalidations
+        self.slow_wave_captures = 0
+        self._watchdogs: dict[int, threading.Timer] = {}
+
+    # -- phase stopwatches (span-backed) --------------------------------------
+
+    @contextmanager
+    def phase(self, name: str, record: WaveRecord | None = None, **attrs):
+        """Time a loop-level phase; emits a `phase/<name>` child span and
+        accumulates into phase_totals (and the wave record, when given)."""
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.span(f"phase/{name}", **attrs):
+                yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.phase_totals[name] = self.phase_totals.get(name, 0.0) + dt
+                if record is not None:
+                    record.phases[name] = record.phases.get(name, 0.0) + dt
+
+    @contextmanager
+    def wave_phase(self, name: str, record: WaveRecord | None = None):
+        """Time a backend wave-path phase (sync/features/.../wait)."""
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.span(f"wave_phase/{name}"):
+                yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.wave_totals[name] = self.wave_totals.get(name, 0.0) + dt
+                if record is not None:
+                    record.phases[name] = record.phases.get(name, 0.0) + dt
+
+    def count_wave(self) -> None:
+        """One wave launched (the phase_profile["waves"] counter)."""
+        with self._lock:
+            self.phase_totals["waves"] += 1
+
+    # -- per-wave records -----------------------------------------------------
+
+    def begin_wave(self, pods: int, pad: int = 0) -> WaveRecord:
+        """Open a flight record at wave launch; arms the slow-wave watchdog
+        when a deadline is configured."""
+        with self._lock:
+            self._wave_seq += 1
+            rec = WaveRecord(wave_id=self._wave_seq, started_at=time.time(),
+                             pods=pods, pad=pad or pods)
+            rec._t0 = time.perf_counter()
+            rec._inv_base = self.invalidations
+        if self.slow_wave_deadline_s:
+            t = threading.Timer(self.slow_wave_deadline_s,
+                                self._capture_slow_wave, args=(rec,))
+            t.daemon = True
+            with self._lock:
+                self._watchdogs[rec.wave_id] = t
+            t.start()
+        return rec
+
+    def note_launch(self, rec: WaveRecord, signatures: int = 0,
+                    dedup: bool = False) -> None:
+        """Attach launch-side wave composition (dedup grouping outcome)."""
+        rec.signatures = signatures
+        rec.dedup_tier = "dedup" if dedup else "off"
+        if dedup and rec.pods:
+            rec.clones = rec.pods - signatures
+            rec.distinct_signature_ratio = round(signatures / rec.pods, 4)
+
+    def carry_invalidated(self) -> None:
+        """The device carry was dropped (resync/divergence/external event);
+        open records count the invalidations that happened in their window."""
+        with self._lock:
+            self.invalidations += 1
+
+    def end_wave(self, rec: WaveRecord,
+                 fallback_reason: str | None = None) -> WaveRecord:
+        """Finalize and ring-buffer a record; disarms the watchdog, attaches
+        any captured profile, and lands the wave's metrics series."""
+        timer = None
+        with self._lock:
+            timer = self._watchdogs.pop(rec.wave_id, None)
+        if timer is not None:
+            timer.cancel()
+        rec.duration_s = time.perf_counter() - rec._t0
+        rec.occupancy = round(rec.pods / rec.pad, 4) if rec.pad else 0.0
+        if fallback_reason is not None:
+            rec.fallback_reason = fallback_reason
+        with self._lock:
+            rec.carry_invalidations = self.invalidations - rec._inv_base
+            self._records.append(rec)
+        m = self.metrics
+        if m is not None:
+            if hasattr(m, "wave_completed"):
+                m.wave_completed(rec)
+            if hasattr(m, "update_sli_quantiles"):
+                m.update_sli_quantiles()
+        return rec
+
+    def _capture_slow_wave(self, rec: WaveRecord) -> None:
+        """Watchdog fire: the wave blew its deadline and is still open —
+        sample every thread's stack so the record explains where the time
+        went. Runs on the timer thread; purely observational."""
+        from ...utils.pprof import take_profile
+
+        try:
+            profile = take_profile(seconds=self.profile_seconds)
+        except Exception as e:  # noqa: BLE001 - diagnostics are best-effort
+            profile = f"profile capture failed: {type(e).__name__}: {e}"
+        rec.profile = (
+            f"slow wave {rec.wave_id}: exceeded "
+            f"{self.slow_wave_deadline_s}s deadline\n{profile}"
+        )
+        with self._lock:
+            self.slow_wave_captures += 1
+        if self.metrics is not None and hasattr(self.metrics,
+                                                "slow_wave_captured"):
+            self.metrics.slow_wave_captured()
+
+    # -- queries / snapshots --------------------------------------------------
+
+    def records(self, last: int | None = None) -> list[WaveRecord]:
+        with self._lock:
+            recs = list(self._records)
+        return recs[-last:] if last else recs
+
+    def phase_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.phase_totals)
+
+    def wave_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.wave_totals)
+
+    def summary(self) -> dict:
+        recs = self.records()
+        durations = sorted(r.duration_s for r in recs)
+        return {
+            "waves_recorded": len(recs),
+            "waves_total": self.phase_snapshot().get("waves", 0),
+            "slow_wave_captures": self.slow_wave_captures,
+            "carry_invalidations": self.invalidations,
+            "fallbacks": sum(1 for r in recs if r.fallback_reason),
+            "wave_p50_s": (round(durations[len(durations) // 2], 4)
+                           if durations else None),
+            "wave_max_s": round(durations[-1], 4) if durations else None,
+        }
+
+    # -- dump hook (cache/debugger.py pattern) --------------------------------
+
+    def dump(self, last: int | None = None) -> str:
+        """JSON post-mortem dump: summary + the ring buffer's records."""
+        return json.dumps({
+            "summary": self.summary(),
+            "phase_totals": {
+                k: (v if k == "waves" else round(v, 6))
+                for k, v in self.phase_snapshot().items()
+            },
+            "wave_totals": {k: round(v, 6)
+                            for k, v in self.wave_snapshot().items()},
+            "records": [r.to_dict() for r in self.records(last)],
+        }, indent=2)
+
+    def install(self, signum=None):
+        """Install a signal handler dumping flight records to the log
+        (SIGUSR1 by default; the cache debugger owns SIGUSR2). Returns the
+        previous handler. Raises ValueError off the main thread."""
+        import logging
+        import signal as _signal
+
+        if signum is None:
+            signum = _signal.SIGUSR1
+        log = logging.getLogger("kubernetes_tpu.flightrecorder")
+
+        def handler(_sig, _frame):
+            log.warning("flight-recorder dump:\n%s", self.dump())
+
+        return _signal.signal(signum, handler)
+
+
+# -- CLI: post-mortem reader / smoke ------------------------------------------
+
+
+def format_postmortem(records: list[dict]) -> str:
+    """Human-readable wave table from to_dict()-shaped records."""
+    if not records:
+        return "(no flight records)"
+    cols = ("wave", "pods", "pad", "occ", "sigs", "tier", "inval",
+            "fallback", "ms", "slowest phases")
+    rows = []
+    for r in records:
+        phases = sorted(r.get("phases", {}).items(), key=lambda kv: -kv[1])
+        top = " ".join(f"{k}={v * 1000:.1f}ms" for k, v in phases[:3])
+        if r.get("profile"):
+            top += "  [profile captured]"
+        rows.append((
+            str(r["wave_id"]), str(r["pods"]), str(r["pad"]),
+            f"{r.get('occupancy', 0):.2f}", str(r.get("signatures", 0)),
+            r.get("dedup_tier", "off"),
+            str(r.get("carry_invalidations", 0)),
+            (r.get("fallback_reason") or "-")[:32],
+            f"{r.get('duration_s', 0) * 1000:.1f}", top,
+        ))
+    widths = [max(len(c), *(len(row[i]) for row in rows))
+              for i, c in enumerate(cols)]
+    out = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for row in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    return "\n".join(out)
+
+
+def _demo() -> FlightRecorder:
+    """Synthetic multi-wave run exercising the full recorder surface
+    (no device, no jax import) — the `make obs` smoke."""
+    rec = FlightRecorder(capacity=8, slow_wave_deadline_s=0.05,
+                         profile_seconds=0.05)
+    for i in range(10):
+        wr = rec.begin_wave(pods=30 + i, pad=32)
+        with rec.wave_phase("sync", wr):
+            pass
+        with rec.wave_phase("dispatch", wr):
+            pass
+        rec.note_launch(wr, signatures=3, dedup=True)
+        with rec.phase("kernel", wr):
+            if i == 4:
+                time.sleep(0.12)  # trip the watchdog once
+        rec.count_wave()
+        rec.end_wave(wr, fallback_reason=(
+            "tie-break draw overflow" if i == 7 else None
+        ))
+    return rec
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.scheduler.tpu.flightrecorder",
+        description="Wave flight-recorder post-mortem reader",
+    )
+    parser.add_argument("dump", nargs="?",
+                        help="JSON dump file (from FlightRecorder.dump() / "
+                             "the SIGUSR1 hook); '-' reads stdin")
+    parser.add_argument("--last", type=int, default=None,
+                        help="show only the last N waves")
+    parser.add_argument("--demo", action="store_true",
+                        help="run a synthetic multi-wave smoke and print its "
+                             "post-mortem (no device needed)")
+    parser.add_argument("--schema", action="store_true",
+                        help="print the flight-record field schema")
+    args = parser.parse_args(argv)
+
+    if args.schema:
+        for f in WaveRecord.__dataclass_fields__:
+            if not f.startswith("_"):
+                print(f)
+        return 0
+    if args.demo:
+        rec = _demo()
+        payload = json.loads(rec.dump(last=args.last))
+    elif args.dump:
+        import sys
+
+        raw = (sys.stdin.read() if args.dump == "-"
+               else open(args.dump).read())
+        payload = json.loads(raw)
+        if args.last:
+            payload["records"] = payload.get("records", [])[-args.last:]
+    else:
+        parser.print_usage()
+        return 2
+    print(format_postmortem(payload.get("records", [])))
+    summary = payload.get("summary", {})
+    print("\nsummary: " + ", ".join(f"{k}={v}" for k, v in summary.items()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,6 +6,14 @@ utiltrace.New("Scheduling", ...) and LogIfLong(100ms)
 (a perf_counter read each) and the trace is only FORMATTED and logged when
 the whole operation exceeded the threshold — the diagnostic exists exactly
 when the perf problem does.
+
+This module is a thin shim over `utils.tracing`: a Trace IS a Span (steps
+are span events, fields are span attributes) and log_if_long runs it
+through `tracing.threshold_log_exporter`, which owns the legacy line
+format. The two surfaces deliberately coexist: Trace mirrors the
+reference's utiltrace call sites (threshold-gated logging, no nesting),
+while Tracer/Span is the component-base/tracing role (always-on trees,
+pluggable exporters). Only the formatting/storage is shared.
 """
 
 from __future__ import annotations
@@ -13,38 +21,46 @@ from __future__ import annotations
 import logging
 import time
 
+from .tracing import Span, threshold_log_exporter
+
 logger = logging.getLogger("kubernetes_tpu.trace")
 
 
 class Trace:
-    """One traced operation; nested steps are (timestamp, message)."""
+    """One traced operation; steps are span events on the backing Span."""
 
-    __slots__ = ("name", "fields", "start", "steps")
+    __slots__ = ("span",)
 
     def __init__(self, name: str, **fields):
-        self.name = name
-        self.fields = fields
-        self.start = time.perf_counter()
-        self.steps: list[tuple[float, str]] = []
+        self.span = Span(name=name, start=time.perf_counter(),
+                         attributes=dict(fields))
+
+    @property
+    def name(self) -> str:
+        return self.span.name
+
+    @property
+    def fields(self) -> dict:
+        return self.span.attributes
+
+    @property
+    def start(self) -> float:
+        return self.span.start
+
+    @property
+    def steps(self) -> list[tuple[float, str]]:
+        # legacy view: absolute (timestamp, message) pairs
+        return [(self.span.start + off, msg)
+                for off, msg, _attrs in self.span.events]
 
     def step(self, msg: str) -> None:
-        self.steps.append((time.perf_counter(), msg))
+        self.span.event(msg)
 
     def total_time(self) -> float:
-        return time.perf_counter() - self.start
+        return self.span.duration_s
 
     def log_if_long(self, threshold: float = 0.1) -> bool:
         """Format + log the step timeline iff total exceeded threshold
         (LogIfLong, trace.go:208). Returns whether it logged."""
-        total = self.total_time()
-        if total < threshold:
-            return False
-        fields = ",".join(f"{k}={v}" for k, v in self.fields.items())
-        lines = [f'Trace "{self.name}" ({fields}): total {total * 1000:.1f}ms '
-                 f'(threshold {threshold * 1000:.0f}ms):']
-        prev = self.start
-        for ts, msg in self.steps:
-            lines.append(f"  +{(ts - prev) * 1000:.1f}ms {msg}")
-            prev = ts
-        logger.warning("\n".join(lines))
-        return True
+        self.span.end = time.perf_counter()
+        return threshold_log_exporter(threshold, logger)(self.span)

@@ -15,6 +15,7 @@ when no exporter is installed (the no-op tracer pattern).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from contextlib import contextmanager
@@ -90,6 +91,33 @@ class _NoopSpan:
 
 
 _NOOP_SPAN = _NoopSpan()
+
+
+def threshold_log_exporter(threshold: float, logger=None):
+    """Exporter that logs a finished span's event timeline iff its total
+    duration crossed `threshold` — the utiltrace LogIfLong contract
+    (vendor/k8s.io/utils/trace/trace.go:208) expressed as a span exporter.
+    `utils.trace.Trace` is a shim over this; the legacy line format is
+    preserved so existing log scrapers keep matching.
+
+    Returns a callable(span) -> bool (whether it logged)."""
+    log = logger or logging.getLogger("kubernetes_tpu.trace")
+
+    def export(sp: Span) -> bool:
+        total = sp.duration_s
+        if total < threshold:
+            return False
+        fields = ",".join(f"{k}={v}" for k, v in sp.attributes.items())
+        lines = [f'Trace "{sp.name}" ({fields}): total {total * 1000:.1f}ms '
+                 f'(threshold {threshold * 1000:.0f}ms):']
+        prev = 0.0
+        for off, msg, _attrs in sp.events:
+            lines.append(f"  +{(off - prev) * 1000:.1f}ms {msg}")
+            prev = off
+        log.warning("\n".join(lines))
+        return True
+
+    return export
 
 
 class InMemoryExporter:

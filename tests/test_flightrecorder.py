@@ -1,0 +1,367 @@
+"""Wave flight recorder tests (PR 3): ring-buffer bounds, span-tree shape,
+recorder-on/off golden bit-compat, slow-wave watchdog, exposition-format
+goldens for the new metric series, CLI smoke, event-recorder counters.
+
+The load-bearing contract: the recorder is ALWAYS on (Scheduler constructs
+one unconditionally), so the golden tests here pin that full telemetry —
+tracer exporter installed, watchdog armed, metrics wired — changes no
+binding decision, no failure diagnosis, and no rng stream position.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.events import EventRecorder
+from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
+from kubernetes_tpu.scheduler.tpu.flightrecorder import (
+    FlightRecorder,
+    WaveRecord,
+    format_postmortem,
+)
+from kubernetes_tpu.scheduler.tpu.flightrecorder import main as fr_main
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.utils.tracing import InMemoryExporter, Tracer
+from tests.test_dedup_golden import mixed_pods
+from tests.wrappers import make_node, make_pod
+
+
+def drain_waves(fr, n, **end_kw):
+    recs = []
+    for _ in range(n):
+        r = fr.begin_wave(pods=8, pad=8)
+        recs.append(fr.end_wave(r, **end_kw))
+    return recs
+
+
+# ------------------------------------------------------------- ring buffer
+
+
+class TestRingBuffer:
+    def test_capacity_bounds_under_churn(self):
+        fr = FlightRecorder(capacity=4, slow_wave_deadline_s=None)
+        drain_waves(fr, 10)
+        recs = fr.records()
+        assert len(recs) == 4, "ring must cap at capacity"
+        assert [r.wave_id for r in recs] == [7, 8, 9, 10], \
+            "oldest records must be dropped first"
+        assert [r.wave_id for r in fr.records(last=2)] == [9, 10]
+        # the cumulative counters keep counting past the ring
+        fr.count_wave()
+        assert fr.summary()["waves_recorded"] == 4
+
+    def test_record_fields_and_dump_shape(self):
+        fr = FlightRecorder(capacity=8, slow_wave_deadline_s=None)
+        rec = fr.begin_wave(pods=30, pad=32)
+        fr.note_launch(rec, signatures=3, dedup=True)
+        with fr.phase("kernel", rec):
+            pass
+        with fr.wave_phase("dispatch", rec):
+            pass
+        fr.carry_invalidated()
+        fr.end_wave(rec, fallback_reason="resync: planes changed")
+        assert rec.clones == 27
+        assert rec.distinct_signature_ratio == 0.1
+        assert rec.dedup_tier == "dedup"
+        assert rec.occupancy == round(30 / 32, 4)
+        assert rec.carry_invalidations == 1
+        assert set(rec.phases) == {"kernel", "dispatch"}
+        payload = json.loads(fr.dump())
+        assert set(payload) == {"summary", "phase_totals", "wave_totals",
+                                "records"}
+        (d,) = payload["records"]
+        assert d["fallback_reason"] == "resync: planes changed"
+        # internal bookkeeping must not leak into the serialized record
+        assert not any(k.startswith("_") for k in d)
+
+    def test_phase_accumulates_across_exceptions(self):
+        # NeedResync propagates through the "kernel" phase on retry; the
+        # stopwatch must still account the aborted attempt
+        fr = FlightRecorder(slow_wave_deadline_s=None)
+        with pytest.raises(RuntimeError):
+            with fr.phase("kernel"):
+                raise RuntimeError("resync")
+        with fr.phase("kernel"):
+            pass
+        assert fr.phase_snapshot()["kernel"] > 0.0
+        snap = fr.phase_snapshot()
+        snap["kernel"] = -1.0  # snapshots are copies, not aliases
+        assert fr.phase_snapshot()["kernel"] >= 0.0
+
+
+# --------------------------------------------------------------- span tree
+
+
+class TestSpanTree:
+    def test_multi_wave_run_exports_wave_roots_with_phase_children(self):
+        exporter = InMemoryExporter(capacity=4096)
+        store = Store()
+        for i in range(6):
+            store.create(make_node(f"n{i}", cpu="4", mem="8Gi",
+                                   zone=f"z{i % 2}"))
+        for p in mixed_pods(24):
+            store.create(p)
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
+                      seed=11, tracer=Tracer("sched", exporter))
+        s.start()
+        s.schedule_pending()
+        waves = exporter.find("wave/")
+        assert len(waves) >= 2, "24 pods at wave_size=8 must trace >1 wave"
+        for root in waves:
+            child_names = {c.name for c in root.children}
+            # collect + finish + bind all nest under the wave root
+            assert "phase/kernel" in child_names
+            assert "phase/finish" in child_names
+            assert "phase/bind" in child_names
+            assert root.attributes.get("pods", 0) > 0
+            assert root.end > root.start
+        # launch-side phases export as their own roots (the launch runs
+        # pipelined, outside any wave span)
+        assert exporter.find("phase/snapshot")
+        # backend wave-path phases ride as descendants or roots, but the
+        # device wait must be inside the wave's kernel phase
+        kernel = next(c for c in waves[0].children
+                      if c.name == "phase/kernel")
+        assert any(g.name == "wave_phase/wait" for g in kernel.children)
+
+    def test_flight_records_match_traced_waves(self):
+        store = Store()
+        for i in range(4):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        for p in mixed_pods(16):
+            store.create(p)
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
+                      seed=3)
+        s.start()
+        s.schedule_pending()
+        fr = s.flight_recorder
+        recs = fr.records()
+        assert recs, "completed waves must land in the ring buffer"
+        for r in recs:
+            assert r.pods > 0 and r.pad >= r.pods
+            assert 0.0 < r.occupancy <= 1.0
+            assert r.duration_s > 0.0
+            assert "bind" in r.phases and "finish" in r.phases
+        assert fr.summary()["waves_total"] == fr.phase_snapshot()["waves"]
+
+
+# ------------------------------------------------- golden bit-compat on/off
+
+
+class TestRecorderGolden:
+    """Full telemetry on vs default-off: byte-identical scheduling outcome.
+    Mirrors tests/test_dedup_golden.py TestFullPipelineGolden."""
+
+    @staticmethod
+    def _run(telemetry):
+        store = Store()
+        for i in range(6):
+            store.create(make_node(f"n{i}", cpu="4", mem="8Gi",
+                                   zone=f"z{i % 2}"))
+        for p in mixed_pods(30):
+            store.create(p)
+        kw = {}
+        if telemetry:
+            kw["tracer"] = Tracer("sched", InMemoryExporter(capacity=4096))
+            kw["metrics"] = SchedulerMetrics()
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
+                      seed=11, **kw)
+        if telemetry:
+            # arm the watchdog so aggressively every wave trips it — the
+            # profile capture thread must not perturb decisions either
+            s.flight_recorder.slow_wave_deadline_s = 1e-4
+            s.flight_recorder.profile_seconds = 0.01
+        s.start()
+        s.schedule_pending()
+        s.event_recorder.flush()
+        placed = {p.meta.name: p.spec.node_name for p in store.pods()}
+        diags = {}
+        for p in store.pods():
+            for c in p.status.conditions:
+                if c.type == "PodScheduled" and c.status == "False":
+                    diags[p.meta.name] = f"{c.reason}: {c.message}"
+        algo = s.algorithms["default-scheduler"]
+        rng_state = algo.rng.getstate() if algo.rng is not None else None
+        return placed, diags, rng_state, s
+
+    def test_full_telemetry_is_bit_compatible(self):
+        placed_off, diags_off, rng_off, _ = self._run(telemetry=False)
+        placed_on, diags_on, rng_on, s = self._run(telemetry=True)
+        assert placed_on == placed_off
+        assert diags_on == diags_off
+        assert rng_on == rng_off
+        assert sum(1 for v in placed_on.values() if v) > 0
+        assert diags_on, "scenario must exercise failures too"
+        # and the telemetry run must have actually recorded things
+        assert s.flight_recorder.records()
+        assert s.flight_recorder.slow_wave_captures > 0
+        assert "scheduler_tpu_wave_duration_seconds" in s.metrics.expose()
+
+
+# ---------------------------------------------------------------- watchdog
+
+
+class TestWatchdog:
+    def test_slow_wave_captures_profile(self):
+        fr = FlightRecorder(slow_wave_deadline_s=0.05, profile_seconds=0.05,
+                            metrics=SchedulerMetrics())
+        rec = fr.begin_wave(pods=8, pad=8)
+        time.sleep(0.2)  # blow the deadline while the wave is open
+        fr.end_wave(rec)
+        assert fr.slow_wave_captures == 1
+        assert rec.profile is not None
+        assert "slow wave 1" in rec.profile
+        assert "sampling profile:" in rec.profile
+        assert fr.metrics.slow_wave_captures_total.get() == 1.0
+        assert "[profile captured]" in format_postmortem(
+            [r.to_dict() for r in fr.records()]
+        )
+
+    def test_fast_wave_disarms_watchdog(self):
+        fr = FlightRecorder(slow_wave_deadline_s=0.1)
+        rec = fr.begin_wave(pods=8, pad=8)
+        fr.end_wave(rec)  # well inside the deadline: timer cancelled
+        time.sleep(0.25)
+        assert fr.slow_wave_captures == 0
+        assert rec.profile is None
+        assert not fr._watchdogs, "end_wave must disarm its timer"
+
+    def test_watchdog_off_by_default(self):
+        assert FlightRecorder().slow_wave_deadline_s is None
+        fr = FlightRecorder(slow_wave_deadline_s=0)  # 0 == off, not "instant"
+        rec = fr.begin_wave(pods=1)
+        assert not fr._watchdogs
+        fr.end_wave(rec)
+
+
+# --------------------------------------------------- exposition goldens
+
+
+class TestMetricsExposition:
+    @staticmethod
+    def _completed_record():
+        rec = WaveRecord(wave_id=1, started_at=0.0, pods=30, pad=32)
+        rec.duration_s = 0.125
+        rec.occupancy = 0.9375
+        rec.signatures = 3
+        rec.clones = 27
+        rec.distinct_signature_ratio = 0.1
+        rec.dedup_tier = "dedup"
+        rec.phases = {"kernel": 0.1, "bind": 0.02}
+        rec.fallback_reason = "resync: planes changed"
+        return rec
+
+    def test_wave_series_exposed(self):
+        m = SchedulerMetrics()
+        m.wave_completed(self._completed_record())
+        text = m.expose()
+        assert "# TYPE scheduler_tpu_wave_duration_seconds histogram" in text
+        assert "scheduler_tpu_wave_duration_seconds_count 1" in text
+        assert ('scheduler_tpu_wave_phase_duration_seconds_count'
+                '{phase="kernel"} 1') in text
+        assert ('scheduler_tpu_wave_phase_duration_seconds_count'
+                '{phase="bind"} 1') in text
+        assert "scheduler_tpu_wave_dedup_ratio 0.1" in text
+        assert "scheduler_tpu_signature_cache_hits_total 27.0" in text
+        # fallback reason cardinality is bounded: detail after ':' stripped
+        assert ('scheduler_tpu_wave_fallbacks_total{reason="resync"} 1.0'
+                in text)
+        assert "planes changed" not in text
+
+    def test_sli_quantile_gauges(self):
+        m = SchedulerMetrics()
+        m._sli_samples = [float(i) for i in range(1, 101)]
+        m.update_sli_quantiles()
+        text = m.expose()
+        assert ('scheduler_pod_scheduling_sli_quantile_seconds'
+                '{quantile="p50"} 51.0') in text
+        assert ('scheduler_pod_scheduling_sli_quantile_seconds'
+                '{quantile="p99"} 100.0') in text
+
+    def test_end_wave_lands_series_via_recorder(self):
+        m = SchedulerMetrics()
+        fr = FlightRecorder(metrics=m, slow_wave_deadline_s=None)
+        rec = fr.begin_wave(pods=8, pad=8)
+        fr.note_launch(rec, signatures=2, dedup=True)
+        fr.end_wave(rec)
+        assert m.wave_duration.count() == 1
+        assert m.signature_cache_hits.get() == 6.0
+        assert m.wave_dedup_ratio.get() == 0.25
+
+
+# ------------------------------------------------- event recorder counters
+
+
+class TestEventRecorderMetrics:
+    def test_dispositions_counted(self):
+        store = Store()
+        rec = EventRecorder(store)
+        rec.metrics = SchedulerMetrics()
+        pod = make_pod("p0", cpu="1", mem="1Gi")
+        for _ in range(rec.AGGREGATE_SPILL + 5):
+            rec.event(pod, "Normal", "Scheduled", "bound", correlation="w1")
+        assert rec.metrics.events_total.get("recorded") == \
+            float(rec.AGGREGATE_SPILL)
+        assert rec.metrics.events_total.get("aggregated") == 5.0
+        assert 'scheduler_events_total{disposition="aggregated"} 5.0' \
+            in rec.metrics.expose()
+
+    def test_gc_reports_pruned_count(self):
+        from kubernetes_tpu.api.events import Event
+        from kubernetes_tpu.api.meta import ObjectMeta
+
+        store = Store()
+        rec = EventRecorder(store)
+        rec.metrics = SchedulerMetrics()
+        stale = Event(meta=ObjectMeta(name="stale"), involved_object="Pod/x",
+                      reason="R", message="old",
+                      first_timestamp=1.0, last_timestamp=1.0)
+        store.create(stale)
+        fresh = Event(meta=ObjectMeta(name="fresh"), involved_object="Pod/y",
+                      reason="R", message="new",
+                      first_timestamp=time.time(),
+                      last_timestamp=time.time())
+        store.create(fresh)
+        assert rec._gc() == 1
+        assert rec.metrics.events_gc_pruned.get() == 1.0
+        events, _ = store.list("Event")
+        assert [e.meta.name for e in events] == ["fresh"]
+
+
+# --------------------------------------------------------------------- CLI
+
+
+class TestCli:
+    def test_demo_smoke(self, capsys):
+        assert fr_main(["--demo"]) == 0
+        out = capsys.readouterr().out
+        assert "slowest phases" in out  # table header
+        assert "[profile captured]" in out  # demo trips the watchdog once
+        assert "tie-break draw overflow" in out
+        assert "summary:" in out
+
+    def test_schema_lists_public_fields_only(self, capsys):
+        assert fr_main(["--schema"]) == 0
+        fields = capsys.readouterr().out.split()
+        assert "wave_id" in fields and "fallback_reason" in fields
+        assert not any(f.startswith("_") for f in fields)
+
+    def test_dump_file_roundtrip(self, tmp_path, capsys):
+        fr = FlightRecorder(capacity=8, slow_wave_deadline_s=None)
+        drain_waves(fr, 5)
+        p = tmp_path / "dump.json"
+        p.write_text(fr.dump())
+        assert fr_main([str(p), "--last", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "waves_recorded=5" in out
+        # --last trims the table to the newest records
+        assert " 5 " in out.splitlines()[2] or "5" in out.splitlines()[2]
+        assert len([ln for ln in out.splitlines()
+                    if ln and ln[0].isdigit()]) == 2
+
+    def test_no_args_prints_usage(self, capsys):
+        assert fr_main([]) == 2

@@ -619,6 +619,72 @@ class TestSignatureSync:
         assert list(SignatureSyncChecker().check_project(tmp_path)) == []
 
 
+# ------------------------------------------------------------------ OBS01
+
+
+class TestObsPurity:
+    def test_recorder_call_in_jit_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def kernel(x, recorder):
+                with recorder.phase("kernel"):
+                    return x + 1
+        """)
+        assert rules(fs) == ["OBS01"]
+        assert "host-side only" in fs[0].message
+
+    def test_tracer_span_in_jit_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import functools, jax
+
+            @functools.partial(jax.jit, static_argnums=0)
+            def scan(cfg, x, tracer):
+                with tracer.span("scan"):
+                    return x * 2
+        """)
+        assert rules(fs) == ["OBS01"]
+
+    def test_metrics_call_in_helper_reached_from_jit(self, tmp_path):
+        # the closure walk JIT01-03 use covers referenced helpers too
+        fs = lint(tmp_path, """
+            import jax
+
+            def observe_step(metrics, x):
+                metrics.observe_wave_phase("kernel", 0.1)
+                return x
+
+            @jax.jit
+            def kernel(metrics, x):
+                return observe_step(metrics, x)
+        """)
+        assert rules(fs) == ["OBS01"]
+
+    def test_host_side_telemetry_ok(self, tmp_path):
+        # no jit decorator: recording after collect is the sanctioned path
+        fs = lint(tmp_path, """
+            def collect(self, fl):
+                rec = fl.record
+                with self.recorder.wave_phase("wait", rec):
+                    out = fl.info
+                self.recorder.end_wave(rec)
+                return out
+        """)
+        assert fs == []
+
+    def test_suppression_silences_obs01(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def kernel(x, span):
+                span.set(step=1)  # kubesched-lint: disable=OBS01
+                return x
+        """)
+        assert fs == []
+
+
 # ----------------------------------------------------------- suppressions
 
 
@@ -682,7 +748,8 @@ class TestCli:
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rule in ("JIT01", "JIT02", "JIT03", "JIT04", "LOCK01", "LOCK02",
-                     "LOCK03", "SNAP01", "REG01", "REG02", "SIG01", "LINT00"):
+                     "LOCK03", "SNAP01", "REG01", "REG02", "SIG01", "OBS01",
+                     "LINT00"):
             assert rule in out
 
     def test_rule_ids_documented_in_readme(self):
