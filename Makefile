@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test obs
+.PHONY: lint test obs chaos
 
 # kubesched-lint: AST invariant checker (rule IDs in README "Invariants");
 # exits non-zero on any unsuppressed finding
@@ -11,6 +11,12 @@ lint:
 
 test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# seeded chaos soak: scale-churn under the standard fault schedule must
+# converge (all pods bound, no leaked assumes, breaker trips AND recovers);
+# exits non-zero on divergence — same seed replays the same schedule
+chaos:
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --seed 7
 
 # flight-recorder CLI smoke: synthetic multi-wave run (no device, no jax),
 # exercises ring buffer + watchdog + post-mortem formatting

@@ -4,8 +4,10 @@ Mechanically enforces the contracts the paper's bit-compat claim rests on:
 jit purity (JIT01-JIT04), lock discipline in the threaded scheduler modules
 (LOCK01-LOCK03), snapshot immutability outside the cache layer (SNAP01),
 kernel/registry constant sync (REG01-REG02), signature-fragment
-purity/coverage for the batching hint path (SIG01), and host-side-only
-telemetry — no recorder/tracer/metrics calls inside traced code (OBS01).
+purity/coverage for the batching hint path (SIG01), host-side-only
+telemetry — no recorder/tracer/metrics calls inside traced code (OBS01),
+and retry/fault-injection discipline — no hand-rolled backoff loops or
+ad-hoc random flakes outside the shared helpers (RET01).
 
 CLI: `python -m kubernetes_tpu.analysis [paths]` (exit 1 on findings);
 suppress a single line with `# kubesched-lint: disable=RULE`.
@@ -25,6 +27,7 @@ from .jit_purity import JitPurityChecker
 from .lock_discipline import LockDisciplineChecker
 from .obs_purity import ObservabilityPurityChecker
 from .registry_sync import RegistrySyncChecker
+from .retry_discipline import RetryDisciplineChecker
 from .signature_sync import SignatureSyncChecker
 from .snapshot_immutability import SnapshotImmutabilityChecker
 
@@ -37,6 +40,7 @@ __all__ = [
     "ObservabilityPurityChecker",
     "ProjectChecker",
     "RegistrySyncChecker",
+    "RetryDisciplineChecker",
     "SignatureSyncChecker",
     "SnapshotImmutabilityChecker",
     "check_file",

@@ -109,6 +109,7 @@ def default_checkers() -> list[Checker]:
     from .lock_discipline import LockDisciplineChecker
     from .obs_purity import ObservabilityPurityChecker
     from .registry_sync import RegistrySyncChecker
+    from .retry_discipline import RetryDisciplineChecker
     from .signature_sync import SignatureSyncChecker
     from .snapshot_immutability import SnapshotImmutabilityChecker
 
@@ -119,6 +120,7 @@ def default_checkers() -> list[Checker]:
         RegistrySyncChecker(),
         SignatureSyncChecker(),
         ObservabilityPurityChecker(),
+        RetryDisciplineChecker(),
     ]
 
 

@@ -1,0 +1,89 @@
+"""Retry / fault-injection discipline (RET01).
+
+The degradation ladder rests on two shared facilities: bounded retry with
+full-jitter backoff lives in `utils/backoff.py` (retry_call), and fault
+injection lives in `utils/faultinject.py` (FaultRegistry behind named
+points). Both are easy to bypass — a hand-rolled `while: try/except:
+time.sleep(...)` loop reinvents backoff without the attempt bound, jitter,
+or abort hook; an ad-hoc `if rng.random() < p: raise` flake makes a test
+nondeterministic and invisible to the registry's seed/replay machinery.
+
+RET01 flags both shapes everywhere except the two modules that own them:
+
+- a `time.sleep` call inside an except handler inside a loop (the
+  hand-rolled retry-backoff shape; `sleep` outside an except handler —
+  polling loops — is fine and covered by LOCK03 where it matters), and
+- a `raise` under an `if` whose condition draws randomness (`random()`,
+  `randrange`, `randint`, `uniform`, `getrandbits`, `choice`) — the
+  ad-hoc fault flake shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, ModuleContext
+from .jit_purity import _dotted
+
+RET01 = "RET01"
+
+# modules that OWN the shared facilities and may use the raw shapes
+EXEMPT_SUFFIXES = ("utils/backoff.py", "utils/faultinject.py")
+
+RANDOM_FNS = {"random", "randrange", "randint", "uniform", "getrandbits",
+              "choice"}
+
+
+def _calls_randomness(test: ast.expr) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is not None and d.split(".")[-1] in RANDOM_FNS:
+                return True
+    return False
+
+
+class RetryDisciplineChecker(Checker):
+    rules = {
+        RET01: "hand-rolled retry backoff or ad-hoc random fault — use "
+               "utils.backoff.retry_call / utils.faultinject.FaultRegistry",
+    }
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.posix_path.endswith(EXEMPT_SUFFIXES):
+            return
+        yield from self._scan(ctx, ctx.tree, in_loop=False, in_except=False)
+
+    def _scan(self, ctx: ModuleContext, node: ast.AST,
+              in_loop: bool, in_except: bool) -> Iterable[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # a nested def is its own retry context
+                yield from self._scan(ctx, child, False, False)
+                continue
+            loop = in_loop or isinstance(child, (ast.While, ast.For))
+            exc = in_except or isinstance(child, ast.ExceptHandler)
+            if (loop and exc and isinstance(child, ast.Call)):
+                d = _dotted(child.func)
+                if d is not None and d.split(".")[-1] == "sleep":
+                    yield Finding(
+                        ctx.posix_path, child.lineno, child.col_offset,
+                        RET01,
+                        "sleep in an except handler inside a loop — "
+                        "hand-rolled retry backoff; use "
+                        "utils.backoff.retry_call",
+                    )
+            if isinstance(child, ast.If) and _calls_randomness(child.test):
+                for sub in child.body:
+                    for raise_node in ast.walk(sub):
+                        if isinstance(raise_node, ast.Raise):
+                            yield Finding(
+                                ctx.posix_path, raise_node.lineno,
+                                raise_node.col_offset, RET01,
+                                "raise gated on a random draw — ad-hoc "
+                                "fault flake; inject through "
+                                "utils.faultinject.FaultRegistry",
+                            )
+            yield from self._scan(ctx, child, loop, exc)

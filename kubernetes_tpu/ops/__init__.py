@@ -7,6 +7,7 @@ must match the host path exactly); all other kernel dtypes are explicit
 
 from .vocab import ClusterVocabs, Vocab, next_pow2
 from .planes import (
+    DeviceFlakeError,
     FallbackNeeded,
     Planes,
     PlaneBuilder,
@@ -24,7 +25,8 @@ from .kernels import (
 )
 
 __all__ = [
-    "ClusterVocabs", "Vocab", "next_pow2", "FallbackNeeded", "Planes",
+    "ClusterVocabs", "Vocab", "next_pow2", "DeviceFlakeError",
+    "FallbackNeeded", "Planes",
     "PlaneBuilder", "PodFeatureExtractor", "pad_features", "stack_features",
     "FILTER_NAMES", "KernelConfig", "batched_assign", "fit_and_score",
 ]

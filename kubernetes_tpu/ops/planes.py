@@ -422,6 +422,22 @@ class FallbackNeeded(Exception):
     """Raised when a pod uses features the dense kernel does not model yet;
     the caller must run the host scheduling path for this pod."""
 
+    # subclasses representing a real device failure (as opposed to a benign
+    # "this pod isn't kernelizable") set this True; the TPU circuit breaker
+    # counts only those toward tripping — duck-typed so consumers in the
+    # host-side scheduler never import the tpu package to check
+    device_flake = False
+
+
+class DeviceFlakeError(FallbackNeeded):
+    """The device path itself failed (today: an injected tpu.launch /
+    tpu.collect fault; tomorrow: a real runtime error wrapped at the
+    backend boundary). Handled exactly like FallbackNeeded — the wave's
+    pods re-run per-pod, landing on the host tier — but ALSO counts as a
+    circuit-breaker failure."""
+
+    device_flake = True
+
 
 class PodFeatureExtractor:
     """Resolves one Pod against the vocabularies into fixed-shape arrays.

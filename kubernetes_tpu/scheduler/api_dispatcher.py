@@ -16,11 +16,17 @@ Semantics preserved:
 
 from __future__ import annotations
 
+import os
 import queue as _queue
+import random
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
+
+from ..store.store import ConflictError
+from ..utils import faultinject
+from ..utils.backoff import RetryPolicy, retry_call
 
 # call-type relevance (api_calls.go Relevances): higher wins on conflict
 POD_STATUS_PATCH = "pod_status_patch"
@@ -31,6 +37,22 @@ RELEVANCES = {POD_STATUS_PATCH: 1, POD_BINDING: 2, POD_DELETE: 3}
 
 class CallSkippedError(Exception):
     """A queued more-relevant call made this one redundant."""
+
+
+class DispatcherClosedError(Exception):
+    """Terminal: the dispatcher shut down before this call could run."""
+
+
+def _default_retry_policy() -> RetryPolicy:
+    """Transient store conflicts and injected flakes merit another attempt;
+    NotFoundError (pod deleted mid-flight) and everything else must surface
+    through on_finish unchanged."""
+    return RetryPolicy(
+        max_attempts=int(os.environ.get("KUBE_TPU_RETRY_MAX", "4")),
+        base_s=float(os.environ.get("KUBE_TPU_RETRY_BASE_S", "0.002")),
+        cap_s=float(os.environ.get("KUBE_TPU_RETRY_CAP_S", "0.1")),
+        retryable=(ConflictError, faultinject.TransientFault),
+    )
 
 
 @dataclass
@@ -55,16 +77,23 @@ class APICall:
 class APIDispatcher:
     """Queue + workers (api_dispatcher.go APIDispatcher)."""
 
-    def __init__(self, parallelism: int = 16, metrics=None, tracer=None):
+    def __init__(self, parallelism: int = 16, metrics=None, tracer=None,
+                 retry_policy: RetryPolicy | None = None, recorder=None):
         self.parallelism = parallelism
         self.metrics = metrics
         self.tracer = tracer  # optional utils.tracing.Tracer: span per call
+        self.recorder = recorder  # optional FlightRecorder: retry counts
+        self.retry_policy = retry_policy or _default_retry_policy()
+        self._retry_rng = random.Random(0xD15)  # jitter only, never decisions
         self._queued: dict[str, APICall] = {}  # object key -> pending call
         self._inflight: set[str] = set()  # keys a worker is executing now
+        self._parked: set[str] = set()  # deferred keys awaiting in-flight done
         self._order: _queue.Queue = _queue.Queue()
         self._lock = threading.Lock()
         self._workers: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._closed = False
+        self.retries = 0  # total retry attempts absorbed by backoff
         # worker busy-seconds: on a GIL'd single-core host this time is
         # stolen from the scheduling thread, so the bench wall-coverage
         # accounting must see it
@@ -77,45 +106,64 @@ class APIDispatcher:
         merged-into call when dedup applies). Raises CallSkippedError when a
         more relevant call is already pending for the object."""
         superseded: APICall | None = None
+        rejected = False
         with self._lock:
-            pending = self._queued.get(call.object_key)
-            if pending is not None:
-                if not call.sync_or_merge(pending):
-                    raise CallSkippedError(
-                        f"{call.call_type} for {call.object_key} skipped: "
-                        f"{pending.call_type} already queued"
-                    )
-                if call.call_type == pending.call_type:
-                    # same type: COMPOSE — two status patches touch
-                    # independent fields; dropping one loses an update
-                    old_exec, new_exec = pending.execute, call.execute
-
-                    def composed(old_exec=old_exec, new_exec=new_exec):
-                        old_exec()
-                        new_exec()
-
-                    pending.execute = composed
-                    old_finish, new_finish = pending.on_finish, call.on_finish
-                    if old_finish is not None and new_finish is not None:
-                        pending.on_finish = lambda err: (old_finish(err),
-                                                         new_finish(err))
-                    else:
-                        pending.on_finish = new_finish or old_finish
-                    return pending
-                # higher relevance REPLACES (a delete supersedes a binding):
-                # the superseded call never runs — its waiters must see a
-                # skip error, NOT inherit the new call's outcome (a binder
-                # waiting on a bind replaced by an eviction would otherwise
-                # 'succeed' and mark a deleted pod scheduled)
-                superseded = pending
-                self._queued[call.object_key] = call
-                # the key is already in _order; the worker will pop the
-                # replacement
+            if self._closed:
+                rejected = True
             else:
-                self._queued[call.object_key] = call
-                self._order.put(call.object_key)
-            if self.metrics is not None:
-                self.metrics.async_api_pending.set(len(self._queued))
+                pending = self._queued.get(call.object_key)
+                if pending is not None:
+                    if not call.sync_or_merge(pending):
+                        raise CallSkippedError(
+                            f"{call.call_type} for {call.object_key} "
+                            f"skipped: {pending.call_type} already queued"
+                        )
+                    if call.call_type == pending.call_type:
+                        # same type: COMPOSE — two status patches touch
+                        # independent fields; dropping one loses an update
+                        old_exec, new_exec = pending.execute, call.execute
+
+                        def composed(old_exec=old_exec, new_exec=new_exec):
+                            old_exec()
+                            new_exec()
+
+                        pending.execute = composed
+                        old_finish, new_finish = (pending.on_finish,
+                                                  call.on_finish)
+                        if old_finish is not None and new_finish is not None:
+                            pending.on_finish = lambda err: (
+                                old_finish(err), new_finish(err))
+                        else:
+                            pending.on_finish = new_finish or old_finish
+                        return pending
+                    # higher relevance REPLACES (a delete supersedes a
+                    # binding): the superseded call never runs — its waiters
+                    # must see a skip error, NOT inherit the new call's
+                    # outcome (a binder waiting on a bind replaced by an
+                    # eviction would otherwise 'succeed' and mark a deleted
+                    # pod scheduled)
+                    superseded = pending
+                    self._queued[call.object_key] = call
+                    # the key is already in _order; the worker will pop the
+                    # replacement
+                else:
+                    self._queued[call.object_key] = call
+                    self._order.put(call.object_key)
+                if self.metrics is not None:
+                    self.metrics.async_api_pending.set(len(self._queued))
+        if rejected:
+            # terminal, not silent: a caller that waits on call.done after
+            # shutdown must wake with an error, exactly like close() treats
+            # the calls it found queued
+            err = DispatcherClosedError(
+                f"{call.call_type} for {call.object_key} rejected: "
+                "dispatcher closed"
+            )
+            call.error = err
+            if call.on_finish is not None:
+                call.on_finish(err)
+            call.done.set()
+            return call
         if superseded is not None:
             err = CallSkippedError(
                 f"{superseded.call_type} for {superseded.object_key} "
@@ -171,21 +219,18 @@ class APIDispatcher:
                 continue
             with self._lock:
                 if key in self._inflight:
-                    # strictly one executing call per object: requeue until
-                    # the in-flight one finishes (call_queue.go semantics)
-                    self._order.put(key)
-                    defer = True
+                    # strictly one executing call per object
+                    # (call_queue.go semantics): PARK the key — the worker
+                    # finishing the in-flight call re-enqueues it, so no
+                    # thread spins re-putting/re-popping it every ~1ms
+                    self._parked.add(key)
                     call = None
                 else:
-                    defer = False
                     call = self._queued.pop(key, None)
                     if call is not None:
                         self._inflight.add(key)
                     if self.metrics is not None:
                         self.metrics.async_api_pending.set(len(self._queued))
-            if defer:
-                time.sleep(0.001)
-                continue
             if call is None:
                 continue
             try:
@@ -193,11 +238,21 @@ class APIDispatcher:
             finally:
                 with self._lock:
                     self._inflight.discard(key)
+                    if key in self._parked:
+                        self._parked.discard(key)
+                        # only re-enqueue if a call is actually still queued
+                        # for the key — it may have been superseded or
+                        # drained while parked
+                        if key in self._queued:
+                            self._order.put(key)
 
     def _execute(self, call: APICall) -> None:
         err: Exception | None = None
-        t0 = time.perf_counter()
-        try:
+        # box, not int: on_backoff is a closure mutating across attempts
+        stats = {"attempts": 1, "backoff_s": 0.0}
+
+        def attempt():
+            faultinject.fire("dispatcher.execute")
             if self.tracer is not None:
                 # worker threads get their own span stacks (thread-local),
                 # so each api/<type> call exports as its own root span
@@ -206,11 +261,40 @@ class APIDispatcher:
                     call.execute()
             else:
                 call.execute()
+
+        def on_backoff(attempt_no: int, delay_s: float) -> None:
+            stats["attempts"] = attempt_no + 1
+            stats["backoff_s"] += delay_s
+
+        t0 = time.perf_counter()
+        try:
+            # bounded retry absorbs transient failures (store conflicts,
+            # injected flakes) without ever releasing the object key: the
+            # one-in-flight-per-object and relevance-supersede invariants
+            # hold across attempts because the key stays in _inflight
+            retry_call(
+                attempt,
+                self.retry_policy,
+                self._retry_rng,
+                should_abort=self._stop.is_set,
+                on_backoff=on_backoff,
+            )
         except Exception as e:  # noqa: BLE001 - surfaced via on_finish
             err = e
         finally:
             with self._lock:
                 self.exec_seconds += time.perf_counter() - t0
+                self.retries += stats["attempts"] - 1
+        if stats["attempts"] > 1:
+            if self.recorder is not None:
+                self.recorder.note_retries(stats["attempts"] - 1)
+            if self.metrics is not None:
+                self.metrics.async_api_retries.observe(
+                    stats["attempts"], call.call_type
+                )
+                self.metrics.async_api_backoff_seconds.observe(
+                    stats["backoff_s"], call.call_type
+                )
         call.error = err
         if self.metrics is not None:
             self.metrics.async_api_calls.inc(
@@ -246,12 +330,36 @@ class APIDispatcher:
             finally:
                 with self._lock:
                     self._inflight.discard(key)
+                    if key in self._parked:
+                        self._parked.discard(key)
+                        if key in self._queued:
+                            self._order.put(key)
 
     def close(self) -> None:
+        """Stop workers and FAIL whatever is still queued: every waiter on
+        call.done wakes with a terminal DispatcherClosedError and on_finish
+        fires exactly once — close never silently abandons a call."""
         self._stop.set()
         for t in self._workers:
             t.join(timeout=1)
         self._workers.clear()
+        with self._lock:
+            self._closed = True
+            abandoned = list(self._queued.values())
+            self._queued.clear()
+            self._parked.clear()
+            if self.metrics is not None:
+                self.metrics.async_api_pending.set(0)
+        # outside the lock: on_finish may re-enter the dispatcher
+        for call in abandoned:
+            err = DispatcherClosedError(
+                f"{call.call_type} for {call.object_key} abandoned: "
+                "dispatcher closed"
+            )
+            call.error = err
+            if call.on_finish is not None:
+                call.on_finish(err)
+            call.done.set()
 
 
 class APICacher:

@@ -118,6 +118,31 @@ class SchedulerMetrics:
         self.async_api_pending = r.gauge(
             "scheduler_pending_async_api_calls", "Queued async API calls",
         )
+        self.async_api_retries = r.histogram(
+            "scheduler_async_api_call_attempts",
+            "Attempts per async API call that needed retrying",
+            labels=("call_type",), buckets=(1, 2, 3, 4, 6, 8),
+        )
+        self.async_api_backoff_seconds = r.histogram(
+            "scheduler_async_api_call_backoff_seconds",
+            "Total backoff slept per retried async API call",
+            labels=("call_type",),
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0),
+        )
+        # TPU device-path circuit breaker (degradation ladder)
+        self.circuit_breaker_state = r.gauge(
+            "scheduler_tpu_circuit_breaker_state",
+            "TPU device-path breaker state (0=closed 1=half_open 2=open)",
+        )
+        self.circuit_breaker_transitions = r.counter(
+            "scheduler_tpu_circuit_breaker_transitions_total",
+            "TPU device-path breaker state transitions",
+            labels=("from_state", "to_state"),
+        )
+        self.wave_injected_faults = r.counter(
+            "scheduler_tpu_wave_injected_faults_total",
+            "Chaos faults fired during completed waves' flight windows",
+        )
         # TPU backend (new: kernel-vs-host path split)
         self.kernel_dispatches = r.counter(
             "scheduler_tpu_kernel_dispatches_total",
@@ -260,6 +285,17 @@ class SchedulerMetrics:
         if record.fallback_reason:
             # reason cardinality is bounded: strip per-wave detail after ':'
             self.wave_fallbacks.inc(record.fallback_reason.split(":")[0])
+        if record.injected_faults:
+            self.wave_injected_faults.inc(by=record.injected_faults)
+
+    def breaker_transition(self, old_state: str, new_state: str) -> None:
+        """TPU circuit-breaker state change (flightrecorder fan-out). The
+        value map mirrors circuitbreaker.STATE_VALUES — inlined so importing
+        metrics never drags the tpu package."""
+        self.circuit_breaker_state.set(
+            {"closed": 0, "half_open": 1, "open": 2}.get(new_state, -1)
+        )
+        self.circuit_breaker_transitions.inc(old_state, new_state)
 
     def slow_wave_captured(self) -> None:
         self.slow_wave_captures_total.inc()

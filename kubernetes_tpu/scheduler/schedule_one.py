@@ -515,10 +515,25 @@ class ScheduleOneLoop:
             # or a poisoned carry): drain before launching
             processed += self._flush_wave_pipeline()
 
+        breaker = getattr(algo, "breaker", None)
+        if breaker is not None and not breaker.allow_device_wave():
+            # breaker OPEN (or probes exhausted): skip the device launch
+            # entirely — drain whatever is in flight (strict queue order)
+            # and run the wave per-pod; while the breaker is cooling,
+            # schedule_pod's device_blocked() check routes each pod to the
+            # host tier
+            processed += self._flush_wave_pipeline()
+            with self.recorder.phase("finish"):
+                for qpi in wave:
+                    algo.revert_wave_plan(qpi.pod)
+                    self.schedule_pod_info(qpi)
+            return processed + len(wave)
+
         with self.recorder.phase("snapshot"):
             self.cache.update_snapshot(self.snapshot)
         pods = [qpi.pod for qpi in wave]
         fl = None
+        flake: Exception | None = None
         for attempt in (0, 1):
             try:
                 with self.recorder.phase("kernel"):
@@ -533,11 +548,20 @@ class ScheduleOneLoop:
                 algo.backend.invalidate_carry()
                 with self.recorder.phase("snapshot"):
                     self.cache.update_snapshot(self.snapshot)
-            except FallbackNeeded:
+            except FallbackNeeded as e:
+                if getattr(e, "device_flake", False):
+                    flake = e
                 break
         if fl is None:
-            # not kernelizable (stale vocab etc.): strict queue order —
-            # whatever is in flight precedes these pods
+            # not kernelizable (stale vocab etc.) or injected launch flake:
+            # strict queue order — whatever is in flight precedes these pods
+            if breaker is not None:
+                if flake is not None:
+                    breaker.record_failure(str(flake))
+                else:
+                    # no device verdict either way (resync exhaustion,
+                    # benign fallback): release a half-open probe slot
+                    breaker.record_benign()
             processed += self._flush_wave_pipeline()
             algo.fallback_count += len(wave)
             with self.recorder.phase("finish"):
@@ -575,14 +599,21 @@ class ScheduleOneLoop:
             f"wave/{record.wave_id if record is not None else 0}",
             pods=len(wave),
         ):
+            breaker = getattr(algo, "breaker", None)
             try:
                 with rec.phase("kernel"):
                     hosts, planes = algo.backend.collect(fl, rng=algo.rng)
-            except FallbackNeeded:
-                # tie-draw overflow or poisoned carry: results discarded,
-                # pods re-run per-pod against live state; a successor
-                # launched on the bad carry is poisoned too. The backend
-                # already closed the flight record with the fallback reason.
+            except FallbackNeeded as e:
+                # tie-draw overflow, poisoned carry, or injected device
+                # flake: results discarded, pods re-run per-pod against
+                # live state; a successor launched on the bad carry is
+                # poisoned too. The backend already closed the flight
+                # record with the fallback reason.
+                if breaker is not None:
+                    if getattr(e, "device_flake", False):
+                        breaker.record_failure(str(e))
+                    else:
+                        breaker.record_benign()
                 self._poison_successor(algo)
                 algo.fallback_count += len(wave)
                 with rec.phase("finish"):
@@ -590,6 +621,11 @@ class ScheduleOneLoop:
                         algo.revert_wave_plan(qpi.pod)
                         self.schedule_pod_info(qpi)
                 return len(wave)
+            if breaker is not None:
+                # the device round-tripped a full wave: that is the
+                # breaker's success signal (host-side bind outcomes are a
+                # different failure domain)
+                breaker.record_success()
             algo.kernel_count += len(wave)
             with rec.phase("finish", record):
                 exported = self._export_wave_signatures(algo, fl, planes)

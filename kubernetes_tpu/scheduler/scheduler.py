@@ -196,7 +196,8 @@ class Scheduler:
             from .api_dispatcher import APICacher, APIDispatcher
 
             self.api_dispatcher = APIDispatcher(parallelism, metrics=metrics,
-                                                tracer=tracer)
+                                                tracer=tracer,
+                                                recorder=self.flight_recorder)
             self.api_dispatcher.run()
             self.api_cacher = APICacher(store, self.api_dispatcher)
             # event flushes ride the dispatcher too: maybe_flush enqueues the
@@ -395,8 +396,46 @@ class Scheduler:
     # -- run -----------------------------------------------------------------
 
     def start(self) -> None:
-        """Sync informers (initial list)."""
+        """Sync informers (initial list), then reconcile half-applied state
+        a previous incarnation may have left behind."""
         self.informers.start_all()
+        self.reconcile()
+
+    def reconcile(self) -> dict:
+        """Startup crash recovery: resolve every assumed-but-unconfirmed pod
+        against store truth. A scheduler killed between assume and the
+        async store write leaves the cache claiming resources the cluster
+        never granted; one killed between the write and the confirming
+        watch event leaves a bound pod still marked assumed. Store truth
+        decides: bound → adopt; gone → forget; unbound → forget + requeue
+        (the bind never happened, the pod must be scheduled again)."""
+        stats = {"adopted": 0, "forgotten": 0, "requeued": 0}
+        for pod in self.cache.assumed_pods():
+            key = pod.meta.key
+            cur = self.store.try_get("Pod", key)
+            if cur is None:
+                self.cache.forget_pod(pod)
+                stats["forgotten"] += 1
+                continue
+            if cur.spec.node_name:
+                # the bind landed (possibly on a different node than
+                # assumed): add_pod confirms a matching assume and
+                # re-places a divergent one
+                self.cache.add_pod(cur)
+                stats["adopted"] += 1
+                continue
+            # half-applied: assumed in cache, store write never landed
+            self.cache.forget_pod(pod)
+            stats["forgotten"] += 1
+            # clear any stale in-flight queue record surviving the crash
+            # (token=None clears unconditionally), then requeue
+            self.queue.done(key)
+            self.queue.add(cur, PodInfo(cur, self.names))
+            stats["requeued"] += 1
+        if stats["adopted"] or stats["forgotten"]:
+            # node occupancy changed under any live device carry
+            self._mark_external()
+        return stats
 
     def pump(self) -> int:
         """Drain informer events (deterministic single-thread mode)."""
@@ -445,6 +484,16 @@ class Scheduler:
                     # declaring the queue drained
                     with self.flight_recorder.phase("drain"):
                         self.api_dispatcher.drain(timeout=1.0)
+                if idle_rounds == 2:
+                    # last chance before declaring drained: a dropped watch
+                    # delivery (lossy stream, injected watch.deliver fault)
+                    # can strand a pod invisible to the queue forever —
+                    # diff-repair the informer caches and go around again
+                    # if anything changed
+                    with self.flight_recorder.phase("pump"):
+                        repaired = self.informers.resync_all()
+                    if repaired:
+                        idle_rounds = 0
                 if idle_rounds > 2:
                     break
                 continue

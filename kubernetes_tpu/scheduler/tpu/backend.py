@@ -34,6 +34,7 @@ import jax
 from ...api.resource import ResourceNames
 from ...api.types import Pod
 from ...ops import (
+    DeviceFlakeError,
     FallbackNeeded,
     KernelConfig,
     PlaneBuilder,
@@ -43,6 +44,7 @@ from ...ops import (
     stack_features,
 )
 from ...ops.kernels import FILTER_NAMES
+from ...utils import faultinject
 from ..framework.interface import (
     Diagnosis,
     FitError,
@@ -547,6 +549,12 @@ class TPUBackend:
         from ...ops import pad_features
         from ...ops.kernels import MAX_TIE_DRAWS
 
+        try:
+            # before any state is touched: an injected launch flake leaves
+            # the carry, inflight frame, and rng exactly as they were
+            faultinject.fire("tpu.launch")
+        except faultinject.FaultInjected as e:
+            raise DeviceFlakeError(f"injected launch fault: {e}") from e
         self._rerun_carry = None  # a new launch closes any re-run window
         rec = self.recorder.begin_wave(pods=len(pods))
         with self.recorder.wave_phase("sync", rec):
@@ -652,6 +660,18 @@ class TPUBackend:
         untouched, carry invalidated — the successor launch, if any, must be
         poisoned by the caller)."""
         rec = fl.record
+        try:
+            faultinject.fire("tpu.collect")
+        except faultinject.FaultInjected as e:
+            # same contract as overflow: results discarded, rng untouched,
+            # carry invalidated; the caller must poison any successor wave
+            if self._inflight is fl:
+                self._inflight = None
+            self.invalidate_carry()
+            if rec is not None:
+                self.recorder.end_wave(
+                    rec, fallback_reason=f"injected: {e}")
+            raise DeviceFlakeError(f"injected collect fault: {e}") from e
         with self.recorder.wave_phase("wait", rec):
             packed = np.asarray(fl.info["packed"])
         winners = packed[: len(fl.pods)]
@@ -976,9 +996,16 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
                  nominator=None, host_tail_percentage: int = 0):
         super().__init__(framework, percentage_of_nodes_to_score=100,
                          rng=rng, nominator=nominator)
+        from .circuitbreaker import CircuitBreaker
+
         self.backend = backend
         self.fallback_count = 0
         self.kernel_count = 0
+        # degradation ladder rung 3: after N consecutive DEVICE failures
+        # (DeviceFlakeError — benign fallbacks don't count) waves bypass
+        # the device and ride the host tier until probe waves succeed
+        self.breaker = CircuitBreaker(
+            on_transition=self._on_breaker_transition)
         # pod key -> node-neutral PodVolumes assumed at wave admission
         self._wave_plans: dict[str, object] = {}
         # the dense kernel evaluates EVERY node for free, so the kernel
@@ -990,9 +1017,19 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         # evaluate everything, so small-cluster decisions are unchanged)
         self.host_tail_percentage = host_tail_percentage
 
+    def _on_breaker_transition(self, old: str, new: str, reason: str) -> None:
+        rec = getattr(self.backend, "recorder", None)
+        if rec is not None:
+            rec.breaker_transition(old, new, reason)
+
     def schedule_pod(self, state, pod: Pod, snapshot) -> ScheduleResult:
         if snapshot.num_nodes() == 0:
             raise FitError(pod, 0, Diagnosis())
+        if self.breaker.device_blocked():
+            # breaker OPEN and cooling: don't pay the device round trip —
+            # route straight to the host tier (pure read, no state change)
+            self.fallback_count += 1
+            return super().schedule_pod(state, pod, snapshot)
         pre_filter_done = None
         if pod.status.nominated_node_name:
             # evaluateNominatedNode fast path (schedule_one.go:718): try

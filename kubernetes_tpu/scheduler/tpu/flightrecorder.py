@@ -34,6 +34,7 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from ...utils import faultinject
 from ...utils.tracing import Tracer
 
 # loop-level pipeline phases (the phase_profile bench.py reports)
@@ -69,12 +70,16 @@ class WaveRecord:
     carry_invalidations: int = 0  # invalidations during this wave's flight
     cache_exports: int = 0  # signature hints exported to the BatchCache
     fallback_reason: str | None = None  # resync/fallback diagnosis, if any
+    injected_faults: int = 0  # chaos faults fired during this wave's flight
+    retries: int = 0  # dispatcher retry attempts during this wave's flight
     phases: dict = field(default_factory=dict)  # phase -> seconds
     duration_s: float = 0.0
     profile: str | None = None  # watchdog pprof capture, when triggered
     # internal bookkeeping (not serialized)
     _t0: float = 0.0
     _inv_base: int = 0
+    _fault_base: int = 0
+    _retry_base: int = 0
 
     def to_dict(self) -> dict:
         d = {
@@ -91,6 +96,8 @@ class WaveRecord:
             "carry_invalidations": self.carry_invalidations,
             "cache_exports": self.cache_exports,
             "fallback_reason": self.fallback_reason,
+            "injected_faults": self.injected_faults,
+            "retries": self.retries,
             "phases": {k: round(v, 6) for k, v in self.phases.items()},
         }
         if self.profile is not None:
@@ -124,8 +131,13 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._wave_seq = 0
         self.invalidations = 0  # cumulative carry invalidations
+        self.retries_total = 0  # cumulative dispatcher retry attempts
         self.slow_wave_captures = 0
         self._watchdogs: dict[int, threading.Timer] = {}
+        # circuit-breaker transition history (old, new, reason), bounded
+        self.breaker_events: "collections.deque[tuple]" = collections.deque(
+            maxlen=64
+        )
 
     # -- phase stopwatches (span-backed) --------------------------------------
 
@@ -174,6 +186,8 @@ class FlightRecorder:
                              pods=pods, pad=pad or pods)
             rec._t0 = time.perf_counter()
             rec._inv_base = self.invalidations
+            rec._fault_base = faultinject.fired_total()
+            rec._retry_base = self.retries_total
         if self.slow_wave_deadline_s:
             t = threading.Timer(self.slow_wave_deadline_s,
                                 self._capture_slow_wave, args=(rec,))
@@ -198,6 +212,21 @@ class FlightRecorder:
         with self._lock:
             self.invalidations += 1
 
+    def note_retries(self, n: int) -> None:
+        """The dispatcher absorbed n retry attempts (called from worker
+        threads); open wave records count retries in their window."""
+        with self._lock:
+            self.retries_total += n
+
+    def breaker_transition(self, old: str, new: str, reason: str) -> None:
+        """Record a TPU circuit-breaker state transition and land it on the
+        metrics registry (state gauge + transition counter)."""
+        with self._lock:
+            self.breaker_events.append((old, new, reason))
+        m = self.metrics
+        if m is not None and hasattr(m, "breaker_transition"):
+            m.breaker_transition(old, new)
+
     def end_wave(self, rec: WaveRecord,
                  fallback_reason: str | None = None) -> WaveRecord:
         """Finalize and ring-buffer a record; disarms the watchdog, attaches
@@ -213,6 +242,8 @@ class FlightRecorder:
             rec.fallback_reason = fallback_reason
         with self._lock:
             rec.carry_invalidations = self.invalidations - rec._inv_base
+            rec.injected_faults = faultinject.fired_total() - rec._fault_base
+            rec.retries = self.retries_total - rec._retry_base
             self._records.append(rec)
         m = self.metrics
         if m is not None:
@@ -265,6 +296,8 @@ class FlightRecorder:
             "waves_total": self.phase_snapshot().get("waves", 0),
             "slow_wave_captures": self.slow_wave_captures,
             "carry_invalidations": self.invalidations,
+            "retries_total": self.retries_total,
+            "breaker_transitions": len(self.breaker_events),
             "fallbacks": sum(1 for r in recs if r.fallback_reason),
             "wave_p50_s": (round(durations[len(durations) // 2], 4)
                            if durations else None),

@@ -24,6 +24,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Iterator
 
 from ..api.meta import new_uid
+from ..utils import faultinject
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -146,6 +147,15 @@ class Store:
             del log[: self._log_cap // 2]
             self._compacted_before[kind] = log[0].revision
         for w in self._watches.get(kind, []):
+            # per-watcher delivery drop (chaos: a lossy watch connection).
+            # _emit runs mid-write under _mu, so an ERROR-mode spec on this
+            # point must NOT corrupt the store state — it degrades to a
+            # drop; the event stays in the log, so a resync can repair it
+            try:
+                if faultinject.fire("watch.deliver"):
+                    continue
+            except faultinject.FaultInjected:
+                continue
             w._push(ev)
 
     def _remove_watch(self, kind: str, w: Watch) -> None:
@@ -161,6 +171,7 @@ class Store:
         object and returns None — for bulk loaders (the perf harness) that
         discard it; a deepcopy per created object is measurable at 11k
         objects."""
+        faultinject.fire("store.create")  # before _mu: may sleep or raise
         with self._mu:
             kind = self._kind_of(obj)
             objs = self._objects.setdefault(kind, {})
@@ -210,6 +221,7 @@ class Store:
 
     def update(self, obj: Any, *, check_version: bool = True) -> Any:
         """Optimistic-concurrency update; stamps a fresh resource_version."""
+        faultinject.fire("store.update")  # before _mu: may sleep or raise
         with self._mu:
             kind = self._kind_of(obj)
             objs = self._objects.setdefault(kind, {})
@@ -247,6 +259,7 @@ class Store:
         full-object round trip. One copy total — the emitted event shares
         the new stored object (informer convention: event objects are
         read-only, as in client-go's shared caches)."""
+        faultinject.fire("store.bind_pod")  # before _mu: may sleep or raise
         with self._mu:
             objs = self._objects.get("Pod", {})
             cur = objs.get(key)
@@ -289,6 +302,15 @@ class Store:
         with self._mu:
             objs = self._objects.get("Pod", {})
             for key, node_name in bindings:
+                # per-binding injection point: a fault here fails ONE pod's
+                # binding while its wave siblings' bindings land — the
+                # status string (never an exception) is how wave-level
+                # failure isolation reaches _apply_wave_bind_results
+                try:
+                    faultinject.fire("store.bind_pod")
+                except faultinject.FaultInjected as e:
+                    out.append(f"error: {e}")
+                    continue
                 cur = objs.get(key)
                 if cur is None:
                     out.append("missing")
@@ -314,6 +336,7 @@ class Store:
         whole-object write would silently unbind the pod). A failure
         condition (status=False) is dropped when the pod is already bound —
         the bind superseded it. Returns the stored object or None."""
+        faultinject.fire("store.patch_pod_status")  # before _mu
         with self._mu:
             objs = self._objects.get("Pod", {})
             cur = objs.get(key)
@@ -345,6 +368,7 @@ class Store:
             return obj
 
     def delete(self, kind: str, key: str) -> Any:
+        faultinject.fire("store.delete")  # before _mu: may sleep or raise
         with self._mu:
             objs = self._objects.get(kind, {})
             cur = objs.pop(key, None)
@@ -419,6 +443,20 @@ class Store:
                 w._push(ev)
             self._watches.setdefault(kind, []).append(w)
             return w
+
+    def sync_watch(self, kind: str) -> tuple[list[Any], Watch]:
+        """Atomic relist + fresh watch under ONE lock acquisition: the refs
+        reflect every write up to now and the new watch sees every write
+        after — no replay window, no gap, no duplicate. This is the repair
+        primitive for dropped watch deliveries (an informer resync): the
+        incremental watch(from_revision) path can't help there because the
+        lost events are still IN the log — only a state diff recovers them.
+        Returned objects follow the list_refs read-only convention."""
+        with self._mu:
+            refs = list(self._objects.get(kind, {}).values())
+            w = Watch(self, kind)
+            self._watches.setdefault(kind, []).append(w)
+            return refs, w
 
     # -- convenience typed helpers ----------------------------------------
 

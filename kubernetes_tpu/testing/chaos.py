@@ -1,0 +1,214 @@
+"""Seeded chaos soak: the scale-churn workload under a recorded fault
+schedule, asserting convergence.
+
+The harness wires a standard transient-fault schedule into the global
+`FaultRegistry` (store conflicts, dispatcher flakes, per-binding bind
+errors, a guaranteed burst of device-collect failures, dropped watch
+deliveries, create latency), runs a create/schedule/delete churn workload
+with the TPU wave pipeline + async dispatcher on, then disarms and drives
+the scheduler to convergence. The pass criteria are the degradation
+ladder's whole contract:
+
+- every surviving pod is bound (nothing stranded by a dropped event or a
+  failed bind — retry, wave isolation, and informer resync absorbed it),
+- no leaked cache assumes (reconciliation/failure paths forgot every
+  half-applied bind),
+- the TPU circuit breaker tripped AND recovered at least once (the
+  collect-fault burst is sized to guarantee both),
+- the queue is empty.
+
+Everything replays from one seed: the registry's per-spec rng streams are
+derived from it, so `python -m kubernetes_tpu.testing.chaos --seed 7`
+fails (or passes) identically run after run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from ..store.store import ConflictError, Store
+from ..utils import faultinject
+from ..utils.faultinject import DROP, ERROR, LATENCY, FaultSpec
+from .wrappers import make_node, make_pod
+
+
+def standard_schedule(registry: faultinject.FaultRegistry) -> None:
+    """Register the soak's transient-fault schedule (registry still owns
+    arming). Bounded `times` on every spec: the workload must outlive the
+    schedule, so convergence is eventually fault-free."""
+    # async dispatcher call flakes: absorbed by bounded retry + backoff
+    registry.register(FaultSpec(
+        "dispatcher.execute", mode=ERROR, transient=True,
+        probability=0.15, times=40, message="dispatcher flake"))
+    # store write conflicts (the real 409 shape): also retried
+    registry.register(FaultSpec(
+        "store.update", mode=ERROR, probability=0.2, times=30,
+        exc=ConflictError, message="injected conflict"))
+    # per-binding failures inside the wave transaction: wave siblings'
+    # bindings must land while the victim is retried alone
+    registry.register(FaultSpec(
+        "store.bind_pod", mode=ERROR, transient=True,
+        probability=0.1, times=20, message="bind flake"))
+    # guaranteed consecutive device-collect failures: trips the breaker
+    # (threshold 3), then one failed probe re-opens it, then exhaustion
+    # lets the probe waves through — trip AND recovery are certain
+    registry.register(FaultSpec(
+        "tpu.collect", mode=ERROR, transient=True,
+        start_after=6, times=4, message="device flake"))
+    # lossy watch stream: informer resync must repair the cache
+    registry.register(FaultSpec(
+        "watch.deliver", mode=DROP, probability=0.05, times=50))
+    # creation latency: jitters event arrival order
+    registry.register(FaultSpec(
+        "store.create", mode=LATENCY, probability=0.05, times=20,
+        latency_s=0.001))
+
+
+@dataclasses.dataclass
+class SoakReport:
+    seed: int
+    rounds: int
+    created: int = 0
+    bound: int = 0
+    unbound: int = 0
+    leaked_assumes: int = 0
+    queue_pending: int = 0
+    breaker_trips: int = 0
+    breaker_recoveries: int = 0
+    faults_fired: int = 0
+    retries: int = 0
+    resync_repairs: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return (
+            self.unbound == 0
+            and self.leaked_assumes == 0
+            and self.queue_pending == 0
+            and self.breaker_trips >= 1
+            and self.breaker_recoveries >= 1
+            and self.faults_fired > 0
+        )
+
+    def render(self) -> str:
+        verdict = "PASS" if self.ok else "FAIL"
+        return (
+            f"chaos soak [{verdict}] seed={self.seed} rounds={self.rounds}: "
+            f"created={self.created} bound={self.bound} "
+            f"unbound={self.unbound} leaked_assumes={self.leaked_assumes} "
+            f"queue_pending={self.queue_pending} "
+            f"breaker_trips={self.breaker_trips} "
+            f"breaker_recoveries={self.breaker_recoveries} "
+            f"faults_fired={self.faults_fired} retries={self.retries} "
+            f"resync_repairs={self.resync_repairs}"
+        )
+
+
+def run_soak(seed: int = 7, rounds: int = 6, pods_per_round: int = 24,
+             nodes: int = 32, wave_size: int = 16,
+             breaker_cooldown_s: float = 0.05) -> SoakReport:
+    """One full seeded soak; leaves the global registry disarmed + reset."""
+    from ..scheduler import Profile, Scheduler
+    from ..scheduler.metrics import SchedulerMetrics
+
+    report = SoakReport(seed=seed, rounds=rounds)
+    registry = faultinject.registry()
+    registry.reset(seed=seed)
+    standard_schedule(registry)
+
+    store = Store()
+    for i in range(nodes):
+        store.create(make_node(f"n{i}", cpu="16", mem="32Gi",
+                               zone=f"z{i % 4}"))
+    sched = Scheduler(
+        store,
+        profiles=[Profile(backend="tpu", wave_size=wave_size)],
+        feature_gates={"SchedulerAsyncAPICalls": True},
+        async_api_calls=True,
+        metrics=SchedulerMetrics(),
+        seed=seed,
+    )
+    # shrink the breaker cooldown so trip -> probe -> recovery fits inside
+    # the soak's wall clock (production default is 1s)
+    algo = next(iter(sched.algorithms.values()))
+    algo.breaker.cooldown_s = breaker_cooldown_s
+    # shrink pod error backoff the same way: injected failures put pods in
+    # the error-backoff tier, whose expiry pop-from-backoff never
+    # short-circuits (it protects the apiserver) — production windows of
+    # 1-10s would dominate the soak's wall clock
+    sched.queue._initial_backoff = 0.02
+    sched.queue._max_backoff = 0.1
+    sched.start()
+
+    registry.arm()
+    seq = 0
+    try:
+        for round_no in range(rounds):
+            for _ in range(pods_per_round):
+                store.create(make_pod(f"chaos-{seq}", cpu="100m",
+                                      mem="64Mi"))
+                seq += 1
+            sched.schedule_pending()
+            # voluntary churn: delete a slice of bound pods
+            bound = [p for p in store.pods() if p.spec.node_name]
+            for p in bound[: pods_per_round // 4]:
+                store.delete("Pod", p.meta.key)
+            sched.schedule_pending()
+    finally:
+        registry.disarm()
+    report.created = seq
+    report.faults_fired = registry.fired_total
+
+    # fault-free convergence: everything the schedule disturbed must now
+    # settle — error backoffs expire, resync repairs dropped deliveries,
+    # requeued pods schedule
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        sched.schedule_pending()
+        pending = [p for p in store.pods() if not p.spec.node_name]
+        active, backoff, unsched = sched.queue.pending_pods()
+        if (not pending and sched.cache.assumed_pod_count() == 0
+                and active + backoff + unsched == 0):
+            break
+        time.sleep(0.05)
+
+    pods = store.pods()
+    report.bound = sum(1 for p in pods if p.spec.node_name)
+    report.unbound = len(pods) - report.bound
+    report.leaked_assumes = sched.cache.assumed_pod_count()
+    active, backoff, unsched = sched.queue.pending_pods()
+    report.queue_pending = active + backoff + unsched
+    report.breaker_trips = algo.breaker.trip_count
+    report.breaker_recoveries = algo.breaker.recovery_count
+    report.retries = sched.api_dispatcher.retries
+    report.resync_repairs = sched.informers.resync_all()
+    sched.api_dispatcher.close()
+    registry.reset()
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.testing.chaos",
+        description="Seeded chaos soak for the TPU scheduler "
+                    "(deterministic fault schedule, convergence asserted)",
+    )
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--rounds", type=int, default=6)
+    parser.add_argument("--pods-per-round", type=int, default=24)
+    parser.add_argument("--nodes", type=int, default=32)
+    parser.add_argument("--wave-size", type=int, default=16)
+    args = parser.parse_args(argv)
+
+    report = run_soak(seed=args.seed, rounds=args.rounds,
+                      pods_per_round=args.pods_per_round,
+                      nodes=args.nodes, wave_size=args.wave_size)
+    print(report.render())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,516 @@
+"""Chaos harness + degradation ladder: fault registry determinism, dispatcher
+retry/close/parking semantics, wave bind isolation, circuit breaker state
+machine, startup reconciliation, informer resync repair, the seeded soak, and
+the golden bit-compat run with every injection point registered but disarmed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.api_dispatcher import (
+    APICall,
+    APIDispatcher,
+    DispatcherClosedError,
+    POD_BINDING,
+    POD_STATUS_PATCH,
+)
+from kubernetes_tpu.scheduler.tpu.circuitbreaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+from kubernetes_tpu.store.store import Store
+from kubernetes_tpu.testing import make_node, make_pod
+from kubernetes_tpu.testing.chaos import run_soak, standard_schedule
+from kubernetes_tpu.utils import faultinject
+from kubernetes_tpu.utils.backoff import RetryPolicy, retry_call
+from kubernetes_tpu.utils.faultinject import (
+    DROP,
+    ERROR,
+    LATENCY,
+    FaultSpec,
+    PermanentFault,
+    TransientFault,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Every test starts and ends with the process-wide registry disarmed
+    and empty — an armed leftover would poison unrelated tests."""
+    faultinject.registry().reset(seed=0)
+    yield
+    faultinject.registry().reset(seed=0)
+
+
+def fast_policy(**kw):
+    kw.setdefault("max_attempts", 4)
+    kw.setdefault("base_s", 0.0001)
+    kw.setdefault("cap_s", 0.001)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------- registry
+
+
+class TestFaultRegistry:
+    def _pattern(self, seed, visits=200):
+        reg = faultinject.FaultRegistry(seed=seed)
+        reg.register(FaultSpec("store.update", mode=ERROR, transient=True,
+                               probability=0.3, times=20))
+        reg.arm()
+        out = []
+        for _ in range(visits):
+            try:
+                out.append(reg.fire("store.update"))
+            except TransientFault:
+                out.append("fault")
+        return out
+
+    def test_same_seed_replays_same_schedule(self):
+        assert self._pattern(7) == self._pattern(7)
+        assert "fault" in self._pattern(7)
+
+    def test_different_seed_differs(self):
+        assert self._pattern(7) != self._pattern(8)
+
+    def test_disarmed_is_inert(self):
+        reg = faultinject.FaultRegistry(seed=1)
+        reg.register(FaultSpec("tpu.launch", mode=ERROR, probability=1.0))
+        for _ in range(10):
+            assert reg.fire("tpu.launch") is False
+        assert reg.fired_total == 0
+
+    def test_times_and_start_after_bound_the_spec(self):
+        reg = faultinject.FaultRegistry(seed=1)
+        reg.register(FaultSpec("tpu.collect", mode=ERROR, transient=True,
+                               start_after=2, times=3))
+        reg.arm()
+        outcomes = []
+        for _ in range(8):
+            try:
+                reg.fire("tpu.collect")
+                outcomes.append("ok")
+            except TransientFault:
+                outcomes.append("fault")
+        assert outcomes == ["ok", "ok", "fault", "fault", "fault",
+                            "ok", "ok", "ok"]
+        assert reg.fired_total == 3
+
+    def test_unknown_point_rejected(self):
+        reg = faultinject.FaultRegistry()
+        with pytest.raises(KeyError):
+            reg.register(FaultSpec("no.such.point"))
+
+    def test_drop_and_latency_modes(self):
+        reg = faultinject.FaultRegistry(seed=1)
+        reg.register(FaultSpec("watch.deliver", mode=DROP, times=1))
+        reg.register(FaultSpec("store.create", mode=LATENCY,
+                               latency_s=0.0, times=1))
+        reg.arm()
+        assert reg.fire("watch.deliver") is True
+        assert reg.fire("watch.deliver") is False
+        assert reg.fire("store.create") is False  # latency never raises
+        assert reg.fired_total == 2
+
+
+# ---------------------------------------------------------------- backoff
+
+
+class TestRetryCall:
+    def test_transient_failures_absorbed(self):
+        import random
+        calls = {"n": 0}
+        delays = []
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise TransientFault("flake")
+            return "ok"
+
+        out = retry_call(flaky, fast_policy(), random.Random(1),
+                         sleep=lambda s: delays.append(s),
+                         on_backoff=lambda a, d: None)
+        assert out == "ok"
+        assert calls["n"] == 3
+        assert len(delays) == 2
+        assert all(0 <= d <= 0.001 for d in delays)
+
+    def test_non_retryable_raises_immediately(self):
+        import random
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise PermanentFault("no")
+
+        with pytest.raises(PermanentFault):
+            retry_call(broken, fast_policy(), random.Random(1),
+                       sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_attempts_exhausted_reraises(self):
+        import random
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise TransientFault("still down")
+
+        with pytest.raises(TransientFault):
+            retry_call(always, fast_policy(max_attempts=3),
+                       random.Random(1), sleep=lambda s: None)
+        assert calls["n"] == 3
+
+    def test_duck_typed_transient_attribute(self):
+        import random
+
+        class WeirdError(Exception):
+            transient = True
+
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise WeirdError()
+            return "ok"
+
+        assert retry_call(flaky, fast_policy(), random.Random(1),
+                          sleep=lambda s: None) == "ok"
+
+
+# ------------------------------------------------------------- dispatcher
+
+
+class TestDispatcherRetry:
+    def test_injected_transient_faults_absorbed(self):
+        reg = faultinject.registry()
+        reg.reset(seed=5)
+        reg.register(FaultSpec("dispatcher.execute", mode=ERROR,
+                               transient=True, times=2))
+        reg.arm()
+        d = APIDispatcher(parallelism=0, retry_policy=fast_policy())
+        executed = {"n": 0}
+
+        def execute():
+            executed["n"] += 1
+
+        call = d.add(APICall(POD_BINDING, "default/p", execute))
+        d.drain(timeout=5.0)
+        assert call.done.is_set()
+        assert call.error is None
+        assert executed["n"] == 1
+        assert d.retries == 2
+
+    def test_permanent_fault_surfaces(self):
+        reg = faultinject.registry()
+        reg.reset(seed=5)
+        reg.register(FaultSpec("dispatcher.execute", mode=ERROR,
+                               transient=False, times=1))
+        reg.arm()
+        d = APIDispatcher(parallelism=0, retry_policy=fast_policy())
+        finishes = []
+        call = d.add(APICall(POD_BINDING, "default/p", lambda: None,
+                             on_finish=finishes.append))
+        d.drain(timeout=5.0)
+        assert isinstance(call.error, PermanentFault)
+        assert len(finishes) == 1 and isinstance(finishes[0], PermanentFault)
+
+
+class TestDispatcherClose:
+    def test_close_fails_queued_calls_terminally(self):
+        d = APIDispatcher(parallelism=0)  # no workers: calls stay queued
+        finishes: list = []
+        c1 = d.add(APICall(POD_BINDING, "default/a", lambda: None,
+                           on_finish=finishes.append))
+        c2 = d.add(APICall(POD_STATUS_PATCH, "default/b", lambda: None,
+                           on_finish=finishes.append))
+        d.close()
+        for c in (c1, c2):
+            assert c.done.is_set()
+            assert isinstance(c.error, DispatcherClosedError)
+        assert len(finishes) == 2
+        assert all(isinstance(e, DispatcherClosedError) for e in finishes)
+
+    def test_add_after_close_rejected(self):
+        d = APIDispatcher(parallelism=0)
+        d.close()
+        finishes: list = []
+        c = d.add(APICall(POD_BINDING, "default/late", lambda: None,
+                          on_finish=finishes.append))
+        assert c.done.is_set()
+        assert isinstance(c.error, DispatcherClosedError)
+        assert len(finishes) == 1
+
+    def test_close_is_idempotent_and_on_finish_fires_once(self):
+        d = APIDispatcher(parallelism=0)
+        finishes: list = []
+        d.add(APICall(POD_BINDING, "default/a", lambda: None,
+                      on_finish=finishes.append))
+        d.close()
+        d.close()
+        assert len(finishes) == 1
+
+
+class TestDispatcherParking:
+    def test_deferred_key_runs_after_inflight_finishes(self):
+        d = APIDispatcher(parallelism=2, retry_policy=fast_policy())
+        d.run()
+        started = threading.Event()
+        release = threading.Event()
+        order: list[str] = []
+
+        def slow():
+            order.append("first")
+            started.set()
+            release.wait(timeout=5.0)
+
+        c1 = d.add(APICall(POD_BINDING, "default/k", slow))
+        assert started.wait(timeout=5.0)
+        # same key while in flight: must park, not spin, and run after
+        c2 = d.add(APICall(POD_BINDING, "default/k",
+                           lambda: order.append("second")))
+        release.set()
+        assert c1.done.wait(timeout=5.0)
+        assert c2.done.wait(timeout=5.0)
+        assert order == ["first", "second"]
+        assert c1.error is None and c2.error is None
+        d.close()
+
+
+# ------------------------------------------------------ wave bind isolation
+
+
+class TestWaveBindIsolation:
+    def test_injected_binding_failure_fails_one_pod_only(self):
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        for name in ("a", "b", "c"):
+            store.create(make_pod(name, cpu="100m", mem="64Mi"))
+        reg = faultinject.registry()
+        reg.reset(seed=5)
+        reg.register(FaultSpec("store.bind_pod", mode=ERROR,
+                               transient=True, times=1))
+        reg.arm()
+        out = store.bind_pods([("default/a", "n0"), ("default/b", "n0"),
+                               ("default/c", "n0")])
+        assert out[0].startswith("error:")
+        assert out[1] == "bound" and out[2] == "bound"
+        assert store.get("Pod", "default/a").spec.node_name == ""
+        assert store.get("Pod", "default/b").spec.node_name == "n0"
+        assert store.get("Pod", "default/c").spec.node_name == "n0"
+
+
+# --------------------------------------------------------- circuit breaker
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        self.now = 0.0
+        kw.setdefault("threshold", 3)
+        kw.setdefault("cooldown_s", 10.0)
+        kw.setdefault("probes", 2)
+        return CircuitBreaker(clock=lambda: self.now, **kw)
+
+    def test_trips_after_threshold_consecutive_failures(self):
+        b = self.make()
+        b.record_failure()
+        b.record_success()  # resets the streak
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == CLOSED
+        b.record_failure()
+        assert b.state == OPEN
+        assert b.trip_count == 1
+        assert b.device_blocked() is True
+        assert b.allow_device_wave() is False
+
+    def test_half_open_probes_metered_then_close(self):
+        b = self.make()
+        for _ in range(3):
+            b.record_failure()
+        self.now = 11.0
+        assert b.device_blocked() is False
+        assert b.allow_device_wave() is True  # probe 1
+        assert b.state == HALF_OPEN
+        assert b.allow_device_wave() is True  # probe 2
+        assert b.allow_device_wave() is False  # metered
+        b.record_success()
+        assert b.state == HALF_OPEN
+        b.record_success()
+        assert b.state == CLOSED
+        assert b.recovery_count == 1
+
+    def test_failed_probe_reopens_and_restarts_cooldown(self):
+        b = self.make()
+        for _ in range(3):
+            b.record_failure()
+        self.now = 11.0
+        assert b.allow_device_wave() is True
+        b.record_failure("probe died")
+        assert b.state == OPEN
+        assert b.trip_count == 2
+        self.now = 20.0  # inside the restarted cooldown
+        assert b.allow_device_wave() is False
+        self.now = 22.0
+        assert b.allow_device_wave() is True
+
+    def test_benign_outcome_releases_probe_slot(self):
+        b = self.make(probes=1)
+        for _ in range(3):
+            b.record_failure()
+        self.now = 11.0
+        assert b.allow_device_wave() is True
+        assert b.allow_device_wave() is False
+        b.record_benign()  # wave never reached the device: slot freed
+        assert b.state == HALF_OPEN
+        assert b.allow_device_wave() is True
+
+    def test_transitions_fan_out(self):
+        seen = []
+        b = CircuitBreaker(threshold=1, cooldown_s=0.0, probes=1,
+                           clock=lambda: 0.0,
+                           on_transition=lambda *e: seen.append(e))
+        b.record_failure()
+        b.allow_device_wave()
+        b.record_success()
+        assert [(o, n) for o, n, _ in seen] == [
+            (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED)]
+
+
+# ---------------------------------------------------------- reconciliation
+
+
+def _cluster():
+    store = Store()
+    store.create(make_node("n0", cpu="8", mem="16Gi"))
+    sched = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=4)],
+                      seed=3)
+    sched.start()
+    return store, sched
+
+
+class TestStartupReconciliation:
+    def test_half_applied_bind_forgotten_and_requeued(self):
+        store, sched = _cluster()
+        store.create(make_pod("half", cpu="100m", mem="64Mi"))
+        sched.pump()
+        # simulate a prior incarnation killed mid-bind: pod popped from the
+        # queue and assumed, but the store write never landed
+        sched.queue.pop_specific("default/half")
+        sched.cache.assume_pod(store.get("Pod", "default/half"), "n0")
+        stats = sched.reconcile()
+        assert stats == {"adopted": 0, "forgotten": 1, "requeued": 1}
+        assert sched.cache.assumed_pod_count() == 0
+        sched.schedule_pending()
+        assert store.get("Pod", "default/half").spec.node_name == "n0"
+
+    def test_bound_in_store_adopted(self):
+        store, sched = _cluster()
+        store.create(make_pod("landed", cpu="100m", mem="64Mi"))
+        sched.pump()
+        sched.queue.pop_specific("default/landed")
+        cur = store.get("Pod", "default/landed")
+        sched.cache.assume_pod(cur, "n0")
+        # the bind DID land, but the scheduler died before the confirming
+        # watch event arrived
+        cur.spec.node_name = "n0"
+        store.update(cur, check_version=False)
+        stats = sched.reconcile()
+        assert stats["adopted"] == 1 and stats["requeued"] == 0
+        assert sched.cache.assumed_pod_count() == 0
+        assert sched.cache.pod_count() == 1
+
+    def test_pod_gone_forgotten(self):
+        store, sched = _cluster()
+        store.create(make_pod("gone", cpu="100m", mem="64Mi"))
+        sched.pump()
+        sched.queue.pop_specific("default/gone")
+        sched.cache.assume_pod(store.get("Pod", "default/gone"), "n0")
+        store.delete("Pod", "default/gone")
+        stats = sched.reconcile()
+        assert stats == {"adopted": 0, "forgotten": 1, "requeued": 0}
+        assert sched.cache.assumed_pod_count() == 0
+
+
+# ----------------------------------------------------------- resync repair
+
+
+class TestInformerResync:
+    def test_dropped_delivery_repaired_and_pod_scheduled(self):
+        store, sched = _cluster()
+        reg = faultinject.registry()
+        reg.reset(seed=5)
+        reg.register(FaultSpec("watch.deliver", mode=DROP))
+        reg.arm()
+        store.create(make_pod("lost", cpu="100m", mem="64Mi"))
+        reg.disarm()
+        sched.pump()  # ADDED never reached the watch: nothing to pump
+        active, backoff, unsched = sched.queue.pending_pods()
+        assert active + backoff + unsched == 0
+        repaired = sched.informers.resync_all()
+        assert repaired >= 1
+        sched.schedule_pending()
+        assert store.get("Pod", "default/lost").spec.node_name == "n0"
+
+    def test_schedule_pending_self_heals_via_resync(self):
+        store, sched = _cluster()
+        reg = faultinject.registry()
+        reg.reset(seed=5)
+        reg.register(FaultSpec("watch.deliver", mode=DROP))
+        reg.arm()
+        store.create(make_pod("stranded", cpu="100m", mem="64Mi"))
+        reg.disarm()
+        # no explicit resync call: the idle path inside schedule_pending
+        # must find and repair the stranded pod on its own
+        sched.schedule_pending()
+        assert store.get("Pod", "default/stranded").spec.node_name == "n0"
+
+
+# ------------------------------------------------------------------- soak
+
+
+class TestChaosSoak:
+    def test_seeded_soak_converges_and_breaker_cycles(self):
+        report = run_soak(seed=7)
+        assert report.ok, report.render()
+        assert report.breaker_trips >= 1
+        assert report.breaker_recoveries >= 1
+        assert report.faults_fired > 0
+        assert report.retries > 0
+
+
+# ------------------------------------------------- golden with points armed
+
+
+class TestGoldenDisarmed:
+    def test_bit_compat_holds_with_all_points_registered_disarmed(self):
+        """The full golden pipeline (dedup on vs off byte-identical) must
+        survive with the retry/breaker machinery permanently on and a spec
+        registered at EVERY injection point — disarmed injection is free
+        and invisible."""
+        from tests.test_dedup_golden import TestFullPipelineGolden
+
+        reg = faultinject.registry()
+        reg.reset(seed=99)
+        for point in faultinject.POINTS:
+            reg.register(FaultSpec(point, mode=ERROR, transient=True))
+        assert set(reg.points()) == set(faultinject.POINTS)
+        assert reg.armed is False
+
+        placed_off, diags_off, rng_off, _ = TestFullPipelineGolden._run(
+            dedup=False)
+        placed_on, diags_on, rng_on, _ = TestFullPipelineGolden._run(
+            dedup=True)
+        assert placed_on == placed_off
+        assert diags_on == diags_off
+        assert rng_on == rng_off
+        assert sum(1 for v in placed_on.values() if v) > 0
+        assert reg.fired_total == 0

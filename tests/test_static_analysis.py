@@ -15,6 +15,7 @@ from kubernetes_tpu.analysis import (
     JitPurityChecker,
     LockDisciplineChecker,
     RegistrySyncChecker,
+    RetryDisciplineChecker,
     SignatureSyncChecker,
     SnapshotImmutabilityChecker,
     check_file,
@@ -728,6 +729,105 @@ class TestSuppressions:
         assert fs == []
 
 
+# ------------------------------------------------------------------- RET01
+
+
+class TestRetryDiscipline:
+    CHECKERS = [RetryDisciplineChecker()]
+
+    def test_hand_rolled_retry_backoff_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import time
+
+            def fetch(op):
+                while True:
+                    try:
+                        return op()
+                    except Exception:
+                        time.sleep(0.1)
+        """, checkers=self.CHECKERS)
+        assert rules(fs) == ["RET01"]
+        assert "retry_call" in fs[0].message
+
+    def test_ad_hoc_random_flake_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import random
+
+            def maybe_fail(rng):
+                if rng.random() < 0.05:
+                    raise RuntimeError("flake")
+        """, checkers=self.CHECKERS)
+        assert rules(fs) == ["RET01"]
+        assert "FaultRegistry" in fs[0].message
+
+    def test_poll_loop_sleep_not_flagged(self, tmp_path):
+        # sleep in a loop OUTSIDE an except handler is a poll loop, not a
+        # hand-rolled retry
+        fs = lint(tmp_path, """
+            import time
+
+            def wait_for(cond):
+                while not cond():
+                    time.sleep(0.01)
+        """, checkers=self.CHECKERS)
+        assert fs == []
+
+    def test_sleep_in_except_outside_loop_not_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import time
+
+            def once(op):
+                try:
+                    op()
+                except Exception:
+                    time.sleep(0.1)
+        """, checkers=self.CHECKERS)
+        assert fs == []
+
+    def test_random_draw_without_raise_not_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import random
+
+            def jitter(rng, cap):
+                if rng.random() < 0.5:
+                    return cap / 2
+                return cap
+        """, checkers=self.CHECKERS)
+        assert fs == []
+
+    def test_owning_modules_exempt(self, tmp_path):
+        src = """
+            import time
+
+            def retry(op):
+                while True:
+                    try:
+                        return op()
+                    except Exception:
+                        time.sleep(0.1)
+        """
+        assert lint(tmp_path, src, name="utils/backoff.py",
+                    checkers=self.CHECKERS) == []
+        assert lint(tmp_path, src, name="utils/faultinject.py",
+                    checkers=self.CHECKERS) == []
+
+    def test_nested_def_is_its_own_context(self, tmp_path):
+        # the sleep lives in a nested def that is not itself a retry loop
+        fs = lint(tmp_path, """
+            import time
+
+            def outer(op):
+                while True:
+                    try:
+                        return op()
+                    except Exception:
+                        def backoff():
+                            time.sleep(0.1)
+                        raise
+        """, checkers=self.CHECKERS)
+        assert fs == []
+
+
 # -------------------------------------------------------------- CLI + repo
 
 
@@ -749,7 +849,7 @@ class TestCli:
         out = capsys.readouterr().out
         for rule in ("JIT01", "JIT02", "JIT03", "JIT04", "LOCK01", "LOCK02",
                      "LOCK03", "SNAP01", "REG01", "REG02", "SIG01", "OBS01",
-                     "LINT00"):
+                     "RET01", "LINT00"):
             assert rule in out
 
     def test_rule_ids_documented_in_readme(self):
