@@ -194,6 +194,14 @@ def main() -> None:
             and dedup["pods"] else None
         ),
         "dedup_waves": (dedup or {}).get("waves"),
+        # cross-wave signature reuse (this PR): fraction of carried-wave
+        # signatures that skipped the full score pass because their
+        # device-resident score rows survived the wave boundary
+        "cross_wave_hit_ratio": (
+            round(dedup["xwave_hits"] / xw_total, 4)
+            if dedup and (xw_total := dedup.get("xwave_hits", 0)
+                          + dedup.get("xwave_misses", 0)) else None
+        ),
         "wall_s": round(wall_s, 2),
         "measured_span_s": round(span_s, 2),
         "async_exec_s": round(async_exec, 2),

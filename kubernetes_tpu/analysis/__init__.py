@@ -4,7 +4,10 @@ Mechanically enforces the contracts the paper's bit-compat claim rests on:
 jit purity (JIT01-JIT04), lock discipline in the threaded scheduler modules
 (LOCK01-LOCK03), snapshot immutability outside the cache layer (SNAP01),
 kernel/registry constant sync (REG01-REG02), signature-fragment
-purity/coverage for the batching hint path (SIG01), host-side-only
+purity/coverage for the batching hint path (SIG01), carry coherence —
+node-plane / device-carry state may only be written through backend.py's
+invalidation hooks so the cross-wave signature cache can never go stale
+(SIG02), host-side-only
 telemetry — no recorder/tracer/metrics calls inside traced code (OBS01),
 and retry/fault-injection discipline — no hand-rolled backoff loops or
 ad-hoc random flakes outside the shared helpers (RET01).
@@ -23,6 +26,7 @@ from .core import (
     known_rules,
     run_paths,
 )
+from .carry_coherence import CarryCoherenceChecker
 from .jit_purity import JitPurityChecker
 from .lock_discipline import LockDisciplineChecker
 from .obs_purity import ObservabilityPurityChecker
@@ -32,6 +36,7 @@ from .signature_sync import SignatureSyncChecker
 from .snapshot_immutability import SnapshotImmutabilityChecker
 
 __all__ = [
+    "CarryCoherenceChecker",
     "Checker",
     "Finding",
     "JitPurityChecker",

@@ -1,0 +1,114 @@
+"""Carry-coherence rule (SIG02) for the cross-wave signature cache.
+
+The device-resident score rows (`TPUBackend.sig_cache`) are scores AGAINST
+the carried node planes: any mutation of the carry state — the device plane
+buffers, the `_carry*` bookkeeping, the dirty-row set — that does not pass
+through the backend's sanctioned invalidation hooks (`invalidate_carry()`,
+`mark_external()`, the carry-assembly path in `launch_batched`) leaves the
+cache serving rows scored against planes that no longer exist. The replay
+tier would then hand back bit-exact-looking but WRONG placements — the
+worst failure mode, because every golden still passes on fresh runs.
+
+SIG02 therefore bans, outside `scheduler/tpu/backend.py`:
+
+- assignment (plain, augmented, annotated, starred, tuple-unpacked) to an
+  attribute in the guarded set: `_carry`, `_carry_rows`, `_carry_anti`,
+  `_carry_pref`, `_carry_external`, `_pending_dirty`, `_device_planes`,
+  `sig_cache`, and anything else spelled `_carry*`;
+- `del` of such an attribute;
+- subscript/element writes through one (`backend._device_planes["x"] = p`);
+- mutating method calls on one (`.clear()`, `.update()`, `.add()`,
+  `.discard()`, `.pop()`, `.remove()`, `.append()`, `.extend()`,
+  `.setdefault()`, and the cache's own `.store()`).
+
+Reads (`backend._carry is not None`, `getattr(b, "_pending_dirty", ...)`)
+and the sanctioned hooks (`invalidate_carry()` / `mark_external()`) remain
+free — the rule polices writes, not observation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from .core import Checker, Finding, ModuleContext
+
+SIG02 = "SIG02"
+
+# the one module allowed to touch carry/cache state directly
+BACKEND = "scheduler/tpu/backend.py"
+
+_GUARDED = {
+    "_carry",
+    "_carry_rows",
+    "_carry_anti",
+    "_carry_pref",
+    "_carry_external",
+    "_pending_dirty",
+    "_device_planes",
+    "sig_cache",
+}
+
+# method names that mutate their receiver in-place
+_MUTATORS = {
+    "clear", "update", "add", "discard", "pop", "remove", "append",
+    "extend", "setdefault", "store",
+}
+
+
+def _is_guarded(name: str) -> bool:
+    return name in _GUARDED or name.startswith("_carry")
+
+
+def _guarded_attrs(expr: ast.expr) -> Iterator[tuple[int, str]]:
+    """(line, attr) for every guarded attribute access inside `expr`."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and _is_guarded(node.attr):
+            yield node.lineno, node.attr
+
+
+class CarryCoherenceChecker(Checker):
+    rules = {
+        SIG02: "carry/plane/signature-cache state written outside "
+               "scheduler/tpu/backend.py — route through invalidate_carry()"
+               " / mark_external() so the cross-wave cache stays coherent",
+    }
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        p = ctx.posix_path
+        if p.endswith(BACKEND):
+            return  # the sanctioned site: backend.py owns this state
+        for node in ast.walk(ctx.tree):
+            yield from self._check_stmt(p, node)
+
+    def _check_stmt(self, path: str, node: ast.AST) -> Iterator[Finding]:
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATORS):
+                for line, attr in _guarded_attrs(func.value):
+                    yield Finding(
+                        path, line, 0, SIG02,
+                        f"mutating call .{func.attr}() on guarded carry "
+                        f"state {attr!r} outside backend.py — use the "
+                        "backend's invalidation hooks (invalidate_carry / "
+                        "mark_external) instead",
+                    )
+            return
+        for tgt in targets:
+            for line, attr in _guarded_attrs(tgt):
+                yield Finding(
+                    path, line, 0, SIG02,
+                    f"write to guarded carry state {attr!r} outside "
+                    "backend.py — node-plane / device-carry mutations "
+                    "must route through the backend's invalidation hooks "
+                    "so the cross-wave signature cache is cleared with "
+                    "them",
+                )

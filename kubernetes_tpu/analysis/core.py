@@ -105,6 +105,7 @@ class ProjectChecker(Checker):
 
 
 def default_checkers() -> list[Checker]:
+    from .carry_coherence import CarryCoherenceChecker
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
     from .obs_purity import ObservabilityPurityChecker
@@ -119,6 +120,7 @@ def default_checkers() -> list[Checker]:
         SnapshotImmutabilityChecker(),
         RegistrySyncChecker(),
         SignatureSyncChecker(),
+        CarryCoherenceChecker(),
         ObservabilityPurityChecker(),
         RetryDisciplineChecker(),
     ]

@@ -42,6 +42,7 @@ class SchedulerServer:
                 ),
                 plugin_args=p.plugin_args,
                 backend=p.backend,
+                wave_size=p.wave_size,
                 disabled_plugins=tuple(p.plugins.disabled),
                 enabled_plugins=tuple(p.plugins.enabled),
             )
@@ -65,6 +66,7 @@ class SchedulerServer:
              if (b := getattr(algo, "backend", None)) is not None),
             None,
         )
+        self.backend = backend  # also serves /debug/flightrecorder
         self.debugger = CacheDebugger(
             self.scheduler.cache, self.scheduler.queue, store,
             backend=backend,
@@ -156,6 +158,22 @@ class SchedulerServer:
                         self._send(400, "seconds must be a number")
                         return
                     self._send(200, take_profile(seconds=secs))
+                elif self.path.startswith("/debug/flightrecorder"):
+                    # wave flight-recorder post-mortem dump (zpages-style);
+                    # ?last=N bounds the ring-buffer slice
+                    from urllib.parse import parse_qs, urlparse
+
+                    rec = getattr(server.backend, "recorder", None)
+                    if rec is None:
+                        self._send(404, "no TPU backend / flight recorder")
+                        return
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        last = (int(q["last"][0]) if "last" in q else None)
+                    except ValueError:
+                        self._send(400, "last must be an integer")
+                        return
+                    self._send(200, rec.dump(last), "application/json")
                 elif self.path == "/flagz":
                     # component-base/zpages/flagz: effective flag values
                     self._send(200, json.dumps(server.flags),
@@ -242,6 +260,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--config", help="KubeSchedulerConfiguration YAML")
     parser.add_argument("--backend", choices=["host", "tpu"], default=None,
                         help="override profile backend")
+    parser.add_argument("--wave-size", type=int, default=None,
+                        help="override profile waveSize (batched device "
+                             "waves; requires backend=tpu)")
     parser.add_argument("--port", type=int, default=10259,
                         help="health/metrics port")
     parser.add_argument("--leader-elect", action="store_true")
@@ -261,6 +282,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.backend:
         for p in config.profiles:
             p.backend = args.backend
+    if args.wave_size is not None:
+        for p in config.profiles:
+            p.wave_size = args.wave_size
     if args.leader_elect:
         config.leader_election.leader_elect = True
     config.health_bind_port = args.port

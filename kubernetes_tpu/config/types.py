@@ -30,6 +30,9 @@ class ProfileConfig:
     plugins: PluginSet = field(default_factory=PluginSet)
     plugin_args: dict = field(default_factory=dict)  # plugin name -> args
     backend: str = "host"  # TPU-native addition: "host" | "tpu"
+    # >0 with backend="tpu": schedule each run of up to waveSize pods in
+    # one device program (bit-identical to per-pod; throughput mode)
+    wave_size: int = 0
 
 
 @dataclass
@@ -71,6 +74,12 @@ class SchedulerConfiguration:
         for p in self.profiles:
             if p.backend not in ("host", "tpu"):
                 errs.append(f"profile {p.scheduler_name}: unknown backend {p.backend}")
+            if p.wave_size < 0:
+                errs.append(f"profile {p.scheduler_name}: waveSize must be >= 0")
+            if p.wave_size > 0 and p.backend != "tpu":
+                errs.append(
+                    f"profile {p.scheduler_name}: waveSize requires backend=tpu"
+                )
             if p.percentage_of_nodes_to_score is not None and not (
                 0 <= p.percentage_of_nodes_to_score <= 100
             ):
@@ -119,6 +128,7 @@ def load_config(data: dict) -> SchedulerConfiguration:
                 ),
                 plugin_args=args,
                 backend=p.get("backend", "host"),
+                wave_size=int(p.get("waveSize", 0)),
             ))
     if "extenders" in data:
         from ..scheduler.extender import ExtenderConfig

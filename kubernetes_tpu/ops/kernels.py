@@ -480,7 +480,8 @@ def filter_masks(cfg: KernelConfig, planes: dict, f: dict):
     # NodeResourcesFit (fit.go:673-760)
     free = planes["alloc"] - planes["used"]
     insufficient = (f["req"][None, :] > 0) & (f["req"][None, :] > free)
-    insufficient = insufficient.at[:, PODS].set(False)
+    # asarray: callers may drive this un-jitted with host numpy planes
+    insufficient = jnp.asarray(insufficient).at[:, PODS].set(False)
     too_many = planes["used"][:, PODS] + 1 > planes["alloc"][:, PODS]
     f_fit = insufficient.any(axis=1) | too_many
 
@@ -922,7 +923,7 @@ def _fit_filter_row(cfg: KernelConfig, alloc_row, used_row, f):
 
 
 def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
-                 carry, inp, static_rows=None, fast=False):
+                 carry, inp, static_rows=None, uniq_f=None, fast=False):
     """One greedy step: carry-dependent filter+score only (static parts come
     precomputed via the scan xs), pick the best node with the HOST tie-break
     (seeded-rng draw over max-score winners in snapshot node order, fed by
@@ -931,27 +932,36 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
 
     Signature dedup (static_rows is not None): the step reads its static
     per-pod parts by gathering row sig_id from the per-SIGNATURE table
-    instead of receiving them via xs. With fast=True (no hard constraints,
-    no IPA, single shard) the step is two-tier: a slot whose sig_id equals
-    its predecessor's replays the predecessor's score row from the dyn
-    carry (ew + feasible + PTS domain tables) and only pays the re-rank +
-    tie-draw; the first slot of each signature run takes the full pass and
-    refreshes the carry. After every placement the dyn carry is patched at
-    the winner row only — in fast mode a placement can change feasibility
-    and fit/balanced scores at exactly that row, which is what makes the
-    replay bit-identical to a full recompute.
+    instead of receiving them via xs. With fast=True the step is two-tier
+    over a per-signature score-row TABLE carried through the scan (and,
+    cross-wave, seeded from the previous wave's table): a slot whose
+    signature already has a resident row replays it (ew + feasibility + PTS
+    domain tables) and only pays the re-rank + tie-draw; a fresh signature
+    takes the full pass and installs its row. After every placement EVERY
+    resident row is patched at the winner column — a placement changes
+    fit/balanced/feasibility at exactly that node for every signature —
+    which is what makes replays (adjacent, a-b-a, and cross-wave alike)
+    bit-identical to a full recompute. With hard spread constraints the
+    carry-dependent fail mask is recomputed each step and a replay is only
+    taken when the resident row's feasibility agrees with it (a placement
+    can flip hard-spread skew at rows the winner patch doesn't model;
+    the equality gate routes exactly those steps back to the full tier —
+    a lost hit, never a wrong replay).
 
     Under shard_map (comm=AxisComm) the per-step cross-shard traffic is
     exactly: the scalar normalizations (pmax/pmin), one [shards] tie-count
-    gather, and two scalar psums publishing the winner — the per-shard
-    top-k → global argmax design of SURVEY §7."""
+    gather, and the scalar psums publishing the winner and its domain —
+    the per-shard top-k → global argmax design of SURVEY §7. Table row
+    columns are shard-local and owner-patched; the replicated segs/pcs
+    domain tables learn the owner's per-slot deltas through one
+    shape-preserving psum (comm.seg) per soft constraint."""
     (used, nonzero_used, sel_counts, dom_counts, ipa, cursor, overflow,
-     dyn, sig_scores) = carry
+     tab, sig_scores) = carry
     if static_rows is None:
         f, sp = inp
-        sid = same = None
+        sid = None
     else:
-        f, sid, same = inp
+        f, sid = inp
         sp = jax.tree_util.tree_map(lambda a: a[sid], static_rows)
     p = dict(planes)
     p["used"], p["nonzero_used"], p["sel_counts"] = used, nonzero_used, sel_counts
@@ -959,18 +969,51 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
         p["ipa_counts"], p["ipa_anti"], p["ipa_pref"] = ipa
 
     if fast:
-        capture_shape = dyn[3].shape
+        t_ew, t_ffit, t_feas, t_segs, t_pcs, t_valid = tab
+        capture_shape = t_segs.shape[1:]
+        # hard-spread fail mask: carry-dependent, so recomputed EVERY step
+        # (replays included) and shared by both tiers
+        pts_fail = jnp.zeros(p["valid"].shape[0], bool)
+        for c in range(min(cfg.max_constraints, cfg.n_hard)):
+            active = f["hard_active"][c]
+            if dom_counts is not None:
+                has_key, count, min_count = _pts_hard_carried(
+                    cfg, p, sel_counts, dom_counts, present,
+                    f["hard_key"][c], f["hard_sel"][c], comm
+                )
+            else:
+                has_key, count, min_count, _ = _pts_domain_stats(
+                    cfg, p, p["valid"], f["hard_key"][c], f["hard_sel"][c],
+                    comm
+                )
+            skew = count + f["hard_self"][c] - min_count
+            pts_fail = pts_fail | (active & ~has_key) | (
+                active & has_key & (skew > f["hard_skew"][c])
+            )
+        row_in = (t_ew[sid], t_ffit[sid], t_feas[sid], t_segs[sid],
+                  t_pcs[sid])
+        replay = t_valid[sid]
+        if cfg.n_hard > 0:
+            # the resident t_ffit column is maintained exactly (placements
+            # only change fit at their winner row, and every winner row is
+            # patched), so static_ok & ~t_ffit & ~pts_fail IS the full-tier
+            # feasibility; replay only when the resident row agrees with it.
+            # comm-reduced so every shard takes the same cond branch (the
+            # branches contain collectives)
+            feas_live = sp["static_ok"] & ~row_in[1] & ~pts_fail
+            mismatch = comm.vsum(
+                (feas_live != row_in[2]).sum().astype(jnp.int32)) > 0
+            replay = replay & ~mismatch
 
-        def _full_tier(dyn_in):
-            del dyn_in
-            # dynamic filter reduces to NodeResourcesFit (fast mode has no
-            # hard spread constraints and no IPA by construction)
+        def _full_tier(row):
+            del row
+            # dynamic filter: NodeResourcesFit + the shared hard-spread mask
             free = p["alloc"] - used
             insufficient = (f["req"][None, :] > 0) & (f["req"][None, :] > free)
             insufficient = insufficient.at[:, PODS].set(False)
             too_many = used[:, PODS] + 1 > p["alloc"][:, PODS]
             f_fit = insufficient.any(axis=1) | too_many
-            feasible = sp["static_ok"] & ~f_fit
+            feasible = sp["static_ok"] & ~f_fit & ~pts_fail
             ew = (
                 _fit_score(cfg, p, f) * cfg.weight("NodeResourcesFit")
                 + _balanced_score(cfg, p, f)
@@ -982,16 +1025,24 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
             total = _finish_total(cfg, ew, pts, f, sp, feasible, comm)
             return total, (ew, f_fit, feasible, segs, pcs)
 
-        def _cheap_tier(dyn_in):
-            ew, f_fit, feasible, segs, pcs = dyn_in
+        def _cheap_tier(row):
+            ew, f_fit, feasible, segs, pcs = row
             pts = _pts_score_carried(
                 cfg, p, f, feasible, sel_counts, segs, pcs, comm
             )
             total = _finish_total(cfg, ew, pts, f, sp, feasible, comm)
-            return total, dyn_in
+            return total, row
 
-        total, dyn = jax.lax.cond(same, _cheap_tier, _full_tier, dyn)
-        feasible = dyn[2]
+        total, row = jax.lax.cond(replay, _cheap_tier, _full_tier, row_in)
+        feasible = row[2]
+        # install the (possibly refreshed) row: a cheap-tier write is a
+        # value-identity no-op, a full-tier write makes the slot resident
+        t_ew = t_ew.at[sid].set(row[0])
+        t_ffit = t_ffit.at[sid].set(row[1])
+        t_feas = t_feas.at[sid].set(row[2])
+        t_segs = t_segs.at[sid].set(row[3])
+        t_pcs = t_pcs.at[sid].set(row[4])
+        t_valid = t_valid.at[sid].set(True)
     else:
         # dynamic filters: NodeResourcesFit + PodTopologySpread hard
         # constraints
@@ -1102,12 +1153,13 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
             ipa_pref.at[win].add(gate * f["ipa_pref_add"]),
         )
     if fast:
-        # patch the dyn carry at the winner row: in fast mode a placement
-        # changes f_fit/feasible/fit/balanced at EXACTLY that row (only its
-        # used/nonzero_used moved), plus the winner's domain segment in each
-        # soft constraint's carried table. All patches gate on `placed` so a
-        # no-placement step is a carry no-op.
-        ew, f_fit_c, feas_c, segs, pcs = dyn
+        # patch EVERY resident row at the winner column: a placement changes
+        # f_fit/feasible/fit/balanced at EXACTLY that node (only its
+        # used/nonzero_used moved) for EVERY signature, plus the winner's
+        # domain segment in each soft constraint's carried tables. Patching
+        # all rows (not just the current slot's) is what lets a row survive
+        # a-b-a runs and wave boundaries and still replay bit-identically.
+        # All patches gate on `placed` so a no-placement step is a no-op.
         placed = owner
         rp = {
             "alloc": planes["alloc"][win][None],
@@ -1115,67 +1167,88 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
             "nonzero_used": nonzero_used[win][None],
             "valid": planes["valid"][win][None],
         }
-        ew_w = (
-            _fit_score(cfg, rp, f)[0] * cfg.weight("NodeResourcesFit")
-            + _balanced_score(cfg, rp, f)[0]
-            * cfg.weight("NodeResourcesBalancedAllocation")
-        )
-        f_fit_w = _fit_filter_row(cfg, planes["alloc"][win], used[win], f)
-        feas_w = sp["static_ok"][win] & ~f_fit_w
-        feas_old_w = feas_c[win]
-        ew = ew.at[win].set(jnp.where(placed, ew_w, ew[win]))
-        f_fit_c = f_fit_c.at[win].set(jnp.where(placed, f_fit_w, f_fit_c[win]))
-        feas_c = feas_c.at[win].set(jnp.where(placed, feas_w, feas_old_w))
-        dseg = segs.shape[1]
+
+        def _row_parts(fc):
+            ew_w = (
+                _fit_score(cfg, rp, fc)[0] * cfg.weight("NodeResourcesFit")
+                + _balanced_score(cfg, rp, fc)[0]
+                * cfg.weight("NodeResourcesBalancedAllocation")
+            )
+            return ew_w, _fit_filter_row(cfg, planes["alloc"][win],
+                                         used[win], fc)
+
+        ew_w, ffit_w = jax.vmap(_row_parts)(uniq_f)            # [C] each
+        so_win = static_rows["static_ok"][:, win]              # [C]
+        feas_w = so_win & ~ffit_w
+        feas_old = t_feas[:, win]                              # [C]
+        # row columns are shard-local: only the winner's owner patches them
+        gate_c = t_valid & placed
+        t_ew = t_ew.at[:, win].set(jnp.where(gate_c, ew_w, t_ew[:, win]))
+        t_ffit = t_ffit.at[:, win].set(
+            jnp.where(gate_c, ffit_w, t_ffit[:, win]))
+        t_feas = t_feas.at[:, win].set(jnp.where(gate_c, feas_w, feas_old))
+        dseg = t_segs.shape[2]
         for c in range(min(cfg.max_constraints, cfg.n_soft)):
-            key_c = f["soft_key"][c]
-            sel_c = f["soft_sel"][c]
-            cnt_old_w = sel_prev[win, sel_c]
-            cnt_new_w = sel_counts[win, sel_c]
+            key_c = uniq_f["soft_key"][:, c]                   # [C]
+            sel_c = uniq_f["soft_sel"][:, c]                   # [C]
+            cnt_old_w = sel_prev[win][sel_c]                   # [C]
+            cnt_new_w = sel_counts[win][sel_c]                 # [C]
+            before = jnp.where(feas_old, cnt_old_w, 0)
+            after = jnp.where(feas_w, cnt_new_w, 0)
+            # segs/pcs are REPLICATED under sharding: non-owners contribute
+            # zeros and learn the owner's per-slot deltas through one
+            # shape-preserving psum per constraint
+            seg_d = comm.seg(jnp.where(placed, after - before, 0))
+            pc_d = comm.seg(jnp.where(
+                placed,
+                feas_w.astype(jnp.int32) - feas_old.astype(jnp.int32),
+                0,
+            ))
             for k, dk in enumerate(cfg.topo_domains):
                 if dk == 0:
                     continue  # singleton keys replay from sel_counts directly
                 dom_w = planes["domain"][win, k]
-                in_k = placed & (key_c == k) & (dom_w >= 0)
-                d_idx = jnp.clip(dom_w, 0, dseg - 1)
-                before = jnp.where(feas_old_w, cnt_old_w, 0)
-                after = jnp.where(feas_w, cnt_new_w, 0)
-                segs = segs.at[c, d_idx].add(
-                    jnp.where(in_k, after - before, 0)
-                )
-                pcs = pcs.at[c, d_idx].add(jnp.where(
-                    in_k,
-                    feas_w.astype(jnp.int32) - feas_old_w.astype(jnp.int32),
-                    0,
-                ))
-        dyn = (ew, f_fit_c, feas_c, segs, pcs)
-        # per-signature score row export (host BatchCache warm-up): the
-        # FIRST slot of each run stores its feasibility-gated totals; pad
-        # slots always replay (same=True) so they never store
+                g_dom = comm.vsum(gate * (dom_w + 1))  # 0 = none/no owner
+                in_k = t_valid & (key_c == k) & (g_dom > 0)
+                d_idx = jnp.clip(g_dom - 1, 0, dseg - 1)
+                t_segs = t_segs.at[:, c, d_idx].add(
+                    jnp.where(in_k, seg_d, 0))
+                t_pcs = t_pcs.at[:, c, d_idx].add(
+                    jnp.where(in_k, pc_d, 0))
+        tab = (t_ew, t_ffit, t_feas, t_segs, t_pcs, t_valid)
+        # per-signature score row export (host BatchCache warm-up): the slot
+        # that pays the full pass stores its feasibility-gated totals;
+        # replays (within-wave AND cross-wave) never store — the host
+        # exporter drops all-(-1) rows, so a cross-wave hit simply keeps the
+        # export it already made on the wave that scored it
         sig_scores = sig_scores.at[sid].set(jnp.where(
-            same, sig_scores[sid], jnp.where(feasible, total, -1)
+            replay, sig_scores[sid], jnp.where(feasible, total, -1)
         ))
     # publish the winner's GLOBAL row id (scalar psum; -1 when unplaced)
     nb = mask.shape[0]
     winner = comm.vsum(gate * (comm.index() * nb + win + 1)) - 1
     return (used, nonzero_used, sel_counts, dom_counts, ipa, cursor,
-            overflow, dyn, sig_scores), winner
+            overflow, tab, sig_scores), winner
 
 
 def dedup_fast_capable(cfg: KernelConfig, comm=LOCAL_COMM) -> bool:
-    """Whether the two-tier clone-replay scan is valid for this config: the
-    carry patch covers exactly the dynamic state of NodeResourcesFit +
-    soft spread. Hard spread constraints and IPA mutate cross-node state a
-    single-row patch can't track, and the replicated dyn carry is only
-    maintained single-shard — those waves take full steps (still dedup's
+    """Whether the two-tier signature-replay scan is valid for this config:
+    the winner-column patch covers the dynamic state of NodeResourcesFit +
+    spread scoring, hard spread divergence is caught by the per-step
+    feasibility gate (a mismatching row re-runs the full tier), and under
+    sharding the row columns are shard-local while the domain tables stay
+    replicated via psum'd deltas. Only IPA still mutates cross-node state
+    the patch can't track — those waves take full steps (still dedup's
     static-pass savings, just no per-step shortcut)."""
-    return cfg.n_hard == 0 and not cfg.ipa_active and comm.n_shards == 1
+    del comm  # kept for API compat; the replay tier is now shard-safe
+    return not cfg.ipa_active
 
 
 def _batched_assign_core(cfg: KernelConfig, planes: dict, packed_f,
                          layout, tie_words, cursor_init, frame_shift,
                          comm=LOCAL_COMM, sig_ids=None, uniq_idx=None,
-                         dedup=False):
+                         dedup=False, carry_map=None, sig_table=None,
+                         xwave=False):
     from .planes import unpack_features
 
     # ONE host→device transfer carries the whole wave's features; the
@@ -1183,6 +1256,8 @@ def _batched_assign_core(cfg: KernelConfig, planes: dict, packed_f,
     batched_f = unpack_features(packed_f, layout)
     dedup = dedup and sig_ids is not None  # static arg: resolved at trace
     fast = dedup and dedup_fast_capable(cfg, comm)
+    xwave = (xwave and fast and carry_map is not None
+             and sig_table is not None)
     nb = planes["valid"].shape[0]
     if dedup:
         # static per-pod parts ONCE PER SIGNATURE: the vmap runs over the
@@ -1193,13 +1268,9 @@ def _batched_assign_core(cfg: KernelConfig, planes: dict, packed_f,
         static_rows = jax.vmap(
             lambda f: _static_pod_parts(cfg, planes, f, comm)
         )(uniq_f)
-        # a slot replays its predecessor iff they share a signature; slot 0
-        # and every run head take the full tier
-        same = jnp.concatenate(
-            [jnp.zeros(1, bool), sig_ids[1:] == sig_ids[:-1]]
-        )
-        xs = (batched_f, sig_ids, same)
+        xs = (batched_f, sig_ids)
     else:
+        uniq_f = None
         static_rows = None
         static = jax.vmap(
             lambda f: _static_pod_parts(cfg, planes, f, comm)
@@ -1216,20 +1287,40 @@ def _batched_assign_core(cfg: KernelConfig, planes: dict, packed_f,
     cursor0 = (jnp.asarray(cursor_init, jnp.int32)
                - jnp.asarray(frame_shift, jnp.int32))
     if fast:
+        C = uniq_idx.shape[0]
         ct = max(1, min(cfg.max_constraints, cfg.n_soft))
         dmax = max((dk for dk in cfg.topo_domains if dk > 0), default=1)
-        dyn0 = (jnp.zeros(nb, jnp.int32), jnp.zeros(nb, bool),
-                jnp.zeros(nb, bool), jnp.zeros((ct, dmax), jnp.int32),
-                jnp.zeros((ct, dmax), jnp.int32))
-        sig_scores0 = jnp.full((uniq_idx.shape[0], nb), -1, jnp.int32)
+        if xwave:
+            # seed the table from the previous wave's resident rows: slot
+            # c replays from prev slot carry_map[c] (host signature-bytes
+            # match), -1 means a fresh signature — its row starts invalid
+            # and pays the full tier on first occurrence
+            m = jnp.clip(carry_map, 0)
+            ok = carry_map >= 0
+            tab0 = (
+                jnp.where(ok[:, None], sig_table["ew"][m], 0),
+                jnp.where(ok[:, None], sig_table["ffit"][m], False),
+                jnp.where(ok[:, None], sig_table["feas"][m], False),
+                jnp.where(ok[:, None, None], sig_table["segs"][m], 0),
+                jnp.where(ok[:, None, None], sig_table["pcs"][m], 0),
+                ok,
+            )
+        else:
+            tab0 = (jnp.zeros((C, nb), jnp.int32),
+                    jnp.zeros((C, nb), bool), jnp.zeros((C, nb), bool),
+                    jnp.zeros((C, ct, dmax), jnp.int32),
+                    jnp.zeros((C, ct, dmax), jnp.int32),
+                    jnp.zeros(C, bool))
+        sig_scores0 = jnp.full((C, nb), -1, jnp.int32)
     else:
-        dyn0 = None
+        tab0 = None
         sig_scores0 = None
     init = (planes["used"], planes["nonzero_used"], planes["sel_counts"],
-            dom_counts, ipa, cursor0, jnp.bool_(False), dyn0, sig_scores0)
+            dom_counts, ipa, cursor0, jnp.bool_(False), tab0, sig_scores0)
     step = functools.partial(_assign_step, cfg, planes, present, tie_words,
-                             comm, static_rows=static_rows, fast=fast)
-    (used, nonzero_used, sel_counts, _, ipa_out, cursor, overflow, _,
+                             comm, static_rows=static_rows, uniq_f=uniq_f,
+                             fast=fast)
+    (used, nonzero_used, sel_counts, _, ipa_out, cursor, overflow, tab,
      sig_scores), winners = jax.lax.scan(step, init, xs, unroll=4)
     # single-transfer result: winners ++ [tie_consumed, tie_overflow] — the
     # host reads everything it needs in ONE device→host round trip (the
@@ -1243,24 +1334,33 @@ def _batched_assign_core(cfg: KernelConfig, planes: dict, packed_f,
            "tie_overflow": overflow, "packed": packed}
     if sig_scores is not None:
         out["sig_scores"] = sig_scores
+    if tab is not None:
+        # the resident table stays on device; the host only keeps the
+        # signature-bytes → slot map and hands the dict back as sig_table
+        # on the next chained wave
+        out["sig_table"] = {"ew": tab[0], "ffit": tab[1], "feas": tab[2],
+                            "segs": tab[3], "pcs": tab[4]}
     if ipa_out is not None:
         out["ipa_counts"], out["ipa_anti"], out["ipa_pref"] = ipa_out
     return winners, out
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 9))
+@functools.partial(jax.jit, static_argnums=(0, 3, 9, 12))
 def _batched_assign_jit(cfg: KernelConfig, planes: dict, packed_f,
                         layout, tie_words, cursor_init, frame_shift,
-                        sig_ids, uniq_idx, dedup):
+                        sig_ids, uniq_idx, dedup, carry_map, sig_table,
+                        xwave):
     return _batched_assign_core(cfg, planes, packed_f, layout, tie_words,
                                 cursor_init, frame_shift, LOCAL_COMM,
                                 sig_ids=sig_ids, uniq_idx=uniq_idx,
-                                dedup=dedup)
+                                dedup=dedup, carry_map=carry_map,
+                                sig_table=sig_table, xwave=xwave)
 
 
 def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
                    tie_words=None, cursor_init=0, frame_shift=0,
-                   sig_ids=None, uniq_idx=None):
+                   sig_ids=None, uniq_idx=None, carry_map=None,
+                   sig_table=None):
     """Greedy multi-pod assignment: lax.scan over the pod axis; pod i+1 sees
     pod i's assumed deltas (the in-kernel analogue of the cache assume in
     schedule_one.go:320-333 and of the gang default algorithm, and the
@@ -1276,10 +1376,18 @@ def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
     Signature dedup: sig_ids [P] int32 groups slots whose packed feature
     rows are byte-identical (backend.group_signatures); uniq_idx [G] holds
     each group's first-occurrence slot. The scan then runs the static pass
-    once per signature and — where dedup_fast_capable — replays score rows
-    across consecutive clones. Decisions (winners, tie stream, planes) are
-    bit-identical to the non-dedup scan; `sig_scores` in the result holds
-    each signature's feasibility-gated score row for host cache export.
+    once per signature and — where dedup_fast_capable — replays resident
+    score rows for every later clone. Decisions (winners, tie stream,
+    planes) are bit-identical to the non-dedup scan; `sig_scores` in the
+    result holds each signature's feasibility-gated score row for host
+    cache export and `sig_table` the resident per-signature rows.
+
+    Cross-wave reuse: carry_map [G] int32 maps each of this wave's
+    signature slots to its slot in the previous chained wave's sig_table
+    (-1 = miss); sig_table is that wave's resident-row dict, still on
+    device. Both must come from a wave whose output planes are THIS wave's
+    input planes (the backend's carry path) — the host is responsible for
+    that gate (SignatureScoreCache).
 
     Returns (winners [P] int32 node index or -1, dict with updated
     used/nonzero_used/sel_counts planes + tie_consumed/tie_overflow)."""
@@ -1289,9 +1397,13 @@ def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
         tie_words = ZERO_TIE_WORDS
     packed, layout = pack_features(batched_f)
     dedup = sig_ids is not None and uniq_idx is not None
+    xwave = bool(dedup and carry_map is not None and sig_table is not None)
     return _batched_assign_jit(cfg, planes, packed, layout, tie_words,
                                np.int32(cursor_init) if isinstance(cursor_init, int) else cursor_init,
                                np.int32(frame_shift),
                                np.asarray(sig_ids, np.int32) if dedup else None,
                                np.asarray(uniq_idx, np.int32) if dedup else None,
-                               dedup)
+                               dedup,
+                               np.asarray(carry_map, np.int32) if xwave else None,
+                               sig_table if xwave else None,
+                               xwave)

@@ -50,6 +50,7 @@ from ..ops.kernels import (
     KernelConfig,
     _batched_assign_core,
     _fit_and_score_jit,
+    dedup_fast_capable,
     filter_masks,
     scores,
 )
@@ -145,22 +146,28 @@ def sharded_fit_and_score(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict, f
     return _fit_and_score_jit(cfg, sharded_planes, replicate(mesh, f))
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 3, 6))
 def _sharded_assign_jit(cfg: KernelConfig, mesh: Mesh, planes: dict, layout,
-                        packed_f, tie_words):
+                        packed_f, tie_words, dedup, sig_ids, uniq_idx):
     """Explicit shard_map over the nodes axis: every plane arrives
     shard-local, features/tie stream replicated, and the scan step's only
     cross-shard traffic is the scalar collectives AxisComm emits (per-shard
     tie counts + winner publication + normalization pmax/pmin) — NOT the
     full-vector reductions GSPMD inferred for the same program (which made
-    the sharded scan a 6.7x pessimization in round 4)."""
+    the sharded scan a 6.7x pessimization in round 4).
+
+    With dedup the signature-replay tier runs shard-safe: score-row columns
+    stay shard-local while the replay predicate and domain-table deltas ride
+    the same scalar/segment psums, so every shard takes the same cond
+    branch."""
     n_shards = mesh.shape[NODE_AXIS]
     comm = AxisComm(NODE_AXIS, n_shards)
 
-    def body(planes_l, packed_l, tie_l):
+    def body(planes_l, packed_l, tie_l, sig_l, uniq_l):
         return _batched_assign_core(
             cfg, planes_l, packed_l, layout, tie_l,
             np.int32(0), np.int32(0), comm,
+            sig_ids=sig_l, uniq_idx=uniq_l, dedup=dedup,
         )
 
     plane_specs = {}
@@ -168,37 +175,55 @@ def _sharded_assign_jit(cfg: KernelConfig, mesh: Mesh, planes: dict, layout,
         dim = _NODE_DIM.get(k)
         plane_specs[k] = (P() if dim is None
                           else P(*([None] * dim + [NODE_AXIS])))
-    # outputs: winners/packed/tie scalars replicated; carry planes sharded
+    fast = dedup and dedup_fast_capable(cfg, comm)
+    # outputs: winners/packed/tie scalars replicated; carry planes sharded;
+    # resident score-row columns sharded like the planes, domain tables and
+    # validity replicated (they're maintained via psum'd deltas)
     out_specs = (
         P(),
         {
             "used": P(NODE_AXIS), "nonzero_used": P(NODE_AXIS),
             "sel_counts": P(NODE_AXIS), "tie_consumed": P(),
             "tie_overflow": P(), "packed": P(),
+            **({"sig_scores": P(None, NODE_AXIS),
+                "sig_table": {"ew": P(None, NODE_AXIS),
+                              "ffit": P(None, NODE_AXIS),
+                              "feas": P(None, NODE_AXIS),
+                              "segs": P(), "pcs": P()}} if fast else {}),
             **({"ipa_counts": P(NODE_AXIS), "ipa_anti": P(NODE_AXIS),
                 "ipa_pref": P(NODE_AXIS)} if cfg.ipa_active else {}),
         },
     )
     return _shard_map(
         body, mesh=mesh,
-        in_specs=(plane_specs, P(), P()),
+        in_specs=(plane_specs, P(), P(), P(), P()),
         out_specs=out_specs,
         **{_SHARD_MAP_CHECK_KW: False},
-    )(planes, packed_f, tie_words)
+    )(planes, packed_f, tie_words, sig_ids, uniq_idx)
 
 
 def sharded_batched_assign(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
-                           batched_f: dict, tie_words=None):
+                           batched_f: dict, tie_words=None, sig_ids=None,
+                           uniq_idx=None):
     """Sequential-greedy wave over node-sharded planes (lax.scan on pods),
-    decisions bit-identical to the single-device batched_assign."""
+    decisions bit-identical to the single-device batched_assign. sig_ids /
+    uniq_idx (see batched_assign) enable signature dedup with the same
+    bit-compat contract; the replay tier applies whenever the config is
+    dedup_fast_capable."""
     from ..ops.planes import pack_features
 
     if tie_words is None:
         tie_words = ZERO_TIE_WORDS
     packed, layout = pack_features(batched_f)
+    dedup = sig_ids is not None and uniq_idx is not None
+    sig_r = (replicate(mesh, np.asarray(sig_ids, np.int32))
+             if dedup else replicate(mesh, np.zeros(1, np.int32)))
+    uniq_r = (replicate(mesh, np.asarray(uniq_idx, np.int32))
+              if dedup else replicate(mesh, np.zeros(1, np.int32)))
     return _sharded_assign_jit(cfg, mesh, sharded_planes, layout,
                                replicate(mesh, packed),
-                               replicate(mesh, tie_words))
+                               replicate(mesh, tie_words),
+                               dedup, sig_r, uniq_r)
 
 
 @functools.partial(jax.jit, static_argnums=0)

@@ -79,6 +79,10 @@ class Framework:
         self.placement_score_plugins = having("score_placement")
         self._waiting_pods: dict[str, WaitingPod] = {}
         self._metric_tick = 1  # 10% plugin-metric sampling LCG state
+        # optional UNSAMPLED per-call observer (point, plugin, seconds) —
+        # installed transiently by the flight recorder's fallback
+        # attribution so host-fallback scoring is attributable per plugin
+        self.plugin_observer = None
 
     # -- queue wiring -------------------------------------------------------
 
@@ -98,6 +102,19 @@ class Framework:
     # -- timing helper ------------------------------------------------------
 
     def _timed(self, point: str, plugin: str, fn: Callable[[], Any]) -> Any:
+        obs = self.plugin_observer
+        if obs is not None:
+            # attribution window open (host-fallback path): time EVERY call
+            # — the window is rare and short, and regressions there need
+            # full per-plugin accounting, not a 10% sample
+            t0 = time.perf_counter()
+            try:
+                return fn()
+            finally:
+                dt = time.perf_counter() - t0
+                obs(point, plugin, dt)
+                if self.metrics is not None:
+                    self.metrics.observe_plugin(point, plugin, dt)
         if self.metrics is None:
             return fn()
         # sample ~1-in-10 like the reference (pluginMetricsSamplePercent=10,

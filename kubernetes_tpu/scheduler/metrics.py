@@ -167,6 +167,12 @@ class SchedulerMetrics:
             "scheduler_tpu_signature_cache_hits_total",
             "Pods that rode a duplicate signature instead of a full score pass",
         )
+        self.cross_wave_signatures = r.counter(
+            "scheduler_tpu_cross_wave_signatures_total",
+            "Signatures reusing device-resident score rows across wave "
+            "boundaries, by outcome (hit|miss|eviction)",
+            labels=("outcome",),
+        )
         self.wave_fallbacks = r.counter(
             "scheduler_tpu_wave_fallbacks_total",
             "Waves that fell back to per-pod host scheduling, by reason",
@@ -282,6 +288,12 @@ class SchedulerMetrics:
             self.wave_dedup_ratio.set(record.distinct_signature_ratio)
         if record.clones:
             self.signature_cache_hits.inc(by=record.clones)
+        if record.xwave_hits:
+            self.cross_wave_signatures.inc("hit", by=record.xwave_hits)
+        if record.xwave_misses:
+            self.cross_wave_signatures.inc("miss", by=record.xwave_misses)
+        if record.xwave_evictions:
+            self.cross_wave_signatures.inc("eviction", by=record.xwave_evictions)
         if record.fallback_reason:
             # reason cardinality is bounded: strip per-wave detail after ':'
             self.wave_fallbacks.inc(record.fallback_reason.split(":")[0])

@@ -14,6 +14,7 @@ for correctness, golden-testing, and the sparse long-tail plugins.
 
 from __future__ import annotations
 
+import os
 import random
 import time as _time
 
@@ -35,6 +36,11 @@ _log = get_logger("scheduler")
 
 MIN_FEASIBLE_NODES_TO_FIND = 100  # schedule_one.go:56
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # schedule_one.go:62
+
+# wave-size cap while the TPU circuit breaker is HALF_OPEN: a recovering
+# device probes with small waves instead of being handed a full one (a
+# probe failure then strands N pods, not max_pods)
+PROBE_WAVE_PODS = int(os.environ.get("KUBE_TPU_PROBE_WAVE_PODS", "8"))
 
 
 def num_feasible_nodes_to_find(percentage: int, num_all_nodes: int) -> int:
@@ -473,6 +479,12 @@ class ScheduleOneLoop:
                     break
                 wave_algo = algo
                 wave.append(qpi)
+                breaker = getattr(algo, "breaker", None)
+                if (breaker is not None and len(wave) >= PROBE_WAVE_PODS
+                        and breaker.probing()):
+                    # HALF_OPEN: probe the recovering device with a small
+                    # wave; the rest of the queue waits for the verdict
+                    break
 
         if not wave:
             processed = self._flush_wave_pipeline()
@@ -523,7 +535,8 @@ class ScheduleOneLoop:
             # schedule_pod's device_blocked() check routes each pod to the
             # host tier
             processed += self._flush_wave_pipeline()
-            with self.recorder.phase("finish"):
+            with self.recorder.phase("finish"), self.recorder.\
+                    fallback_attribution(self.framework_for_pod(wave[0].pod)):
                 for qpi in wave:
                     algo.revert_wave_plan(qpi.pod)
                     self.schedule_pod_info(qpi)
@@ -564,7 +577,8 @@ class ScheduleOneLoop:
                     breaker.record_benign()
             processed += self._flush_wave_pipeline()
             algo.fallback_count += len(wave)
-            with self.recorder.phase("finish"):
+            with self.recorder.phase("finish"), self.recorder.\
+                    fallback_attribution(self.framework_for_pod(wave[0].pod)):
                 for qpi in wave:
                     algo.revert_wave_plan(qpi.pod)
                     self.schedule_pod_info(qpi)
@@ -616,7 +630,8 @@ class ScheduleOneLoop:
                         breaker.record_benign()
                 self._poison_successor(algo)
                 algo.fallback_count += len(wave)
-                with rec.phase("finish"):
+                with rec.phase("finish"), rec.fallback_attribution(
+                        self.framework_for_pod(wave[0].pod)):
                     for qpi in wave:
                         algo.revert_wave_plan(qpi.pod)
                         self.schedule_pod_info(qpi)

@@ -43,7 +43,7 @@ from ...ops import (
     fit_and_score,
     stack_features,
 )
-from ...ops.kernels import FILTER_NAMES
+from ...ops.kernels import FILTER_NAMES, dedup_fast_capable
 from ...utils import faultinject
 from ..framework.interface import (
     Diagnosis,
@@ -147,6 +147,69 @@ def group_feature_rows(packed: np.ndarray):
     return ids, np.asarray(uniq, np.int32)
 
 
+class SignatureScoreCache:
+    """Host bookkeeping for the device-resident cross-wave score rows.
+
+    The kernel's fast tier materializes a per-signature score-row table
+    (sig_table) that stays on device; this cache keeps the matching
+    signature-bytes → slot map plus a shape/config key so the NEXT chained
+    wave can hand the table back (batched_assign carry_map/sig_table) and
+    replay signatures it has already scored. The device arrays themselves
+    never round-trip through the host — only the dict of handles does.
+
+    Validity contract: the table's rows are score rows AGAINST THE CARRY
+    PLANES as of the end of the wave that produced it. They are only
+    handed back when the next launch chains on that same carry (the
+    launch-time NeedResync checks prove no external change slipped in);
+    any carry invalidation — resync, poison, overflow, breaker trip —
+    clears this cache too (TPUBackend.invalidate_carry)."""
+
+    def __init__(self):
+        self.slots: dict[bytes, int] = {}   # signature bytes → table slot
+        self.table: dict | None = None      # device arrays from sig_table
+        self.key: tuple | None = None       # (cfg, bucket_sizes, G_pad)
+        self.hits = 0                        # cumulative, for stats
+        self.misses = 0
+        self.evictions = 0
+
+    def clear(self) -> None:
+        self.slots = {}
+        self.table = None
+        self.key = None
+
+    def lookup(self, key, sig_bytes, g_pad: int):
+        """carry_map [g_pad] for this wave's signatures against the cached
+        table, or None when the cache is cold / keyed differently (a config
+        or shape change would hand the kernel mis-shaped rows). Slot gid of
+        the new wave replays from cached slot carry_map[gid]; -1 = miss."""
+        if self.table is None or key != self.key:
+            return None
+        m = np.full(g_pad, -1, np.int32)
+        for gid, b in enumerate(sig_bytes):
+            m[gid] = self.slots.get(b, -1)
+        return m
+
+    def store(self, key, table, sig_bytes) -> tuple[int, int, int]:
+        """Adopt a just-launched wave's table as the new resident
+        generation; returns (hits, misses, evictions) of this wave's
+        signatures against the PREVIOUS generation. Bounded by
+        construction: the table holds exactly one generation (one wave's
+        G_pad slots) — signatures absent from the new wave are evicted."""
+        warm = self.table is not None and key == self.key
+        hit = sum(1 for b in sig_bytes if b in self.slots) if warm else 0
+        miss = len(sig_bytes) - hit
+        evict = max(0, len(self.slots) - hit) if warm else len(self.slots)
+        self.slots = {}
+        for gid, b in enumerate(sig_bytes):
+            self.slots.setdefault(b, gid)  # first-appearance wins
+        self.table = table
+        self.key = key
+        self.hits += hit
+        self.misses += miss
+        self.evictions += evict
+        return hit, miss, evict
+
+
 class InflightWave:
     """A launched-but-uncollected batched wave: device handles only."""
 
@@ -236,8 +299,20 @@ class TPUBackend:
         # way (golden-tested), so the switch exists for A/B and fallback.
         self.dedup_enabled = True
         # cumulative wave-composition counters for metrics/bench
-        # (distinct_signature_ratio = signatures/pods)
-        self.dedup_stats = {"pods": 0, "signatures": 0, "waves": 0}
+        # (distinct_signature_ratio = signatures/pods; xwave_* count
+        # cross-wave signature reuse — hits replay a previous chained
+        # wave's resident score row without any fresh scoring pass)
+        self.dedup_stats = {"pods": 0, "signatures": 0, "waves": 0,
+                            "xwave_hits": 0, "xwave_misses": 0,
+                            "xwave_evictions": 0}
+        # cross-wave signature reuse (ISSUE 5): the kernel's resident
+        # per-signature score rows survive wave boundaries while launches
+        # chain on the device carry, so a repeat-heavy burst pays the full
+        # scoring pass once per signature per BURST, not per wave. The
+        # switch exists for A/B and golden tests; decisions are
+        # bit-identical either way.
+        self.cross_wave_enabled = True
+        self.sig_cache = SignatureScoreCache()
         # (carry dict, allowed dirty rows) of the wave being processed RIGHT
         # NOW: single-pod re-runs inside that window must see state as of
         # THAT wave — the live carry already contains the uncollected
@@ -460,7 +535,7 @@ class TPUBackend:
         n_slots = max(pad_to, len(pods))
         dev = self.device_inputs(planes)
         cfg = self.kernel_config(planes, feats)
-        sig_ids, uniq = self._group_wave(feats, len(pods))
+        sig_ids, uniq, _ = self._group_wave(feats, len(pods))
         tie_words = None
         if rng is not None:
             # vectorized stream cloning instead of n_slots*16 interpreter-
@@ -488,12 +563,14 @@ class TPUBackend:
 
     def _group_wave(self, feats, n_real: int):
         """Signature-group a (possibly padded) stacked feature batch:
-        returns (sig_ids [P_pad], uniq_idx [G_pad]) for batched_assign, or
-        (None, None) with dedup disabled. uniq_idx is padded to a pow2
-        bucket (floor 8, repeating the first group's slot) so the per-wave
-        distinct count doesn't fan out XLA program shapes."""
+        returns (sig_ids [P_pad], uniq_idx [G_pad], sig_bytes [G]) for
+        batched_assign, or (None, None, None) with dedup disabled. uniq_idx
+        is padded to a pow2 bucket (floor 8, repeating the first group's
+        slot) so the per-wave distinct count doesn't fan out XLA program
+        shapes; sig_bytes holds the G real groups' packed-row bytes — the
+        cross-wave cache key material."""
         if not self.dedup_enabled:
-            return None, None
+            return None, None, None
         from ...ops.planes import pack_features
         from ...ops.vocab import next_pow2
 
@@ -502,12 +579,13 @@ class TPUBackend:
         self.dedup_stats["pods"] += n_real
         self.dedup_stats["signatures"] += int(sig_ids[:n_real].max()) + 1
         self.dedup_stats["waves"] += 1
+        sig_bytes = tuple(packed_rows[i].tobytes() for i in uniq)
         gp = next_pow2(len(uniq), floor=8)
         if gp > len(uniq):
             uniq = np.concatenate(
                 [uniq, np.full(gp - len(uniq), uniq[0], np.int32)]
             )
-        return sig_ids, uniq
+        return sig_ids, uniq, sig_bytes
 
     # -- pipelined wave launch/collect ----------------------------------------
 
@@ -522,6 +600,9 @@ class TPUBackend:
         self._carry_external = False
         self._rerun_carry = None
         self._pending_dirty = None  # carried planes on device are stale
+        # resident score rows are scores AGAINST the carry planes — they
+        # die with it
+        self.sig_cache.clear()
 
     def mark_external(self) -> None:
         """An event outside the wave pipeline's own writeback touched
@@ -571,6 +652,7 @@ class TPUBackend:
         rec.pad = pad
 
         prev = self._inflight
+        chained = False
         try:
             if prev is not None and self._carry is None:
                 # a single-pod cycle (or divergence) dropped the carry while
@@ -596,6 +678,10 @@ class TPUBackend:
                 self._fresh_term_key(planes)
                 dev = {**self._device_planes, **self._carry,
                        **self._device_tables}
+                # the carry survived every resync check: this wave chains
+                # on the exact planes the resident score rows were scored
+                # against, so cross-wave replay is sound
+                chained = True
             else:
                 with self.recorder.wave_phase("upload", rec):
                     dev = self.device_inputs(planes)
@@ -606,7 +692,18 @@ class TPUBackend:
 
         cfg = self.kernel_config(planes, feats)
         with self.recorder.wave_phase("dedup", rec):
-            sig_ids, uniq = self._group_wave(feats, len(pods))
+            sig_ids, uniq, sig_bytes = self._group_wave(feats, len(pods))
+        # cross-wave signature reuse: hand the previous chained wave's
+        # resident score-row table back to the kernel with a slot map so
+        # already-scored signatures skip the full pass entirely
+        carry_map = sig_table = xw_key = None
+        if sig_ids is not None and dedup_fast_capable(cfg):
+            xw_key = (cfg, planes.bucket_sizes, len(uniq))
+            if chained and self.cross_wave_enabled:
+                carry_map = self.sig_cache.lookup(xw_key, sig_bytes,
+                                                  len(uniq))
+                if carry_map is not None:
+                    sig_table = self.sig_cache.table
         self.recorder.note_launch(
             rec,
             signatures=(int(sig_ids[: len(pods)].max()) + 1
@@ -633,7 +730,22 @@ class TPUBackend:
                 cfg, dev, feats, tie_words, cursor_init,
                 frame_shift if prev is not None else 0,
                 sig_ids=sig_ids, uniq_idx=uniq,
+                carry_map=carry_map, sig_table=sig_table,
             )
+        if xw_key is not None and "sig_table" in info:
+            if carry_map is None:
+                # nothing was replayed (cold cache / fresh upload / reuse
+                # off): this wave's table starts a fresh generation
+                self.sig_cache.clear()
+            xw_hit, xw_miss, xw_evict = self.sig_cache.store(
+                xw_key, info["sig_table"], sig_bytes
+            )
+            self.dedup_stats["xwave_hits"] += xw_hit
+            self.dedup_stats["xwave_misses"] += xw_miss
+            self.dedup_stats["xwave_evictions"] += xw_evict
+            self.recorder.note_cross_wave(rec, xw_hit, xw_miss, xw_evict)
+        else:
+            self.sig_cache.clear()
         # next launch chains on these outputs
         self._carry = {k: info[k] for k in
                        ("used", "nonzero_used", "sel_counts")}
@@ -1018,6 +1130,16 @@ class TPUSchedulingAlgorithm(SchedulingAlgorithm):
         self.host_tail_percentage = host_tail_percentage
 
     def _on_breaker_transition(self, old: str, new: str, reason: str) -> None:
+        from .circuitbreaker import OPEN
+
+        if new == OPEN:
+            # trip: per-pod host scheduling is about to mutate cluster
+            # state outside the wave pipeline's writeback — the resident
+            # cross-wave score rows can't be trusted past this point. The
+            # carry's own NeedResync checks handle the planes; the
+            # signature cache must be dropped explicitly (it would
+            # otherwise look warm if the carry happens to survive).
+            self.backend.sig_cache.clear()
         rec = getattr(self.backend, "recorder", None)
         if rec is not None:
             rec.breaker_transition(old, new, reason)

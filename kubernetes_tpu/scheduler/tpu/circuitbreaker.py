@@ -102,6 +102,13 @@ class CircuitBreaker:
                 and self._clock() - self._opened_at < self.cooldown_s
             )
 
+    def probing(self) -> bool:
+        """Pure read: True while HALF_OPEN — the wave popper uses this to
+        cap probe waves at a small size (a recovering device gets a taster,
+        not a full wave). Never mutates state."""
+        with self._mu:
+            return self.state == HALF_OPEN
+
     # -- outcomes ----------------------------------------------------------
 
     def record_success(self) -> None:

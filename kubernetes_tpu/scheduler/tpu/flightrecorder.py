@@ -69,6 +69,12 @@ class WaveRecord:
     occupancy: float = 0.0  # pods / pad
     carry_invalidations: int = 0  # invalidations during this wave's flight
     cache_exports: int = 0  # signature hints exported to the BatchCache
+    # cross-wave signature reuse (device-resident score cache): signatures
+    # of this wave replayed from / missing in / evicted from the previous
+    # chained wave's resident table
+    xwave_hits: int = 0
+    xwave_misses: int = 0
+    xwave_evictions: int = 0
     fallback_reason: str | None = None  # resync/fallback diagnosis, if any
     injected_faults: int = 0  # chaos faults fired during this wave's flight
     retries: int = 0  # dispatcher retry attempts during this wave's flight
@@ -95,6 +101,9 @@ class WaveRecord:
             "dedup_tier": self.dedup_tier,
             "carry_invalidations": self.carry_invalidations,
             "cache_exports": self.cache_exports,
+            "xwave_hits": self.xwave_hits,
+            "xwave_misses": self.xwave_misses,
+            "xwave_evictions": self.xwave_evictions,
             "fallback_reason": self.fallback_reason,
             "injected_faults": self.injected_faults,
             "retries": self.retries,
@@ -205,6 +214,43 @@ class FlightRecorder:
         if dedup and rec.pods:
             rec.clones = rec.pods - signatures
             rec.distinct_signature_ratio = round(signatures / rec.pods, 4)
+
+    def note_cross_wave(self, rec: WaveRecord, hits: int, misses: int,
+                        evictions: int) -> None:
+        """Attach the launch-side cross-wave cache outcome: how many of
+        this wave's signatures replayed a previous chained wave's resident
+        score row (hits) vs paid a fresh full pass (misses), and how many
+        resident rows fell out of the single-generation table."""
+        rec.xwave_hits = hits
+        rec.xwave_misses = misses
+        rec.xwave_evictions = evictions
+
+    @contextmanager
+    def fallback_attribution(self, framework, record: WaveRecord | None = None):
+        """Per-plugin phase attribution for host-fallback scoring: while
+        active, every plugin call the framework times lands in
+        `fallback/<plugin>` phase buckets (phase_totals + the wave record)
+        UNSAMPLED, so a fallback regression is attributable to the plugin
+        that caused it instead of vanishing into one "finish" span. Host-
+        side only — the observer fires around interpreter-level plugin
+        calls, never inside jitted code."""
+        if framework is None:
+            yield
+            return
+        prev = getattr(framework, "plugin_observer", None)
+
+        def observe(point: str, plugin: str, dt: float) -> None:
+            key = f"fallback/{plugin}"
+            with self._lock:
+                self.phase_totals[key] = self.phase_totals.get(key, 0.0) + dt
+                if record is not None:
+                    record.phases[key] = record.phases.get(key, 0.0) + dt
+
+        framework.plugin_observer = observe
+        try:
+            yield
+        finally:
+            framework.plugin_observer = prev
 
     def carry_invalidated(self) -> None:
         """The device carry was dropped (resync/divergence/external event);
@@ -379,6 +425,8 @@ def _demo() -> FlightRecorder:
         with rec.wave_phase("dispatch", wr):
             pass
         rec.note_launch(wr, signatures=3, dedup=True)
+        rec.note_cross_wave(wr, hits=(3 if i else 0),
+                            misses=(0 if i else 3), evictions=0)
         with rec.phase("kernel", wr):
             if i == 4:
                 time.sleep(0.12)  # trip the watchdog once

@@ -385,6 +385,42 @@ class TestCircuitBreaker:
             (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED)]
 
 
+class TestProbeWaveSizing:
+    def test_half_open_caps_wave_at_probe_size(self):
+        """While HALF_OPEN, the wave popper gives the recovering device a
+        PROBE_WAVE_PODS taster instead of a full wave; the rest of the
+        queue waits for the probe's verdict."""
+        from kubernetes_tpu.scheduler.schedule_one import PROBE_WAVE_PODS
+
+        store = Store()
+        for i in range(4):
+            store.create(make_node(f"n{i}", cpu="32", mem="64Gi"))
+        for i in range(PROBE_WAVE_PODS * 3):
+            store.create(make_pod(f"p{i:02d}", cpu="100m", mem="64Mi",
+                                  labels={"app": "probe"}))
+        s = Scheduler(store,
+                      profiles=[Profile(backend="tpu", wave_size=256)],
+                      seed=3)
+        algo = s.algorithms["default-scheduler"]
+        s.start()
+        s.pump()
+        with algo.breaker._mu:
+            algo.breaker.state = HALF_OPEN
+        s.loop.schedule_wave()
+        infl = s.loop._inflight_wave
+        assert infl is not None, "probe wave must still go to the device"
+        probe_pods = len(infl[1].pods)
+        assert 0 < probe_pods <= PROBE_WAVE_PODS, \
+            f"HALF_OPEN wave popped {probe_pods} (cap {PROBE_WAVE_PODS})"
+        # once CLOSED again the backlog drains in full-size waves
+        with algo.breaker._mu:
+            algo.breaker.state = CLOSED
+            algo.breaker._probes_inflight = 0
+        s.schedule_pending()
+        sizes = [r.pods for r in s.flight_recorder.records()]
+        assert max(sizes) > PROBE_WAVE_PODS, sizes
+
+
 # ---------------------------------------------------------- reconciliation
 
 
@@ -513,4 +549,29 @@ class TestGoldenDisarmed:
         assert diags_on == diags_off
         assert rng_on == rng_off
         assert sum(1 for v in placed_on.values() if v) > 0
+        assert reg.fired_total == 0
+
+    def test_cross_wave_reuse_inert_under_disarmed_points(self):
+        """Same inverse check for the cross-wave signature cache: with a
+        spec registered at EVERY injection point (disarmed), chained waves
+        replaying device-resident score rows schedule byte-identically to
+        reuse off — the cache changes nothing but the work skipped."""
+        from tests.test_dedup_golden import TestFullPipelineGolden
+
+        reg = faultinject.registry()
+        reg.reset(seed=101)
+        for point in faultinject.POINTS:
+            reg.register(FaultSpec(point, mode=ERROR, transient=True))
+        assert reg.armed is False
+
+        placed_off, diags_off, rng_off, stats_off = (
+            TestFullPipelineGolden._run(dedup=True, cross_wave=False))
+        placed_on, diags_on, rng_on, stats_on = (
+            TestFullPipelineGolden._run(dedup=True, cross_wave=True))
+        assert placed_on == placed_off
+        assert diags_on == diags_off
+        assert rng_on == rng_off
+        assert stats_on["xwave_hits"] > 0, \
+            "reuse must be live in the enabled run"
+        assert stats_off["xwave_hits"] == 0
         assert reg.fired_total == 0

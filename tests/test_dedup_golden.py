@@ -1,4 +1,4 @@
-"""Golden bit-compat tests for signature-dedup wave scoring (PR 2).
+"""Golden bit-compat tests for signature-dedup wave scoring (PR 2 + PR 5).
 
 The dedup kernel's contract: grouping a wave's pods by packed feature-row
 bytes and replaying clones from the carried per-signature score row
@@ -7,6 +7,13 @@ carries, tie-draw consumption, overflow flags, rng stream position, and
 the failure diagnoses of unschedulable clones. These tests pin that
 contract on a mixed interleaved wave whose nodes fill mid-run (so clone
 feasibility genuinely changes between steps of one signature run).
+
+PR 5 extends the contract three ways, each with its own golden here:
+hard-PTS waves (`n_hard > 0`) now ride the fast tier behind an equality
+gate; sharded meshes run the same table-based tier with shard-local score
+rows; and the resident per-signature score rows survive wave boundaries
+(`TPUBackend.sig_cache`), so chained waves replay signatures scored by
+their predecessors — still byte-identical, including the tie-draw stream.
 """
 
 import random
@@ -25,6 +32,7 @@ from kubernetes_tpu.scheduler.tpu.backend import (
     group_feature_rows,
 )
 from kubernetes_tpu.store import Store
+from kubernetes_tpu.testing import synthetic_cluster, with_spread
 from tests.wrappers import make_node, make_pod
 
 
@@ -81,7 +89,7 @@ class TestKernelGolden:
         tw = clone_tie_words(random.Random(7),
                              n_pods * MAX_TIE_DRAWS + MAX_TIE_DRAWS)
         if dedup:
-            sig_ids, uniq = backend._group_wave(feats, n_pods)
+            sig_ids, uniq, _ = backend._group_wave(feats, n_pods)
             assert int(sig_ids.max()) + 1 == 3
             assert dedup_fast_capable(cfg)
             return batched_assign(cfg, dev, feats, tw,
@@ -110,25 +118,129 @@ class TestKernelGolden:
         assert uniq.tolist() == [0, 1, 3]
 
 
+class TestKernelGoldenHardPTS:
+    """Same kernel golden with a hard DoNotSchedule topology spread in
+    every pod: `cfg.n_hard > 0` now takes the fast tier (behind the
+    feasibility-equality gate) instead of being excluded from dedup —
+    outputs must stay byte-equal to the full-pass scan."""
+
+    def _wave(self, dedup, n_pods=27):
+        names, _, snap = make_cluster(n_nodes=8)
+        backend = TPUBackend(names)
+        pods = [
+            with_spread(p, max_skew=3, key="topology.kubernetes.io/zone",
+                        when="DoNotSchedule")
+            for p in mixed_pods(n_pods)
+        ]
+        for p in pods:
+            backend.extractor.register(p)
+        planes = backend.sync(snap)
+        feats = stack_features(
+            [backend.extractor.features_cached(p, planes) for p in pods]
+        )
+        dev = backend.device_inputs(planes)
+        cfg = backend.kernel_config(planes, feats)
+        assert cfg.n_hard > 0, "scenario must exercise hard-PTS"
+        tw = clone_tie_words(random.Random(13),
+                             n_pods * MAX_TIE_DRAWS + MAX_TIE_DRAWS)
+        if dedup:
+            sig_ids, uniq, _ = backend._group_wave(feats, n_pods)
+            assert dedup_fast_capable(cfg), \
+                "hard-PTS must no longer disqualify the fast tier"
+            return batched_assign(cfg, dev, feats, tw,
+                                  sig_ids=sig_ids, uniq_idx=uniq)
+        return batched_assign(cfg, dev, feats, tw)
+
+    def test_hard_pts_wave_outputs_byte_identical(self):
+        _, info_off = self._wave(dedup=False)
+        _, info_on = self._wave(dedup=True)
+        p_off = np.asarray(info_off["packed"])
+        p_on = np.asarray(info_on["packed"])
+        assert np.array_equal(p_off, p_on)
+        assert (p_off[:-2] >= 0).any(), "some pods must place under spread"
+        for key in ("used", "nonzero_used", "sel_counts"):
+            assert np.array_equal(np.asarray(info_off[key]),
+                                  np.asarray(info_on[key])), key
+
+
+class TestShardedGolden:
+    """The 8-device CPU mesh with dedup on must reproduce the single-device
+    dedup-off scan bit-for-bit — score rows are shard-local, segment/pair
+    tables replicated, and the replay predicate comm-reduced so every
+    shard takes the same tier."""
+
+    def test_sharded_dedup_matches_single_device_reference(self):
+        from kubernetes_tpu.parallel import (
+            scheduler_mesh,
+            shard_planes,
+            sharded_batched_assign,
+        )
+
+        names = ResourceNames()
+        _, snapshot = synthetic_cluster(40, n_zones=4, init_pods_per_node=1,
+                                        names=names)
+        backend = TPUBackend(names)
+        pods = []
+        for i in range(16):
+            p = make_pod(f"p{i}", cpu=f"{1 + i % 2}", mem="1Gi",
+                         labels={"app": f"g{i % 3}"})
+            p = with_spread(p, max_skew=2,
+                            key="topology.kubernetes.io/zone",
+                            when="DoNotSchedule")
+            pods.append(p)
+        for p in pods:
+            backend.extractor.register(p)
+        planes = backend.builder.sync(snapshot)
+        inputs = {**planes.as_dict(),
+                  **backend.extractor.affinity_tables(planes)}
+        feats = stack_features(
+            [backend.extractor.features(p, planes) for p in pods]
+        )
+        cfg = backend.kernel_config(planes, feats)
+        ref_w, ref_state = batched_assign(cfg, inputs, feats)
+        sig_ids, uniq, _ = backend._group_wave(feats, len(pods))
+        assert int(sig_ids[:len(pods)].max()) + 1 < len(pods), \
+            "wave must contain clones"
+        assert cfg.n_hard > 0 and dedup_fast_capable(cfg), \
+            "sharded + hard-PTS must ride the fast tier"
+        mesh = scheduler_mesh(wave=2)
+        dev = shard_planes(mesh, inputs)
+        w, state = sharded_batched_assign(cfg, mesh, dev, feats,
+                                          sig_ids=sig_ids, uniq_idx=uniq)
+        np.testing.assert_array_equal(np.asarray(ref_w), np.asarray(w))
+        for k in ref_state:
+            np.testing.assert_array_equal(np.asarray(ref_state[k]),
+                                          np.asarray(state[k]), err_msg=k)
+
+
 class TestFullPipelineGolden:
     """Scheduler end-to-end, dedup on vs off: identical bindings, identical
     PodScheduled failure diagnoses for the clones that no longer fit, and
     an identical rng stream position afterwards."""
 
     @staticmethod
-    def _run(dedup):
+    def _run(dedup, cross_wave=True, spread=False):
         store = Store()
         for i in range(6):
             store.create(make_node(f"n{i}", cpu="4", mem="8Gi",
                                    zone=f"z{i % 2}"))
         # 30 mixed pods demand 27 cpu on a 24-cpu cluster: nodes fill
         # mid-run and the last clones of each signature fail
-        for p in mixed_pods(30):
+        pods = mixed_pods(30)
+        if spread:
+            pods = [
+                with_spread(p, max_skew=5,
+                            key="topology.kubernetes.io/zone",
+                            when="DoNotSchedule")
+                for p in pods
+            ]
+        for p in pods:
             store.create(p)
         s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
                       seed=11)
         algo = s.algorithms["default-scheduler"]
         algo.backend.dedup_enabled = dedup
+        algo.backend.cross_wave_enabled = cross_wave
         s.start()
         s.schedule_pending()
         s.event_recorder.flush()
@@ -194,3 +306,90 @@ class TestBatchCacheExport:
         s.start()
         s.schedule_pending()
         assert s.batch_cache is None
+
+
+class TestCrossWaveGolden:
+    """Cross-wave signature reuse, pipeline end-to-end: a repeat-heavy
+    burst split into chained waves must schedule byte-identically with the
+    resident score-row cache on vs off (and vs dedup off entirely), while
+    the enabled run actually replays rows across wave boundaries."""
+
+    def test_cross_wave_on_off_schedule_identically(self):
+        run = TestFullPipelineGolden._run
+        placed_ref, diags_ref, rng_ref, _ = run(dedup=False)
+        placed_off, diags_off, rng_off, stats_off = run(
+            dedup=True, cross_wave=False)
+        placed_on, diags_on, rng_on, stats_on = run(
+            dedup=True, cross_wave=True)
+        assert placed_on == placed_off == placed_ref
+        assert diags_on == diags_off == diags_ref
+        assert rng_on == rng_off == rng_ref
+        assert sum(1 for v in placed_on.values() if v) > 0
+        assert diags_on, "some clones must fail with a diagnosis"
+        # the enabled run must have genuinely reused rows across waves —
+        # 30 pods / wave 8 = 4 chained waves sharing 3 signatures
+        assert stats_on["xwave_hits"] > 0, \
+            "repeat-heavy chained waves must replay resident score rows"
+        assert stats_off["xwave_hits"] == 0
+
+    def test_hard_pts_cross_wave_identical(self):
+        """Hard-PTS schedules take the gated fast tier AND the cross-wave
+        cache; placements stay bit-identical to dedup off."""
+        run = TestFullPipelineGolden._run
+        placed_ref, diags_ref, rng_ref, _ = run(dedup=False, spread=True)
+        placed_on, diags_on, rng_on, stats_on = run(
+            dedup=True, cross_wave=True, spread=True)
+        assert placed_on == placed_ref
+        assert diags_on == diags_ref
+        assert rng_on == rng_ref
+        assert sum(1 for v in placed_on.values() if v) > 0
+        # dedup itself must be live (hard-PTS no longer disables it)
+        assert 0 < stats_on["signatures"] < stats_on["pods"]
+
+
+class TestBreakerCacheLifecycle:
+    """The signature cache dies on a breaker trip (OPEN serves host-path
+    placements the resident rows never saw) and re-warms after recovery —
+    CLOSED → OPEN → CLOSED round trip."""
+
+    def test_trip_clears_close_rewarms(self):
+        store = Store()
+        for i in range(6):
+            store.create(make_node(f"n{i}", cpu="16", mem="32Gi",
+                                   zone=f"z{i % 2}"))
+        for p in mixed_pods(16):
+            store.create(p)
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
+                      seed=5)
+        algo = s.algorithms["default-scheduler"]
+        backend = algo.backend
+        s.start()
+        s.schedule_pending()
+        s.event_recorder.flush()
+        assert backend.sig_cache.table is not None, \
+            "dedup waves must leave the cache warm"
+        assert backend.sig_cache.slots
+
+        # trip: the transition hook must clear the resident rows
+        algo.breaker.threshold = 1
+        algo.breaker.record_failure("injected: test trip")
+        assert algo.breaker.state == "open"
+        assert backend.sig_cache.table is None
+        assert not backend.sig_cache.slots
+
+        # recover: zero cooldown, both probes succeed -> CLOSED again
+        algo.breaker.cooldown_s = 0.0
+        assert algo.breaker.allow_device_wave()
+        algo.breaker.record_success()
+        assert algo.breaker.allow_device_wave()
+        algo.breaker.record_success()
+        assert algo.breaker.state == "closed"
+
+        # a fresh burst after recovery re-warms the cache
+        for i, p in enumerate(mixed_pods(12)):
+            p.meta.name = f"post-{p.meta.name}"
+            store.create(p)
+        s.pump()
+        s.schedule_pending()
+        assert backend.sig_cache.table is not None, \
+            "cache must re-warm once the breaker closes"

@@ -93,6 +93,66 @@ class TestRingBuffer:
         assert fr.phase_snapshot()["kernel"] >= 0.0
 
 
+# ------------------------------------------------- fallback attribution
+
+
+class TestFallbackAttribution:
+    def test_context_attributes_plugin_time(self):
+        fr = FlightRecorder(slow_wave_deadline_s=None)
+
+        class FW:
+            plugin_observer = None
+
+        fw = FW()
+        rec = fr.begin_wave(pods=2, pad=2)
+        with fr.fallback_attribution(fw, record=rec):
+            assert fw.plugin_observer is not None
+            fw.plugin_observer("Filter", "NodeResourcesFit", 0.05)
+            fw.plugin_observer("Score", "NodeResourcesFit", 0.01)
+            fw.plugin_observer("Filter", "TaintToleration", 0.02)
+        assert fw.plugin_observer is None, "observer must be uninstalled"
+        assert rec.phases["fallback/NodeResourcesFit"] == pytest.approx(0.06)
+        assert rec.phases["fallback/TaintToleration"] == pytest.approx(0.02)
+        snap = fr.phase_snapshot()
+        assert snap["fallback/NodeResourcesFit"] == pytest.approx(0.06)
+
+    def test_observer_restored_on_exception(self):
+        fr = FlightRecorder(slow_wave_deadline_s=None)
+
+        class FW:
+            plugin_observer = None
+
+        fw = FW()
+        with pytest.raises(RuntimeError):
+            with fr.fallback_attribution(fw):
+                raise RuntimeError("fallback blew up")
+        assert fw.plugin_observer is None
+
+    def test_breaker_open_wave_attributes_host_plugins(self):
+        """End to end: a wave hitting an OPEN breaker drains through the
+        host tier with per-plugin attribution — `fallback/<plugin>` phases
+        land in the recorder's totals."""
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        for p in mixed_pods(6):
+            store.create(p)
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
+                      seed=2)
+        algo = s.algorithms["default-scheduler"]
+        s.start()
+        with algo.breaker._mu:
+            algo.breaker.state = "open"
+            algo.breaker._opened_at = algo.breaker._clock()
+            algo.breaker.cooldown_s = 120.0
+        s.schedule_pending()
+        s.event_recorder.flush()
+        placed = [p for p in store.pods() if p.spec.node_name]
+        assert len(placed) == 6, "host tier must still schedule the wave"
+        fallback_phases = [k for k in s.flight_recorder.phase_snapshot()
+                           if k.startswith("fallback/")]
+        assert fallback_phases, "per-plugin fallback attribution missing"
+
+
 # --------------------------------------------------------------- span tree
 
 

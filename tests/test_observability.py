@@ -239,6 +239,54 @@ class TestPprofProfile:
             server.shutdown()
 
 
+class TestFlightRecorderZpage:
+    def test_dump_served_with_last_param(self):
+        import json
+        import urllib.request
+
+        from kubernetes_tpu.cmd.scheduler import SchedulerServer
+        from kubernetes_tpu.config.types import SchedulerConfiguration
+
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        for i in range(6):
+            store.create(make_pod(f"p{i}", cpu="500m", mem="256Mi"))
+        cfg = SchedulerConfiguration()
+        cfg.profiles[0].backend = "tpu"
+        cfg.profiles[0].wave_size = 4  # batched waves feed the recorder ring
+        server = SchedulerServer(store, cfg)
+        port = server.serve(0)
+        try:
+            server.scheduler.start()
+            server.scheduler.pump()
+            server.scheduler.schedule_pending()
+
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}"
+                ) as r:
+                    return r.status, r.headers.get("Content-Type"), r.read()
+
+            code, ctype, body = get("/debug/flightrecorder?last=2")
+            assert code == 200 and ctype == "application/json"
+            payload = json.loads(body)
+            assert set(payload) == {"summary", "phase_totals",
+                                    "wave_totals", "records"}
+            assert payload["records"], "scheduled waves must show up"
+            assert len(payload["records"]) <= 2
+
+            # malformed ?last is a client error, not a crash
+            import urllib.error
+
+            try:
+                get("/debug/flightrecorder?last=abc")
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            server.shutdown()
+
+
 class TestGoleak:
     def test_detects_leak_and_passes_clean(self):
         import threading

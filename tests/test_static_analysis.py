@@ -620,6 +620,86 @@ class TestSignatureSync:
         assert list(SignatureSyncChecker().check_project(tmp_path)) == []
 
 
+# ------------------------------------------------------------------ SIG02
+
+
+class TestCarryCoherence:
+    CHECKERS = None  # default set; SIG02 is module-scoped
+
+    def test_carry_write_outside_backend_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(backend):
+                backend._carry = None
+        """, name="scheduler/schedule_one.py")
+        assert rules(fs) == ["SIG02"]
+        assert "_carry" in fs[0].message
+
+    def test_pending_dirty_mutator_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(backend, rows):
+                backend._pending_dirty.update(rows)
+        """, name="scheduler/cache/debugger.py")
+        assert rules(fs) == ["SIG02"]
+        assert ".update()" in fs[0].message
+
+    def test_plane_subscript_write_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(backend, plane):
+                backend._device_planes["alloc"] = plane
+        """, name="parallel/mesh.py")
+        assert rules(fs) == ["SIG02"]
+
+    def test_sig_cache_clear_outside_backend_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(algo):
+                algo.backend.sig_cache.clear()
+        """, name="scheduler/tpu/circuitbreaker.py")
+        assert rules(fs) == ["SIG02"]
+
+    def test_del_carry_attr_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(backend):
+                del backend._carry_rows
+        """, name="scheduler/schedule_one.py")
+        assert rules(fs) == ["SIG02"]
+
+    def test_backend_module_is_sanctioned(self, tmp_path):
+        fs = lint(tmp_path, """
+            def invalidate_carry(self):
+                self._carry = None
+                self._pending_dirty = None
+                self.sig_cache.clear()
+        """, name="scheduler/tpu/backend.py")
+        assert fs == []
+
+    def test_reads_and_hooks_ok(self, tmp_path):
+        # observation and the sanctioned hooks are not writes
+        fs = lint(tmp_path, """
+            def use(backend):
+                if backend._carry is not None:
+                    backend.invalidate_carry()
+                pending = getattr(backend, "_pending_dirty", None) or set()
+                return len(pending)
+        """, name="scheduler/schedule_one.py")
+        assert fs == []
+
+    def test_unrelated_attr_names_ok(self, tmp_path):
+        fs = lint(tmp_path, """
+            def setup(self):
+                self.carry_on = True
+                self.pending = set()
+                self.pending.update({1})
+        """, name="scheduler/queue.py")
+        assert fs == []
+
+    def test_suppression_silences_sig02(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(backend):
+                backend._carry = None  # kubesched-lint: disable=SIG02
+        """, name="scheduler/schedule_one.py")
+        assert fs == []
+
+
 # ------------------------------------------------------------------ OBS01
 
 
@@ -848,8 +928,8 @@ class TestCli:
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rule in ("JIT01", "JIT02", "JIT03", "JIT04", "LOCK01", "LOCK02",
-                     "LOCK03", "SNAP01", "REG01", "REG02", "SIG01", "OBS01",
-                     "RET01", "LINT00"):
+                     "LOCK03", "SNAP01", "REG01", "REG02", "SIG01", "SIG02",
+                     "OBS01", "RET01", "LINT00"):
             assert rule in out
 
     def test_rule_ids_documented_in_readme(self):
