@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test obs chaos
+.PHONY: lint test obs chaos verify
 
 # kubesched-lint: AST invariant checker (rule IDs in README "Invariants");
 # exits non-zero on any unsuppressed finding
@@ -12,14 +12,24 @@ lint:
 test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
-# seeded chaos soak: scale-churn under the standard fault schedule must
-# converge (all pods bound, no leaked assumes, breaker trips AND recovers);
-# exits non-zero on divergence — same seed replays the same schedule
+# seeded chaos soaks: (1) scale-churn under the standard fault schedule,
+# then (2) the arrival-trace soak at two fixed seeds — Poisson/burst
+# arrivals with a watch partition, a fleet-wide kubelet outage, and bind
+# latency armed; each must converge (no leaked assumes, breaker trip AND
+# recover, partition detect AND repair, evicted pods gone, late arrivals
+# bound) inside the wall-clock budget. Exits non-zero on divergence —
+# same seed replays the same schedule
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --seed 7
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --trace --seed 7 --budget-s 60
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --trace --seed 1234 --budget-s 60
 
 # flight-recorder CLI smoke: synthetic multi-wave run (no device, no jax),
 # exercises ring buffer + watchdog + post-mortem formatting
 obs:
 	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --demo
 	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --schema
+
+# the full gate: invariants, tier-1 tests, chaos soaks (incl. the
+# arrival-trace runs), observability smoke
+verify: lint test chaos obs

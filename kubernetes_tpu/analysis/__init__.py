@@ -2,8 +2,11 @@
 
 Mechanically enforces the contracts the paper's bit-compat claim rests on:
 jit purity (JIT01-JIT04), lock discipline in the threaded scheduler modules
-(LOCK01-LOCK03), snapshot immutability outside the cache layer (SNAP01),
-kernel/registry constant sync (REG01-REG02), signature-fragment
+(LOCK01-LOCK04, LOCK04 being the prepare/commit split's short-commit
+contract), snapshot immutability outside the cache layer (SNAP01),
+kernel/registry constant sync (REG01-REG02), fault-point declaration sync
+— every fire() call site names a FAULT_POINTS entry (FI01),
+signature-fragment
 purity/coverage for the batching hint path (SIG01), carry coherence —
 node-plane / device-carry state may only be written through backend.py's
 invalidation hooks so the cross-wave signature cache can never go stale
@@ -27,6 +30,7 @@ from .core import (
     run_paths,
 )
 from .carry_coherence import CarryCoherenceChecker
+from .fault_points import FaultPointChecker
 from .jit_purity import JitPurityChecker
 from .lock_discipline import LockDisciplineChecker
 from .obs_purity import ObservabilityPurityChecker
@@ -38,6 +42,7 @@ from .snapshot_immutability import SnapshotImmutabilityChecker
 __all__ = [
     "CarryCoherenceChecker",
     "Checker",
+    "FaultPointChecker",
     "Finding",
     "JitPurityChecker",
     "LockDisciplineChecker",

@@ -106,6 +106,7 @@ class ProjectChecker(Checker):
 
 def default_checkers() -> list[Checker]:
     from .carry_coherence import CarryCoherenceChecker
+    from .fault_points import FaultPointChecker
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
     from .obs_purity import ObservabilityPurityChecker
@@ -123,6 +124,7 @@ def default_checkers() -> list[Checker]:
         CarryCoherenceChecker(),
         ObservabilityPurityChecker(),
         RetryDisciplineChecker(),
+        FaultPointChecker(),
     ]
 
 

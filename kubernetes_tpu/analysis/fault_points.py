@@ -1,0 +1,131 @@
+"""Fault-point declaration sync rule (FI01).
+
+`utils/faultinject.py` declares every injection point in one
+`FAULT_POINTS` constant: the golden bit-compat tests register-and-disarm
+exactly that set, and chaos schedules arm by those names. A `fire()` call
+site whose point name is not declared there can never be armed — the
+chaos suite silently stops covering that seam — and a non-literal point
+name can't be cross-checked at all. Nothing imports FAULT_POINTS at the
+call sites (fire is called from packages that must not depend on the
+constant's module loading order), so the only enforcement possible is
+cross-parsing, same as the registry-sync checker.
+
+FI01 flags, across the whole tree:
+- a `fire(...)` / `*.fire(...)` call whose point argument is not a string
+  literal;
+- a literal point name missing from FAULT_POINTS.
+
+`utils/faultinject.py` itself is exempt (the registry dispatches by
+variable). Findings are project-scoped, so per-line suppressions do not
+apply — declare the point instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding, ProjectChecker
+
+FI01 = "FI01"
+
+FAULTINJECT = "utils/faultinject.py"
+
+
+def _parse_points(path: Path) -> set[str] | None:
+    """The FAULT_POINTS literal as a set of names, or None if unparseable."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
+            for t in node.targets
+        ):
+            value = node.value
+            if isinstance(value, ast.Call) and value.args:
+                value = value.args[0]  # frozenset((...)) wrapper
+            if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+                out: set[str] = set()
+                for el in value.elts:
+                    if not (isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)):
+                        return None
+                    out.add(el.value)
+                return out
+    return None
+
+
+def _point_arg(call: ast.Call) -> ast.expr | None:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "point":
+            return kw.value
+    return None
+
+
+class FaultPointChecker(ProjectChecker):
+    rules = {
+        FI01: "fire() call site out of sync with utils/faultinject.py "
+              "FAULT_POINTS (undeclared or non-literal point name)",
+    }
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        decl = root / FAULTINJECT
+        if not decl.is_file():
+            return  # partial tree (fixture dirs) — nothing to cross-check
+        points = _parse_points(decl)
+        if points is None:
+            yield Finding(
+                decl.as_posix(), 1, 0, FI01,
+                "could not parse FAULT_POINTS for cross-checking — keep it "
+                "a literal tuple of string constants",
+            )
+            return
+        for path in sorted(root.rglob("*.py")):
+            if path.as_posix().endswith(FAULTINJECT):
+                continue  # the registry dispatches by variable
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except (OSError, SyntaxError):
+                continue  # LINT01 reports unparseable files
+            yield from self._check_tree(path.as_posix(), tree, points)
+
+    def _check_tree(
+        self, path: str, tree: ast.AST, points: set[str]
+    ) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name):
+                name = func.id
+            elif isinstance(func, ast.Attribute):
+                name = func.attr
+            else:
+                continue
+            if name != "fire":
+                continue
+            arg = _point_arg(node)
+            if arg is None:
+                yield Finding(
+                    path, node.lineno, node.col_offset, FI01,
+                    "fire() call without a point argument",
+                )
+            elif not (isinstance(arg, ast.Constant)
+                      and isinstance(arg.value, str)):
+                yield Finding(
+                    path, node.lineno, node.col_offset, FI01,
+                    "fire() point must be a string literal so FI01 can "
+                    "cross-check it against FAULT_POINTS",
+                )
+            elif arg.value not in points:
+                yield Finding(
+                    path, node.lineno, node.col_offset, FI01,
+                    f"fire({arg.value!r}) references a point not declared "
+                    "in utils/faultinject.py FAULT_POINTS — it can never "
+                    "be armed",
+                )

@@ -1,4 +1,4 @@
-"""Lock discipline rules (LOCK01-LOCK03) for the threaded modules.
+"""Lock discipline rules (LOCK01-LOCK04) for the threaded modules.
 
 The threaded scheduler components (api_dispatcher, cache, scheduling_queue,
 pod_workers, controllers) follow client-go's convention: every shared
@@ -15,6 +15,12 @@ attribute is guarded by one `threading.Lock`/`RLock`/`Condition` held via
   `.join()`, `.wait()` on a non-lock object) while holding a lock stalls
   every other thread on that lock. `self._cv.wait()` on the held Condition
   is the sanctioned idiom and is not flagged.
+- LOCK04: commit-section discipline — in a lock-owning class, a method
+  whose name contains "commit" is the short validate-and-publish tail of a
+  prepare/commit split (store.bind_pods); it may not make blocking calls
+  NOR call `faultinject.fire` (fire can sleep under a LATENCY spec, which
+  LOCK03 cannot see), held or not. Slow work belongs in the prepare phase
+  outside the lock.
 
 Held contexts are `with self.<lock>:` bodies, whole methods whose names end
 in `_locked` (the cache.py convention), and private methods whose
@@ -34,6 +40,7 @@ from .core import Checker, Finding, ModuleContext
 LOCK01 = "LOCK01"
 LOCK02 = "LOCK02"
 LOCK03 = "LOCK03"
+LOCK04 = "LOCK04"
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
 # attrs that synchronize themselves; mutating them unlocked is by design
@@ -81,7 +88,7 @@ def _factory_name(value: ast.expr) -> str | None:
 
 @dataclasses.dataclass
 class _Event:
-    kind: str          # "mut" | "acquire" | "blocking" | "call_self"
+    kind: str          # "mut" | "acquire" | "blocking" | "fire" | "call_self"
     name: str          # attr, or callee method, or blocking description
     held: bool         # with-block status at the site (pre-fixpoint)
     method: str
@@ -182,8 +189,22 @@ class _ClassScan:
             if not isinstance(n, ast.Call):
                 continue
             func = n.func
+            if isinstance(func, ast.Name):
+                # bare `fire("point")` (from ..utils.faultinject import fire)
+                if func.id == "fire":
+                    self.events.append(
+                        _Event("fire", "fire()", held, method,
+                               n.lineno, n.col_offset)
+                    )
+                continue
             if not isinstance(func, ast.Attribute):
                 continue
+            # fault-point visit: can sleep under a LATENCY spec
+            if func.attr == "fire":
+                self.events.append(
+                    _Event("fire", _dotted(func) or ".fire()", held, method,
+                           n.lineno, n.col_offset)
+                )
             recv_attr = _self_attr(func.value)
             d = _dotted(func)
             # LOCK02: raw acquire/release on a lock attribute
@@ -268,6 +289,8 @@ class LockDisciplineChecker(Checker):
                 "can't leak the lock",
         LOCK03: "blocking call while holding a lock stalls every thread "
                 "contending on it",
+        LOCK04: "commit sections (methods named *commit*) must not block "
+                "or visit fault points — prepare outside the lock",
     }
 
     def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
@@ -299,6 +322,27 @@ class LockDisciplineChecker(Checker):
                     ctx.posix_path, ev.line, ev.col, LOCK03,
                     f"{cls.name}.{ev.method} makes blocking call "
                     f"{ev.name} while holding a lock",
+                )
+
+        # LOCK04: commit sections stay short — no blocking, no fault
+        # points (a LATENCY spec turns fire() into a sleep LOCK03 cannot
+        # see), regardless of whether the lock is provably held
+        for ev in scan.events:
+            if "commit" not in ev.method:
+                continue
+            if ev.kind == "blocking":
+                yield Finding(
+                    ctx.posix_path, ev.line, ev.col, LOCK04,
+                    f"{cls.name}.{ev.method} is a commit section but makes "
+                    f"blocking call {ev.name} — move it to the prepare "
+                    "phase outside the lock",
+                )
+            elif ev.kind == "fire":
+                yield Finding(
+                    ctx.posix_path, ev.line, ev.col, LOCK04,
+                    f"{cls.name}.{ev.method} is a commit section but visits "
+                    f"fault point via {ev.name} — injected latency would "
+                    "sleep inside the lock; fire in the prepare phase",
                 )
 
         # LOCK01: attr mutated both under and outside the lock

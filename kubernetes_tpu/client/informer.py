@@ -51,6 +51,20 @@ class SharedInformer:
         # mutation detector: pristine deepcopies to compare against
         self._detect = _mutation_detector_enabled()
         self._pristine: dict[str, Any] = {}
+        # revision-continuity tracking (partition detection):
+        #   _last_rev  — store revision this cache is known current through
+        #   _last_seq  — per-kind event seq of the last delivered event
+        #                (None = stream without seq support; tracking off)
+        # A delivered event jumping the seq by more than one means the
+        # stream LOST events (an interior gap); the log holding an event
+        # newer than _last_rev after a full pump means the stream is
+        # silently stale (a tail gap — the open-partition case).
+        self._last_rev = 0
+        self._last_seq: int | None = None
+        self._gap = False
+        self._gap_rev = 0
+        self.partitions_detected = 0
+        self._partition_observer: Callable[[str, int, float], None] | None = None
 
     def add_handler(self, handler: Handler) -> None:
         """Register a handler. If already synced, replays Adds for the current
@@ -82,6 +96,8 @@ class SharedInformer:
                 self._pristine[obj.meta.key] = _copy.deepcopy(obj)
             for h in self._handlers:
                 h(ADDED, None, obj)
+        self._last_rev = rev
+        self._last_seq = getattr(self._watch, "start_seq", None)
         self._synced = True
 
     def has_synced(self) -> bool:
@@ -95,6 +111,16 @@ class SharedInformer:
             self.check_mutations()
         n = 0
         for ev in self._watch.drain():
+            seq = getattr(ev, "seq", 0)
+            if self._last_seq is not None and seq:
+                if seq > self._last_seq + 1 and not self._gap:
+                    # interior gap: events between _last_seq and this one
+                    # never arrived, even though delivery has resumed
+                    self._gap = True
+                    self._gap_rev = self._last_rev
+                self._last_seq = max(self._last_seq, seq)
+            if ev.revision:
+                self._last_rev = max(self._last_rev, ev.revision)
             self._dispatch(ev)
             n += 1
         return n
@@ -116,7 +142,12 @@ class SharedInformer:
         self.pump()
         sync = getattr(self._store, "sync_watch", None)
         if sync is not None:
-            refs, new_watch = sync(self.kind)
+            res = sync(self.kind)
+            if len(res) == 3:
+                refs, new_watch, rev = res
+            else:  # pre-revision facade
+                refs, new_watch = res
+                rev = None
         else:
             # facade without the primitive: non-atomic list+watch; events
             # landing in between replay through the new watch, which is
@@ -126,6 +157,15 @@ class SharedInformer:
         old_watch, self._watch = self._watch, new_watch
         if old_watch is not None:
             old_watch.stop()
+        # restart the continuity bookmarks from the sync point — captured
+        # under the SAME lock as the relist, so neither under- nor
+        # overshoots: an earlier value would re-flag the diff-repaired
+        # events as a gap forever, a later one would hide real losses
+        if rev is not None:
+            self._last_rev = rev
+        self._last_seq = getattr(new_watch, "start_seq", None)
+        self._gap = False
+        self._gap_rev = 0
         n = 0
         seen = set()
         for obj in refs:
@@ -147,6 +187,52 @@ class SharedInformer:
                                  gone.meta.resource_version))
             n += 1
         return n
+
+    def set_partition_observer(
+        self, cb: Callable[[str, int, float], None] | None
+    ) -> None:
+        """cb(kind, repaired_count, repair_latency_s) fires once per
+        detected partition, right after the repairing resync."""
+        self._partition_observer = cb
+
+    def detect_and_repair(self) -> int:
+        """Partition self-heal: pump, then check revision continuity; on a
+        gap, resync immediately and report the repair latency (now minus
+        the emit time of the first event the stream lost).
+
+        Detection is exact, not heuristic: watch delivery is synchronous
+        under the store lock, so after a full pump any logged event newer
+        than `_last_rev` was dropped, and any seq jump seen during the
+        pump brackets events that will never arrive. No-gap cost is one
+        store revision read. Returns the number of repaired cache entries
+        (0 when no gap, and also when the gap's objects were already
+        superseded by later deliveries)."""
+        if not self._synced or self._watch is None:
+            return 0
+        self.pump()
+        gap_rev: int | None = self._gap_rev if self._gap else None
+        if gap_rev is None:
+            probe = getattr(self._store, "latest_revision", None)
+            if probe is not None and probe(self.kind) > self._last_rev:
+                gap_rev = self._last_rev
+        if gap_rev is None:
+            return 0
+        lost_ts: float | None = None
+        first = getattr(self._store, "first_event_after", None)
+        if first is not None:
+            hit = first(self.kind, gap_rev)
+            if hit is not None:
+                lost_ts = hit[1]
+        repaired = self.resync()  # clears _gap, re-bookmarks atomically
+        self.partitions_detected += 1
+        latency_s = 0.0
+        if lost_ts is not None:
+            import time as _time
+
+            latency_s = max(_time.perf_counter() - lost_ts, 0.0)
+        if self._partition_observer is not None:
+            self._partition_observer(self.kind, repaired, latency_s)
+        return repaired
 
     def check_mutations(self) -> None:
         """Compare every cached object against its pristine copy; raises
@@ -222,11 +308,13 @@ class InformerFactory:
     def __init__(self, store: Store):
         self._store = store
         self._informers: dict[str, SharedInformer] = {}
+        self._partition_observer: Callable[[str, int, float], None] | None = None
 
     def informer(self, kind: str) -> SharedInformer:
         inf = self._informers.get(kind)
         if inf is None:
             inf = SharedInformer(self._store, kind)
+            inf.set_partition_observer(self._partition_observer)
             self._informers[kind] = inf
         return inf
 
@@ -241,6 +329,20 @@ class InformerFactory:
     def resync_all(self) -> int:
         """Diff-repair every informer's cache (see SharedInformer.resync)."""
         return sum(inf.resync() for inf in self._informers.values())
+
+    def detect_and_repair_all(self) -> int:
+        """Run every informer's partition detector; resyncs only the
+        informers with an actual gap (cheap when the streams are healthy —
+        one revision probe per kind)."""
+        return sum(inf.detect_and_repair() for inf in self._informers.values())
+
+    def set_partition_observer(
+        self, cb: Callable[[str, int, float], None] | None
+    ) -> None:
+        """Install cb on every existing AND future informer."""
+        self._partition_observer = cb
+        for inf in self._informers.values():
+            inf.set_partition_observer(cb)
 
     def wait_for_cache_sync(self) -> bool:
         return all(inf.has_synced() for inf in self._informers.values())

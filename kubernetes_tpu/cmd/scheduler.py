@@ -48,6 +48,13 @@ class SchedulerServer:
             )
             for p in config.profiles
         ]
+        # span export for /debug/traces: a bounded in-memory exporter takes
+        # the OTLP exporter's role; the flight recorder's phase/wave spans
+        # all land here because the scheduler shares this tracer
+        from ..utils.tracing import InMemoryExporter, Tracer
+
+        self.trace_exporter = InMemoryExporter(capacity=512)
+        self.tracer = Tracer("tpu-scheduler", exporter=self.trace_exporter)
         self.scheduler = Scheduler(
             store,
             profiles=profiles,
@@ -56,6 +63,7 @@ class SchedulerServer:
             async_api_calls=gates.enabled("SchedulerAsyncAPICalls"),
             parallelism=config.parallelism,
             extenders=config.extenders,
+            tracer=self.tracer,
         )
         # SIGUSR2 → cache dump + cache/store comparison (the reference's
         # backend/cache/debugger wiring)
@@ -174,6 +182,24 @@ class SchedulerServer:
                         self._send(400, "last must be an integer")
                         return
                     self._send(200, rec.dump(last), "application/json")
+                elif self.path.startswith("/debug/traces"):
+                    # OTLP-shaped span export (the /debug/traces zpage);
+                    # ?last=N bounds to the most recent N root spans
+                    import json as _json
+                    from urllib.parse import parse_qs, urlparse
+
+                    from ..utils.tracing import spans_to_otlp
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        last = (int(q["last"][0]) if "last" in q else None)
+                    except ValueError:
+                        self._send(400, "last must be an integer")
+                        return
+                    spans = server.trace_exporter.last(last)
+                    self._send(200, _json.dumps(
+                        spans_to_otlp(spans, component=server.tracer.component)
+                    ), "application/json")
                 elif self.path == "/flagz":
                     # component-base/zpages/flagz: effective flag values
                     self._send(200, json.dumps(server.flags),

@@ -12,6 +12,7 @@ import threading
 
 from ..client.informer import InformerFactory
 from ..client.workqueue import WorkQueue
+from ..utils import faultinject
 
 
 class Controller:
@@ -76,8 +77,14 @@ class Controller:
             if key is None:
                 break
             try:
-                self.reconcile(key)
-                self.queue.forget(key)
+                # chaos: a reconcile that never ran (DROP — requeued with
+                # backoff, the item is NOT lost) or crashed mid-flight
+                # (ERROR — caught below, same backoff path as a real panic)
+                if faultinject.fire("controller.reconcile"):
+                    self.queue.add_rate_limited(key)
+                else:
+                    self.reconcile(key)
+                    self.queue.forget(key)
             except Exception:  # noqa: BLE001 - controller retries with backoff
                 self.queue.add_rate_limited(key)
             finally:
@@ -102,8 +109,13 @@ class Controller:
                 if key is None:
                     continue
                 try:
-                    self.reconcile(key)
-                    self.queue.forget(key)
+                    # chaos: same contract as sync_once — DROP requeues,
+                    # ERROR takes the normal backoff path
+                    if faultinject.fire("controller.reconcile"):
+                        self.queue.add_rate_limited(key)
+                    else:
+                        self.reconcile(key)
+                        self.queue.forget(key)
                 except Exception:  # noqa: BLE001
                     self.queue.add_rate_limited(key)
                 finally:

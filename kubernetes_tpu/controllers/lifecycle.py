@@ -13,6 +13,7 @@ from ..api.labels import labels_subset
 from ..api.types import NO_EXECUTE, NodeCondition, Taint
 from ..api.workloads import Endpoint, EndpointSlice
 from ..api.meta import ObjectMeta
+from ..utils import faultinject
 from .base import Controller
 
 UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
@@ -136,6 +137,13 @@ class NodeLifecycleController(Controller):
         return self.clock.now() - lease.spec.renew_time < self.grace_period
 
     def reconcile(self, key: str) -> None:
+        # chaos: the node-health monitor itself degrades — ERROR rides the
+        # base class's backoff requeue and DROP skips one pass but keeps
+        # the monitor's self-requeue alive; either way tainting/eviction
+        # is DELAYED, never abandoned
+        if faultinject.fire("controller.lifecycle"):
+            self.queue.add_after(key, max(self.grace_period / 2, 0.2))
+            return
         node = self.store.try_get("Node", key)
         if node is None:
             return

@@ -21,6 +21,7 @@ from ..api.workloads import (
 )
 from ..api.labels import LabelSelector
 from ..store.store import NotFoundError
+from ..utils import faultinject
 from .base import Controller
 
 
@@ -86,6 +87,12 @@ class ReplicaSetController(Controller):
             self.store.update(p, check_version=False)
 
     def reconcile(self, key: str) -> None:
+        # chaos: workload reconciles degrade — ERROR raises directly and
+        # DROP is promoted to a raise, so both land on the base class's
+        # rate-limited requeue: convergence is delayed, never lost
+        # (replica math is re-derived from live state each run)
+        if faultinject.fire("controller.workloads"):
+            raise faultinject.TransientFault("controller.workloads: dropped")
         try:
             rs = self.store.get("ReplicaSet", key)
         except NotFoundError:
@@ -161,6 +168,8 @@ class DeploymentController(Controller):
         return None
 
     def reconcile(self, key: str) -> None:
+        if faultinject.fire("controller.workloads"):  # chaos: see ReplicaSet
+            raise faultinject.TransientFault("controller.workloads: dropped")
         try:
             dep = self.store.get("Deployment", key)
         except NotFoundError:
@@ -358,6 +367,8 @@ class JobController(Controller):
         return None
 
     def reconcile(self, key: str) -> None:
+        if faultinject.fire("controller.workloads"):  # chaos: see ReplicaSet
+            raise faultinject.TransientFault("controller.workloads: dropped")
         try:
             job = self.store.get("Job", key)
         except NotFoundError:
@@ -473,6 +484,8 @@ class StatefulSetController(Controller):
         return bool(pod.spec.node_name) and not pod.is_terminating
 
     def reconcile(self, key: str) -> None:
+        if faultinject.fire("controller.workloads"):  # chaos: see ReplicaSet
+            raise faultinject.TransientFault("controller.workloads: dropped")
         try:
             st = self.store.get("StatefulSet", key)
         except NotFoundError:
@@ -631,6 +644,8 @@ class DaemonSetController(Controller):
         return True
 
     def reconcile(self, key: str) -> None:
+        if faultinject.fire("controller.workloads"):  # chaos: see ReplicaSet
+            raise faultinject.TransientFault("controller.workloads: dropped")
         try:
             ds = self.store.get("DaemonSet", key)
         except NotFoundError:

@@ -13,6 +13,7 @@ from ..api.coordination import Lease, LeaseSpec
 from ..api.meta import ObjectMeta
 from ..api.types import Node, NodeCondition
 from ..store.store import ConflictError, NotFoundError
+from ..utils import faultinject
 
 LEASE_NAMESPACE = "kube-node-lease"
 
@@ -38,6 +39,16 @@ class NodeAgentBase:
         self.heartbeat()
 
     def heartbeat(self) -> None:
+        # chaos: a lost heartbeat — the node keeps running pods but its
+        # lease goes stale, the exact asymmetry the lifecycle controller's
+        # grace period exists for. DROP skips this renewal only; the next
+        # heartbeat recreates/renews as usual (degrades ERROR to a skip —
+        # a crashed heartbeat and a lost one look identical to the lease)
+        try:
+            if faultinject.fire("kubelet.lease"):
+                return
+        except faultinject.FaultInjected:
+            return
         key = f"{LEASE_NAMESPACE}/{self.node_name}"
         now = self.clock.now()
         lease = self.store.try_get("Lease", key)

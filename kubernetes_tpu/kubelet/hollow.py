@@ -20,6 +20,7 @@ from ..api.types import (
     PodCondition,
 )
 from ..store.store import ConflictError, NotFoundError
+from ..utils import faultinject
 from .agent import NodeAgentBase
 
 
@@ -80,6 +81,11 @@ class HollowKubelet(NodeAgentBase):
     def sync_once(self) -> int:
         """One syncLoopIteration: converge every assigned pod; returns the
         number of pods whose status changed."""
+        # chaos: a dead/hung kubelet (see Kubelet.sync_loop_iteration) —
+        # skipping the iteration skips the heartbeat too, so the node's
+        # lease goes stale and the lifecycle controller reacts
+        if faultinject.fire("kubelet.sync"):
+            return 0
         self.heartbeat()
         if self._watch is not None:
             self._watch.drain()  # consume; state is re-listed below

@@ -21,6 +21,7 @@ from ..api.types import (
     PodCondition,
 )
 from ..store.store import ConflictError, NotFoundError
+from ..utils import faultinject
 from .agent import NodeAgentBase
 from .cri import CONTAINER_RUNNING, CREATED, EXITED, InMemoryRuntime
 from .eviction import EvictionManager, PodStats, Threshold
@@ -82,6 +83,12 @@ class Kubelet(NodeAgentBase):
     def sync_loop_iteration(self) -> int:
         """One syncLoopIteration: config changes + PLEG events +
         housekeeping. Returns pods dispatched to workers."""
+        # chaos: a dead/hung kubelet. DROP skips the whole iteration
+        # (heartbeat included, so the lease goes stale and the lifecycle
+        # controller takes over); ERROR models a crashing sync loop the
+        # driving harness catches
+        if faultinject.fire("kubelet.sync"):
+            return 0
         self.heartbeat()
         dispatched = set()
         # configCh: only pods whose API object CHANGED since the last

@@ -13,6 +13,7 @@ from __future__ import annotations
 from collections import deque
 from dataclasses import dataclass
 
+from ..utils import faultinject
 from .cri import CONTAINER_RUNNING, EXITED, RuntimeService
 
 CONTAINER_STARTED = "ContainerStarted"
@@ -37,6 +38,12 @@ class GenericPLEG:
     def relist(self) -> int:
         """One relist pass; queues events for every observed transition.
         Returns the number of events generated."""
+        # chaos: a stalled relist. Safe to skip wholesale — the diff is
+        # against `_last`, which this leaves untouched, so the missed
+        # transitions are emitted by the next healthy relist (the PLEG is
+        # level-triggered, not edge-triggered)
+        if faultinject.fire("kubelet.pleg"):
+            return 0
         sandboxes = {s.id: s.pod_key for s in self.runtime.list_pod_sandboxes()}
         current: dict[str, tuple[str, str]] = {}
         for c in self.runtime.list_containers():

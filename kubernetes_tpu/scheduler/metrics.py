@@ -143,6 +143,19 @@ class SchedulerMetrics:
             "scheduler_tpu_wave_injected_faults_total",
             "Chaos faults fired during completed waves' flight windows",
         )
+        # watch-stream partition self-heal (degradation ladder)
+        self.watch_partitions_detected = r.counter(
+            "scheduler_watch_partitions_detected_total",
+            "Watch-stream partitions the informers detected from revision "
+            "continuity and repaired by resync, by kind",
+            labels=("kind",),
+        )
+        self.watch_partition_repair_latency = r.histogram(
+            "scheduler_watch_partition_repair_latency_seconds",
+            "Time from the first lost event's emit to the repairing resync",
+            labels=("kind",),
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+        )
         # TPU backend (new: kernel-vs-host path split)
         self.kernel_dispatches = r.counter(
             "scheduler_tpu_kernel_dispatches_total",
@@ -311,6 +324,12 @@ class SchedulerMetrics:
 
     def slow_wave_captured(self) -> None:
         self.slow_wave_captures_total.inc()
+
+    def partition_detected(self, kind: str, latency_s: float) -> None:
+        """A watch-stream partition was detected and repaired
+        (flightrecorder fan-out from the informer's partition observer)."""
+        self.watch_partitions_detected.inc(kind)
+        self.watch_partition_repair_latency.observe(latency_s, kind)
 
     def update_sli_quantiles(self) -> None:
         """Record exact p50/p99 over the recent-sample window (the SLO the

@@ -234,6 +234,12 @@ class Scheduler:
 
         # informers (addAllEventHandlers, eventhandlers.go:481)
         self.informers = InformerFactory(store)
+        # partition self-heal telemetry: every informer's detector reports
+        # through the flight recorder (detection counter + repair-latency
+        # histogram land on /metrics from there)
+        self.informers.set_partition_observer(
+            self.flight_recorder.partition_detected
+        )
         self.informers.informer("Pod").add_handler(self._on_pod_event)
         self.informers.informer("Node").add_handler(self._on_node_event)
         self.informers.informer("PodGroup").add_handler(self._on_podgroup_event)
@@ -484,16 +490,18 @@ class Scheduler:
                     # declaring the queue drained
                     with self.flight_recorder.phase("drain"):
                         self.api_dispatcher.drain(timeout=1.0)
-                if idle_rounds == 2:
-                    # last chance before declaring drained: a dropped watch
-                    # delivery (lossy stream, injected watch.deliver fault)
-                    # can strand a pod invisible to the queue forever —
-                    # diff-repair the informer caches and go around again
-                    # if anything changed
-                    with self.flight_recorder.phase("pump"):
-                        repaired = self.informers.resync_all()
-                    if repaired:
-                        idle_rounds = 0
+                # a lost watch delivery (lossy stream, injected
+                # watch.deliver fault, or a watch.partition gap that opened
+                # DURING the drain) can strand a pod invisible to the queue
+                # forever — consult the partition detector on every idle
+                # round, not a single unconditional pre-drain resync: the
+                # no-gap cost is one revision probe per kind, and a gap
+                # that opens between idle rounds still gets caught before
+                # the queue is declared empty
+                with self.flight_recorder.phase("pump"):
+                    repaired = self.informers.detect_and_repair_all()
+                if repaired:
+                    idle_rounds = 0
                 if idle_rounds > 2:
                     break
                 continue

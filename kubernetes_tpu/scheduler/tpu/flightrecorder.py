@@ -147,6 +147,10 @@ class FlightRecorder:
         self.breaker_events: "collections.deque[tuple]" = collections.deque(
             maxlen=64
         )
+        # watch-partition detections (kind, repaired, latency_s), bounded
+        self.partition_events: "collections.deque[tuple]" = collections.deque(
+            maxlen=64
+        )
 
     # -- phase stopwatches (span-backed) --------------------------------------
 
@@ -273,6 +277,18 @@ class FlightRecorder:
         if m is not None and hasattr(m, "breaker_transition"):
             m.breaker_transition(old, new)
 
+    def partition_detected(self, kind: str, repaired: int,
+                           latency_s: float) -> None:
+        """An informer detected (and just repaired) a watch-stream
+        partition; lands the detection counter + repair-latency histogram
+        on the metrics registry. Wired as the InformerFactory's partition
+        observer."""
+        with self._lock:
+            self.partition_events.append((kind, repaired, latency_s))
+        m = self.metrics
+        if m is not None and hasattr(m, "partition_detected"):
+            m.partition_detected(kind, latency_s)
+
     def end_wave(self, rec: WaveRecord,
                  fallback_reason: str | None = None) -> WaveRecord:
         """Finalize and ring-buffer a record; disarms the watchdog, attaches
@@ -344,6 +360,7 @@ class FlightRecorder:
             "carry_invalidations": self.invalidations,
             "retries_total": self.retries_total,
             "breaker_transitions": len(self.breaker_events),
+            "partitions_detected": len(self.partition_events),
             "fallbacks": sum(1 for r in recs if r.fallback_reason),
             "wave_p50_s": (round(durations[len(durations) // 2], 4)
                            if durations else None),

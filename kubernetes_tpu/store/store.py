@@ -68,6 +68,12 @@ class Event:
     # object transitioning out of (or into) the selector and synthesize
     # DELETED/ADDED, exactly as staging/.../storage/cacher does
     prev_obj: Any = None
+    # per-KIND contiguous sequence number stamped at emit (1, 2, 3, ...).
+    # Revisions are global across kinds, so a Pod watcher seeing revisions
+    # 5, 9, 12 cannot tell a delivery gap from other kinds' writes — seq
+    # is what makes the informer's continuity check exact. 0 = synthesized
+    # event (resync diff), exempt from continuity tracking.
+    seq: int = 0
 
 
 class Watch:
@@ -82,6 +88,10 @@ class Watch:
         self._events: list[Event] = []
         self._cond = threading.Condition()
         self._stopped = False
+        # seq of the last event this stream is NOT responsible for
+        # delivering (everything before it was covered by the list/replay
+        # that opened the stream) — the informer's continuity bookmark
+        self.start_seq = 0
 
     def _push(self, ev: Event) -> None:
         with self._cond:
@@ -130,6 +140,8 @@ class Store:
         # kind → revision of the first retained event after compaction:
         # watches older than this get CompactedError (etcd compaction rev)
         self._compacted_before: dict[str, int] = {}
+        # kind → last Event.seq handed out (compaction never rewinds it)
+        self._seq: dict[str, int] = {}
 
     # -- helpers -----------------------------------------------------------
 
@@ -141,6 +153,7 @@ class Store:
         return obj.kind
 
     def _emit(self, kind: str, ev: Event) -> None:
+        ev.seq = self._seq[kind] = self._seq.get(kind, 0) + 1
         log = self._log.setdefault(kind, [])
         log.append(ev)
         if len(log) > self._log_cap:
@@ -150,9 +163,15 @@ class Store:
             # per-watcher delivery drop (chaos: a lossy watch connection).
             # _emit runs mid-write under _mu, so an ERROR-mode spec on this
             # point must NOT corrupt the store state — it degrades to a
-            # drop; the event stays in the log, so a resync can repair it
+            # drop; the event stays in the log, so a resync can repair it.
+            # watch.partition is the long-lived cousin: a PARTITION spec
+            # swallows a contiguous run of deliveries (a revision-RANGE
+            # gap), which the informer must detect from revision
+            # continuity — there is no per-event error to react to
             try:
                 if faultinject.fire("watch.deliver"):
+                    continue
+                if faultinject.fire("watch.partition"):
                     continue
             except faultinject.FaultInjected:
                 continue
@@ -289,45 +308,79 @@ class Store:
                 c.status, c.reason, c.message = "True", "", ""
 
     def bind_pods(self, bindings: list[tuple[str, str]]) -> list[str]:
-        """Batched pods/binding: one lock acquisition + one event-log pass
-        for a whole scheduling wave of (pod key, node name) pairs — the
-        writeback half of the batched TPU wave (the reference's analogue is
-        the async dispatcher draining one binding call per pod,
-        backend/api_dispatcher/api_dispatcher.go:32-112; a wave is our unit
-        of pipelining, so the transaction is too). Returns one of
-        "bound" | "missing" (pod deleted — binding moot) | "conflict"
-        (already bound) per pair; failures leave the rest of the wave
-        untouched."""
+        """Batched pods/binding for a whole scheduling wave of (pod key,
+        node name) pairs — the writeback half of the batched TPU wave (the
+        reference's analogue is the async dispatcher draining one binding
+        call per pod, backend/api_dispatcher/api_dispatcher.go:32-112; a
+        wave is our unit of pipelining, so the transaction is too).
+        Returns one of "bound" | "missing" (pod deleted — binding moot) |
+        "conflict" (already bound) per pair; failures leave the rest of
+        the wave untouched.
+
+        Prepare/commit split: the per-binding fault window (which may
+        SLEEP under LATENCY injection) and the deepcopy run with the store
+        unlocked, so one slow binding no longer serializes every unrelated
+        read/write behind `_mu`. The short commit section re-validates
+        each pod against the live store before landing it."""
         out: list[str] = []
+        # (out index, key, node_name, object observed at prepare, staged copy)
+        prepared: list[tuple[int, str, str, Any, Any]] = []
+        for key, node_name in bindings:
+            # per-binding injection point: a fault here fails ONE pod's
+            # binding while its wave siblings' bindings land — the
+            # status string (never an exception) is how wave-level
+            # failure isolation reaches _apply_wave_bind_results
+            try:
+                faultinject.fire("store.bind_pod")
+            except faultinject.FaultInjected as e:
+                out.append(f"error: {e}")
+                continue
+            cur = self.get_ref("Pod", key)
+            if cur is None:
+                out.append("missing")
+                continue
+            if cur.spec.node_name:
+                out.append("conflict")
+                continue
+            obj = copy.deepcopy(cur)
+            obj.spec.node_name = node_name
+            self._clear_failed_scheduling_condition(obj)
+            out.append("bound")  # provisional; commit may downgrade it
+            prepared.append((len(out) - 1, key, node_name, cur, obj))
+        self._commit_bindings(prepared, out)
+        return out
+
+    def _commit_bindings(
+        self,
+        prepared: list[tuple[int, str, str, Any, Any]],
+        out: list[str],
+    ) -> None:
+        """Commit section of bind_pods (LOCK04: nothing in here may block
+        or fire an injection point — prepare already paid those windows).
+        Re-validates each staged pod against the live store: a write that
+        raced the unlocked prepare window shows up as an identity change
+        on the stored object."""
         with self._mu:
-            objs = self._objects.get("Pod", {})
-            for key, node_name in bindings:
-                # per-binding injection point: a fault here fails ONE pod's
-                # binding while its wave siblings' bindings land — the
-                # status string (never an exception) is how wave-level
-                # failure isolation reaches _apply_wave_bind_results
-                try:
-                    faultinject.fire("store.bind_pod")
-                except faultinject.FaultInjected as e:
-                    out.append(f"error: {e}")
+            objs = self._objects.setdefault("Pod", {})
+            for idx, key, node_name, cur, obj in prepared:
+                now_cur = objs.get(key)
+                if now_cur is None:
+                    out[idx] = "missing"
                     continue
-                cur = objs.get(key)
-                if cur is None:
-                    out.append("missing")
-                    continue
-                if cur.spec.node_name:
-                    out.append("conflict")
-                    continue
-                obj = copy.deepcopy(cur)
-                obj.spec.node_name = node_name
-                self._clear_failed_scheduling_condition(obj)
+                if now_cur is not cur:
+                    # raced: re-validate and re-stage from the live object
+                    if now_cur.spec.node_name:
+                        out[idx] = "conflict"
+                        continue
+                    obj = copy.deepcopy(now_cur)
+                    obj.spec.node_name = node_name
+                    self._clear_failed_scheduling_condition(obj)
+                    cur = now_cur
                 rev = self._bump()
                 obj.meta.resource_version = rev
                 objs[key] = obj
                 self._emit("Pod", Event(MODIFIED, obj, rev,
                                         time.perf_counter(), prev_obj=cur))
-                out.append("bound")
-        return out
 
     def patch_pod_status(self, key: str, condition: Any = None,
                          nominated_node: str | None = None) -> Any | None:
@@ -417,6 +470,30 @@ class Store:
         with self._mu:
             return self._revision
 
+    def latest_revision(self, kind: str) -> int:
+        """Revision of the newest logged event for `kind` (0 = no events
+        yet). This is the informer's partition probe: delivery is
+        synchronous under `_mu`, so any logged event at revision ≤ R that
+        a connected watch has not received after draining was LOST — the
+        comparison has no in-flight window and thus no false positives."""
+        with self._mu:
+            log = self._log.get(kind)
+            return log[-1].revision if log else 0
+
+    def first_event_after(self, kind: str, revision: int) -> tuple[int, float] | None:
+        """(revision, emit ts) of the oldest retained event for `kind`
+        with revision > `revision`, or None. The ts anchors the partition
+        repair-latency measurement: gap age = now − first missed emit."""
+        import bisect
+
+        with self._mu:
+            log = self._log.get(kind, [])
+            i = bisect.bisect_right(log, revision, key=lambda e: e.revision)
+            if i >= len(log):
+                return None
+            ev = log[i]
+            return ev.revision, ev.ts
+
     # -- watch -------------------------------------------------------------
 
     def watch(self, kind: str, from_revision: int = 0) -> Watch:
@@ -439,24 +516,35 @@ class Store:
                 raise CompactedError(from_revision, compacted_before)
             w = Watch(self, kind)
             i = bisect.bisect_right(log, from_revision, key=lambda e: e.revision)
+            # replayed events keep their original seqs, so the bookmark is
+            # the seq just before the first replayed event (or the current
+            # counter when nothing replays)
+            w.start_seq = log[i].seq - 1 if i < len(log) else self._seq.get(kind, 0)
             for ev in log[i:]:
                 w._push(ev)
             self._watches.setdefault(kind, []).append(w)
             return w
 
-    def sync_watch(self, kind: str) -> tuple[list[Any], Watch]:
+    def sync_watch(self, kind: str) -> tuple[list[Any], Watch, int]:
         """Atomic relist + fresh watch under ONE lock acquisition: the refs
         reflect every write up to now and the new watch sees every write
         after — no replay window, no gap, no duplicate. This is the repair
         primitive for dropped watch deliveries (an informer resync): the
         incremental watch(from_revision) path can't help there because the
         lost events are still IN the log — only a state diff recovers them.
-        Returned objects follow the list_refs read-only convention."""
+        Returned objects follow the list_refs read-only convention.
+
+        The third element is the store revision AT the sync, captured under
+        the same lock. The informer's revision-continuity tracker must
+        restart its bookmark from exactly this value: anything earlier
+        re-flags diff-repaired events as a gap forever (a perpetual
+        false-positive partition), anything later hides real losses."""
         with self._mu:
             refs = list(self._objects.get(kind, {}).values())
             w = Watch(self, kind)
+            w.start_seq = self._seq.get(kind, 0)
             self._watches.setdefault(kind, []).append(w)
-            return refs, w
+            return refs, w, self._revision
 
     # -- convenience typed helpers ----------------------------------------
 

@@ -25,11 +25,12 @@ fails (or passes) identically run after run.
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 
 from ..store.store import ConflictError, Store
 from ..utils import faultinject
-from ..utils.faultinject import DROP, ERROR, LATENCY, FaultSpec
+from ..utils.faultinject import DROP, ERROR, LATENCY, PARTITION, FaultSpec
 from .wrappers import make_node, make_pod
 
 
@@ -188,6 +189,258 @@ def run_soak(seed: int = 7, rounds: int = 6, pods_per_round: int = 24,
     return report
 
 
+# -- arrival-trace soak: production-shaped load + the full fleet ---------------
+
+
+@dataclasses.dataclass
+class ArrivalTrace:
+    """Seeded, replayable Poisson arrival process with periodic burst
+    windows — the millions-of-users load shape (ROADMAP item 3) instead of
+    batch-dumping pods. `arrivals()` returns sorted virtual timestamps;
+    the same seed replays the same trace, independent of everything else
+    (its rng stream is its own, not the fault registry's)."""
+
+    seed: int
+    pods: int = 96
+    rate: float = 120.0        # base arrivals per virtual second
+    burst_every: float = 0.5   # a burst window opens each period...
+    burst_len: float = 0.1     # ...and lasts this long...
+    burst_factor: float = 4.0  # ...at this rate multiple
+
+    def arrivals(self) -> list[float]:
+        rng = random.Random(f"{self.seed}:arrival-trace")
+        out: list[float] = []
+        t = 0.0
+        while len(out) < self.pods:
+            in_burst = (t % self.burst_every) < self.burst_len
+            lam = self.rate * (self.burst_factor if in_burst else 1.0)
+            t += rng.expovariate(lam)
+            out.append(t)
+        return out
+
+
+def trace_schedule(registry: faultinject.FaultRegistry, nodes: int,
+                   outage_start_tick: int, outage_ticks: int) -> None:
+    """The trace soak's fault schedule: the tentpole trio — a long-lived
+    watch-stream PARTITION, a full-fleet kubelet outage window (AZ-outage
+    shaped: every sync in [start, start+len) ticks is dropped, so leases
+    go stale together), and bind LATENCY riding the new commit seam —
+    plus the breaker-burst and light transient flakes from the standard
+    schedule so the load shape stays production-like."""
+    # long-lived revision-range gap: opens once, swallows a contiguous run
+    # of deliveries across every watcher; the informers must detect it
+    # from revision continuity — there is no error to react to
+    registry.register(FaultSpec(
+        "watch.partition", mode=PARTITION, start_after=200, window=400,
+        times=1))
+    # kubelet death mid-wave: sync visits go round-robin (one per kubelet
+    # per tick), so a [start*n, (start+len)*n) visit window is a fleet-wide
+    # outage measured in driver ticks
+    registry.register(FaultSpec(
+        "kubelet.sync", mode=DROP, start_after=outage_start_tick * nodes,
+        times=outage_ticks * nodes))
+    # injected latency inside the bind transaction: with the
+    # prepare/commit seam this sleeps OUTSIDE the store lock, so readers
+    # (kubelet relists, controller reconciles) proceed — the soak's
+    # wall-clock budget is the regression tripwire
+    registry.register(FaultSpec(
+        "store.bind_pod", mode=LATENCY, probability=0.15, times=12,
+        latency_s=0.02))
+    # guaranteed breaker trip + recovery (same shape as standard_schedule)
+    registry.register(FaultSpec(
+        "tpu.collect", mode=ERROR, transient=True,
+        start_after=4, times=4, message="device flake"))
+    # light production noise: call flakes, write conflicts, lossy watch
+    registry.register(FaultSpec(
+        "dispatcher.execute", mode=ERROR, transient=True,
+        probability=0.1, times=20, message="dispatcher flake"))
+    registry.register(FaultSpec(
+        "store.update", mode=ERROR, probability=0.05, times=15,
+        exc=ConflictError, message="injected conflict"))
+    registry.register(FaultSpec(
+        "watch.deliver", mode=DROP, probability=0.03, times=30))
+
+
+@dataclasses.dataclass
+class TraceSoakReport(SoakReport):
+    partitions_detected: int = 0
+    partition_repairs: int = 0
+    partition_repair_latency_s: float = 0.0
+    kubelet_outage_drops: int = 0
+    nodes_unreachable_seen: int = 0
+    evicted: int = 0
+    wall_clock_s: float = 0.0
+    budget_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:  # type: ignore[override]
+        return (
+            SoakReport.ok.fget(self)  # type: ignore[attr-defined]
+            and self.partitions_detected >= 1
+            and self.partition_repairs >= 1
+            and self.kubelet_outage_drops >= 1
+            and self.nodes_unreachable_seen >= 1
+            # the outage must actually bite (bound pods evicted) AND the
+            # cluster must come back (late arrivals bound after recovery)
+            and self.evicted >= 1
+            and self.bound >= 1
+            and self.wall_clock_s <= self.budget_s
+        )
+
+    def render(self) -> str:
+        verdict = "PASS" if self.ok else "FAIL"
+        return (
+            f"trace soak [{verdict}] seed={self.seed}: "
+            f"created={self.created} bound={self.bound} "
+            f"unbound={self.unbound} evicted={self.evicted} "
+            f"leaked_assumes={self.leaked_assumes} "
+            f"queue_pending={self.queue_pending} "
+            f"breaker_trips={self.breaker_trips} "
+            f"breaker_recoveries={self.breaker_recoveries} "
+            f"partitions_detected={self.partitions_detected} "
+            f"partition_repairs={self.partition_repairs} "
+            f"partition_repair_latency_s="
+            f"{self.partition_repair_latency_s:.4f} "
+            f"kubelet_outage_drops={self.kubelet_outage_drops} "
+            f"nodes_unreachable_seen={self.nodes_unreachable_seen} "
+            f"faults_fired={self.faults_fired} retries={self.retries} "
+            f"wall_clock_s={self.wall_clock_s:.2f} (budget {self.budget_s})"
+        )
+
+
+def run_trace_soak(seed: int = 7, pods: int = 96, nodes: int = 12,
+                   wave_size: int = 16, tick_s: float = 0.02,
+                   grace_period_s: float = 0.35,
+                   outage_start_tick: int = 10, outage_ticks: int = 30,
+                   breaker_cooldown_s: float = 0.05,
+                   budget_s: float = 60.0) -> TraceSoakReport:
+    """Chaos under a production-shaped arrival trace, against the WHOLE
+    control loop: every node runs a hollow kubelet (heartbeating a lease),
+    the node-lifecycle controller monitors lease staleness, and the fault
+    schedule kills the entire kubelet fleet mid-trace, opens a watch
+    partition, and injects bind latency. Converges iff the scheduler,
+    informers (partition self-heal), lifecycle controller (taint/evict),
+    and breaker (trip + recover) all do their jobs — at arrival-trace load,
+    not synthetic churn. Leaves the global registry disarmed + reset."""
+    from ..controllers.lifecycle import (
+        UNREACHABLE_TAINT,
+        NodeLifecycleController,
+    )
+    from ..kubelet.hollow import HollowKubelet
+    from ..scheduler import Profile, Scheduler
+    from ..scheduler.metrics import SchedulerMetrics
+
+    report = TraceSoakReport(seed=seed, rounds=1, budget_s=budget_s)
+    t_start = time.monotonic()
+    registry = faultinject.registry()
+    registry.reset(seed=seed)
+    trace_schedule(registry, nodes=nodes,
+                   outage_start_tick=outage_start_tick,
+                   outage_ticks=outage_ticks)
+
+    store = Store()
+    metrics = SchedulerMetrics()
+    sched = Scheduler(
+        store,
+        profiles=[Profile(backend="tpu", wave_size=wave_size)],
+        feature_gates={"SchedulerAsyncAPICalls": True},
+        async_api_calls=True,
+        metrics=metrics,
+        seed=seed,
+    )
+    algo = next(iter(sched.algorithms.values()))
+    algo.breaker.cooldown_s = breaker_cooldown_s
+    sched.queue._initial_backoff = 0.02
+    sched.queue._max_backoff = 0.1
+
+    # the fleet: EVERY node gets a kubelet — the lifecycle controller
+    # taints any node without a fresh lease, so a node without an agent
+    # would be evicted as collateral instead of by the injected outage
+    kubelets = []
+    for i in range(nodes):
+        node = make_node(f"tn{i}", cpu="16", mem="32Gi", zone=f"z{i % 4}")
+        k = HollowKubelet(store, node)
+        k.register()
+        kubelets.append(k)
+    lifecycle = NodeLifecycleController(store)
+    lifecycle.grace_period = grace_period_s
+    lifecycle.start()
+    lifecycle.sweep()
+    sched.start()
+
+    trace = ArrivalTrace(seed=seed, pods=pods)
+    arrivals = trace.arrivals()
+    # the trace plays out in wall time (leases are wall-clock state); run
+    # enough ticks to cover the trace AND the outage + grace expiry
+    total_ticks = max(
+        int(arrivals[-1] / tick_s) + 1,
+        outage_start_tick + outage_ticks + int(grace_period_s / tick_s) + 10,
+    )
+    registry.arm()
+    created = 0
+    try:
+        for tick in range(total_ticks):
+            virtual_now = tick * tick_s
+            while created < len(arrivals) and arrivals[created] <= virtual_now:
+                store.create(make_pod(f"trace-{created}", cpu="100m",
+                                      mem="64Mi"))
+                created += 1
+            for k in kubelets:
+                k.sync_once()
+            lifecycle.sync_once()
+            sched.schedule_pending()
+            unreachable = sum(
+                1 for n in store.nodes()
+                if any(t.key == UNREACHABLE_TAINT for t in n.spec.taints)
+            )
+            report.nodes_unreachable_seen = max(
+                report.nodes_unreachable_seen, unreachable
+            )
+            time.sleep(tick_s)
+    finally:
+        registry.disarm()
+    report.created = created
+    report.faults_fired = registry.fired_total
+    report.kubelet_outage_drops = registry.fired_by_point["kubelet.sync"]
+
+    # fault-free convergence: kubelets heartbeat again, the lifecycle
+    # controller un-taints recovered nodes, stranded/backoff pods bind
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        for k in kubelets:
+            k.sync_once()
+        lifecycle.sync_once()
+        sched.schedule_pending()
+        pending = [p for p in store.pods() if not p.spec.node_name]
+        active, backoff, unsched = sched.queue.pending_pods()
+        if (not pending and sched.cache.assumed_pod_count() == 0
+                and active + backoff + unsched == 0):
+            break
+        time.sleep(0.02)
+
+    pods_now = store.pods()
+    report.bound = sum(1 for p in pods_now if p.spec.node_name)
+    report.unbound = len(pods_now) - report.bound
+    report.evicted = created - len(pods_now)
+    report.leaked_assumes = sched.cache.assumed_pod_count()
+    active, backoff, unsched = sched.queue.pending_pods()
+    report.queue_pending = active + backoff + unsched
+    report.breaker_trips = algo.breaker.trip_count
+    report.breaker_recoveries = algo.breaker.recovery_count
+    report.retries = sched.api_dispatcher.retries
+    partition_events = list(sched.flight_recorder.partition_events)
+    report.partitions_detected = len(partition_events)
+    report.partition_repairs = sum(ev[1] for ev in partition_events)
+    report.partition_repair_latency_s = max(
+        (ev[2] for ev in partition_events), default=0.0
+    )
+    report.resync_repairs = report.partition_repairs
+    sched.api_dispatcher.close()
+    registry.reset()
+    report.wall_clock_s = time.monotonic() - t_start
+    return report
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -201,11 +454,26 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--pods-per-round", type=int, default=24)
     parser.add_argument("--nodes", type=int, default=32)
     parser.add_argument("--wave-size", type=int, default=16)
+    parser.add_argument("--trace", action="store_true",
+                        help="run the arrival-trace soak (watch partition "
+                             "+ fleet-wide kubelet outage + bind latency "
+                             "under a Poisson/burst arrival trace) instead "
+                             "of the scale-churn soak")
+    parser.add_argument("--pods", type=int, default=96,
+                        help="total arrivals for --trace")
+    parser.add_argument("--budget-s", type=float, default=60.0,
+                        help="wall-clock budget asserted by --trace")
     args = parser.parse_args(argv)
 
-    report = run_soak(seed=args.seed, rounds=args.rounds,
-                      pods_per_round=args.pods_per_round,
-                      nodes=args.nodes, wave_size=args.wave_size)
+    if args.trace:
+        report = run_trace_soak(seed=args.seed, pods=args.pods,
+                                nodes=min(args.nodes, 12),
+                                wave_size=args.wave_size,
+                                budget_s=args.budget_s)
+    else:
+        report = run_soak(seed=args.seed, rounds=args.rounds,
+                          pods_per_round=args.pods_per_round,
+                          nodes=args.nodes, wave_size=args.wave_size)
     print(report.render())
     return 0 if report.ok else 1
 

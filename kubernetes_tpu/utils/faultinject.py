@@ -49,11 +49,18 @@ class PermanentFault(FaultInjected):
 ERROR = "error"
 LATENCY = "latency"
 DROP = "drop"
+# a long-lived gap: once triggered, the spec drops `window` CONSECUTIVE
+# visits unconditionally — on a watch point that is a contiguous
+# revision-range loss the informer must detect by itself (bookmark
+# staleness), not a per-delivery coin flip like DROP
+PARTITION = "partition"
 
 # every injection point threaded through the tree; the golden bit-compat
 # tests assert this exact set is registered (and disarmed) — a new call
-# site must be declared here or `fire` raises KeyError under chaos tests
-POINTS = (
+# site must be declared here or `fire` raises KeyError under chaos tests.
+# kubesched-lint rule FI01 cross-checks every fire() call site against
+# this constant, so a typo'd point name can't silently never arm.
+FAULT_POINTS = (
     "store.create",
     "store.update",
     "store.delete",
@@ -63,7 +70,16 @@ POINTS = (
     "tpu.launch",
     "tpu.collect",
     "watch.deliver",
+    "watch.partition",
+    "kubelet.sync",
+    "kubelet.lease",
+    "kubelet.pleg",
+    "controller.reconcile",
+    "controller.lifecycle",
+    "controller.workloads",
 )
+# historical alias (pre-FI01 name); same object, never diverges
+POINTS = FAULT_POINTS
 
 
 @dataclass
@@ -73,7 +89,11 @@ class FaultSpec:
     `start_after` skips the first N visits to the point; `times` bounds how
     often the spec fires (None = unlimited); `probability` gates each
     remaining visit through the spec's own seeded rng. `exc` overrides the
-    raised exception (e.g. a real store ConflictError) for ERROR mode."""
+    raised exception (e.g. a real store ConflictError) for ERROR mode.
+
+    PARTITION mode: `times` bounds how often the partition OPENS; each
+    opening then drops `window` consecutive visits unconditionally (the
+    opening visit included), producing one contiguous gap per opening."""
 
     point: str
     mode: str = ERROR
@@ -82,10 +102,12 @@ class FaultSpec:
     times: int | None = None
     start_after: int = 0
     latency_s: float = 0.0
+    window: int = 1
     message: str = "injected fault"
     exc: Callable[[str], Exception] | None = None
     # runtime state (owned by the registry)
     fired: int = 0
+    _open_left: int = 0
     _rng: random.Random | None = field(default=None, repr=False)
 
     def make_error(self) -> Exception:
@@ -122,6 +144,7 @@ class FaultRegistry:
             # tuple hashing under PYTHONHASHSEED randomization)
             spec._rng = random.Random(f"{self.seed}:{spec.point}:{idx}")
             spec.fired = 0
+            spec._open_left = 0
             self._specs[spec.point].append(spec)
             return spec
 
@@ -159,6 +182,16 @@ class FaultRegistry:
             visit = self._visits[point]  # KeyError = undeclared point
             self._visits[point] = visit + 1
             for spec in self._specs[point]:
+                # an open partition window swallows every visit
+                # unconditionally until it closes — that is what makes
+                # the gap contiguous (a revision RANGE, not scattered
+                # drops a probability gate would produce)
+                if spec.mode == PARTITION and spec._open_left > 0:
+                    spec._open_left -= 1
+                    self.fired_total += 1
+                    self.fired_by_point[point] += 1
+                    dropped = True
+                    break
                 if visit < spec.start_after:
                     continue
                 if spec.times is not None and spec.fired >= spec.times:
@@ -175,6 +208,11 @@ class FaultRegistry:
                 elif spec.mode == LATENCY:
                     sleep_s = spec.latency_s
                 elif spec.mode == DROP:
+                    dropped = True
+                elif spec.mode == PARTITION:
+                    # this visit opens the gap and is itself dropped;
+                    # the remaining window - 1 visits drop above
+                    spec._open_left = max(spec.window - 1, 0)
                     dropped = True
                 break
         # act OUTSIDE the registry lock: a latency injection must not

@@ -138,3 +138,110 @@ class InMemoryExporter:
     def find(self, name_prefix: str) -> list[Span]:
         with self._lock:
             return [s for s in self.spans if s.name.startswith(name_prefix)]
+
+    def last(self, n: int | None = None) -> list[Span]:
+        """The most recent n finished root spans (all when n is None)."""
+        with self._lock:
+            return list(self.spans[-n:] if n else self.spans)
+
+
+# -- OTLP-shaped export --------------------------------------------------------
+
+
+def spans_to_otlp(spans: list[Span], component: str = "kubernetes-tpu") -> dict:
+    """Serialize finished root spans (children included) into the OTLP/JSON
+    trace shape (resourceSpans → scopeSpans → spans) so the /debug/traces
+    payload drops straight into any OTLP-speaking viewer. Span/trace ids
+    are synthesized by traversal order — this process never talked to a
+    real collector, so there is no propagated context to preserve. Span
+    times are exported as epoch nanos via one perf_counter→epoch offset
+    captured per export call (spans record perf_counter internally)."""
+    # perf_counter and time.time advance in lockstep; one offset converts
+    epoch_offset = time.time() - time.perf_counter()
+
+    def _attrs(d: dict) -> list[dict]:
+        return [
+            {"key": str(k), "value": {"stringValue": str(v)}}
+            for k, v in d.items()
+        ]
+
+    out_spans: list[dict] = []
+    counter = [0]
+
+    def _walk(sp: Span, trace_id: str, parent_id: str) -> None:
+        counter[0] += 1
+        span_id = f"{counter[0]:016x}"
+        start_ns = int((sp.start + epoch_offset) * 1e9)
+        end_ns = int(((sp.end or time.perf_counter()) + epoch_offset) * 1e9)
+        out_spans.append({
+            "traceId": trace_id,
+            "spanId": span_id,
+            "parentSpanId": parent_id,
+            "name": sp.name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": _attrs(sp.attributes),
+            "events": [
+                {
+                    "timeUnixNano": str(int((sp.start + off + epoch_offset) * 1e9)),
+                    "name": msg,
+                    "attributes": _attrs(attrs),
+                }
+                for off, msg, attrs in sp.events
+            ],
+        })
+        for child in sp.children:
+            _walk(child, trace_id, span_id)
+
+    for i, root in enumerate(spans, start=1):
+        _walk(root, f"{i:032x}", "")
+
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": _attrs({"service.name": component})},
+            "scopeSpans": [{
+                "scope": {"name": "kubernetes_tpu.utils.tracing"},
+                "spans": out_spans,
+            }],
+        }],
+    }
+
+
+# -- CLI: dump an exporter-shaped demo / inspect OTLP dumps --------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.utils.tracing",
+        description="Span tracing introspection",
+    )
+    parser.add_argument("--dump", action="store_true",
+                        help="run a short synthetic trace and print it as "
+                             "OTLP JSON (the /debug/traces payload shape)")
+    parser.add_argument("--last", type=int, default=None,
+                        help="limit the dump to the last N root spans")
+    args = parser.parse_args(argv)
+
+    if not args.dump:
+        parser.print_usage()
+        return 2
+
+    exporter = InMemoryExporter()
+    tracer = Tracer("tracing-cli", exporter=exporter)
+    with tracer.span("demo/schedule", pods="3") as sp:
+        sp.event("queue popped", pods="3")
+        with tracer.span("demo/kernel", tier="dedup"):
+            pass
+        with tracer.span("demo/bind"):
+            sp.event("bind dispatched")
+    print(json.dumps(spans_to_otlp(exporter.last(args.last),
+                                   component="tracing-cli"), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,3 +25,10 @@ jax.config.update("jax_platforms", "cpu")
 from kubernetes_tpu.utils.jaxcache import enable_persistent_cache  # noqa: E402
 
 enable_persistent_cache()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak runs excluded from tier-1 (-m 'not slow')",
+    )

@@ -26,13 +26,19 @@ from kubernetes_tpu.scheduler.tpu.circuitbreaker import (
 )
 from kubernetes_tpu.store.store import Store
 from kubernetes_tpu.testing import make_node, make_pod
-from kubernetes_tpu.testing.chaos import run_soak, standard_schedule
+from kubernetes_tpu.testing.chaos import (
+    ArrivalTrace,
+    run_soak,
+    run_trace_soak,
+    standard_schedule,
+)
 from kubernetes_tpu.utils import faultinject
 from kubernetes_tpu.utils.backoff import RetryPolicy, retry_call
 from kubernetes_tpu.utils.faultinject import (
     DROP,
     ERROR,
     LATENCY,
+    PARTITION,
     FaultSpec,
     PermanentFault,
     TransientFault,
@@ -510,6 +516,281 @@ class TestInformerResync:
         assert store.get("Pod", "default/stranded").spec.node_name == "n0"
 
 
+# -------------------------------------------------------- watch partitions
+
+
+class TestWatchPartition:
+    def test_partition_window_drops_consecutive_visits(self):
+        """PARTITION semantics: the spec opens once (times=1) after
+        start_after visits and then swallows `window` CONSECUTIVE visits
+        unconditionally — one contiguous gap, not a per-visit coin flip."""
+        reg = faultinject.FaultRegistry(seed=1)
+        reg.register(FaultSpec("watch.partition", mode=PARTITION,
+                               start_after=2, window=3, times=1))
+        reg.arm()
+        out = [reg.fire("watch.partition") for _ in range(8)]
+        assert out == [False, False, True, True, True, False, False, False]
+        assert reg.fired_total == 3
+
+    def test_tail_gap_detected_and_repaired(self):
+        """A partition that swallows the newest deliveries leaves the
+        stream looking merely quiet; the informer must notice from store
+        revision continuity, not from any error."""
+        from kubernetes_tpu.client.informer import InformerFactory
+
+        store = Store()
+        fac = InformerFactory(store)
+        inf = fac.informer("Pod")
+        events: list = []
+        inf.add_handler(lambda et, old, new: events.append(et))
+        fac.start_all()
+        reg = faultinject.registry()
+        reg.reset(seed=3)
+        reg.register(FaultSpec("watch.partition", mode=PARTITION,
+                               window=50, times=1))
+        reg.arm()
+        store.create(make_pod("a", cpu="100m", mem="64Mi"))
+        store.create(make_pod("b", cpu="100m", mem="64Mi"))
+        reg.disarm()
+        assert inf.pump() == 0 and events == []
+        repaired = inf.detect_and_repair()
+        assert repaired == 2
+        assert inf.partitions_detected == 1
+        assert sorted(inf.keys()) == ["default/a", "default/b"]
+        # healthy stream: detection is a no-op, not a false positive
+        assert inf.detect_and_repair() == 0
+        assert inf.partitions_detected == 1
+
+    def test_interior_gap_detected_after_stream_resumes(self):
+        """The harder case: the partition CLOSES and later deliveries
+        resume, so revision staleness alone would never show — the
+        per-kind sequence jump inside pump must flag the hole."""
+        from kubernetes_tpu.client.informer import InformerFactory
+
+        store = Store()
+        fac = InformerFactory(store)
+        inf = fac.informer("Pod")
+        fac.start_all()
+        store.create(make_pod("before", cpu="100m", mem="64Mi"))
+        inf.pump()
+        reg = faultinject.registry()
+        reg.reset(seed=3)
+        reg.register(FaultSpec("watch.partition", mode=PARTITION,
+                               window=1, times=1))
+        reg.arm()
+        store.create(make_pod("lost", cpu="100m", mem="64Mi"))
+        reg.disarm()
+        store.create(make_pod("after", cpu="100m", mem="64Mi"))
+        inf.pump()  # 'after' arrives; 'lost' never will
+        assert inf.get("default/after") is not None
+        assert inf.get("default/lost") is None
+        repaired = inf.detect_and_repair()
+        assert repaired >= 1
+        assert inf.partitions_detected == 1
+        assert inf.get("default/lost") is not None
+
+    def test_scheduler_self_heals_and_records_partition(self):
+        """End to end through schedule_pending's idle path: a stranded pod
+        behind a partition gets scheduled without any explicit resync, and
+        the repair shows up in the flight recorder AND the metrics
+        histogram/counter."""
+        from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
+
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        metrics = SchedulerMetrics()
+        sched = Scheduler(store,
+                          profiles=[Profile(backend="tpu", wave_size=4)],
+                          metrics=metrics, seed=3)
+        sched.start()
+        reg = faultinject.registry()
+        reg.reset(seed=5)
+        reg.register(FaultSpec("watch.partition", mode=PARTITION,
+                               window=100, times=1))
+        reg.arm()
+        store.create(make_pod("stranded", cpu="100m", mem="64Mi"))
+        reg.disarm()
+        sched.schedule_pending()
+        assert store.get("Pod", "default/stranded").spec.node_name == "n0"
+        assert len(sched.flight_recorder.partition_events) >= 1
+        kind, repaired, latency_s = sched.flight_recorder.partition_events[0]
+        assert repaired >= 1 and latency_s >= 0.0
+        assert sched.flight_recorder.summary()["partitions_detected"] >= 1
+        exposed = metrics.expose()
+        assert "watch_partitions_detected" in exposed
+        assert "watch_partition_repair_latency" in exposed
+
+
+# ------------------------------------------------- bind commit concurrency
+
+
+class TestBindCommitConcurrency:
+    def test_reader_not_blocked_during_injected_bind_latency(self):
+        """The prepare/commit seam contract: injected bind latency sleeps
+        in the prepare phase OUTSIDE the store lock, so concurrent readers
+        proceed while the bind is 'slow'. Before the split, this read
+        would stall for the full injected latency."""
+        import time as _time
+
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        store.create(make_pod("slow", cpu="100m", mem="64Mi"))
+        reg = faultinject.registry()
+        reg.reset(seed=3)
+        latency_s = 0.75
+        reg.register(FaultSpec("store.bind_pod", mode=LATENCY,
+                               latency_s=latency_s, times=1))
+        reg.arm()
+        done = threading.Event()
+        t0 = _time.perf_counter()
+
+        def binder():
+            store.bind_pods([("default/slow", "n0")])
+            done.set()
+
+        th = threading.Thread(target=binder)
+        th.start()
+        # barrier: wait until the spec has fired (the injected sleep is
+        # underway inside bind_pods' prepare phase)
+        while reg.fired_total < 1 and _time.perf_counter() - t0 < 5.0:
+            _time.sleep(0.001)
+        assert reg.fired_total >= 1
+        r0 = _time.perf_counter()
+        assert store.get("Pod", "default/slow") is not None
+        store.pods()
+        store.nodes()
+        read_s = _time.perf_counter() - r0
+        assert not done.is_set(), "bind finished before the latency elapsed"
+        assert th.join(timeout=5.0) is None and done.is_set()
+        bind_s = _time.perf_counter() - t0
+        assert read_s < 0.25, (
+            f"reads took {read_s:.3f}s during a {latency_s}s injected bind "
+            "— the latency is sleeping inside the store lock"
+        )
+        assert bind_s >= latency_s
+        assert store.get("Pod", "default/slow").spec.node_name == "n0"
+
+
+# --------------------------------------------------- kubelet death mid-run
+
+
+class TestKubeletDeathMidWave:
+    def test_victim_kubelet_death_taints_evicts_and_recovers(self):
+        """Kill ONE kubelet via its fault point: its lease goes stale, the
+        lifecycle controller taints the node and evicts its pods, the
+        scheduler keeps converging on the survivors; reviving the kubelet
+        clears the taint and new pods bind again — no leaked assumes."""
+        from kubernetes_tpu.controllers.lifecycle import (
+            UNREACHABLE_TAINT,
+            NodeLifecycleController,
+        )
+        from kubernetes_tpu.kubelet.hollow import HollowKubelet
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        store = Store()
+        clock = FakeClock()
+        kubelets = []
+        for i in range(3):
+            node = make_node(f"n{i}", cpu="16", mem="32Gi")
+            k = HollowKubelet(store, node, clock=clock)
+            k.register()
+            kubelets.append(k)
+        lc = NodeLifecycleController(store, clock=clock)
+        lc.grace_period = 10.0
+        lc.start()
+        lc.sweep()
+        sched = Scheduler(store,
+                          profiles=[Profile(backend="tpu", wave_size=4)],
+                          seed=3)
+        sched.start()
+        for i in range(6):
+            store.create(make_pod(f"p{i}", cpu="100m", mem="64Mi"))
+        sched.schedule_pending()
+        assert all(p.spec.node_name for p in store.pods())
+        assert any(p.spec.node_name == "n0" for p in store.pods())
+
+        reg = faultinject.registry()
+        reg.reset(seed=3)
+        reg.register(FaultSpec("kubelet.sync", mode=DROP))
+        victim, survivors = kubelets[0], kubelets[1:]
+        for _ in range(8):
+            clock.step(2.5)
+            reg.arm()
+            victim.sync_once()  # dropped: no heartbeat, lease goes stale
+            reg.disarm()
+            for k in survivors:
+                k.sync_once()
+            lc.sync_once()
+            sched.schedule_pending()
+        n0 = store.get("Node", "n0")
+        assert any(t.key == UNREACHABLE_TAINT for t in n0.spec.taints)
+        assert all(p.spec.node_name != "n0" for p in store.pods()), \
+            "pods on the dead node must be evicted"
+        assert all(p.spec.node_name for p in store.pods()), \
+            "survivors must stay bound"
+
+        # revival: heartbeats resume, taint clears, node schedulable again
+        for _ in range(6):
+            clock.step(2.5)
+            for k in kubelets:
+                k.sync_once()
+            lc.sync_once()
+            sched.schedule_pending()
+        n0 = store.get("Node", "n0")
+        assert not any(t.key == UNREACHABLE_TAINT for t in n0.spec.taints)
+        for i in range(2):
+            store.create(make_pod(f"late{i}", cpu="100m", mem="64Mi"))
+        sched.schedule_pending()
+        assert all(p.spec.node_name for p in store.pods())
+        assert sched.cache.assumed_pod_count() == 0
+        active, backoff, unsched = sched.queue.pending_pods()
+        assert active + backoff + unsched == 0
+
+
+# ------------------------------------------------------- new fault points
+
+
+class TestNewPointsRegistered:
+    NEW_POINTS = ("watch.partition", "kubelet.sync", "kubelet.lease",
+                  "kubelet.pleg", "controller.reconcile",
+                  "controller.lifecycle", "controller.workloads")
+
+    def test_fleet_points_declared(self):
+        for p in self.NEW_POINTS:
+            assert p in faultinject.FAULT_POINTS, p
+        assert faultinject.POINTS is faultinject.FAULT_POINTS
+
+    def test_disarmed_new_points_are_free(self):
+        reg = faultinject.FaultRegistry(seed=1)
+        for p in self.NEW_POINTS:
+            reg.register(FaultSpec(p, mode=ERROR, transient=True))
+        for p in self.NEW_POINTS:
+            for _ in range(5):
+                assert reg.fire(p) is False
+        assert reg.fired_total == 0
+
+
+# ------------------------------------------------------------ arrival trace
+
+
+class TestArrivalTrace:
+    def test_same_seed_replays_same_trace(self):
+        a = ArrivalTrace(seed=7).arrivals()
+        assert a == ArrivalTrace(seed=7).arrivals()
+        assert a != ArrivalTrace(seed=8).arrivals()
+
+    def test_trace_shape(self):
+        a = ArrivalTrace(seed=7, pods=50).arrivals()
+        assert len(a) == 50
+        assert a == sorted(a)
+        assert a[0] > 0.0
+        # burst windows make inter-arrivals non-uniform: the fastest
+        # stretch is markedly denser than the slowest
+        gaps = [b - c for b, c in zip(a[1:], a)]
+        assert min(gaps) >= 0.0
+        assert max(gaps) > 3 * (sum(gaps) / len(gaps))
+
+
 # ------------------------------------------------------------------- soak
 
 
@@ -521,6 +802,32 @@ class TestChaosSoak:
         assert report.breaker_recoveries >= 1
         assert report.faults_fired > 0
         assert report.retries > 0
+
+
+class TestTraceSoak:
+    def test_arrival_trace_soak_converges(self):
+        """Production-shaped load against the whole control loop: Poisson/
+        burst arrivals with a watch partition, a fleet-wide kubelet outage
+        (taint + evict + recover), and bind latency all armed — must
+        converge inside the wall-clock budget with every ladder rung
+        actually exercised."""
+        report = run_trace_soak(seed=7)
+        assert report.ok, report.render()
+        assert report.partitions_detected >= 1
+        assert report.partition_repairs >= 1
+        assert report.breaker_trips >= 1
+        assert report.breaker_recoveries >= 1
+        assert report.nodes_unreachable_seen >= 1
+        assert report.evicted >= 1
+        assert report.bound >= 1, "post-recovery arrivals must bind"
+        assert report.unbound == 0
+        assert report.leaked_assumes == 0
+        assert report.wall_clock_s <= report.budget_s
+
+    @pytest.mark.slow
+    def test_arrival_trace_soak_second_seed_heavier(self):
+        report = run_trace_soak(seed=1234, pods=192, budget_s=120.0)
+        assert report.ok, report.render()
 
 
 # ------------------------------------------------- golden with points armed

@@ -12,6 +12,7 @@ import textwrap
 from pathlib import Path
 
 from kubernetes_tpu.analysis import (
+    FaultPointChecker,
     JitPurityChecker,
     LockDisciplineChecker,
     RegistrySyncChecker,
@@ -366,6 +367,109 @@ class TestLockDiscipline:
         assert fs == []
 
 
+# ------------------------------------------------------------------ LOCK04
+
+
+class TestLockCommitSection:
+    def test_blocking_call_in_commit_method_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+            import time
+
+            class Store:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.objs = {}
+
+                def _commit_bindings(self, prepared):
+                    with self._mu:
+                        time.sleep(0.1)
+                        for k in prepared:
+                            self.objs[k] = True
+        """, checkers=[LockDisciplineChecker()])
+        assert "LOCK04" in rules(fs)
+
+    def test_fire_in_commit_method_flagged(self, tmp_path):
+        """A LATENCY spec turns fire() into a sleep LOCK03 can't see —
+        LOCK04 bans the visit from commit sections outright, held or not."""
+        fs = lint(tmp_path, """
+            import threading
+
+            from ..utils import faultinject
+
+            class Store:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.objs = {}
+
+                def _commit_bindings(self, prepared):
+                    faultinject.fire("store.bind_pod")
+                    with self._mu:
+                        for k in prepared:
+                            self.objs[k] = True
+        """, checkers=[LockDisciplineChecker()])
+        assert rules(fs) == ["LOCK04"]
+        assert "fire" in fs[0].message
+
+    def test_bare_fire_in_commit_method_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            from ..utils.faultinject import fire
+
+            class Store:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.objs = {}
+
+                def commit(self, key):
+                    fire("store.bind_pod")
+                    with self._mu:
+                        self.objs[key] = True
+        """, checkers=[LockDisciplineChecker()])
+        assert rules(fs) == ["LOCK04"]
+
+    def test_fire_in_prepare_phase_ok(self, tmp_path):
+        """The sanctioned prepare/commit split: fire + validation outside,
+        a short locked commit section with neither blocking nor fault
+        points."""
+        fs = lint(tmp_path, """
+            import threading
+
+            from ..utils import faultinject
+
+            class Store:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.objs = {}
+
+                def bind(self, keys):
+                    prepared = []
+                    for k in keys:
+                        faultinject.fire("store.bind_pod")
+                        prepared.append(k)
+                    self._commit_bindings(prepared)
+
+                def _commit_bindings(self, prepared):
+                    with self._mu:
+                        for k in prepared:
+                            self.objs[k] = True
+        """, checkers=[LockDisciplineChecker()])
+        assert fs == []
+
+    def test_lockless_class_exempt(self, tmp_path):
+        # LOCK04 is commit-SECTION discipline; a class with no lock has
+        # no commit sections to protect
+        fs = lint(tmp_path, """
+            import time
+
+            class Journal:
+                def commit(self):
+                    time.sleep(0.1)
+        """, checkers=[LockDisciplineChecker()])
+        assert fs == []
+
+
 # ----------------------------------------------------------------- SNAP01
 
 
@@ -537,6 +641,87 @@ class TestRegistrySync:
         ))
         fs = run_paths([tmp_path], project_root=tmp_path)
         assert "REG02" in rules(fs)
+
+
+# ------------------------------------------------------------------- FI01
+
+
+FAULTINJECT_SRC = """\
+FAULT_POINTS = (
+    "store.create",
+    "watch.deliver",
+)
+POINTS = FAULT_POINTS
+"""
+
+
+def write_fi_tree(root, caller_src, faultinject=FAULTINJECT_SRC):
+    p = root / "utils/faultinject.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(faultinject)
+    c = root / "store/store.py"
+    c.parent.mkdir(parents=True, exist_ok=True)
+    c.write_text(textwrap.dedent(caller_src))
+    return root
+
+
+class TestFaultPoints:
+    def test_declared_literal_points_clean(self, tmp_path):
+        write_fi_tree(tmp_path, """
+            from ..utils import faultinject
+
+            def create():
+                faultinject.fire("store.create")
+                if faultinject.fire("watch.deliver"):
+                    return None
+        """)
+        assert list(FaultPointChecker().check_project(tmp_path)) == []
+
+    def test_undeclared_point_flagged(self, tmp_path):
+        write_fi_tree(tmp_path, """
+            from ..utils import faultinject
+
+            def create():
+                faultinject.fire("store.creat")
+        """)
+        fs = list(FaultPointChecker().check_project(tmp_path))
+        assert rules(fs) == ["FI01"]
+        assert "store.creat" in fs[0].message
+
+    def test_non_literal_point_flagged(self, tmp_path):
+        write_fi_tree(tmp_path, """
+            from ..utils import faultinject
+
+            def create(point):
+                faultinject.fire(point)
+        """)
+        fs = list(FaultPointChecker().check_project(tmp_path))
+        assert rules(fs) == ["FI01"]
+        assert "string literal" in fs[0].message
+
+    def test_faultinject_module_itself_exempt(self, tmp_path):
+        # the registry's own dispatch is by variable, by design
+        write_fi_tree(tmp_path, "x = 1\n", faultinject=FAULTINJECT_SRC + """
+
+def fire(point):
+    return _REGISTRY.fire(point)
+""")
+        assert list(FaultPointChecker().check_project(tmp_path)) == []
+
+    def test_partial_tree_is_silent(self, tmp_path):
+        # fixture dirs without the declaration file can't be cross-checked
+        assert list(FaultPointChecker().check_project(tmp_path)) == []
+
+    def test_unparseable_declaration_flagged(self, tmp_path):
+        write_fi_tree(tmp_path, "x = 1\n",
+                      faultinject="FAULT_POINTS = tuple(make_points())\n")
+        fs = list(FaultPointChecker().check_project(tmp_path))
+        assert rules(fs) == ["FI01"]
+        assert "literal" in fs[0].message
+
+    def test_repo_fire_sites_in_sync(self):
+        """Every fire() call in the shipped tree names a declared point."""
+        assert list(FaultPointChecker().check_project(PKG)) == []
 
 
 # ------------------------------------------------------------------ SIG01
