@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test obs chaos verify
+.PHONY: lint test obs chaos bench-smoke bench-gate verify
 
 # kubesched-lint: AST invariant checker (rule IDs in README "Invariants");
 # exits non-zero on any unsuppressed finding
@@ -30,6 +30,18 @@ obs:
 	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --demo
 	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --schema
 
+# trace-bench CI smoke: a tiny 200-pod Poisson trace through the real
+# loop (virtual-time SLI, deterministic), asserting the standing row keys
+# exist and that the regression gate passes an artifact against itself
+bench-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.perf.trace_bench --smoke
+
+# mechanical perf-regression gate: diff the newest two BENCH_* artifacts
+# in the repo root; >10% regression in any throughput/SLI row fails and
+# names the ledger segment whose p50 delta explains it
+bench-gate:
+	$(PY) -m kubernetes_tpu.perf.regression_gate
+
 # the full gate: invariants, tier-1 tests, chaos soaks (incl. the
-# arrival-trace runs), observability smoke
-verify: lint test chaos obs
+# arrival-trace runs), observability smoke, trace-bench smoke
+verify: lint test chaos obs bench-smoke

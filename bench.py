@@ -21,10 +21,17 @@ failure the bench falls back to CPU — the JSON line then carries
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The full BASELINE-table suite lives in bench_suite.py (one line per row).
+
+`--trace poisson|burst|diurnal --seed S` switches to the arrival-trace SLI
+mode (kubernetes_tpu/perf/trace_bench.py): a seeded ArrivalTrace replayed
+through the real loop at fixed per-tick capacity, reporting deterministic
+virtual-time trace_p50_s / trace_p99_s rows plus the pod latency ledger's
+wall-clock segment breakdown. Argumentless invocation is unchanged.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -82,7 +89,43 @@ def force_cpu() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def main() -> None:
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="bench.py",
+        description="Headline throughput bench; --trace switches to the "
+                    "arrival-trace SLI mode",
+    )
+    parser.add_argument("--trace", choices=("poisson", "burst", "diurnal"),
+                        default=None,
+                        help="replay a seeded arrival trace instead of the "
+                             "batch-dump headline workload")
+    parser.add_argument("--seed", type=int, default=7,
+                        help="trace seed (trace mode only)")
+    parser.add_argument("--pods", type=int, default=2000,
+                        help="trace length in pods (trace mode only)")
+    args = parser.parse_args(argv)
+    if args.trace:
+        run_trace(args.trace, args.seed, args.pods)
+        return
+    run_headline()
+
+
+def run_trace(shape: str, seed: int, pods: int) -> None:
+    """Trace SLI mode: always CPU (virtual-time numbers gain nothing from
+    an accelerator, and the subprocess probe would cost determinism-free
+    wall time); prints ONE JSON line with the standing trace row."""
+    base = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, base)
+    force_cpu()
+
+    from kubernetes_tpu.perf.trace_bench import run_trace_bench
+
+    row = run_trace_bench(shape=shape, seed=seed, pods=pods)
+    row["device"] = "cpu"
+    print(json.dumps(row))
+
+
+def run_headline() -> None:
     base = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, base)
 

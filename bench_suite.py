@@ -36,11 +36,35 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import time
 
 from bench import force_cpu, probe_device
 
 WAVE_SIZE = 512
+# the harness never overrides the Scheduler's tie-break rng seed; recording
+# it per row makes every JSONL line self-describing for the gate
+SUITE_SEED = 0
+
+# standing arrival-trace SLI rows (perf/trace_bench.py): virtual-time
+# deterministic, same defaults as `bench.py --trace` so the regression
+# gate can diff a suite artifact against a headline-bench artifact
+TRACE_ROWS = [("poisson", 7, "trace_poisson"), ("burst", 7, "trace_burst")]
+
+
+def _git_rev() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except Exception:  # noqa: BLE001 - a bench row must never die on git
+        pass
+    return "unknown"
 
 # (config, case, workload, short label) — the workload's `threshold` in the
 # YAML is the floor; keep the table here limited to naming
@@ -94,6 +118,7 @@ def main() -> None:
     all_pass = True
     summary: dict[str, float] = {}
     only = os.environ.get("BENCH_SUITE_ONLY", "")
+    git_rev = _git_rev()
     for cfg_name, case_name, wl_name, label in ROWS:
         if only and only not in label:
             continue
@@ -103,7 +128,9 @@ def main() -> None:
         floor = workload.get("threshold")
         executor = WorkloadExecutor(case, workload, backend="tpu",
                                     wave_size=WAVE_SIZE)
+        row_t0 = time.monotonic()
         result = executor.run()
+        row_wall_s = time.monotonic() - row_t0
         sli = {}
         for item in result.data_items:
             if item.unit == "seconds":
@@ -122,10 +149,35 @@ def main() -> None:
             "device": platform,
             "scheduled": result.scheduled,
             "sli_p99_s": sli.get("Perc99"),
+            "seed": SUITE_SEED,
+            "git_rev": git_rev,
+            "row_wall_s": round(row_wall_s, 2),
         }
         if fallback_reason:
             line["fallback_reason"] = fallback_reason
         print(json.dumps(line), flush=True)
+
+    # standing trace-SLI rows: deterministic virtual-time latency under the
+    # production arrival shape, with the ledger's segment breakdown
+    from kubernetes_tpu.perf.trace_bench import run_trace_bench
+
+    for shape, seed, label in TRACE_ROWS:
+        if only and only not in label:
+            continue
+        row_t0 = time.monotonic()
+        line = run_trace_bench(shape=shape, seed=seed)
+        row_wall_s = time.monotonic() - row_t0
+        ok = bool(line["sli_p50_ok"] and line["sli_p99_ok"]
+                  and line["scheduled"] == line["pods"])
+        all_pass = all_pass and ok
+        line.update({
+            "pass": ok,
+            "device": platform,
+            "git_rev": git_rev,
+            "row_wall_s": round(row_wall_s, 2),
+        })
+        print(json.dumps(line), flush=True)
+
     print(json.dumps({
         "metric": "bench_suite_summary",
         "value": float(sum(summary.values())),
@@ -133,6 +185,8 @@ def main() -> None:
         "rows": summary,
         "all_pass": all_pass,
         "device": platform,
+        "seed": SUITE_SEED,
+        "git_rev": git_rev,
     }), flush=True)
     sys.exit(0 if all_pass else 1)
 

@@ -12,6 +12,8 @@ node-plane / device-carry state may only be written through backend.py's
 invalidation hooks so the cross-wave signature cache can never go stale
 (SIG02), host-side-only
 telemetry — no recorder/tracer/metrics calls inside traced code (OBS01),
+ledger metric-series sync — every series the pod latency ledger declares
+and emits is registered in scheduler/metrics.py (OBS02),
 and retry/fault-injection discipline — no hand-rolled backoff loops or
 ad-hoc random flakes outside the shared helpers (RET01).
 
@@ -32,6 +34,7 @@ from .core import (
 from .carry_coherence import CarryCoherenceChecker
 from .fault_points import FaultPointChecker
 from .jit_purity import JitPurityChecker
+from .ledger_series import LedgerSeriesChecker
 from .lock_discipline import LockDisciplineChecker
 from .obs_purity import ObservabilityPurityChecker
 from .registry_sync import RegistrySyncChecker
@@ -45,6 +48,7 @@ __all__ = [
     "FaultPointChecker",
     "Finding",
     "JitPurityChecker",
+    "LedgerSeriesChecker",
     "LockDisciplineChecker",
     "ModuleContext",
     "ObservabilityPurityChecker",

@@ -108,6 +108,7 @@ def default_checkers() -> list[Checker]:
     from .carry_coherence import CarryCoherenceChecker
     from .fault_points import FaultPointChecker
     from .jit_purity import JitPurityChecker
+    from .ledger_series import LedgerSeriesChecker
     from .lock_discipline import LockDisciplineChecker
     from .obs_purity import ObservabilityPurityChecker
     from .registry_sync import RegistrySyncChecker
@@ -125,6 +126,7 @@ def default_checkers() -> list[Checker]:
         ObservabilityPurityChecker(),
         RetryDisciplineChecker(),
         FaultPointChecker(),
+        LedgerSeriesChecker(),
     ]
 
 

@@ -1,0 +1,150 @@
+"""Ledger metric-series registry sync rule (OBS02).
+
+The pod latency ledger (`scheduler/tpu/podlatency.py`) declares every
+Prometheus series it emits in one literal `LEDGER_SERIES` constant and
+resolves instruments at emission time by name (`self._series("...")`)
+against the `scheduler/metrics.py` registry. A series emitted but never
+registered silently drops every observation (`registry.get` returns
+None); a registered-but-undeclared name rots the declared contract the
+README documents. Nothing imports across the seam at runtime (the ledger
+must construct without a metrics object at all), so — like FI01 for fault
+points — the only enforcement possible is cross-parsing.
+
+OBS02 flags, across the whole tree:
+- a `LEDGER_SERIES` declaration that is not a literal tuple/list/set of
+  string constants (can't be cross-checked);
+- a declared series name with no matching literal registration
+  (`r.counter/gauge/histogram("name", ...)`) in `scheduler/metrics.py`;
+- a `_series(...)` emission call, in a module that declares
+  `LEDGER_SERIES`, whose argument is not a string literal or names a
+  series outside the declaration.
+
+Findings are project-scoped, so per-line suppressions do not apply —
+register (or declare) the series instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding, ProjectChecker
+
+OBS02 = "OBS02"
+
+METRICS_REGISTRY = "scheduler/metrics.py"
+DECL_NAME = "LEDGER_SERIES"
+REGISTER_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _registered_names(path: Path) -> set[str] | None:
+    """Literal first args of every `*.counter/gauge/histogram(...)` call
+    in the metrics registry module, or None if unparseable."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in REGISTER_METHODS
+                and node.args):
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            out.add(first.value)
+    return out
+
+
+def _parse_decl(tree: ast.AST) -> tuple[set[str] | None, int] | None:
+    """(declared names | None-if-non-literal, lineno) for LEDGER_SERIES,
+    or None when the module has no declaration at all."""
+    for node in getattr(tree, "body", ()):
+        if not (isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == DECL_NAME
+            for t in node.targets
+        )):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call) and value.args:
+            value = value.args[0]  # frozenset((...)) / tuple((...)) wrapper
+        if not isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+            return None, node.lineno
+        out: set[str] = set()
+        for el in value.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None, node.lineno
+            out.add(el.value)
+        return out, node.lineno
+    return None
+
+
+class LedgerSeriesChecker(ProjectChecker):
+    rules = {
+        OBS02: "ledger metric series out of sync with scheduler/metrics.py "
+               "registry (unregistered, undeclared, or non-literal name)",
+    }
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        registry = root / METRICS_REGISTRY
+        if not registry.is_file():
+            return  # partial tree (fixture dirs) — nothing to cross-check
+        registered = _registered_names(registry)
+        if registered is None:
+            yield Finding(
+                registry.as_posix(), 1, 0, OBS02,
+                "could not parse scheduler/metrics.py registrations for "
+                "cross-checking",
+            )
+            return
+        for path in sorted(root.rglob("*.py")):
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except (OSError, SyntaxError):
+                continue  # LINT01 reports unparseable files
+            yield from self._check_tree(path.as_posix(), tree, registered)
+
+    def _check_tree(
+        self, path: str, tree: ast.AST, registered: set[str]
+    ) -> Iterable[Finding]:
+        decl = _parse_decl(tree)
+        if decl is None:
+            return  # module emits no ledger series
+        declared, lineno = decl
+        if declared is None:
+            yield Finding(
+                path, lineno, 0, OBS02,
+                f"{DECL_NAME} must be a literal tuple of string constants "
+                "so OBS02 can cross-check it against scheduler/metrics.py",
+            )
+            return
+        for name in sorted(declared - registered):
+            yield Finding(
+                path, lineno, 0, OBS02,
+                f"{DECL_NAME} entry {name!r} is not registered in "
+                "scheduler/metrics.py — every observation on it would be "
+                "silently dropped",
+            )
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_series"
+                    and (node.args or node.keywords)):
+                continue
+            arg = node.args[0] if node.args else node.keywords[0].value
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                yield Finding(
+                    path, node.lineno, node.col_offset, OBS02,
+                    "_series() name must be a string literal so OBS02 can "
+                    f"cross-check it against {DECL_NAME}",
+                )
+            elif arg.value not in declared:
+                yield Finding(
+                    path, node.lineno, node.col_offset, OBS02,
+                    f"_series({arg.value!r}) emits a series not declared "
+                    f"in {DECL_NAME}",
+                )

@@ -182,6 +182,22 @@ class SchedulerServer:
                         self._send(400, "last must be an integer")
                         return
                     self._send(200, rec.dump(last), "application/json")
+                elif self.path.startswith("/debug/podlatency"):
+                    # pod latency ledger zpage: per-pod e2e decomposition;
+                    # ?last=N (recent completions) &slowest=K (worst e2e)
+                    from urllib.parse import parse_qs, urlparse
+
+                    ledger = server.scheduler.flight_recorder.pod_ledger
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        last = int(q.get("last", ["10"])[0])
+                        slowest = int(q.get("slowest", ["5"])[0])
+                    except ValueError:
+                        self._send(400, "last/slowest must be integers")
+                        return
+                    self._send(200, json.dumps(
+                        ledger.snapshot(last=last, slowest=slowest), indent=2
+                    ), "application/json")
                 elif self.path.startswith("/debug/traces"):
                     # OTLP-shaped span export (the /debug/traces zpage);
                     # ?last=N bounds to the most recent N root spans

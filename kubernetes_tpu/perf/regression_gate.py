@@ -1,0 +1,207 @@
+"""Mechanical perf-regression gate over BENCH artifacts (`make bench-gate`).
+
+Diffs the newest two bench artifacts in the repo root (or two explicit
+paths) row-by-row and FAILS (exit 1) when any throughput or SLI row
+regressed by more than the tolerance (default 10%):
+
+- throughput rows (unit "pods/s..."): regression = new < old * 0.9
+- latency keys  (sli_p50_s, sli_p99_s, trace_p50_s, trace_p99_s):
+  regression = new > old * 1.1
+- SLI pass flags (sli_p50_ok, sli_p99_ok): true -> false is a regression
+  outright — a blown target never hides inside the tolerance band
+
+When a row regresses and both artifacts carry the pod latency ledger's
+"segments" breakdown, the gate names the segment whose p50 delta explains
+the regression — the first question of any perf triage, answered
+mechanically.
+
+Artifacts come in three shapes, all accepted:
+- a raw JSON line (bench.py stdout saved to a file)
+- JSONL, one row per line (bench_suite.py stdout)
+- the round-runner wrapper {"n", "cmd", "rc", "tail"} where the real rows
+  are the JSON lines embedded in "tail" (the BENCH_r*.json files)
+
+Rows are matched by their "metric" name; only metrics present in BOTH
+artifacts are compared (a newly added row can't regress against nothing).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+TOLERANCE = 0.10
+LATENCY_KEYS = ("sli_p50_s", "sli_p99_s", "trace_p50_s", "trace_p99_s")
+OK_KEYS = ("sli_p50_ok", "sli_p99_ok")
+
+
+def _rows_from_obj(obj: object) -> list[dict]:
+    """Pull bench rows out of one parsed JSON object (row or wrapper)."""
+    rows: list[dict] = []
+    if not isinstance(obj, dict):
+        return rows
+    if "metric" in obj:
+        rows.append(obj)
+    tail = obj.get("tail")
+    if isinstance(tail, str):
+        rows.extend(_rows_from_text(tail))
+    return rows
+
+
+def _rows_from_text(text: str) -> list[dict]:
+    rows: list[dict] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rows.extend(_rows_from_obj(json.loads(line)))
+        except json.JSONDecodeError:
+            continue
+    return rows
+
+
+def load_rows(path: str) -> dict[str, dict]:
+    """{metric: row} from an artifact in any of the three shapes."""
+    with open(path) as f:
+        text = f.read()
+    rows = _rows_from_text(text)
+    if not rows:
+        # maybe one pretty-printed JSON object spanning lines
+        try:
+            rows = _rows_from_obj(json.loads(text))
+        except json.JSONDecodeError:
+            pass
+    out: dict[str, dict] = {}
+    for row in rows:
+        out[str(row["metric"])] = row  # later rows win (retry supersedes)
+    return out
+
+
+def newest_artifacts(root: str = ".") -> list[str]:
+    """BENCH_* artifacts, newest first by mtime (name as the tiebreak —
+    a fresh checkout stamps every artifact with the same mtime, and the
+    round-numbered names order correctly)."""
+    paths = [p for pat in ("BENCH_*.json", "BENCH_*.jsonl")
+             for p in glob.glob(os.path.join(root, pat))]
+    return sorted(paths, key=lambda p: (os.path.getmtime(p), p),
+                  reverse=True)
+
+
+def _num(row: dict, key: str):
+    v = row.get(key)
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+
+
+def _explain(old: dict, new: dict) -> str | None:
+    """Name the ledger segment whose p50 grew the most between the runs."""
+    so, sn = old.get("segments"), new.get("segments")
+    if not isinstance(so, dict) or not isinstance(sn, dict):
+        return None
+    worst, worst_delta = None, 0.0
+    for seg, q in sn.items():
+        if not isinstance(q, dict) or seg not in so:
+            continue
+        np50, op50 = q.get("p50"), so[seg].get("p50")
+        if isinstance(np50, (int, float)) and isinstance(op50, (int, float)):
+            delta = np50 - op50
+            if delta > worst_delta:
+                worst, worst_delta = seg, delta
+    if worst is None:
+        return None
+    return (f"segment '{worst}' explains it: p50 "
+            f"{so[worst]['p50']:.4f}s -> {sn[worst]['p50']:.4f}s "
+            f"(+{worst_delta:.4f}s)")
+
+
+def compare(old_rows: dict[str, dict], new_rows: dict[str, dict],
+            tolerance: float = TOLERANCE) -> list[str]:
+    """Regression messages (empty = gate passes)."""
+    failures: list[str] = []
+    for metric in sorted(set(old_rows) & set(new_rows)):
+        old, new = old_rows[metric], new_rows[metric]
+        checks: list[tuple[str, float, float, bool]] = []
+        unit = str(old.get("unit", ""))
+        if unit.startswith("pods/s"):
+            ov, nv = _num(old, "value"), _num(new, "value")
+            if ov is not None and nv is not None:
+                checks.append(("value", ov, nv, True))  # higher is better
+        for key in LATENCY_KEYS:
+            ov, nv = _num(old, key), _num(new, key)
+            if ov is not None and nv is not None:
+                checks.append((key, ov, nv, False))  # lower is better
+        for key, ov, nv, higher_better in checks:
+            if higher_better:
+                bad = nv < ov * (1.0 - tolerance)
+                arrow = f"{ov:g} -> {nv:g} ({(nv / ov - 1) * 100:+.1f}%)" \
+                    if ov else f"{ov:g} -> {nv:g}"
+            else:
+                bad = nv > ov * (1.0 + tolerance) and nv - ov > 1e-9
+                arrow = f"{ov:g}s -> {nv:g}s ({(nv / ov - 1) * 100:+.1f}%)" \
+                    if ov else f"{ov:g}s -> {nv:g}s"
+            if bad:
+                msg = f"{metric}.{key}: {arrow} exceeds {tolerance:.0%} tolerance"
+                why = _explain(old, new)
+                if why:
+                    msg += f"; {why}"
+                failures.append(msg)
+        for key in OK_KEYS:
+            if old.get(key) is True and new.get(key) is False:
+                msg = f"{metric}.{key}: SLI target newly blown (true -> false)"
+                why = _explain(old, new)
+                if why:
+                    msg += f"; {why}"
+                failures.append(msg)
+    return failures
+
+
+def run_gate(old_path: str, new_path: str,
+             tolerance: float = TOLERANCE) -> int:
+    old_rows, new_rows = load_rows(old_path), load_rows(new_path)
+    common = sorted(set(old_rows) & set(new_rows))
+    if not common:
+        print(f"bench-gate: no common metrics between {old_path} and "
+              f"{new_path}; nothing to compare (pass)")
+        return 0
+    failures = compare(old_rows, new_rows, tolerance)
+    if failures:
+        print(f"bench-gate: FAIL ({new_path} vs {old_path}, "
+              f"{len(common)} common rows)")
+        for msg in failures:
+            print(f"  REGRESSION {msg}")
+        return 1
+    print(f"bench-gate: PASS ({new_path} vs {old_path}, "
+          f"{len(common)} common rows within {tolerance:.0%})")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.perf.regression_gate",
+        description="Fail on >tolerance regression between two BENCH "
+                    "artifacts (newest two in the repo root by default)",
+    )
+    parser.add_argument("old", nargs="?", help="baseline artifact")
+    parser.add_argument("new", nargs="?", help="candidate artifact")
+    parser.add_argument("--tolerance", type=float, default=TOLERANCE)
+    parser.add_argument("--root", default=".",
+                        help="where to look for BENCH_* artifacts")
+    args = parser.parse_args(argv)
+
+    old_path, new_path = args.old, args.new
+    if old_path is None or new_path is None:
+        arts = newest_artifacts(args.root)
+        if len(arts) < 2:
+            print("bench-gate: fewer than two BENCH_* artifacts found; "
+                  "nothing to compare (pass)")
+            return 0
+        new_path, old_path = arts[0], arts[1]
+    return run_gate(old_path, new_path, tolerance=args.tolerance)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

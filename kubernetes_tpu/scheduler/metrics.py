@@ -200,6 +200,18 @@ class SchedulerMetrics:
             "Recorded p50/p99 of pod scheduling SLI duration",
             labels=("quantile",), stability="BETA",
         )
+        # pod latency ledger (per-pod e2e decomposition; emitted by
+        # scheduler/tpu/podlatency.py — OBS02 keeps LEDGER_SERIES in sync)
+        self.pod_e2e_latency = r.histogram(
+            "scheduler_pod_e2e_latency_seconds",
+            "Per-pod end-to-end scheduling latency by ledger segment",
+            labels=("segment",),
+        )
+        self.pod_e2e_latency_quantiles = r.gauge(
+            "scheduler_pod_e2e_latency_quantile_seconds",
+            "Recorded p50/p99 of per-pod latency by ledger segment",
+            labels=("segment", "quantile"), stability="BETA",
+        )
         # event recorder (satellite: spill/aggregation visibility)
         self.events_total = r.counter(
             "scheduler_events_total",

@@ -31,8 +31,17 @@ from .framework.runtime import Framework
 from .nodeinfo import NodeInfo, PodInfo
 from .queue.scheduling_queue import QueuedPodInfo
 from ..utils.logging import get_logger
+from ..utils.tracing import Span, threshold_log_exporter
 
 _log = get_logger("scheduler")
+
+# slow-cycle diagnosis (utiltrace LogIfLong, schedule_one.go:570-571):
+# steps are span events, formatted + logged only when the cycle breaches
+# the threshold; logs to the legacy "kubernetes_tpu.trace" logger so
+# existing scrapers keep matching (the utils.trace shim is deprecated —
+# the ledger's exemplar links want ONE tracer surface)
+_SLOW_CYCLE_THRESHOLD_S = 0.1
+_slow_cycle_export = threshold_log_exporter(_SLOW_CYCLE_THRESHOLD_S)
 
 MIN_FEASIBLE_NODES_TO_FIND = 100  # schedule_one.go:56
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # schedule_one.go:62
@@ -373,8 +382,6 @@ class ScheduleOneLoop:
         return True
 
     def schedule_pod_info(self, qpi: QueuedPodInfo) -> None:
-        from ..utils.trace import Trace
-
         pod = qpi.pod
         fw = self.framework_for_pod(pod)
         if fw is None:
@@ -386,28 +393,35 @@ class ScheduleOneLoop:
         # whole-gang cycle (ScheduleOne, schedule_one.go:77: SchedulingGroup
         # + GenericWorkload gate routes to scheduleOnePodGroup)
         if pod.spec.scheduling_group is not None and self.pod_group_cycles:
-            trace = Trace("SchedulingPodGroup", pod=pod.meta.key)
+            sp = Span(name="SchedulingPodGroup", start=_time.perf_counter(),
+                      attributes={"pod": pod.meta.key})
             self.schedule_pod_group(qpi, fw)
-            trace.log_if_long(0.1)
+            sp.end = _time.perf_counter()
+            _slow_cycle_export(sp)
             return
 
-        # slow-cycle diagnosis (utiltrace LogIfLong, schedule_one.go:570-571):
-        # steps logged only when the cycle breaches 100ms
-        trace = Trace("Scheduling", pod=pod.meta.key,
-                      scheduler=fw.profile_name)
+        sp = Span(name="Scheduling", start=_time.perf_counter(),
+                  attributes={"pod": pod.meta.key,
+                              "scheduler": fw.profile_name})
+        # ledger: a host-path cycle is this pod's "wave" admission
+        ledger = self.recorder.pod_ledger
+        ledger.stamp(pod.meta.key, "wave_admission")
         state = CycleState()
         scheduling_cycle = self.queue.moved_count
         result, status = self._scheduling_cycle(state, fw, qpi)
-        trace.step("Computing pod placement done" if status.is_success
-                   else "Scheduling attempt failed")
+        sp.event("Computing pod placement done" if status.is_success
+                 else "Scheduling attempt failed")
         if not status.is_success:
             self._handle_scheduling_failure(fw, qpi, status, scheduling_cycle)
-            trace.step("Failure handled (requeue + condition)")
-            trace.log_if_long(0.1)
+            sp.event("Failure handled (requeue + condition)")
+            sp.end = _time.perf_counter()
+            _slow_cycle_export(sp)
             return
+        ledger.stamp(pod.meta.key, "kernel_verdict")
         self._dispatch_binding(state, fw, qpi, result)
-        trace.step("Binding dispatched")
-        trace.log_if_long(0.1)
+        sp.event("Binding dispatched")
+        sp.end = _time.perf_counter()
+        _slow_cycle_export(sp)
 
     def _dispatch_binding(self, state, fw: Framework, qpi: QueuedPodInfo,
                           result: ScheduleResult) -> None:
@@ -479,6 +493,7 @@ class ScheduleOneLoop:
                     break
                 wave_algo = algo
                 wave.append(qpi)
+                self.recorder.pod_ledger.stamp(pod.meta.key, "wave_admission")
                 breaker = getattr(algo, "breaker", None)
                 if (breaker is not None and len(wave) >= PROBE_WAVE_PODS
                         and breaker.probing()):
@@ -648,7 +663,14 @@ class ScheduleOneLoop:
                     record.cache_exports = exported
                 invalidated = False
                 batch: list[tuple] = []
+                ledger = rec.pod_ledger
+                wave_id = record.wave_id if record is not None else None
                 for qpi, host in zip(wave, hosts):
+                    if host is not None and not invalidated:
+                        # kernel picked this pod's node; the wave_id is the
+                        # exemplar link to the wave/<id> trace span
+                        ledger.stamp(qpi.pod.meta.key, "kernel_verdict",
+                                     wave_id=wave_id)
                     if invalidated or host is None:
                         # host=None re-runs reproduce the FitError (no rng
                         # draws, no state change — safe under a live
@@ -783,6 +805,8 @@ class ScheduleOneLoop:
         if not ready:
             return
         bindings = [(q.pod.meta.key, r.suggested_host) for _, _, q, r in ready]
+        for key, _host in bindings:
+            self.recorder.pod_ledger.stamp(key, "bind_dispatch")
 
         if self.api_cacher is not None:
             # the dispatcher worker ONLY parks the outcome; all queue/cache/
@@ -1161,6 +1185,7 @@ class ScheduleOneLoop:
             self._handle_binding_failure(state, fw, qpi, host, st)
             return
 
+        self.recorder.pod_ledger.stamp(pod.meta.key, "bind_dispatch")
         st = self._bind(state, fw, pod, host)
         if not st.is_success and not st.is_skip:
             self._handle_binding_failure(state, fw, qpi, host, st)
@@ -1172,6 +1197,10 @@ class ScheduleOneLoop:
                         correlation: str | None = None) -> None:
         """Post-bind tail shared by the per-pod cycle and the wave batch."""
         pod = qpi.pod
+        # ledger: the bind is durable — close the entry (status_ack, if a
+        # kubelet reports the pod Running, lands on the retained entry later)
+        self.recorder.pod_ledger.stamp(pod.meta.key, "bind_commit")
+        self.recorder.pod_ledger.complete(pod.meta.key)
         fw.run_post_bind_plugins(state, pod, host)
         # pod leaves the cycle for good: stop in-flight event tracking only now
         # (a done() before bind would drop events needed on bind failure)

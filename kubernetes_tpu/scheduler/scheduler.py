@@ -11,7 +11,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from ..api.resource import ResourceNames
-from ..api.types import DEFAULT_SCHEDULER_NAME, Node, Pod
+from ..api.types import DEFAULT_SCHEDULER_NAME, RUNNING, Node, Pod
 from ..client.informer import InformerFactory
 from ..store.store import ADDED, DELETED, MODIFIED, Store
 from .cache import Cache, Snapshot
@@ -271,6 +271,7 @@ class Scheduler:
 
     def _on_pod_event(self, etype: str, old: Pod | None, new: Pod) -> None:
         gk = self._group_key(new)
+        ledger = self.flight_recorder.pod_ledger
         if etype == ADDED:
             if new.is_scheduled:
                 if not self.cache.is_assumed_pod(new):
@@ -283,9 +284,14 @@ class Scheduler:
                     ClusterEvent(ev.ASSIGNED_POD, ev.ADD), None, new
                 )
             else:
+                # ledger edges: informer delivered the pod, then it entered
+                # the scheduling queue (the informer segment spans PodInfo
+                # construction + queue admission)
+                ledger.stamp(new.meta.key, "watch_arrival")
                 if gk:
                     self.cache.pod_group_states.pod_added(gk, new.meta.key)
                 self.queue.add(new, PodInfo(new, self.names))
+                ledger.stamp(new.meta.key, "queue_admission")
                 self.queue.move_all_to_active_or_backoff(
                     ClusterEvent(ev.UNSCHEDULED_POD, ev.ADD), None, new
                 )
@@ -304,6 +310,10 @@ class Scheduler:
                 else:
                     # update of a placed pod (labels/scale-down) changes the
                     # node planes outside the wave pipeline's writeback
+                    if (old is not None and old.status.phase != RUNNING
+                            and new.status.phase == RUNNING):
+                        # kubelet reported the pod up: the ledger's final edge
+                        ledger.stamp(new.meta.key, "status_ack")
                     self._mark_external()
                     self.cache.update_pod(old, new)
                     action = self._pod_update_actions(old, new)
@@ -323,6 +333,7 @@ class Scheduler:
                 self.cache.pod_group_states.pod_removed(gk, new.meta.key)
             if self.metrics is not None and hasattr(self.metrics, "forget_pod"):
                 self.metrics.forget_pod(new.meta.key)
+            ledger.forget(new.meta.key)
             if new.is_scheduled:
                 self._mark_external()
                 self.cache.remove_pod(new)

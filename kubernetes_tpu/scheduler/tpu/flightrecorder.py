@@ -36,6 +36,7 @@ from dataclasses import dataclass, field
 
 from ...utils import faultinject
 from ...utils.tracing import Tracer
+from .podlatency import PodLatencyLedger
 
 # loop-level pipeline phases (the phase_profile bench.py reports)
 LOOP_PHASES = ("snapshot", "kernel", "finish", "bind", "pump", "events",
@@ -128,6 +129,8 @@ class FlightRecorder:
                  profile_seconds: float = DEFAULT_PROFILE_S):
         self.tracer = tracer or Tracer("flight-recorder")  # no-op by default
         self.metrics = metrics
+        # per-pod e2e latency decomposition (README "Observability")
+        self.pod_ledger = PodLatencyLedger(metrics=metrics)
         self.slow_wave_deadline_s = slow_wave_deadline_s or None
         self.profile_seconds = profile_seconds
         # cumulative phase stopwatches (the dicts bench.py diffs)
@@ -313,6 +316,8 @@ class FlightRecorder:
                 m.wave_completed(rec)
             if hasattr(m, "update_sli_quantiles"):
                 m.update_sli_quantiles()
+        # ledger quantile gauges refresh once per wave, not per pod
+        self.pod_ledger.update_gauges()
         return rec
 
     def _capture_slow_wave(self, rec: WaveRecord) -> None:
@@ -379,6 +384,7 @@ class FlightRecorder:
             },
             "wave_totals": {k: round(v, 6)
                             for k, v in self.wave_snapshot().items()},
+            "pod_latency": self.pod_ledger.snapshot(slowest=8),
             "records": [r.to_dict() for r in self.records(last)],
         }, indent=2)
 

@@ -25,6 +25,7 @@ fails (or passes) identically run after run.
 from __future__ import annotations
 
 import dataclasses
+import math
 import random
 import time
 
@@ -198,7 +199,14 @@ class ArrivalTrace:
     windows — the millions-of-users load shape (ROADMAP item 3) instead of
     batch-dumping pods. `arrivals()` returns sorted virtual timestamps;
     the same seed replays the same trace, independent of everything else
-    (its rng stream is its own, not the fault registry's)."""
+    (its rng stream is its own, not the fault registry's).
+
+    `shape` selects the rate curve: "burst" (the default — identical draws
+    to the original trace, the chaos soaks replay on it), "poisson"
+    (constant rate), or "diurnal" (sinusoidal day-curve over
+    `diurnal_period` virtual seconds). The rng stream derivation is shared,
+    so the same seed at a different shape is a different — but equally
+    replayable — trace."""
 
     seed: int
     pods: int = 96
@@ -206,14 +214,24 @@ class ArrivalTrace:
     burst_every: float = 0.5   # a burst window opens each period...
     burst_len: float = 0.1     # ...and lasts this long...
     burst_factor: float = 4.0  # ...at this rate multiple
+    shape: str = "burst"       # "burst" | "poisson" | "diurnal"
+    diurnal_period: float = 2.0  # virtual seconds per diurnal cycle
 
     def arrivals(self) -> list[float]:
         rng = random.Random(f"{self.seed}:arrival-trace")
         out: list[float] = []
         t = 0.0
         while len(out) < self.pods:
-            in_burst = (t % self.burst_every) < self.burst_len
-            lam = self.rate * (self.burst_factor if in_burst else 1.0)
+            if self.shape == "poisson":
+                lam = self.rate
+            elif self.shape == "diurnal":
+                # day-curve: rate swings between 25% and 175% of base
+                lam = self.rate * (1.0 + 0.75 * math.sin(
+                    2.0 * math.pi * t / self.diurnal_period))
+                lam = max(lam, self.rate * 0.25)
+            else:  # "burst" — bit-identical to the original formula
+                in_burst = (t % self.burst_every) < self.burst_len
+                lam = self.rate * (self.burst_factor if in_burst else 1.0)
             t += rng.expovariate(lam)
             out.append(t)
         return out

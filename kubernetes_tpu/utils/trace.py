@@ -10,16 +10,21 @@ when the perf problem does.
 This module is a thin shim over `utils.tracing`: a Trace IS a Span (steps
 are span events, fields are span attributes) and log_if_long runs it
 through `tracing.threshold_log_exporter`, which owns the legacy line
-format. The two surfaces deliberately coexist: Trace mirrors the
-reference's utiltrace call sites (threshold-gated logging, no nesting),
-while Tracer/Span is the component-base/tracing role (always-on trees,
-pluggable exporters). Only the formatting/storage is shared.
+format.
+
+DEPRECATED: the scheduler now uses `utils.tracing` Span +
+`threshold_log_exporter` directly (one tracer surface, so the pod latency
+ledger's exemplar links resolve against the same span tree the flight
+recorder exports). Constructing a Trace emits a DeprecationWarning; new
+call sites should build a Span and run it through
+`threshold_log_exporter` as schedule_one.py does.
 """
 
 from __future__ import annotations
 
 import logging
 import time
+import warnings
 
 from .tracing import Span, threshold_log_exporter
 
@@ -32,6 +37,11 @@ class Trace:
     __slots__ = ("span",)
 
     def __init__(self, name: str, **fields):
+        warnings.warn(
+            "utils.trace.Trace is deprecated; use utils.tracing Span + "
+            "threshold_log_exporter (one tracer surface)",
+            DeprecationWarning, stacklevel=2,
+        )
         self.span = Span(name=name, start=time.perf_counter(),
                          attributes=dict(fields))
 

@@ -54,26 +54,44 @@ class TestEventRecorder:
 
 
 class TestSlowCycleTrace:
-    def test_slow_cycle_logs_steps(self, caplog):
-        from kubernetes_tpu.utils.trace import Trace
+    """Slow-cycle diagnosis now rides utils.tracing directly (one tracer
+    surface); the utils.trace shim only survives as a deprecated alias."""
 
-        t = Trace("Scheduling", pod="default/slow")
-        t.step("step one")
+    def test_slow_cycle_logs_steps(self, caplog):
+        from kubernetes_tpu.utils.tracing import Span, threshold_log_exporter
+
+        sp = Span(name="Scheduling", start=time.perf_counter(),
+                  attributes={"pod": "default/slow"})
+        sp.event("step one")
         time.sleep(0.12)
-        t.step("step two")
+        sp.event("step two")
+        sp.end = time.perf_counter()
         with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
-            assert t.log_if_long(0.1)
+            assert threshold_log_exporter(0.1)(sp)
         assert "Scheduling" in caplog.text
         assert "step two" in caplog.text
 
     def test_fast_cycle_stays_silent(self, caplog):
-        from kubernetes_tpu.utils.trace import Trace
+        from kubernetes_tpu.utils.tracing import Span, threshold_log_exporter
 
-        t = Trace("Scheduling", pod="default/fast")
+        sp = Span(name="Scheduling", start=time.perf_counter(),
+                  attributes={"pod": "default/fast"})
+        sp.event("quick")
+        sp.end = time.perf_counter()
+        with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+            assert not threshold_log_exporter(0.1)(sp)
+        assert caplog.text == ""
+
+    def test_trace_shim_is_deprecated_but_compatible(self, caplog):
+        import pytest
+
+        with pytest.warns(DeprecationWarning):
+            from kubernetes_tpu.utils.trace import Trace
+
+            t = Trace("Scheduling", pod="default/shim")
         t.step("quick")
         with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
-            assert not t.log_if_long(0.1)
-        assert caplog.text == ""
+            assert not t.log_if_long(10.0)
 
 
 class TestCondvarPermit:
@@ -271,7 +289,7 @@ class TestFlightRecorderZpage:
             assert code == 200 and ctype == "application/json"
             payload = json.loads(body)
             assert set(payload) == {"summary", "phase_totals",
-                                    "wave_totals", "records"}
+                                    "wave_totals", "pod_latency", "records"}
             assert payload["records"], "scheduled waves must show up"
             assert len(payload["records"]) <= 2
 

@@ -1,0 +1,452 @@
+"""Pod latency ledger: quantile golden vs numpy, ledger semantics, the
+ledger-on/off bit-compat golden, trace-bench determinism, and the
+regression gate's mechanics (including the synthetically-slowed-segment
+failure the gate exists to catch)."""
+
+from __future__ import annotations
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.perf.regression_gate import (
+    compare,
+    load_rows,
+    run_gate,
+)
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
+from kubernetes_tpu.scheduler.tpu import podlatency
+from kubernetes_tpu.scheduler.tpu.podlatency import (
+    EDGES,
+    LEDGER_SERIES,
+    SEGMENT_NAMES,
+    PodLatencyLedger,
+    StreamingQuantile,
+)
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_node, make_pod
+
+# ------------------------------------------------------- streaming quantile
+
+
+class TestStreamingQuantileGolden:
+    """The ledger's estimator must agree with numpy's inverted-CDF
+    percentile — the definition the README promises — on fixed seeds."""
+
+    @pytest.mark.parametrize("seed", [0, 7, 1234])
+    @pytest.mark.parametrize("n", [1, 5, 100, 1000])
+    def test_matches_numpy_inverted_cdf(self, seed, n):
+        rng = random.Random(seed)
+        values = [rng.expovariate(3.0) for _ in range(n)]
+        est = StreamingQuantile(window=max(n, 2))
+        for v in values:
+            est.add(v)
+        for q in (0.5, 0.9, 0.99):
+            expected = float(np.percentile(values, q * 100,
+                                           method="inverted_cdf"))
+            assert est.quantile(q) == expected
+
+    def test_window_compression_is_deterministic(self):
+        """Past the window, the oldest half is dropped — quantiles stay
+        exact over the independently-simulated retained slice."""
+        rng = random.Random(42)
+        values = [rng.expovariate(1.0) for _ in range(1000)]
+        est = StreamingQuantile(window=64)
+        retained: list[float] = []
+        for v in values:
+            est.add(v)
+            retained.append(v)
+            if len(retained) > 64:
+                del retained[:32]
+        assert est.n() == len(retained)
+        assert est.total_n == 1000
+        for q in (0.5, 0.99):
+            expected = float(np.percentile(retained, q * 100,
+                                           method="inverted_cdf"))
+            assert est.quantile(q) == expected
+
+    def test_empty_returns_none(self):
+        assert StreamingQuantile().quantile(0.5) is None
+
+
+# ----------------------------------------------------------------- ledger
+
+
+def stamp_all(ledger, key, t0=100.0, wave_id=None, clock=None):
+    """Stamp every edge at exact binary-fraction offsets via a fake clock."""
+    offsets = {  # edge -> perf_counter value (all exact in float64)
+        "watch_arrival": t0,
+        "queue_admission": t0 + 0.5,
+        "wave_admission": t0 + 1.0,
+        "kernel_verdict": t0 + 1.25,
+        "bind_dispatch": t0 + 1.375,
+        "bind_commit": t0 + 1.5,
+    }
+    for edge in EDGES[:-1]:
+        clock.now = offsets[edge]
+        ledger.stamp(key, edge, wave_id=wave_id)
+    return offsets
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = FakeClock()
+    monkeypatch.setattr(podlatency.time, "perf_counter", c)
+    return c
+
+
+class TestLedger:
+    def test_exact_segment_decomposition(self, clock):
+        ledger = PodLatencyLedger()
+        stamp_all(ledger, "default/p0", wave_id=3, clock=clock)
+        entry = ledger.complete("default/p0")
+        assert entry.segments == {
+            "informer": 0.5,
+            "queue_wait": 0.5,
+            "kernel": 0.25,
+            "bind_dispatch": 0.125,
+            "bind_commit": 0.125,
+            "e2e": 1.5,
+        }
+        d = entry.to_dict()
+        assert d["wave_id"] == 3
+        assert d["span"] == "wave/3"  # exemplar link to the wave span
+
+    def test_first_wins_and_last_wins_edges(self, clock):
+        ledger = PodLatencyLedger()
+        clock.now = 10.0
+        ledger.stamp("default/p0", "watch_arrival")
+        clock.now = 20.0
+        ledger.stamp("default/p0", "watch_arrival")  # requeue: must not move
+        ledger.stamp("default/p0", "wave_admission")
+        clock.now = 30.0
+        ledger.stamp("default/p0", "wave_admission")  # retry: must move
+        entry = ledger._open["default/p0"]
+        assert entry.stamps["watch_arrival"] == 10.0
+        assert entry.stamps["wave_admission"] == 30.0
+
+    def test_late_status_ack_lands_on_retained_entry(self, clock):
+        metrics = SchedulerMetrics()
+        ledger = PodLatencyLedger(metrics=metrics)
+        stamp_all(ledger, "default/p0", clock=clock)
+        ledger.complete("default/p0")
+        clock.now = 102.0  # bind_commit was at 101.5
+        ledger.stamp("default/p0", "status_ack")
+        (entry,) = ledger._completed
+        assert entry.segments["status_ack"] == 0.5
+        hist = metrics.registry.get(LEDGER_SERIES[0])
+        assert hist.count("status_ack") == 1
+
+    def test_histogram_and_gauges_land(self, clock):
+        metrics = SchedulerMetrics()
+        ledger = PodLatencyLedger(metrics=metrics)
+        stamp_all(ledger, "default/p0", clock=clock)
+        ledger.complete("default/p0")
+        hist = metrics.registry.get(LEDGER_SERIES[0])
+        for seg in ("informer", "queue_wait", "kernel", "e2e"):
+            assert hist.count(seg) == 1
+        ledger.update_gauges()
+        gauge = metrics.registry.get(LEDGER_SERIES[1])
+        assert gauge.get("e2e", "p50") == 1.5
+        assert gauge.get("kernel", "p99") == 0.25
+
+    def test_forget_drops_open_entry(self, clock):
+        ledger = PodLatencyLedger()
+        clock.now = 1.0
+        ledger.stamp("default/p0", "watch_arrival")
+        ledger.forget("default/p0")
+        assert ledger.complete("default/p0") is None
+
+    def test_open_cap_sheds_oldest_first(self, clock):
+        ledger = PodLatencyLedger(open_cap=4)
+        for i in range(6):
+            clock.now = float(i)
+            ledger.stamp(f"default/p{i}", "watch_arrival")
+        assert len(ledger._open) == 4
+        assert ledger.dropped_open == 2
+        assert "default/p0" not in ledger._open  # oldest shed first
+        assert "default/p5" in ledger._open
+
+    def test_disabled_ledger_is_inert(self, clock):
+        ledger = PodLatencyLedger()
+        ledger.enabled = False
+        clock.now = 1.0
+        ledger.stamp("default/p0", "watch_arrival")
+        assert ledger.complete("default/p0") is None
+        assert ledger.summary()["pods_completed"] == 0
+
+    def test_snapshot_last_and_slowest(self, clock):
+        ledger = PodLatencyLedger()
+        for i, t0 in enumerate([100.0, 200.0, 300.0]):
+            key = f"default/p{i}"
+            stamp_all(ledger, key, t0=t0, clock=clock)
+            if i == 1:  # make p1 the slowest e2e
+                clock.now = t0 + 9.0
+                ledger.stamp(key, "bind_commit")
+            ledger.complete(key)
+        snap = ledger.snapshot(last=2, slowest=1)
+        assert [e["pod"] for e in snap["last"]] == ["default/p1",
+                                                    "default/p2"]
+        assert snap["slowest"][0]["pod"] == "default/p1"
+        assert snap["summary"]["pods_completed"] == 3
+        assert set(snap["summary"]["segments"]) <= set(SEGMENT_NAMES)
+
+    def test_completed_ring_bounded(self, clock):
+        ledger = PodLatencyLedger(capacity=2)
+        for i in range(5):
+            key = f"default/p{i}"
+            stamp_all(ledger, key, t0=10.0 * i, clock=clock)
+            ledger.complete(key)
+        assert len(ledger._completed) == 2
+        assert ledger.completed_total == 5
+
+
+# -------------------------------------------------- ledger on/off golden
+
+
+class TestLedgerBitCompat:
+    def test_placements_identical_ledger_on_vs_off(self):
+        """The ledger consumes no rng and influences no decision: the same
+        seeded wave workload places identically with it on (production
+        default) and off."""
+
+        def run(ledger_on: bool) -> dict[str, str]:
+            store = Store()
+            for i in range(8):
+                store.create(make_node(f"n{i}", cpu="4", mem="8Gi",
+                                       zone=f"z{i % 2}"))
+            sched = Scheduler(
+                store,
+                profiles=[Profile(backend="tpu", wave_size=16)],
+                metrics=SchedulerMetrics(),
+                seed=11,
+            )
+            sched.flight_recorder.pod_ledger.enabled = ledger_on
+            sched.start()
+            for i in range(24):
+                kind = i % 3
+                cpu, mem = [("1", "1Gi"), ("900m", "900Mi"),
+                            ("800m", "800Mi")][kind]
+                store.create(make_pod(f"g{i:02d}", cpu=cpu, mem=mem,
+                                      labels={"app": "abc"[kind]}))
+            sched.pump()
+            sched.schedule_pending()
+            return {p.meta.key: p.spec.node_name for p in store.pods()}
+
+        on, off = run(True), run(False)
+        assert on == off
+        assert any(on.values())  # the workload actually scheduled
+
+    def test_ledger_populated_under_wave_path(self):
+        """With the ledger on (default), the wave pipeline completes an
+        entry per bound pod, with every pipeline segment present."""
+        store = Store()
+        for i in range(4):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        sched = Scheduler(
+            store,
+            profiles=[Profile(backend="tpu", wave_size=8)],
+            metrics=SchedulerMetrics(),
+            seed=3,
+        )
+        sched.start()
+        for i in range(10):
+            store.create(make_pod(f"w{i}", cpu="500m", mem="256Mi"))
+        sched.pump()
+        sched.schedule_pending()
+        ledger = sched.flight_recorder.pod_ledger
+        bound = sum(1 for p in store.pods() if p.spec.node_name)
+        assert bound == 10
+        assert ledger.completed_total == bound
+        segs = ledger.segment_quantiles()
+        for name in ("informer", "queue_wait", "kernel", "bind_commit",
+                     "e2e"):
+            assert segs[name]["n"] == bound
+
+
+# ----------------------------------------------- trace bench determinism
+
+
+class TestTraceBenchDeterminism:
+    def test_same_seed_same_sli_rows(self):
+        """Two runs at the same seed produce identical deterministic rows
+        (virtual-time SLI — satellite contract for `bench.py --trace`)."""
+        from kubernetes_tpu.perf.trace_bench import (
+            DETERMINISTIC_KEYS,
+            run_trace_bench,
+        )
+
+        rows = [run_trace_bench(shape="poisson", seed=7, pods=120)
+                for _ in range(2)]
+        a, b = [{k: r[k] for k in DETERMINISTIC_KEYS} for r in rows]
+        assert a == b
+        assert rows[0]["scheduled"] == 120
+        assert rows[0]["sli_p50_ok"] and rows[0]["sli_p99_ok"]
+        # the ledger's wall-clock breakdown rides along as diagnostics
+        assert rows[0]["segments"]["e2e"]["n"] == 120
+
+    def test_different_shapes_are_different_traces(self):
+        from kubernetes_tpu.testing.chaos import ArrivalTrace
+
+        base = ArrivalTrace(seed=7, pods=50)
+        assert base.arrivals() == ArrivalTrace(seed=7, pods=50,
+                                               shape="burst").arrivals()
+        poisson = ArrivalTrace(seed=7, pods=50, shape="poisson").arrivals()
+        diurnal = ArrivalTrace(seed=7, pods=50, shape="diurnal").arrivals()
+        assert poisson != base.arrivals()
+        assert diurnal != poisson
+        # replayable: same seed + shape -> same trace
+        assert poisson == ArrivalTrace(seed=7, pods=50,
+                                       shape="poisson").arrivals()
+
+
+# -------------------------------------------------------- regression gate
+
+
+BASE_ROW = {
+    "metric": "trace_sli_poisson",
+    "value": 0.15,
+    "unit": "s (virtual p50)",
+    "trace_p50_s": 0.15,
+    "trace_p99_s": 0.55,
+    "sli_p50_ok": True,
+    "sli_p99_ok": True,
+    "segments": {
+        "kernel": {"p50": 0.010, "p99": 0.050, "n": 200},
+        "queue_wait": {"p50": 0.001, "p99": 0.004, "n": 200},
+    },
+}
+
+THROUGHPUT_ROW = {
+    "metric": "scheduling_throughput_basic_5000",
+    "value": 300.0,
+    "unit": "pods/s",
+    "sli_p99_s": 12.0,
+}
+
+
+def write_artifact(path, *rows):
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(path)
+
+
+class TestRegressionGate:
+    def test_self_diff_passes(self, tmp_path):
+        art = write_artifact(tmp_path / "BENCH_a.json", BASE_ROW,
+                             THROUGHPUT_ROW)
+        assert run_gate(art, art) == 0
+
+    def test_within_tolerance_passes(self, tmp_path):
+        old = write_artifact(tmp_path / "BENCH_old.json", THROUGHPUT_ROW)
+        new_row = dict(THROUGHPUT_ROW, value=280.0)  # -6.7%
+        new = write_artifact(tmp_path / "BENCH_new.json", new_row)
+        assert run_gate(old, new) == 0
+
+    def test_throughput_regression_fails(self, tmp_path, capsys):
+        old = write_artifact(tmp_path / "BENCH_old.json", THROUGHPUT_ROW)
+        new_row = dict(THROUGHPUT_ROW, value=250.0)  # -16.7%
+        new = write_artifact(tmp_path / "BENCH_new.json", new_row)
+        assert run_gate(old, new) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_slowed_segment_fails_and_is_named(self, tmp_path, capsys):
+        """The acceptance demo: synthetically slow the kernel segment,
+        inflating trace_p99_s — the gate fails AND names the segment."""
+        old = write_artifact(tmp_path / "BENCH_old.json", BASE_ROW)
+        slowed = json.loads(json.dumps(BASE_ROW))  # deep copy
+        slowed["trace_p99_s"] = 1.2   # > 0.55 * 1.1
+        slowed["segments"]["kernel"] = {"p50": 0.450, "p99": 0.900, "n": 200}
+        new = write_artifact(tmp_path / "BENCH_new.json", slowed)
+        assert run_gate(old, new) == 1
+        out = capsys.readouterr().out
+        assert "trace_p99_s" in out
+        assert "segment 'kernel'" in out  # the delta explanation
+
+    def test_blown_sli_flag_fails_outside_tolerance_band(self, tmp_path):
+        old = write_artifact(tmp_path / "BENCH_old.json", BASE_ROW)
+        blown = dict(BASE_ROW, sli_p99_ok=False)
+        new = write_artifact(tmp_path / "BENCH_new.json", blown)
+        assert run_gate(old, new) == 1
+
+    def test_no_common_metrics_passes(self, tmp_path):
+        old = write_artifact(tmp_path / "BENCH_old.json", THROUGHPUT_ROW)
+        new = write_artifact(tmp_path / "BENCH_new.json", BASE_ROW)
+        assert run_gate(old, new) == 0
+
+    def test_loads_wrapper_artifact(self, tmp_path):
+        """BENCH_r*.json shape: rows embedded as JSON lines in 'tail'."""
+        tail = "noise\n" + json.dumps(THROUGHPUT_ROW) + "\nmore noise\n"
+        wrapper = {"n": 5, "cmd": "python bench.py", "rc": 0, "tail": tail}
+        p = tmp_path / "BENCH_r99.json"
+        p.write_text(json.dumps(wrapper, indent=2))
+        rows = load_rows(str(p))
+        assert rows["scheduling_throughput_basic_5000"]["value"] == 300.0
+
+    def test_loads_jsonl_artifact(self, tmp_path):
+        art = write_artifact(tmp_path / "BENCH_SUITE.jsonl", BASE_ROW,
+                             THROUGHPUT_ROW)
+        rows = load_rows(art)
+        assert set(rows) == {"trace_sli_poisson",
+                             "scheduling_throughput_basic_5000"}
+
+    def test_compare_improvement_never_fails(self):
+        old = {"m": dict(THROUGHPUT_ROW, metric="m")}
+        new = {"m": dict(THROUGHPUT_ROW, metric="m", value=400.0)}
+        assert compare(old, new) == []
+
+
+# ------------------------------------------------------------------ zpage
+
+
+class TestPodLatencyZpage:
+    def test_served_with_params(self):
+        import urllib.error
+        import urllib.request
+
+        from kubernetes_tpu.cmd.scheduler import SchedulerServer
+        from kubernetes_tpu.config.types import SchedulerConfiguration
+
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        for i in range(6):
+            store.create(make_pod(f"z{i}", cpu="500m", mem="256Mi"))
+        cfg = SchedulerConfiguration()
+        cfg.profiles[0].backend = "tpu"
+        cfg.profiles[0].wave_size = 4
+        server = SchedulerServer(store, cfg)
+        port = server.serve(0)
+        try:
+            server.scheduler.start()
+            server.scheduler.pump()
+            server.scheduler.schedule_pending()
+
+            url = (f"http://127.0.0.1:{port}"
+                   "/debug/podlatency?last=2&slowest=1")
+            with urllib.request.urlopen(url) as r:
+                assert r.status == 200
+                assert r.headers.get("Content-Type") == "application/json"
+                payload = json.loads(r.read())
+            assert payload["summary"]["pods_completed"] == 6
+            assert len(payload["last"]) == 2
+            assert len(payload["slowest"]) == 1
+            assert "segments" in payload["last"][0]
+
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/podlatency?last=abc")
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            server.shutdown()

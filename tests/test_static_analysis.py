@@ -14,6 +14,7 @@ from pathlib import Path
 from kubernetes_tpu.analysis import (
     FaultPointChecker,
     JitPurityChecker,
+    LedgerSeriesChecker,
     LockDisciplineChecker,
     RegistrySyncChecker,
     RetryDisciplineChecker,
@@ -722,6 +723,100 @@ def fire(point):
     def test_repo_fire_sites_in_sync(self):
         """Every fire() call in the shipped tree names a declared point."""
         assert list(FaultPointChecker().check_project(PKG)) == []
+
+
+# ------------------------------------------------------------------ OBS02
+
+
+METRICS_REGISTRY_SRC = """\
+class SchedulerMetrics:
+    def __init__(self):
+        r = self.registry
+        self.pod_e2e_latency = r.histogram(
+            "scheduler_pod_e2e_latency_seconds", "help", labels=("segment",))
+        self.quantiles = r.gauge(
+            "scheduler_pod_e2e_latency_quantile_seconds", "help",
+            labels=("segment", "quantile"))
+"""
+
+
+def write_ledger_tree(root, ledger_src, registry=METRICS_REGISTRY_SRC):
+    p = root / "scheduler/metrics.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(registry)
+    c = root / "scheduler/tpu/podlatency.py"
+    c.parent.mkdir(parents=True, exist_ok=True)
+    c.write_text(textwrap.dedent(ledger_src))
+    return root
+
+
+class TestLedgerSeriesSync:
+    def test_declared_and_registered_clean(self, tmp_path):
+        write_ledger_tree(tmp_path, """
+            LEDGER_SERIES = (
+                "scheduler_pod_e2e_latency_seconds",
+                "scheduler_pod_e2e_latency_quantile_seconds",
+            )
+
+            class Ledger:
+                def emit(self, dt):
+                    h = self._series("scheduler_pod_e2e_latency_seconds")
+                    if h is not None:
+                        h.observe(dt, "e2e")
+        """)
+        assert list(LedgerSeriesChecker().check_project(tmp_path)) == []
+
+    def test_unregistered_declaration_flagged(self, tmp_path):
+        write_ledger_tree(tmp_path, """
+            LEDGER_SERIES = ("scheduler_pod_e2e_latency_secondz",)
+        """)
+        fs = list(LedgerSeriesChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS02"]
+        assert "secondz" in fs[0].message
+
+    def test_undeclared_emission_flagged(self, tmp_path):
+        write_ledger_tree(tmp_path, """
+            LEDGER_SERIES = ("scheduler_pod_e2e_latency_seconds",)
+
+            class Ledger:
+                def emit(self):
+                    return self._series(
+                        "scheduler_pod_e2e_latency_quantile_seconds")
+        """)
+        fs = list(LedgerSeriesChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS02"]
+        assert "not declared" in fs[0].message
+
+    def test_non_literal_emission_flagged(self, tmp_path):
+        write_ledger_tree(tmp_path, """
+            LEDGER_SERIES = ("scheduler_pod_e2e_latency_seconds",)
+
+            class Ledger:
+                def emit(self, name):
+                    return self._series(name)
+        """)
+        fs = list(LedgerSeriesChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS02"]
+        assert "string literal" in fs[0].message
+
+    def test_non_literal_declaration_flagged(self, tmp_path):
+        write_ledger_tree(tmp_path,
+                          "LEDGER_SERIES = tuple(make_series())\n")
+        fs = list(LedgerSeriesChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS02"]
+        assert "literal tuple" in fs[0].message
+
+    def test_partial_tree_is_silent(self, tmp_path):
+        # fixture dirs without scheduler/metrics.py can't be cross-checked
+        assert list(LedgerSeriesChecker().check_project(tmp_path)) == []
+
+    def test_module_without_declaration_ignored(self, tmp_path):
+        write_ledger_tree(tmp_path, "x = 1\n")
+        assert list(LedgerSeriesChecker().check_project(tmp_path)) == []
+
+    def test_repo_ledger_series_in_sync(self):
+        """The shipped ledger's LEDGER_SERIES matches scheduler/metrics.py."""
+        assert list(LedgerSeriesChecker().check_project(PKG)) == []
 
 
 # ------------------------------------------------------------------ SIG01
