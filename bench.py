@@ -245,6 +245,11 @@ def run_headline() -> None:
             if dedup and (xw_total := dedup.get("xwave_hits", 0)
                           + dedup.get("xwave_misses", 0)) else None
         ),
+        # streaming waves (this PR): fraction of launch-side host prep that
+        # ran under an in-flight predecessor wave, and the adaptive
+        # controller's realized wave sizes by pow2 pad bucket
+        "pipeline_overlap_ratio": recorder.pipeline_overlap_ratio(),
+        "wave_size_hist": recorder.wave_size_histogram(),
         "wall_s": round(wall_s, 2),
         "measured_span_s": round(span_s, 2),
         "async_exec_s": round(async_exec, 2),

@@ -49,7 +49,10 @@ SUITE_SEED = 0
 
 # standing arrival-trace SLI rows (perf/trace_bench.py): virtual-time
 # deterministic, same defaults as `bench.py --trace` so the regression
-# gate can diff a suite artifact against a headline-bench artifact
+# gate can diff a suite artifact against a headline-bench artifact.
+# From round r06 these rows run the STREAMING (pipelined + adaptively
+# sized) wave loop and carry pipeline_overlap_ratio / wave_size_hist, so
+# `make bench-gate` guards the overlap win via their trace_p50/p99_s.
 TRACE_ROWS = [("poisson", 7, "trace_poisson"), ("burst", 7, "trace_burst")]
 
 

@@ -10,7 +10,9 @@ signature-fragment
 purity/coverage for the batching hint path (SIG01), carry coherence —
 node-plane / device-carry state may only be written through backend.py's
 invalidation hooks so the cross-wave signature cache can never go stale
-(SIG02), host-side-only
+(SIG02), pipeline-state ownership — the streaming-wave double buffer and
+the in-flight wave handle may only be written from backend.py so the
+pipelined buffers never desynchronize (PIPE01), host-side-only
 telemetry — no recorder/tracer/metrics calls inside traced code (OBS01),
 ledger metric-series sync — every series the pod latency ledger declares
 and emits is registered in scheduler/metrics.py (OBS02),
@@ -37,6 +39,7 @@ from .jit_purity import JitPurityChecker
 from .ledger_series import LedgerSeriesChecker
 from .lock_discipline import LockDisciplineChecker
 from .obs_purity import ObservabilityPurityChecker
+from .pipeline_state import PipelineStateChecker
 from .registry_sync import RegistrySyncChecker
 from .retry_discipline import RetryDisciplineChecker
 from .signature_sync import SignatureSyncChecker
@@ -52,6 +55,7 @@ __all__ = [
     "LockDisciplineChecker",
     "ModuleContext",
     "ObservabilityPurityChecker",
+    "PipelineStateChecker",
     "ProjectChecker",
     "RegistrySyncChecker",
     "RetryDisciplineChecker",

@@ -111,6 +111,7 @@ def default_checkers() -> list[Checker]:
     from .ledger_series import LedgerSeriesChecker
     from .lock_discipline import LockDisciplineChecker
     from .obs_purity import ObservabilityPurityChecker
+    from .pipeline_state import PipelineStateChecker
     from .registry_sync import RegistrySyncChecker
     from .retry_discipline import RetryDisciplineChecker
     from .signature_sync import SignatureSyncChecker
@@ -123,6 +124,7 @@ def default_checkers() -> list[Checker]:
         RegistrySyncChecker(),
         SignatureSyncChecker(),
         CarryCoherenceChecker(),
+        PipelineStateChecker(),
         ObservabilityPurityChecker(),
         RetryDisciplineChecker(),
         FaultPointChecker(),

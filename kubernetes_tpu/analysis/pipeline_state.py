@@ -1,0 +1,114 @@
+"""Pipeline-state rule (PIPE01) for the streaming-waves double buffer.
+
+The streaming wave pipeline keeps TWO device buffer sets live at once: the
+base plane mirror (`_device_planes` + its `_mirror_dirty` repair debt) and
+the in-flight wave's carry overlay, with the `InflightWave` handle
+(`_inflight`, `poisoned`, `cursor_base_host`, `frame_shift`,
+`_advanced_since_launch`, `_rerun_carry`) recording which buffer owns which
+rows and where the seeded tie-break cursor stands. A write to any of that
+state from outside `scheduler/tpu/backend.py` silently desynchronizes the
+two buffers — the successor wave then scores against planes that are
+neither host truth nor the predecessor's carry, and the golden bit-compat
+contract breaks only under pipelined load, the hardest place to debug it.
+
+PIPE01 therefore bans, outside `scheduler/tpu/backend.py`:
+
+- assignment (plain, augmented, annotated, starred, tuple-unpacked) to an
+  attribute in the guarded set: `_inflight`, `_mirror_dirty`,
+  `_advanced_since_launch`, `_rerun_carry`, `poisoned`,
+  `cursor_base_host`, `frame_shift`;
+- `del` of such an attribute;
+- mutating method calls on one (`.clear()`, `.update()`, `.add()`, ...).
+
+The guard set is EXACT names (no prefix match, unlike SIG02's `_carry*`):
+the scheduling loop legitimately owns its own `_inflight_wave` tuple and
+must stay free to rotate it. Reads (`infl.poisoned`, `fl.cursor_base_host`)
+and the sanctioned hook `InflightWave.mark_poisoned()` remain free — the
+rule polices writes, not observation. The loop-side plane/carry state has
+its own rule (SIG02, `carry_coherence.py`); PIPE01 covers the in-flight
+half the pipeline added.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from .core import Checker, Finding, ModuleContext
+
+PIPE01 = "PIPE01"
+
+# the one module allowed to touch pipeline/in-flight-wave state directly
+BACKEND = "scheduler/tpu/backend.py"
+
+_GUARDED = {
+    "_inflight",
+    "_mirror_dirty",
+    "_advanced_since_launch",
+    "_rerun_carry",
+    "poisoned",
+    "cursor_base_host",
+    "frame_shift",
+}
+
+# method names that mutate their receiver in-place
+_MUTATORS = {
+    "clear", "update", "add", "discard", "pop", "remove", "append",
+    "extend", "setdefault", "store",
+}
+
+
+def _guarded_attrs(expr: ast.expr) -> Iterator[tuple[int, str]]:
+    """(line, attr) for every guarded attribute access inside `expr`."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _GUARDED:
+            yield node.lineno, node.attr
+
+
+class PipelineStateChecker(Checker):
+    rules = {
+        PIPE01: "double-buffer plane / in-flight-wave state written outside "
+                "scheduler/tpu/backend.py — use the backend's sanctioned "
+                "hooks (mark_poisoned / invalidate_carry) so the pipelined "
+                "buffers stay coherent",
+    }
+
+    def check_module(self, ctx: ModuleContext) -> Iterable[Finding]:
+        p = ctx.posix_path
+        if p.endswith(BACKEND):
+            return  # the sanctioned site: backend.py owns this state
+        for node in ast.walk(ctx.tree):
+            yield from self._check_stmt(p, node)
+
+    def _check_stmt(self, path: str, node: ast.AST) -> Iterator[Finding]:
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATORS):
+                for line, attr in _guarded_attrs(func.value):
+                    yield Finding(
+                        path, line, 0, PIPE01,
+                        f"mutating call .{func.attr}() on guarded pipeline "
+                        f"state {attr!r} outside backend.py — in-flight-wave "
+                        "and double-buffer mutations must go through the "
+                        "backend's sanctioned hooks (mark_poisoned / "
+                        "invalidate_carry)",
+                    )
+            return
+        for tgt in targets:
+            for line, attr in _guarded_attrs(tgt):
+                yield Finding(
+                    path, line, 0, PIPE01,
+                    f"write to guarded pipeline state {attr!r} outside "
+                    "backend.py — the double-buffered planes and the "
+                    "in-flight wave handle are only coherent when every "
+                    "mutation routes through the backend's sanctioned "
+                    "hooks (mark_poisoned / invalidate_carry)",
+                )

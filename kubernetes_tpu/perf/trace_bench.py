@@ -51,15 +51,24 @@ MAX_DRAIN_TICKS = 10_000
 
 def run_trace_bench(shape: str = "poisson", seed: int = 7,
                     pods: int = 2000, nodes: int = 64,
-                    wave_size: int = 16, tick_s: float = 0.1) -> dict:
+                    wave_size: int = 16, tick_s: float = 0.1,
+                    max_wave: int | None = None) -> dict:
     """Replay the trace; return one bench row (see module docstring).
 
-    Capacity is wave_size/tick_s pods per virtual second (160/s at the
-    defaults) against the trace's base rate of 120/s — modest headroom, so
-    burst/diurnal peaks queue and the SLI has a real tail.
+    Baseline capacity is wave_size/tick_s pods per virtual second (160/s
+    at the defaults) against the trace's base rate of 120/s — modest
+    headroom, so burst/diurnal peaks queue and the SLI has a real tail.
+    The adaptive wave-size controller works WITHIN a per-tick cap of
+    `max_wave` (default wave_size*8): under a light tail it runs small
+    pow2 waves, under a burst backlog it grows toward the cap — the
+    load-adaptive batching this bench exists to measure. Queue depth is
+    deterministic in virtual time, so the sized waves (and every
+    DETERMINISTIC_KEYS field) stay bit-identical across same-seed runs.
     """
     if shape not in SHAPES:
         raise ValueError(f"shape must be one of {SHAPES}, got {shape!r}")
+    if max_wave is None:
+        max_wave = wave_size * 8
     from ..scheduler import Profile, Scheduler
     from ..scheduler.metrics import SchedulerMetrics
     from ..scheduler.tpu.podlatency import StreamingQuantile
@@ -101,8 +110,9 @@ def run_trace_bench(shape: str = "poisson", seed: int = 7,
             pending.add(pod.meta.key)
             created += 1
         sched.pump()
-        # exactly one bounded wave per tick: fixed virtual capacity
-        sched.loop.schedule_wave(wave_size, timeout=0.0)
+        # one capped wave per tick: the adaptive controller sizes the wave
+        # from queue depth, up to max_wave of virtual capacity per tick
+        sched.loop.schedule_wave(max_wave, timeout=0.0)
         sched.pump()
         for pod in store.pods():
             key = pod.meta.key
@@ -148,7 +158,15 @@ def run_trace_bench(shape: str = "poisson", seed: int = 7,
         "ticks": tick + 1,
         "tick_s": tick_s,
         "wave_size": wave_size,
+        "wave_cap": max_wave,
         "nodes": nodes,
+        # streaming-waves telemetry (diagnostic: the overlap ratio weights
+        # by wall-clock prep seconds, so it is machine-dependent; the
+        # histogram's pad buckets come from deterministic queue depths)
+        "pipeline_depth": sched.loop.pipeline_depth,
+        "pipeline_overlap_ratio":
+            sched.flight_recorder.pipeline_overlap_ratio(),
+        "wave_size_hist": sched.flight_recorder.wave_size_histogram(),
         # wall-clock decomposition from the pod latency ledger: which
         # segment the virtual latency was spent in (diagnostic, NOT part
         # of the deterministic contract — machine-speed dependent)
@@ -185,6 +203,13 @@ def _smoke() -> int:
         print(json.dumps({"smoke": "FAIL",
                           "error": f"only {row['scheduled']}/{row['pods']} "
                                    "pods scheduled"}))
+        return 1
+    overlap = row["pipeline_overlap_ratio"]
+    if row["pipeline_depth"] > 1 and not (overlap and overlap > 0):
+        print(json.dumps({"smoke": "FAIL",
+                          "error": "pipeline enabled but overlap ratio is "
+                                   f"{overlap!r} — host prep is not hiding "
+                                   "under device waves"}))
         return 1
     with tempfile.TemporaryDirectory() as td:
         art = os.path.join(td, "BENCH_smoke.json")

@@ -86,7 +86,7 @@ class APIDispatcher:
         self.retry_policy = retry_policy or _default_retry_policy()
         self._retry_rng = random.Random(0xD15)  # jitter only, never decisions
         self._queued: dict[str, APICall] = {}  # object key -> pending call
-        self._inflight: set[str] = set()  # keys a worker is executing now
+        self._executing: set[str] = set()  # keys a worker is executing now
         self._parked: set[str] = set()  # deferred keys awaiting in-flight done
         self._order: _queue.Queue = _queue.Queue()
         self._lock = threading.Lock()
@@ -218,7 +218,7 @@ class APIDispatcher:
             except _queue.Empty:
                 continue
             with self._lock:
-                if key in self._inflight:
+                if key in self._executing:
                     # strictly one executing call per object
                     # (call_queue.go semantics): PARK the key — the worker
                     # finishing the in-flight call re-enqueues it, so no
@@ -228,7 +228,7 @@ class APIDispatcher:
                 else:
                     call = self._queued.pop(key, None)
                     if call is not None:
-                        self._inflight.add(key)
+                        self._executing.add(key)
                     if self.metrics is not None:
                         self.metrics.async_api_pending.set(len(self._queued))
             if call is None:
@@ -237,7 +237,7 @@ class APIDispatcher:
                 self._execute(call)
             finally:
                 with self._lock:
-                    self._inflight.discard(key)
+                    self._executing.discard(key)
                     if key in self._parked:
                         self._parked.discard(key)
                         # only re-enqueue if a call is actually still queued
@@ -271,7 +271,7 @@ class APIDispatcher:
             # bounded retry absorbs transient failures (store conflicts,
             # injected flakes) without ever releasing the object key: the
             # one-in-flight-per-object and relevance-supersede invariants
-            # hold across attempts because the key stays in _inflight
+            # hold across attempts because the key stays in _executing
             retry_call(
                 attempt,
                 self.retry_policy,
@@ -313,15 +313,15 @@ class APIDispatcher:
         while time.monotonic() < deadline:
             with self._lock:
                 key = next(
-                    (k for k in self._queued if k not in self._inflight), None
+                    (k for k in self._queued if k not in self._executing), None
                 )
                 if key is None:
-                    if not self._queued and not self._inflight:
+                    if not self._queued and not self._executing:
                         return
                     call = None  # everything left is busy in a worker
                 else:
                     call = self._queued.pop(key)
-                    self._inflight.add(key)
+                    self._executing.add(key)
             if call is None:
                 time.sleep(0.001)
                 continue
@@ -329,7 +329,7 @@ class APIDispatcher:
                 self._execute(call)
             finally:
                 with self._lock:
-                    self._inflight.discard(key)
+                    self._executing.discard(key)
                     if key in self._parked:
                         self._parked.discard(key)
                         if key in self._queued:

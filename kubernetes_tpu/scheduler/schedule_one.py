@@ -38,8 +38,8 @@ _log = get_logger("scheduler")
 # slow-cycle diagnosis (utiltrace LogIfLong, schedule_one.go:570-571):
 # steps are span events, formatted + logged only when the cycle breaches
 # the threshold; logs to the legacy "kubernetes_tpu.trace" logger so
-# existing scrapers keep matching (the utils.trace shim is deprecated —
-# the ledger's exemplar links want ONE tracer surface)
+# existing scrapers keep matching (utils.tracing is the ONE tracer
+# surface — the ledger's exemplar links depend on it)
 _SLOW_CYCLE_THRESHOLD_S = 0.1
 _slow_cycle_export = threshold_log_exporter(_SLOW_CYCLE_THRESHOLD_S)
 
@@ -50,6 +50,14 @@ MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # schedule_one.go:62
 # device probes with small waves instead of being handed a full one (a
 # probe failure then strands N pods, not max_pods)
 PROBE_WAVE_PODS = int(os.environ.get("KUBE_TPU_PROBE_WAVE_PODS", "8"))
+
+# async-bind completion budget: total seconds a binding cycle waits for the
+# dispatcher to land one bind call. Waited in short slices (so a stalled
+# dispatcher surfaces in the log before the budget burns down) instead of
+# one silent blocking wait that would freeze the pipelined loop's binding
+# thread for the whole budget with no diagnosis.
+BIND_WAIT_S = float(os.environ.get("KUBE_TPU_BIND_WAIT_S", "30"))
+_BIND_WAIT_SLICE_S = 5.0
 
 
 def num_feasible_nodes_to_find(percentage: int, num_all_nodes: int) -> int:
@@ -346,6 +354,19 @@ class ScheduleOneLoop:
         # wave's results — the TPU-native form of the reference's
         # scheduling/binding pipeline parallelism (schedule_one.go:146)
         self._inflight_wave: tuple | None = None
+        # streaming-waves knobs (README "Streaming waves"): depth <= 1
+        # degrades the pipeline to the serial loop (launch then complete
+        # immediately — same code path, so the golden triple covers both);
+        # env is read at construction so tests can flip it per instance
+        from .tpu.wavecontroller import WaveSizeController
+
+        self.pipeline_depth = max(
+            1, int(os.environ.get("KUBE_TPU_PIPELINE_DEPTH", "2"))
+        )
+        # adaptive wave sizing: queue depth decides the next wave's pow2
+        # target within the caller's max_pods cap (the breaker's HALF_OPEN
+        # probe break below stays authoritative over both)
+        self.wave_controller = WaveSizeController()
         # async wave-bind completions: dispatcher worker threads only append
         # here; the scheduling thread drains. Keeping ALL queue/cache/carry
         # mutation on the scheduling thread avoids check-then-act races on
@@ -461,8 +482,14 @@ class ScheduleOneLoop:
         wave: list[QueuedPodInfo] = []
         wave_algo = None
         trailer: QueuedPodInfo | None = None
+        # adaptive wave sizing: the queue's active depth (deterministic —
+        # pure informer/store state) picks the next wave's pow2 target
+        # within the caller's cap; a 3-pod trickle gets an 8-slot program,
+        # a dumped backlog still fills max_pods
+        active, _, _ = self.queue.pending_pods()
+        target = self.wave_controller.next_size(active, cap=max_pods)
         with self.recorder.phase("pop"):
-            while len(wave) < max_pods:
+            while len(wave) < target:
                 qpi = self.queue.pop(
                     timeout=timeout if not wave and not trailer else 0.0
                 )
@@ -603,6 +630,10 @@ class ScheduleOneLoop:
         self.recorder.count_wave()
         if prev is not None:
             processed += self._complete_wave(*prev)
+        if self.pipeline_depth <= 1:
+            # pipelining disabled: complete the wave we just launched before
+            # returning — the serial loop, through the identical code path
+            processed += self._flush_wave_pipeline()
         return processed
 
     def _flush_wave_pipeline(self) -> int:
@@ -650,6 +681,13 @@ class ScheduleOneLoop:
                     for qpi in wave:
                         algo.revert_wave_plan(qpi.pod)
                         self.schedule_pod_info(qpi)
+                if (breaker is not None and breaker.device_blocked()
+                        and getattr(e, "device_flake", False)):
+                    # the flake tripped the breaker OPEN: drain the (poisoned)
+                    # successor now rather than holding it in flight through
+                    # the cooldown — its pods reroute to the host tier in
+                    # queue order right behind this wave's
+                    return len(wave) + self._flush_wave_pipeline()
                 return len(wave)
             if breaker is not None:
                 # the device round-tripped a full wave: that is the
@@ -728,6 +766,8 @@ class ScheduleOneLoop:
                 fallback_reason="host revert: carry poisoned"
                 if invalidated else None,
             )
+            # feed the adaptive controller's (opt-in) latency guard
+            self.wave_controller.observe(record.duration_s)
         return len(wave)
 
     def _export_wave_signatures(self, algo, fl, planes) -> int:
@@ -771,7 +811,7 @@ class ScheduleOneLoop:
         host-side state diverged from what its kernel assumed."""
         algo.backend.invalidate_carry()
         if self._inflight_wave is not None:
-            self._inflight_wave[1].poisoned = True
+            self._inflight_wave[1].mark_poisoned()
 
     def _default_bind_only(self, fw: Framework) -> bool:
         """True when the profile's bind chain is exactly the DefaultBinder —
@@ -1165,7 +1205,7 @@ class ScheduleOneLoop:
                 backend.mark_external()
                 marked = True
         if poison and marked and self._inflight_wave is not None:
-            self._inflight_wave[1].poisoned = True
+            self._inflight_wave[1].mark_poisoned()
 
     # -- binding cycle --------------------------------------------------------------
 
@@ -1240,11 +1280,23 @@ class ScheduleOneLoop:
             except CallSkippedError as e:
                 return Status.as_error(e)
             # binding cycle already runs off the scheduling loop; waiting here
-            # preserves failure handling without blocking scheduling
-            if not call.done.wait(timeout=30):
-                return Status.as_error(
-                    TimeoutError(f"async bind of {pod.meta.key} timed out")
-                )
+            # preserves failure handling without blocking scheduling. The
+            # budget (KUBE_TPU_BIND_WAIT_S) is burned in short slices so a
+            # stalled dispatcher is logged while it stalls, not 30s later
+            deadline = _time.monotonic() + BIND_WAIT_S
+            while not call.done.wait(
+                timeout=min(_BIND_WAIT_SLICE_S,
+                            max(0.0, deadline - _time.monotonic()))
+            ):
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return Status.as_error(TimeoutError(
+                        f"async bind of {pod.meta.key} timed out after "
+                        f"{BIND_WAIT_S}s (KUBE_TPU_BIND_WAIT_S)"
+                    ))
+                _log.error("async bind still pending; waiting",
+                           pod=pod.meta.key, node=host,
+                           remaining_s=round(remaining, 1))
             if call.error is not None:
                 return Status.as_error(call.error)
             return Status()

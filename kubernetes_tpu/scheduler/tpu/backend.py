@@ -236,6 +236,14 @@ class InflightWave:
         self.frame_shift = frame_shift
         self.poisoned = False
 
+    def mark_poisoned(self) -> None:
+        """Sanctioned poison hook for the scheduling loop: this wave's
+        results must be discarded at collect — host state diverged from
+        what its kernel assumed. In-flight-wave state is only writable
+        from backend.py (kubesched-lint PIPE01); callers poison through
+        this method instead of assigning the flag."""
+        self.poisoned = True
+
 
 class TPUBackend:
     """Planes + features + device-state bookkeeping for one cluster."""
@@ -266,10 +274,22 @@ class TPUBackend:
         self.rtc_shape = (
             tuple(sorted(tuple(p) for p in shape)) if shape else ((0, 0), (100, 100))
         )
+        # Double-buffered device planes (streaming waves): buffer ONE is
+        # the base host-truth mirror (`_device_planes`, written only by
+        # device_inputs' put/scatter), buffer TWO is the carry overlay
+        # (`_carry`, written only by the kernel's own outputs). A chained
+        # launch reads {**base, **overlay} with zero upload; when the
+        # overlay dies, the base owes exactly the rows in
+        # `_mirror_dirty ∪ _pending_dirty` — repaid by one O(churn) row
+        # scatter, not an O(cluster) re-put, so a resync no longer stalls
+        # the pipeline behind a full plane upload.
         self._device_planes: dict | None = None
         self._device_version = -1
         self._device_buckets: tuple | None = None
         self._pending_dirty: set[int] | None = set()  # None = full re-put
+        # rows whose BASE plane values are stale because the carry overlay
+        # holds their truth (our own collected binds); base-buffer debt
+        self._mirror_dirty: set[int] = set()
         self._device_tables: dict | None = None
         self._tables_src: dict | None = None
         self._uploaded_term_key: np.ndarray | None = None  # host-side copy
@@ -393,13 +413,20 @@ class TPUBackend:
             self._device_planes is None
             or self._pending_dirty is None
             or self._device_buckets != planes.bucket_sizes
+            # a dirty set past half the cluster costs more to scatter
+            # (gather + pow2-padded index) than to re-put wholesale
+            or len(self._pending_dirty) > max(64, planes.n // 2)
         )
         if full:
             self._device_planes = {
                 k: self._jax.device_put(a) for k, a in planes.as_dict().items()
             }
             self._uploaded_term_key = planes.ipa_term_key.copy()
-        elif self._device_version != planes.version and self._pending_dirty:
+            self._mirror_dirty = set()
+        elif self._pending_dirty:
+            # NOTE: no version guard — after invalidate_carry folds the
+            # mirror debt into _pending_dirty, rows can be stale even when
+            # planes.version hasn't moved since the last upload
             # pad the dirty index list to a pow2 bucket (repeat the first
             # index — duplicate scatter writes of identical rows are benign)
             # so XLA sees a bounded set of scatter shapes, not one per wave
@@ -473,6 +500,9 @@ class TPUBackend:
             if compatible and self._rerun_carry is not None:
                 carry, allowed = self._rerun_carry
                 if not (self._pending_dirty - allowed):
+                    # consumable dirt: the overlay holds those rows' truth;
+                    # the BASE buffer now owes them (mirror debt)
+                    self._mirror_dirty |= self._pending_dirty
                     self._pending_dirty = set()
                     self._device_version = planes.version
                     self._refresh_tables(planes)
@@ -590,8 +620,13 @@ class TPUBackend:
     # -- pipelined wave launch/collect ----------------------------------------
 
     def invalidate_carry(self) -> None:
-        """Drop the device-resident carry; the next device_inputs re-uploads
-        every plane from host truth."""
+        """Drop the carry overlay (device buffer two); the BASE plane
+        buffer stays valid except for the rows the overlay owned
+        (`_mirror_dirty`) plus whatever was already pending — folded into
+        `_pending_dirty` so the next device_inputs repairs the base with
+        one O(churn) row scatter instead of an O(cluster) re-put. A full
+        re-put is still owed when row tracking itself was lost
+        (`_pending_dirty is None`: builder full rebuild / bucket reshape)."""
         if self._carry is not None:
             self.recorder.carry_invalidated()
         self._carry = None
@@ -599,7 +634,9 @@ class TPUBackend:
         self._carry_anti = self._carry_pref = False
         self._carry_external = False
         self._rerun_carry = None
-        self._pending_dirty = None  # carried planes on device are stale
+        if self._pending_dirty is not None:
+            self._pending_dirty |= self._mirror_dirty
+        self._mirror_dirty = set()
         # resident score rows are scores AGAINST the carry planes — they
         # die with it
         self.sig_cache.clear()
@@ -669,9 +706,12 @@ class TPUBackend:
                 external = self._pending_dirty - self._carry_rows
                 if external:
                     raise NeedResync(f"{len(external)} externally-dirtied rows")
-                # remaining dirty rows are our own collected binds — the carry
-                # already holds their exact values (same int updates), so the
-                # host-truth scatter is redundant
+                # remaining dirty rows are our own collected binds — the
+                # carry overlay already holds their exact values (same int
+                # updates), so no host-truth scatter now; the BASE buffer
+                # owes those rows (mirror debt, repaid by one delta scatter
+                # if the overlay dies)
+                self._mirror_dirty |= self._pending_dirty
                 self._pending_dirty = set()
                 self._device_version = planes.version
                 self._refresh_tables(planes)
@@ -761,6 +801,10 @@ class TPUBackend:
             fl.cursor_base_host = 0
         self._inflight = fl
         self._advanced_since_launch = 0
+        # pipeline overlap accounting: when a predecessor was still in
+        # flight, every host prep phase above (sync/features/upload/dedup/
+        # tie/dispatch) ran while the device executed it — hidden time
+        self.recorder.note_pipeline(rec, overlapped=prev is not None)
         return fl
 
     def collect(self, fl: InflightWave, rng=None):

@@ -44,6 +44,10 @@ LOOP_PHASES = ("snapshot", "kernel", "finish", "bind", "pump", "events",
 # backend wave-path phases (the wave_profile bench.py reports)
 WAVE_PHASES = ("sync", "features", "tie", "dispatch", "upload", "wait",
                "dedup")
+# launch-side host-prep phases: with the pipeline on, these run while the
+# PREDECESSOR wave executes on device — the overlap the streaming-waves
+# pipeline exists to create (pipeline_overlap_ratio = hidden prep / prep)
+PREP_PHASES = ("sync", "features", "upload", "dedup", "tie", "dispatch")
 
 # watchdog defaults; env knobs so production runs can tune without code
 DEFAULT_CAPACITY = int(os.environ.get("KUBE_TPU_FLIGHT_CAPACITY", "256"))
@@ -79,6 +83,10 @@ class WaveRecord:
     fallback_reason: str | None = None  # resync/fallback diagnosis, if any
     injected_faults: int = 0  # chaos faults fired during this wave's flight
     retries: int = 0  # dispatcher retry attempts during this wave's flight
+    # host prep seconds that ran while a predecessor wave was in flight on
+    # device (the pipelined overlap), and the per-wave ratio of prep hidden
+    overlap_s: float = 0.0
+    pipeline_overlap_ratio: float = 0.0
     phases: dict = field(default_factory=dict)  # phase -> seconds
     duration_s: float = 0.0
     profile: str | None = None  # watchdog pprof capture, when triggered
@@ -108,6 +116,8 @@ class WaveRecord:
             "fallback_reason": self.fallback_reason,
             "injected_faults": self.injected_faults,
             "retries": self.retries,
+            "overlap_s": round(self.overlap_s, 6),
+            "pipeline_overlap_ratio": round(self.pipeline_overlap_ratio, 4),
             "phases": {k: round(v, 6) for k, v in self.phases.items()},
         }
         if self.profile is not None:
@@ -144,6 +154,12 @@ class FlightRecorder:
         self._wave_seq = 0
         self.invalidations = 0  # cumulative carry invalidations
         self.retries_total = 0  # cumulative dispatcher retry attempts
+        # streaming-wave pipeline accounting: cumulative launch-side host
+        # prep seconds, and how many of them ran under an in-flight
+        # predecessor (see note_pipeline); wave-size histogram by pad
+        self.prep_s_total = 0.0
+        self.overlap_s_total = 0.0
+        self.wave_sizes: dict[int, int] = {}
         self.slow_wave_captures = 0
         self._watchdogs: dict[int, threading.Timer] = {}
         # circuit-breaker transition history (old, new, reason), bounded
@@ -221,6 +237,20 @@ class FlightRecorder:
         if dedup and rec.pods:
             rec.clones = rec.pods - signatures
             rec.distinct_signature_ratio = round(signatures / rec.pods, 4)
+
+    def note_pipeline(self, rec: WaveRecord, overlapped: bool) -> None:
+        """Attach launch-side pipeline accounting: `overlapped` is True
+        when a predecessor wave was in flight on device while this wave's
+        host prep (the PREP_PHASES stopwatches) ran — i.e. the prep was
+        hidden under the predecessor's `wait`. Called by the backend at the
+        end of launch_batched, before collect; pure bookkeeping, never in
+        jitted code."""
+        prep = sum(rec.phases.get(p, 0.0) for p in PREP_PHASES)
+        rec.overlap_s = prep if overlapped else 0.0
+        rec.pipeline_overlap_ratio = 1.0 if (overlapped and prep) else 0.0
+        with self._lock:
+            self.prep_s_total += prep
+            self.overlap_s_total += rec.overlap_s
 
     def note_cross_wave(self, rec: WaveRecord, hits: int, misses: int,
                         evictions: int) -> None:
@@ -309,6 +339,7 @@ class FlightRecorder:
             rec.carry_invalidations = self.invalidations - rec._inv_base
             rec.injected_faults = faultinject.fired_total() - rec._fault_base
             rec.retries = self.retries_total - rec._retry_base
+            self.wave_sizes[rec.pad] = self.wave_sizes.get(rec.pad, 0) + 1
             self._records.append(rec)
         m = self.metrics
         if m is not None:
@@ -355,6 +386,20 @@ class FlightRecorder:
         with self._lock:
             return dict(self.wave_totals)
 
+    def wave_size_histogram(self) -> dict:
+        """Completed-wave count per pow2 pad bucket (the adaptive wave-size
+        controller's observable output), keyed by stringified pad size."""
+        with self._lock:
+            return {str(k): v for k, v in sorted(self.wave_sizes.items())}
+
+    def pipeline_overlap_ratio(self) -> float | None:
+        """Fraction of cumulative launch-side host prep that ran under an
+        in-flight predecessor wave. None until any prep has been timed."""
+        with self._lock:
+            if not self.prep_s_total:
+                return None
+            return round(self.overlap_s_total / self.prep_s_total, 4)
+
     def summary(self) -> dict:
         recs = self.records()
         durations = sorted(r.duration_s for r in recs)
@@ -370,6 +415,8 @@ class FlightRecorder:
             "wave_p50_s": (round(durations[len(durations) // 2], 4)
                            if durations else None),
             "wave_max_s": round(durations[-1], 4) if durations else None,
+            "pipeline_overlap_ratio": self.pipeline_overlap_ratio(),
+            "wave_size_hist": self.wave_size_histogram(),
         }
 
     # -- dump hook (cache/debugger.py pattern) --------------------------------
@@ -450,6 +497,9 @@ def _demo() -> FlightRecorder:
         rec.note_launch(wr, signatures=3, dedup=True)
         rec.note_cross_wave(wr, hits=(3 if i else 0),
                             misses=(0 if i else 3), evictions=0)
+        # wave 0 launches into an idle device; every later wave's prep
+        # overlaps the (synthetic) in-flight predecessor
+        rec.note_pipeline(wr, overlapped=bool(i))
         with rec.phase("kernel", wr):
             if i == 4:
                 time.sleep(0.12)  # trip the watchdog once

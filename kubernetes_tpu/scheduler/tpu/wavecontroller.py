@@ -1,0 +1,113 @@
+"""Adaptive wave sizing for the streaming wave pipeline.
+
+The wave loop's capacity knob used to be one constant (`Profile.wave_size`
+/ the `schedule_wave(max_pods)` cap): great under sustained backlog, but a
+light trickle then waits for nothing (a 512-slot program to place 3 pods)
+and a burst gets no headroom beyond the constant. The controller sizes the
+NEXT wave from the scheduling queue's observed depth instead — Kant's
+(arxiv 2510.01256) load-adaptive batching applied to the pods×nodes
+kernel: small waves under light arrival (latency), large waves under
+backlog (throughput).
+
+Determinism contract: the PRIMARY signal is queue depth — a pure function
+of store/informer state, so the trace bench's virtual-time rows stay
+bit-identical across runs (`trace_bench.DETERMINISTIC_KEYS`). The
+wall-clock latency guard (AIMD: halve the size ceiling when observed
+per-wave latency blows the budget, recover one pow2 step per good wave)
+is OPT-IN via `KUBE_TPU_WAVE_LATENCY_S` precisely because wall time is
+not deterministic; it ships disabled for every bench row.
+
+Sizes are pow2-bucketed (floor `KUBE_TPU_WAVE_MIN_PODS`, default 8) so the
+controller never fans out XLA program shapes beyond the buckets the wave
+padding in `schedule_wave` already compiles. The circuit breaker's
+HALF_OPEN probe sizing (`schedule_one.PROBE_WAVE_PODS`) stays authoritative:
+the pop loop's probe break caps a recovering device's wave regardless of
+what the controller asked for — the controller sizes load, the breaker
+sizes risk.
+
+Env knobs:
+- KUBE_TPU_WAVE_MIN_PODS  (default 8): pow2 floor for any wave
+- KUBE_TPU_WAVE_MAX_PODS  (default 0 = use the caller's cap): hard ceiling
+- KUBE_TPU_WAVE_LATENCY_S (default unset = guard off): per-wave latency
+  budget for the AIMD guard
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _next_pow2(n: int, floor: int = 1) -> int:
+    p = max(1, floor)
+    while p < n:
+        p <<= 1
+    return p
+
+
+class WaveSizeController:
+    """Sizes the next batched wave from queue depth (+ optional latency).
+
+    One instance is owned by the ScheduleOneLoop and consulted at the top
+    of every `schedule_wave` call; `observe()` feeds it completed waves'
+    durations (a no-op unless the latency guard is armed).
+    """
+
+    def __init__(self, min_pods: int | None = None,
+                 max_pods: int | None = None,
+                 latency_budget_s: float | None = None):
+        env = os.environ.get
+        self.min_pods = _next_pow2(int(
+            env("KUBE_TPU_WAVE_MIN_PODS", "8")) if min_pods is None
+            else min_pods)
+        self.max_pods = int(
+            env("KUBE_TPU_WAVE_MAX_PODS", "0")) if max_pods is None \
+            else max_pods
+        if latency_budget_s is None:
+            raw = env("KUBE_TPU_WAVE_LATENCY_S", "")
+            latency_budget_s = float(raw) if raw else None
+        self.latency_budget_s = latency_budget_s or None
+        # AIMD ceiling driven by the latency guard; None = wide open
+        self._soft_max: int | None = None
+        # decision trail for bench/debug dumps (bounded)
+        self.sized_waves = 0
+
+    def next_size(self, backlog: int, cap: int) -> int:
+        """Target pod count for the next wave.
+
+        `backlog` is the queue's active-pod depth (deterministic);
+        `cap` is the caller's legacy max_pods and stays a hard ceiling —
+        existing callers that ask for 512-pod waves under a dumped backlog
+        still get exactly 512."""
+        ceiling = cap
+        if self.max_pods > 0:
+            ceiling = min(ceiling, self.max_pods)
+        if self._soft_max is not None:
+            ceiling = min(ceiling, self._soft_max)
+        # +1: the pod about to be popped may not be counted as active yet
+        target = _next_pow2(backlog + 1, self.min_pods)
+        self.sized_waves += 1
+        return max(1, min(target, ceiling))
+
+    def observe(self, wave_duration_s: float) -> None:
+        """AIMD latency guard (opt-in): a wave over budget halves the size
+        ceiling; a wave under budget recovers one pow2 step."""
+        budget = self.latency_budget_s
+        if budget is None:
+            return
+        if wave_duration_s > budget:
+            base = self._soft_max if self._soft_max is not None else \
+                max(self.max_pods, self.min_pods * 4)
+            self._soft_max = max(self.min_pods, base // 2)
+        elif self._soft_max is not None:
+            doubled = self._soft_max * 2
+            limit = self.max_pods if self.max_pods > 0 else doubled
+            self._soft_max = doubled if doubled < limit else None
+
+    def snapshot(self) -> dict:
+        return {
+            "min_pods": self.min_pods,
+            "max_pods": self.max_pods,
+            "latency_budget_s": self.latency_budget_s,
+            "soft_max": self._soft_max,
+            "sized_waves": self.sized_waves,
+        }

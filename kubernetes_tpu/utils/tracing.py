@@ -97,8 +97,8 @@ def threshold_log_exporter(threshold: float, logger=None):
     """Exporter that logs a finished span's event timeline iff its total
     duration crossed `threshold` — the utiltrace LogIfLong contract
     (vendor/k8s.io/utils/trace/trace.go:208) expressed as a span exporter.
-    `utils.trace.Trace` is a shim over this; the legacy line format is
-    preserved so existing log scrapers keep matching.
+    The legacy utiltrace line format is preserved so existing log scrapers
+    keep matching.
 
     Returns a callable(span) -> bool (whether it logged)."""
     log = logger or logging.getLogger("kubernetes_tpu.trace")

@@ -182,7 +182,7 @@ class TestAPIDispatcher:
                     )
                 else:
                     assert isinstance(val, CallSkippedError)
-            assert not d._queued and not d._inflight
+            assert not d._queued and not d._executing
         finally:
             d.close()
 

@@ -54,8 +54,8 @@ class TestEventRecorder:
 
 
 class TestSlowCycleTrace:
-    """Slow-cycle diagnosis now rides utils.tracing directly (one tracer
-    surface); the utils.trace shim only survives as a deprecated alias."""
+    """Slow-cycle diagnosis rides utils.tracing directly (one tracer
+    surface; the old utils.trace shim is gone)."""
 
     def test_slow_cycle_logs_steps(self, caplog):
         from kubernetes_tpu.utils.tracing import Span, threshold_log_exporter
@@ -81,17 +81,6 @@ class TestSlowCycleTrace:
         with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
             assert not threshold_log_exporter(0.1)(sp)
         assert caplog.text == ""
-
-    def test_trace_shim_is_deprecated_but_compatible(self, caplog):
-        import pytest
-
-        with pytest.warns(DeprecationWarning):
-            from kubernetes_tpu.utils.trace import Trace
-
-            t = Trace("Scheduling", pod="default/shim")
-        t.step("quick")
-        with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
-            assert not t.log_if_long(10.0)
 
 
 class TestCondvarPermit:

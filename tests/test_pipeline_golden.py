@@ -1,0 +1,233 @@
+"""Golden bit-compat tests for the streaming wave pipeline (PR 8).
+
+The pipelined loop's contract: with `KUBE_TPU_PIPELINE_DEPTH=2` the loop
+launches wave k+1 while wave k is still in flight on the device, prepping
+k+1's host inputs from the carry overlay — and the resulting binding
+stream is BIT-IDENTICAL to the serial loop (depth 1, flush-after-launch)
+and to the dedup-disabled loop: same placements, same PodScheduled
+failure diagnoses for the pods that no longer fit, same tie-break rng
+stream position afterwards. The triple runs over three config shapes:
+
+  * basic mixed-signature bursts on a small two-zone cluster,
+  * hard-PTS (DoNotSchedule zone spread — the equality-gated fast tier),
+  * the sharded-mesh config shape (40 nodes / 4 zones + spread pods;
+    kernel-level sharded byte-equality is pinned by
+    test_dedup_golden.TestShardedGolden — here we pin the Scheduler
+    stream over the same shape).
+
+Plus the failure half of the contract: a breaker trip mid-flight must
+drain the poisoned successor out of the pipeline (no wave held in flight
+through the cooldown), and a chaos run with `tpu.collect` faults armed
+under the pipelined loop must still converge with every pod bound.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.tpu.circuitbreaker import CLOSED, OPEN
+from kubernetes_tpu.store.store import Store
+from kubernetes_tpu.testing import with_spread
+from kubernetes_tpu.utils import faultinject
+from kubernetes_tpu.utils.faultinject import ERROR, FaultSpec
+from tests.wrappers import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Every test starts and ends with the process-wide registry disarmed
+    and empty — an armed leftover would poison unrelated tests."""
+    faultinject.registry().reset(seed=0)
+    yield
+    faultinject.registry().reset(seed=0)
+
+
+def mixed_pods(lo, hi, spread=False):
+    """Three interleaved signatures (same shape as test_dedup_golden):
+    every clone run is split across other signatures' steps, so the dedup
+    fast tier re-enters mid-wave under the pipelined loop too."""
+    pods = []
+    for i in range(lo, hi):
+        kind = i % 3
+        if kind == 0:
+            p = make_pod(f"a{i:02d}", cpu="1", mem="1Gi",
+                         labels={"app": "a"})
+        elif kind == 1:
+            p = make_pod(f"b{i:02d}", cpu="900m", mem="900Mi",
+                         labels={"app": "b"})
+        else:
+            p = make_pod(f"c{i:02d}", cpu="800m", mem="800Mi",
+                         labels={"app": "c"})
+        if spread:
+            p = with_spread(p, max_skew=5,
+                            key="topology.kubernetes.io/zone",
+                            when="DoNotSchedule")
+        pods.append(p)
+    return pods
+
+
+def _run_stream(monkeypatch, depth, dedup=True, spread=False,
+                nodes=6, zones=2, cpu="4",
+                bursts=((0, 15), (15, 30), (30, 42))):
+    """One streamed scenario: pods arrive in bursts, each burst drained by
+    `schedule_pending` so waves within a burst genuinely pipeline (wave
+    k+1 preps from the carry overlay while wave k is on the device).
+    Returns the binding stream fingerprint plus the live Scheduler for
+    telemetry assertions."""
+    monkeypatch.setenv("KUBE_TPU_PIPELINE_DEPTH", str(depth))
+    store = Store()
+    for i in range(nodes):
+        store.create(make_node(f"n{i}", cpu=cpu, mem="8Gi",
+                               zone=f"z{i % zones}"))
+    s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
+                  seed=11)
+    algo = s.algorithms["default-scheduler"]
+    algo.backend.dedup_enabled = dedup
+    s.start()
+    assert s.loop.pipeline_depth == depth
+    for lo, hi in bursts:
+        for p in mixed_pods(lo, hi, spread=spread):
+            store.create(p)
+        s.schedule_pending()
+    s.event_recorder.flush()
+    placed = {p.meta.name: p.spec.node_name for p in store.pods()}
+    diags = {}
+    for p in store.pods():
+        for c in p.status.conditions:
+            if c.type == "PodScheduled" and c.status == "False":
+                diags[p.meta.name] = f"{c.reason}: {c.message}"
+    rng_state = algo.rng.getstate() if algo.rng is not None else None
+    return placed, diags, rng_state, s
+
+
+def _triple(monkeypatch, **kw):
+    """pipelined / serial / dedup-off (pipelined) over one config."""
+    piped = _run_stream(monkeypatch, depth=2, dedup=True, **kw)
+    serial = _run_stream(monkeypatch, depth=1, dedup=True, **kw)
+    nodedup = _run_stream(monkeypatch, depth=2, dedup=False, **kw)
+    return piped, serial, nodedup
+
+
+def _assert_identical(piped, serial, nodedup):
+    placed_p, diags_p, rng_p, _ = piped
+    placed_s, diags_s, rng_s, _ = serial
+    placed_d, diags_d, rng_d, _ = nodedup
+    assert placed_p == placed_s == placed_d
+    assert diags_p == diags_s == diags_d
+    assert rng_p == rng_s == rng_d
+
+
+class TestPipelineGoldenTriple:
+    def test_basic_triple_identical(self, monkeypatch):
+        piped, serial, nodedup = _triple(monkeypatch)
+        _assert_identical(piped, serial, nodedup)
+        placed, diags = piped[0], piped[1]
+        # the scenario must exercise both outcomes
+        assert sum(1 for v in placed.values() if v) > 0
+        assert diags, "some pods must fail with a diagnosis"
+        # and the pipelined run must have actually overlapped: host prep
+        # seconds hidden under an in-flight predecessor, zero when serial
+        assert piped[3].flight_recorder.overlap_s_total > 0
+        assert serial[3].flight_recorder.overlap_s_total == 0
+        assert nodedup[3].flight_recorder.overlap_s_total > 0
+
+    def test_hard_pts_triple_identical(self, monkeypatch):
+        """DoNotSchedule zone spread makes every wave hard-PTS (n_hard >
+        0): the equality-gated fast tier must stay bit-compatible when its
+        waves chain through the double-buffered pipeline."""
+        piped, serial, nodedup = _triple(monkeypatch, spread=True)
+        _assert_identical(piped, serial, nodedup)
+        # dedup must be live in the dedup-on arms, not silently disabled
+        stats = piped[3].algorithms["default-scheduler"].backend.dedup_stats
+        assert stats["waves"] > 0
+        assert 0 < stats["signatures"] < stats["pods"]
+
+    def test_sharded_mesh_config_triple_identical(self, monkeypatch):
+        """The 40-node / 4-zone spread shape is what the shard-capable
+        fast tier serves at kernel level; the Scheduler stream over that
+        shape must be depth-invariant too."""
+        piped, serial, nodedup = _triple(
+            monkeypatch, spread=True, nodes=40, zones=4,
+            bursts=((0, 30), (30, 60)))
+        _assert_identical(piped, serial, nodedup)
+        assert sum(1 for v in piped[0].values() if v) == 60
+        assert piped[3].flight_recorder.overlap_s_total > 0
+
+
+class TestBreakerTripMidFlight:
+    def test_trip_drains_poisoned_successor(self, monkeypatch):
+        """Three consecutive injected collect flakes trip the breaker
+        while a successor wave is in flight: the trip must DRAIN that
+        (poisoned) successor out of the pipeline immediately — its pods
+        reroute to the host tier in queue order — rather than holding a
+        wave in flight through the cooldown."""
+        monkeypatch.setenv("KUBE_TPU_PIPELINE_DEPTH", "2")
+        store = Store()
+        for i in range(4):
+            store.create(make_node(f"n{i}", cpu="32", mem="64Gi"))
+        for i in range(40):
+            store.create(make_pod(f"p{i:02d}", cpu="100m", mem="64Mi",
+                                  labels={"app": "x"}))
+        reg = faultinject.registry()
+        reg.reset(seed=0)
+        # first collect passes (pipeline warm), then 3 consecutive flakes:
+        # exactly the breaker's default threshold
+        reg.register(FaultSpec("tpu.collect", mode=ERROR, transient=True,
+                               start_after=1, times=3))
+        reg.arm()
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
+                      seed=3)
+        algo = s.algorithms["default-scheduler"]
+        s.start()
+        s.schedule_pending()
+        s.loop.wait_for_bindings()
+        s.pump()
+        assert reg.fired_by_point["tpu.collect"] >= 3
+        events = list(s.flight_recorder.breaker_events)
+        assert any(old == CLOSED and new == OPEN
+                   for old, new, _ in events), events
+        # the drain: nothing left in flight the moment the trip landed
+        assert s.loop._inflight_wave is None
+        # every pod still binds — flaked + poisoned waves reroute host-side
+        assert all(p.spec.node_name for p in store.pods())
+        assert algo.fallback_count > 0
+        reasons = [r.fallback_reason
+                   for r in s.flight_recorder.records()
+                   if r.fallback_reason]
+        assert any(r.startswith("injected:") for r in reasons), reasons
+        assert any(r.startswith("poisoned:") for r in reasons), reasons
+
+
+class TestChaosUnderPipeline:
+    def test_collect_faults_converge_pipelined(self, monkeypatch):
+        """Probabilistic transient collect flakes armed under the
+        pipelined loop: trips, cooldowns, HALF_OPEN probes and host
+        reroutes may all happen, but the run converges with every pod
+        bound — the degradation ladder holds with waves in flight."""
+        monkeypatch.setenv("KUBE_TPU_PIPELINE_DEPTH", "2")
+        monkeypatch.setenv("KUBE_TPU_BREAKER_COOLDOWN_S", "0.05")
+        store = Store()
+        for i in range(6):
+            store.create(make_node(f"n{i}", cpu="16", mem="32Gi",
+                                   zone=f"z{i % 2}"))
+        for p in mixed_pods(0, 48):
+            store.create(p)
+        reg = faultinject.registry()
+        reg.reset(seed=7)
+        reg.register(FaultSpec("tpu.collect", mode=ERROR, transient=True,
+                               probability=0.4, times=6,
+                               message="device flake"))
+        reg.arm()
+        s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
+                      seed=7)
+        s.start()
+        s.schedule_pending()
+        s.loop.wait_for_bindings()
+        s.pump()
+        assert faultinject.fired_total() > 0, \
+            "chaos run must actually inject faults"
+        assert s.loop._inflight_wave is None
+        placed = {p.meta.name: p.spec.node_name for p in store.pods()}
+        assert all(placed.values()), \
+            {k: v for k, v in placed.items() if not v}

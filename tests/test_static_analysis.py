@@ -980,6 +980,81 @@ class TestCarryCoherence:
         assert fs == []
 
 
+# ------------------------------------------------------------------ PIPE01
+
+
+class TestPipelineState:
+    def test_poison_write_outside_backend_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(fl):
+                fl.poisoned = True
+        """, name="scheduler/schedule_one.py")
+        assert rules(fs) == ["PIPE01"]
+        assert "poisoned" in fs[0].message
+
+    def test_mirror_dirty_mutator_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(backend, rows):
+                backend._mirror_dirty.update(rows)
+        """, name="scheduler/cache/debugger.py")
+        assert rules(fs) == ["PIPE01"]
+        assert ".update()" in fs[0].message
+
+    def test_inflight_handle_write_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(backend):
+                backend._inflight = None
+                backend._rerun_carry = None
+        """, name="scheduler/tpu/chaos.py")
+        assert rules(fs) == ["PIPE01", "PIPE01"]
+
+    def test_cursor_write_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(fl, base):
+                fl.cursor_base_host = base
+                fl.frame_shift += 1
+        """, name="perf/bench.py")
+        assert rules(fs) == ["PIPE01", "PIPE01"]
+
+    def test_backend_module_is_sanctioned(self, tmp_path):
+        fs = lint(tmp_path, """
+            def mark_poisoned(self):
+                self.poisoned = True
+
+            def launch(self, fl):
+                self._inflight = fl
+                self._mirror_dirty = set()
+                self._advanced_since_launch = 0
+        """, name="scheduler/tpu/backend.py")
+        assert fs == []
+
+    def test_reads_and_mark_poisoned_hook_ok(self, tmp_path):
+        # observation and the sanctioned hook are not writes
+        fs = lint(tmp_path, """
+            def use(self, infl):
+                if infl.poisoned or infl.cursor_base_host is None:
+                    infl.mark_poisoned()
+                return infl.frame_shift
+        """, name="scheduler/schedule_one.py")
+        assert fs == []
+
+    def test_loop_owned_inflight_wave_ok(self, tmp_path):
+        # exact-name guard: the loop's own _inflight_wave rotation is free
+        fs = lint(tmp_path, """
+            def rotate(self, algo, fl):
+                prev, self._inflight_wave = self._inflight_wave, (algo, fl)
+                return prev
+        """, name="scheduler/schedule_one.py")
+        assert fs == []
+
+    def test_suppression_silences_pipe01(self, tmp_path):
+        fs = lint(tmp_path, """
+            def poke(fl):
+                fl.poisoned = True  # kubesched-lint: disable=PIPE01
+        """, name="scheduler/schedule_one.py")
+        assert fs == []
+
+
 # ------------------------------------------------------------------ OBS01
 
 
@@ -1209,7 +1284,7 @@ class TestCli:
         out = capsys.readouterr().out
         for rule in ("JIT01", "JIT02", "JIT03", "JIT04", "LOCK01", "LOCK02",
                      "LOCK03", "SNAP01", "REG01", "REG02", "SIG01", "SIG02",
-                     "OBS01", "RET01", "LINT00"):
+                     "PIPE01", "OBS01", "RET01", "LINT00"):
             assert rule in out
 
     def test_rule_ids_documented_in_readme(self):
