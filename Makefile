@@ -25,7 +25,9 @@ chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --trace --seed 1234 --budget-s 60
 
 # flight-recorder CLI smoke: synthetic multi-wave run (no device, no jax),
-# exercises ring buffer + watchdog + post-mortem formatting
+# exercises ring buffer + watchdog + post-mortem formatting, and asserts
+# the device-telemetry block (transfer ledger / compile tracker / memory
+# watermark) is present in the dump with per-plane sums that add up
 obs:
 	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --demo
 	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --schema

@@ -269,6 +269,11 @@ def run_headline() -> None:
         # `python -m kubernetes_tpu.scheduler.tpu.flightrecorder`
         "flight": recorder.summary(),
     }
+    # device telemetry (transfer ledger / compile tracker / memory
+    # watermark): upload_bytes_per_wave + compile_count feed the
+    # regression gate's lower-is-better device checks
+    line.update(recorder.device_telemetry.bench_columns(
+        recorder.phase_snapshot().get("waves", 0)))
     if fallback_reason:
         line["fallback_reason"] = fallback_reason
     _finish(line)

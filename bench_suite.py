@@ -156,6 +156,10 @@ def main() -> None:
             "git_rev": git_rev,
             "row_wall_s": round(row_wall_s, 2),
         }
+        # device telemetry columns (gate-checked: upload/compile growth)
+        recorder = executor.scheduler.flight_recorder
+        line.update(recorder.device_telemetry.bench_columns(
+            recorder.phase_snapshot().get("waves", 0)))
         if fallback_reason:
             line["fallback_reason"] = fallback_reason
         print(json.dumps(line), flush=True)

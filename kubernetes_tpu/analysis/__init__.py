@@ -16,6 +16,9 @@ pipelined buffers never desynchronize (PIPE01), host-side-only
 telemetry — no recorder/tracer/metrics calls inside traced code (OBS01),
 ledger metric-series sync — every series the pod latency ledger declares
 and emits is registered in scheduler/metrics.py (OBS02),
+accounted device-transfer seam — no raw device_put in backend.py and every
+seam call names a declared TRANSFER_PLANES plane, so the transfer ledger
+sees every byte (OBS03),
 and retry/fault-injection discipline — no hand-rolled backoff loops or
 ad-hoc random flakes outside the shared helpers (RET01).
 
@@ -44,6 +47,7 @@ from .registry_sync import RegistrySyncChecker
 from .retry_discipline import RetryDisciplineChecker
 from .signature_sync import SignatureSyncChecker
 from .snapshot_immutability import SnapshotImmutabilityChecker
+from .transfer_seam import TransferSeamChecker
 
 __all__ = [
     "CarryCoherenceChecker",
@@ -61,6 +65,7 @@ __all__ = [
     "RetryDisciplineChecker",
     "SignatureSyncChecker",
     "SnapshotImmutabilityChecker",
+    "TransferSeamChecker",
     "check_file",
     "default_checkers",
     "known_rules",

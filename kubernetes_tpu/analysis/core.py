@@ -116,6 +116,7 @@ def default_checkers() -> list[Checker]:
     from .retry_discipline import RetryDisciplineChecker
     from .signature_sync import SignatureSyncChecker
     from .snapshot_immutability import SnapshotImmutabilityChecker
+    from .transfer_seam import TransferSeamChecker
 
     return [
         JitPurityChecker(),
@@ -129,6 +130,7 @@ def default_checkers() -> list[Checker]:
         RetryDisciplineChecker(),
         FaultPointChecker(),
         LedgerSeriesChecker(),
+        TransferSeamChecker(),
     ]
 
 

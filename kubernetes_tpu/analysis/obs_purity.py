@@ -26,6 +26,11 @@ OBS01 = "OBS01"
 TELEMETRY_SEGMENTS = {
     "recorder", "flight_recorder", "flightrecorder", "tracer", "metrics",
     "span", "wave_phase", "begin_wave", "end_wave", "take_profile", "pprof",
+    # device telemetry seam (scheduler/tpu/devicetelemetry.py): accounting
+    # wraps device calls, never runs inside them
+    "telemetry", "device_telemetry", "devicetelemetry",
+    "accounted_put", "accounted_fetch", "account_upload", "account_fetch",
+    "compile_span", "note_resident", "stamp_watermark",
 }
 
 

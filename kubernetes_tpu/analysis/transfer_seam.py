@@ -1,0 +1,158 @@
+"""Accounted device-transfer seam rule (OBS03).
+
+The device telemetry layer (`scheduler/tpu/devicetelemetry.py`) only sees
+the bytes that cross the host<->device boundary if every transfer in
+`scheduler/tpu/backend.py` routes through its accounted seam
+(`accounted_put` / `accounted_fetch`, or the accounting-only
+`account_upload` / `account_fetch`). One raw `jax.device_put` added in a
+refactor silently punches a hole in the transfer ledger — per-plane
+attribution stops summing to the wave total and the "upload bytes flat as
+node count grows" done-criterion becomes unmeasurable again. Nothing can
+enforce this at runtime (the backend works with telemetry disabled), so —
+like FI01 for fault points and OBS02 for ledger series — the enforcement
+is cross-parsing.
+
+OBS03 flags:
+- a `TRANSFER_PLANES` declaration in devicetelemetry.py that is not a
+  literal tuple of string constants (can't be cross-checked);
+- any call to `device_put` (dotted or bare) in
+  `scheduler/tpu/backend.py` — the backend must route uploads through
+  the seam, which applies `device_put` itself;
+- a seam call, anywhere in the tree outside the declaring module, whose
+  plane argument is not a string literal or names a plane outside
+  `TRANSFER_PLANES` — unattributable bytes.
+
+Findings are project-scoped, so per-line suppressions do not apply —
+route the transfer through the seam (or declare the plane) instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding, ProjectChecker
+
+OBS03 = "OBS03"
+
+BACKEND_MODULE = "scheduler/tpu/backend.py"
+DECL_MODULE = "scheduler/tpu/devicetelemetry.py"
+DECL_NAME = "TRANSFER_PLANES"
+SEAM_METHODS = {"accounted_put", "accounted_fetch",
+                "account_upload", "account_fetch"}
+
+
+def _parse_planes(path: Path) -> tuple[set[str] | None, int] | None:
+    """(declared plane names | None-if-non-literal, lineno), or None when
+    the module has no TRANSFER_PLANES declaration at all."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    for node in getattr(tree, "body", ()):
+        if not (isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == DECL_NAME
+            for t in node.targets
+        )):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call) and value.args:
+            value = value.args[0]  # frozenset((...)) wrapper
+        if not isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+            return None, node.lineno
+        out: set[str] = set()
+        for el in value.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None, node.lineno
+            out.add(el.value)
+        return out, node.lineno
+    return None
+
+
+def _call_name(node: ast.Call) -> str | None:
+    """Last segment of the called name: `a.b.device_put(...)` -> device_put."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+class TransferSeamChecker(ProjectChecker):
+    rules = {
+        OBS03: "device transfer bypasses the accounted telemetry seam "
+               "(raw device_put in backend.py, or a non-literal/undeclared "
+               "plane name at a seam call site)",
+    }
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        decl_file = root / DECL_MODULE
+        if not decl_file.is_file():
+            return  # partial tree (fixture dirs) — nothing to cross-check
+        decl = _parse_planes(decl_file)
+        if decl is None:
+            yield Finding(
+                decl_file.as_posix(), 1, 0, OBS03,
+                f"{DECL_MODULE} must declare {DECL_NAME} so OBS03 can "
+                "cross-check seam call sites against it",
+            )
+            return
+        planes, lineno = decl
+        if planes is None:
+            yield Finding(
+                decl_file.as_posix(), lineno, 0, OBS03,
+                f"{DECL_NAME} must be a literal tuple of string constants "
+                "so OBS03 can cross-check seam call sites against it",
+            )
+            return
+        for path in sorted(root.rglob("*.py")):
+            if path == decl_file:
+                continue  # the seam itself forwards plane names internally
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except (OSError, SyntaxError):
+                continue  # LINT01 reports unparseable files
+            is_backend = path.as_posix().endswith(BACKEND_MODULE)
+            yield from self._check_tree(path.as_posix(), tree, planes,
+                                        is_backend)
+
+    def _check_tree(self, path: str, tree: ast.AST, planes: set[str],
+                    is_backend: bool) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if is_backend and name == "device_put":
+                yield Finding(
+                    path, node.lineno, node.col_offset, OBS03,
+                    "raw device_put in backend.py bypasses the accounted "
+                    "transfer seam — route the upload through "
+                    "telemetry.accounted_put so the bytes are attributed",
+                )
+                continue
+            if name not in SEAM_METHODS:
+                continue
+            arg = None
+            if node.args:
+                arg = node.args[0]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "plane":
+                        arg = kw.value
+                        break
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                yield Finding(
+                    path, node.lineno, node.col_offset, OBS03,
+                    f"{name}() plane must be a string literal so OBS03 can "
+                    f"cross-check it against {DECL_NAME}",
+                )
+            elif arg.value not in planes:
+                yield Finding(
+                    path, node.lineno, node.col_offset, OBS03,
+                    f"{name}({arg.value!r}) attributes bytes to a plane "
+                    f"not declared in {DECL_NAME}",
+                )

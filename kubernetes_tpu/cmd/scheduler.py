@@ -198,6 +198,15 @@ class SchedulerServer:
                     self._send(200, json.dumps(
                         ledger.snapshot(last=last, slowest=slowest), indent=2
                     ), "application/json")
+                elif self.path.startswith("/debug/devicetelemetry"):
+                    # device telemetry zpage: transfer ledger per plane,
+                    # compile tracker, device-memory watermark
+                    telemetry = (
+                        server.scheduler.flight_recorder.device_telemetry
+                    )
+                    self._send(200, json.dumps(
+                        telemetry.snapshot(), indent=2
+                    ), "application/json")
                 elif self.path.startswith("/debug/traces"):
                     # OTLP-shaped span export (the /debug/traces zpage);
                     # ?last=N bounds to the most recent N root spans

@@ -7,6 +7,9 @@ regressed by more than the tolerance (default 10%):
 - throughput rows (unit "pods/s..."): regression = new < old * 0.9
 - latency keys  (sli_p50_s, sli_p99_s, trace_p50_s, trace_p99_s):
   regression = new > old * 1.1
+- device keys   (upload_bytes_per_wave, compile_count): lower is better —
+  growth past the tolerance means host->device transfer crept back in or
+  a kernel started recompiling per wave (a recompile storm)
 - SLI pass flags (sli_p50_ok, sli_p99_ok): true -> false is a regression
   outright — a blown target never hides inside the tolerance band
 
@@ -34,6 +37,8 @@ import sys
 
 TOLERANCE = 0.10
 LATENCY_KEYS = ("sli_p50_s", "sli_p99_s", "trace_p50_s", "trace_p99_s")
+# device telemetry rows (devicetelemetry.py bench_columns): lower is better
+DEVICE_KEYS = ("upload_bytes_per_wave", "compile_count")
 OK_KEYS = ("sli_p50_ok", "sli_p99_ok")
 
 
@@ -122,25 +127,27 @@ def compare(old_rows: dict[str, dict], new_rows: dict[str, dict],
     failures: list[str] = []
     for metric in sorted(set(old_rows) & set(new_rows)):
         old, new = old_rows[metric], new_rows[metric]
-        checks: list[tuple[str, float, float, bool]] = []
+        checks: list[tuple[str, float, float, bool, str]] = []
         unit = str(old.get("unit", ""))
         if unit.startswith("pods/s"):
             ov, nv = _num(old, "value"), _num(new, "value")
             if ov is not None and nv is not None:
-                checks.append(("value", ov, nv, True))  # higher is better
+                checks.append(("value", ov, nv, True, ""))  # higher is better
         for key in LATENCY_KEYS:
             ov, nv = _num(old, key), _num(new, key)
             if ov is not None and nv is not None:
-                checks.append((key, ov, nv, False))  # lower is better
-        for key, ov, nv, higher_better in checks:
+                checks.append((key, ov, nv, False, "s"))  # lower is better
+        for key in DEVICE_KEYS:
+            ov, nv = _num(old, key), _num(new, key)
+            if ov is not None and nv is not None:
+                checks.append((key, ov, nv, False, ""))  # lower is better
+        for key, ov, nv, higher_better, suf in checks:
             if higher_better:
                 bad = nv < ov * (1.0 - tolerance)
-                arrow = f"{ov:g} -> {nv:g} ({(nv / ov - 1) * 100:+.1f}%)" \
-                    if ov else f"{ov:g} -> {nv:g}"
             else:
                 bad = nv > ov * (1.0 + tolerance) and nv - ov > 1e-9
-                arrow = f"{ov:g}s -> {nv:g}s ({(nv / ov - 1) * 100:+.1f}%)" \
-                    if ov else f"{ov:g}s -> {nv:g}s"
+            arrow = f"{ov:g}{suf} -> {nv:g}{suf}" + (
+                f" ({(nv / ov - 1) * 100:+.1f}%)" if ov else "")
             if bad:
                 msg = f"{metric}.{key}: {arrow} exceeds {tolerance:.0%} tolerance"
                 why = _explain(old, new)

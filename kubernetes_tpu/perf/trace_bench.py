@@ -174,6 +174,11 @@ def run_trace_bench(shape: str = "poisson", seed: int = 7,
         "ledger_completed": ledger.completed_total,
         "ledger_dropped_open": ledger.dropped_open,
     }
+    # device telemetry columns (diagnostic, not in DETERMINISTIC_KEYS:
+    # compile/upload accounting can shift with kernel-shape tuning without
+    # changing any scheduling decision — the gate bounds them at ±10%)
+    row.update(sched.flight_recorder.device_telemetry.bench_columns(
+        sched.flight_recorder.phase_snapshot().get("waves", 0)))
     return row
 
 
@@ -195,9 +200,19 @@ def _smoke() -> int:
     from .regression_gate import run_gate
 
     row = run_trace_bench(shape="poisson", seed=7, pods=200)
-    missing = [k for k in DETERMINISTIC_KEYS + ("segments",) if k not in row]
+    device_keys = ("upload_bytes_per_wave", "compile_count",
+                   "mem_watermark_bytes")
+    missing = [k for k in DETERMINISTIC_KEYS + ("segments",) + device_keys
+               if k not in row]
     if missing:
         print(json.dumps({"smoke": "FAIL", "missing_keys": missing}))
+        return 1
+    if not (row["upload_bytes_per_wave"] > 0 and row["compile_count"] > 0
+            and row["mem_watermark_bytes"] > 0):
+        print(json.dumps({"smoke": "FAIL",
+                          "error": "device telemetry reported zero "
+                                   "upload/compile/watermark — the backend "
+                                   "seams are not routing through it"}))
         return 1
     if row["scheduled"] != row["pods"]:
         print(json.dumps({"smoke": "FAIL",

@@ -212,6 +212,38 @@ class SchedulerMetrics:
             "Recorded p50/p99 of per-pod latency by ledger segment",
             labels=("segment", "quantile"), stability="BETA",
         )
+        # device telemetry (transfer ledger / compile tracker / memory
+        # watermark; emitted by scheduler/tpu/devicetelemetry.py — OBS02
+        # keeps its LEDGER_SERIES in sync)
+        self.tpu_transfer_bytes = r.counter(
+            "scheduler_tpu_transfer_bytes_total",
+            "Bytes crossing the host<->device boundary, by direction "
+            "(upload|fetch) and transfer plane",
+            labels=("direction", "plane"),
+        )
+        self.tpu_wave_transfer_bytes = r.histogram(
+            "scheduler_tpu_wave_transfer_bytes",
+            "Per-wave host<->device transfer bytes, by direction",
+            labels=("direction",),
+            buckets=tuple(float(4 ** i * 1024) for i in range(10)),
+        )
+        self.tpu_compiles = r.counter(
+            "scheduler_tpu_compiles_total",
+            "XLA compilations (jit cache misses), by kernel entry point "
+            "and shape-signature label",
+            labels=("kernel", "shape"),
+        )
+        self.tpu_compiled_shapes = r.gauge(
+            "scheduler_tpu_compiled_shapes",
+            "Distinct compiled shape signatures per kernel entry point",
+            labels=("kernel",),
+        )
+        self.tpu_device_memory = r.gauge(
+            "scheduler_tpu_device_memory_bytes",
+            "Device-resident plane-buffer bytes (source=ledger from seam "
+            "accounting, source=jax from memory_stats when available)",
+            labels=("source",),
+        )
         # event recorder (satellite: spill/aggregation visibility)
         self.events_total = r.counter(
             "scheduler_events_total",
@@ -324,6 +356,13 @@ class SchedulerMetrics:
             self.wave_fallbacks.inc(record.fallback_reason.split(":")[0])
         if record.injected_faults:
             self.wave_injected_faults.inc(by=record.injected_faults)
+        # device transfer ledger: per-wave byte histograms (getattr-guarded
+        # for records predating the telemetry fields)
+        upload = getattr(record, "upload_bytes", 0)
+        fetch = getattr(record, "fetch_bytes", 0)
+        if upload or fetch:
+            self.tpu_wave_transfer_bytes.observe(float(upload), "upload")
+            self.tpu_wave_transfer_bytes.observe(float(fetch), "fetch")
 
     def breaker_transition(self, old_state: str, new_state: str) -> None:
         """TPU circuit-breaker state change (flightrecorder fan-out). The

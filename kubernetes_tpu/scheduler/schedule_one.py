@@ -783,7 +783,9 @@ class ScheduleOneLoop:
             return 0
         import numpy as np
 
-        rows = np.asarray(sig_scores)
+        # device->host fetch of the per-signature score rows, through the
+        # backend's accounted transfer seam (devicetelemetry "scores" plane)
+        rows = algo.backend.telemetry.accounted_fetch("scores", sig_scores)
         seen: set[int] = set()
         exported = 0
         for pod, gid in zip(fl.pods, fl.sig_ids):

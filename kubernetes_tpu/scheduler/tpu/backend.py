@@ -53,6 +53,7 @@ from ..framework.interface import (
     Status,
 )
 from ..schedule_one import SchedulingAlgorithm, num_feasible_nodes_to_find
+from .devicetelemetry import tree_nbytes
 from .flightrecorder import FlightRecorder
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -60,6 +61,16 @@ def _scatter_rows_jit(dev: dict, rows: dict, idx):
     """Row-scatter every plane in one program (one dispatch, donated
     buffers): dev[k][idx] = rows[k] for all planes simultaneously."""
     return {k: dev[k].at[idx].set(rows[k]) for k in dev}
+
+
+def _bucket_label(bucket_sizes) -> str:
+    """Compact shape label for the compile tracker's metrics series."""
+    return "nb" + "x".join(str(b) for b in bucket_sizes)
+
+
+def _wave_label(bucket_sizes, pad: int, uniq) -> str:
+    g = len(uniq) if uniq is not None else 0
+    return f"pad{pad}/g{g}/{_bucket_label(bucket_sizes)}"
 
 
 def _mt_stream(rng_state) -> np.random.RandomState:
@@ -313,6 +324,10 @@ class TPUBackend:
         # wave_totals dict (same object) so existing consumers keep reading.
         self.recorder = recorder if recorder is not None else FlightRecorder()
         self.perf = self.recorder.wave_totals
+        # accounted host<->device seam (transfer ledger + compile tracker +
+        # memory watermark): every device_put/fetch below routes through it
+        # (kubesched-lint OBS03), so per-plane byte attribution is exact
+        self.telemetry = self.recorder.device_telemetry
         # signature-dedup wave scoring (ISSUE 2): group byte-identical
         # feature rows so the kernel scores each distinct signature once and
         # replays clones from the carry. Decisions are bit-identical either
@@ -400,7 +415,7 @@ class TPUBackend:
                 self._pending_dirty.update(dirty)
         return planes
 
-    def device_inputs(self, planes) -> dict:
+    def device_inputs(self, planes, rec=None) -> dict:
         """Node planes + affinity signature tables, mirrored to device HBM.
 
         Call AFTER feature extraction — features intern affinity signatures.
@@ -418,9 +433,9 @@ class TPUBackend:
             or len(self._pending_dirty) > max(64, planes.n // 2)
         )
         if full:
-            self._device_planes = {
-                k: self._jax.device_put(a) for k, a in planes.as_dict().items()
-            }
+            self._device_planes = self.telemetry.accounted_put(
+                "node_planes", planes.as_dict(), put=self._jax.device_put,
+                record=rec)
             self._uploaded_term_key = planes.ipa_term_key.copy()
             self._mirror_dirty = set()
         elif self._pending_dirty:
@@ -447,17 +462,30 @@ class TPUBackend:
             # kernel rejects every node).
             scatter_in = {k: v for k, v in dev.items() if k != "ipa_term_key"}
             rows_host = {k: host[k][idx] for k in scatter_in}
-            updated = _scatter_rows_jit(scatter_in, rows_host, idx)
+            # explicit accounted put of the scattered rows (and index)
+            # instead of letting the jit call transfer them implicitly:
+            # same avals, same compiled program, exact byte attribution
+            rows_dev = self.telemetry.accounted_put(
+                "carry_scatter", rows_host, put=self._jax.device_put,
+                record=rec)
+            idx_dev = self.telemetry.accounted_put(
+                "carry_scatter", idx, put=self._jax.device_put, record=rec)
+            with self.telemetry.compile_span(
+                    "scatter_rows", ("scatter", planes.bucket_sizes, len(idx)),
+                    label=f"rows{len(idx)}", record=rec):
+                updated = _scatter_rows_jit(scatter_in, rows_dev, idx_dev)
             updated["ipa_term_key"] = dev["ipa_term_key"]
             self._device_planes = updated
-        self._fresh_term_key(planes)
+        self._fresh_term_key(planes, rec)
         self._device_version = planes.version
         self._device_buckets = planes.bucket_sizes
         self._pending_dirty = set()
-        self._refresh_tables(planes)
+        self._refresh_tables(planes, rec)
+        self.telemetry.note_resident(
+            "planes", tree_nbytes(self._device_planes), rec)
         return {**self._device_planes, **self._device_tables}
 
-    def _fresh_term_key(self, planes) -> None:
+    def _fresh_term_key(self, planes, rec=None) -> None:
         """Re-upload the GLOBAL ipa_term_key table when its HOST content
         moved (a new term interned mid-run): the comparison is host-side
         only (last-uploaded copy), so the steady state costs no device
@@ -469,16 +497,19 @@ class TPUBackend:
                 and np.array_equal(self._uploaded_term_key, host_tk)):
             return
         if self._device_planes is not None:
-            self._device_planes["ipa_term_key"] = self._jax.device_put(host_tk)
+            self._device_planes["ipa_term_key"] = self.telemetry.accounted_put(
+                "ipa_term_key", host_tk, put=self._jax.device_put, record=rec)
         self._uploaded_term_key = host_tk.copy()
 
-    def _refresh_tables(self, planes) -> None:
+    def _refresh_tables(self, planes, rec=None) -> None:
         tables = self.extractor.affinity_tables(planes)
         if self._tables_src is not tables:
-            self._device_tables = {
-                k: self._jax.device_put(a) for k, a in tables.items()
-            }
+            self._device_tables = self.telemetry.accounted_put(
+                "affinity_tables", tables, put=self._jax.device_put,
+                record=rec)
             self._tables_src = tables
+            self.telemetry.note_resident(
+                "tables", tree_nbytes(self._device_tables), rec)
 
     def _carry_view(self, planes) -> dict:
         """Device inputs for a single-pod cycle while the wave pipeline's
@@ -528,13 +559,15 @@ class TPUBackend:
         f = self.extractor.features(pod, planes)
         dev = self._carry_view(planes)
         cfg = self.kernel_config(planes, f)
-        out = fit_and_score(cfg, dev, f)
+        self.telemetry.account_upload("features", tree_nbytes(f))
+        with self.telemetry.compile_span(
+                "fit_and_score", (cfg, planes.bucket_sizes),
+                label=_bucket_label(planes.bucket_sizes)):
+            out = fit_and_score(cfg, dev, f)
         return planes, {
-            "fails": np.asarray(out["fails"]),
-            "feasible": np.asarray(out["feasible"]),
-            "insufficient": np.asarray(out["insufficient"]),
-            "too_many_pods": np.asarray(out["too_many_pods"]),
-            "total": np.asarray(out["total"]),
+            k: self.telemetry.accounted_fetch("scores", out[k])
+            for k in ("fails", "feasible", "insufficient",
+                      "too_many_pods", "total")
         }
 
     def run_batched(self, pods: list[Pod], snapshot, rng=None,
@@ -573,12 +606,20 @@ class TPUBackend:
             tie_words = clone_tie_words(
                 rng, n_slots * MAX_TIE_DRAWS + MAX_TIE_DRAWS
             )
-        _winners_dev, info = batched_assign(cfg, dev, feats, tie_words,
-                                            sig_ids=sig_ids, uniq_idx=uniq)
+        self.telemetry.account_upload(
+            "features", tree_nbytes(feats) + tree_nbytes(tie_words))
+        with self.telemetry.compile_span(
+                "batched_assign",
+                (cfg, planes.bucket_sizes, n_slots,
+                 len(uniq) if uniq is not None else 0,
+                 tie_words is not None, False, False),
+                label=_wave_label(planes.bucket_sizes, n_slots, uniq)):
+            _winners_dev, info = batched_assign(cfg, dev, feats, tie_words,
+                                                sig_ids=sig_ids, uniq_idx=uniq)
         # ONE device→host transfer for everything the host needs: winners ++
         # [tie_consumed, tie_overflow] (separate np.asarray calls each pay
         # the tunnel's full round-trip latency)
-        packed = np.asarray(info["packed"])
+        packed = self.telemetry.accounted_fetch("results", info["packed"])
         winners, consumed, overflow = (
             packed[: len(pods)], int(packed[-2]), bool(packed[-1])
         )
@@ -640,6 +681,8 @@ class TPUBackend:
         # resident score rows are scores AGAINST the carry planes — they
         # die with it
         self.sig_cache.clear()
+        self.telemetry.note_resident("carry", 0)
+        self.telemetry.note_resident("sig_table", 0)
 
     def mark_external(self) -> None:
         """An event outside the wave pipeline's own writeback touched
@@ -724,7 +767,7 @@ class TPUBackend:
                 chained = True
             else:
                 with self.recorder.wave_phase("upload", rec):
-                    dev = self.device_inputs(planes)
+                    dev = self.device_inputs(planes, rec)
         except NeedResync as e:
             # caller drains and retries; this attempt's record closes here
             self.recorder.end_wave(rec, fallback_reason=f"resync: {e}")
@@ -766,12 +809,24 @@ class TPUBackend:
                     # inside the next kernel's trace — no host sync/eager op
                     cursor_init = prev.info["tie_consumed"]
         with self.recorder.wave_phase("dispatch", rec):
-            _winners_dev, info = batched_assign(
-                cfg, dev, feats, tie_words, cursor_init,
-                frame_shift if prev is not None else 0,
-                sig_ids=sig_ids, uniq_idx=uniq,
-                carry_map=carry_map, sig_table=sig_table,
-            )
+            # the wave's stacked features (+ tie words) cross to the device
+            # implicitly with this jit call — accounting-only seam entry
+            self.telemetry.account_upload(
+                "features", tree_nbytes(feats) + tree_nbytes(tie_words), rec)
+            with self.telemetry.compile_span(
+                    "batched_assign",
+                    (cfg, planes.bucket_sizes, pad,
+                     len(uniq) if uniq is not None else 0,
+                     tie_words is not None, carry_map is not None,
+                     sig_table is not None),
+                    label=_wave_label(planes.bucket_sizes, pad, uniq),
+                    record=rec):
+                _winners_dev, info = batched_assign(
+                    cfg, dev, feats, tie_words, cursor_init,
+                    frame_shift if prev is not None else 0,
+                    sig_ids=sig_ids, uniq_idx=uniq,
+                    carry_map=carry_map, sig_table=sig_table,
+                )
         if xw_key is not None and "sig_table" in info:
             if carry_map is None:
                 # nothing was replayed (cold cache / fresh upload / reuse
@@ -794,6 +849,12 @@ class TPUBackend:
                 self._carry[k] = info[k]
         self._carry_anti = self._carry_anti or bool(feats["ipa_anti_add"].any())
         self._carry_pref = self._carry_pref or bool(feats["ipa_pref_add"].any())
+        # the carry overlay and resident score table now hold device memory;
+        # fold the new live total into the wave's high-water mark
+        self.telemetry.note_resident("carry", tree_nbytes(self._carry))
+        self.telemetry.note_resident(
+            "sig_table", tree_nbytes(self.sig_cache.table))
+        self.telemetry.stamp_watermark(rec)
         fl = InflightWave(pods, planes, info, pad, frame_shift,
                           sig_ids=sig_ids)
         fl.record = rec
@@ -829,7 +890,8 @@ class TPUBackend:
                     rec, fallback_reason=f"injected: {e}")
             raise DeviceFlakeError(f"injected collect fault: {e}") from e
         with self.recorder.wave_phase("wait", rec):
-            packed = np.asarray(fl.info["packed"])
+            packed = self.telemetry.accounted_fetch(
+                "results", fl.info["packed"], rec)
         winners = packed[: len(fl.pods)]
         final_abs, overflow = int(packed[-2]), bool(packed[-1])
         if self._inflight is fl:

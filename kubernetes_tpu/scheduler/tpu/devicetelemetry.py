@@ -1,0 +1,381 @@
+"""Device telemetry: transfer ledger, compile tracker, memory watermark.
+
+The flight recorder answers "where did wave k spend its time" and the pod
+ledger answers "where did pod p spend its seconds"; this module answers
+the device-side questions those two cannot see: how many bytes crossed
+the host<->device boundary (and for which plane), how often XLA had to
+compile a fresh program (and for which shape), and how many bytes of
+plane buffers are resident on the device right now.
+
+Three instruments, one owner (the FlightRecorder, like the pod ledger):
+
+- **Transfer ledger** — every host->device upload and device->host fetch
+  in scheduler/tpu/backend.py routes through the accounted seam
+  (`accounted_put` / `accounted_fetch`, or the accounting-only
+  `account_upload` for bytes the jit call moves implicitly). Each call
+  names a plane from TRANSFER_PLANES; bytes accumulate per plane and
+  per direction, and per wave onto `WaveRecord.upload_bytes` /
+  `fetch_bytes` / `*_by_plane`. kubesched-lint rule OBS03
+  (analysis/transfer_seam.py) cross-parses backend.py to keep every
+  `device_put` behind this seam and every plane name declared here.
+- **Compile tracker** — `compile_span(kernel, signature)` wraps each
+  jitted entry point. The first time a (kernel, signature) pair is seen
+  the call is a jit cache miss (jax caches on static args + array
+  avals, which the signature mirrors), so its wall time is the
+  compile+run cost: it is counted, labelled with a compact shape label,
+  and recorded as a `compile/<kernel>` phase on the wave record.
+- **Memory watermark** — `note_resident(group, nbytes)` tracks the
+  bytes of each device-resident buffer group (base planes, affinity
+  tables, carry overlay, signature table); live bytes are the sum, the
+  watermark is the running max, and jax `memory_stats()` (when jax is
+  already imported — this module never imports it) is emitted alongside
+  as a cross-check.
+
+Everything here is HOST-SIDE ONLY (OBS01): accounting happens around
+device calls, never inside jitted code, consumes no rng, and no
+scheduling decision reads the telemetry — the bit-compat goldens hold
+with telemetry on or off. `accounted_put` preserves values and dtypes
+exactly (it calls the same `device_put` the backend would), so routing
+a transfer through the seam cannot change a binding.
+
+Every metric series this module emits is declared in LEDGER_SERIES and
+registered in scheduler/metrics.py; kubesched-lint rule OBS02
+cross-parses the two files to keep them in sync (the FI01 pattern).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import sys
+import threading
+import time
+
+import numpy as np
+
+# Series this telemetry emits. OBS02 checks (a) every name here is
+# registered in scheduler/metrics.py and (b) every _series() call site
+# uses a literal name from this tuple. Keep it a literal tuple.
+LEDGER_SERIES = (
+    "scheduler_tpu_transfer_bytes_total",
+    "scheduler_tpu_compiles_total",
+    "scheduler_tpu_compiled_shapes",
+    "scheduler_tpu_device_memory_bytes",
+)
+
+# Named planes a seam call may attribute transfer bytes to. OBS03
+# cross-parses this tuple against every seam call site in the tree:
+# the plane argument must be a string literal naming one of these.
+# Keep it a literal tuple of string constants.
+TRANSFER_PLANES = (
+    "node_planes",      # full base-mirror upload of every node plane
+    "carry_scatter",    # O(churn) row scatter repairing the base mirror
+    "affinity_tables",  # interned (anti-)affinity signature tables
+    "ipa_term_key",     # global IPA term-key table refresh
+    "features",         # the wave's stacked pod features + tie words
+    "results",          # packed winners/cursor fetch at collect
+    "scores",           # per-node score/fail rows (single-pod, sig export)
+)
+
+# Device-resident buffer groups for the memory watermark.
+RESIDENT_GROUPS = ("planes", "tables", "carry", "sig_table")
+
+UPLOAD = "upload"
+FETCH = "fetch"
+
+
+def tree_nbytes(tree) -> int:
+    """Total nbytes of an array, or of every value of a dict of arrays."""
+    if tree is None:
+        return 0
+    if isinstance(tree, dict):
+        return sum(tree_nbytes(v) for v in tree.values())
+    return int(getattr(tree, "nbytes", 0) or 0)
+
+
+def _shape_label(signature) -> str:
+    """Deterministic compact fallback label for a compile signature.
+
+    Call sites pass an explicit structural label (e.g. "pad32/g8");
+    this digest is only the fallback, and it must be stable across
+    processes (str hashing is salted, hashlib is not) so bench
+    artifacts compare across runs.
+    """
+    digest = hashlib.md5(repr(signature).encode()).hexdigest()[:10]
+    return f"sig-{digest}"
+
+
+class DeviceTelemetry:
+    """Accounted transfer seam + compile tracker + memory watermark.
+
+    Owned by the FlightRecorder (one per scheduler); called from the
+    backend around its device seams. `enabled` exists for the
+    bit-compat golden — production keeps it on. When disabled the seam
+    still performs the underlying put/fetch (the backend depends on the
+    return value) and only the accounting is skipped.
+    """
+
+    def __init__(self, metrics=None):
+        self.enabled = True
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # direction -> {plane: cumulative bytes}
+        self._transfers: dict[str, dict[str, int]] = {UPLOAD: {}, FETCH: {}}
+        self._totals: dict[str, int] = {UPLOAD: 0, FETCH: 0}
+        # compile tracker: first-seen (kernel, signature) == jit cache miss
+        self._compiled: set = set()
+        self._compiles: dict[str, int] = {}
+        self._compile_seconds: dict[str, float] = {}
+        self._shapes: dict[str, set[str]] = {}
+        # memory watermark: group -> currently resident bytes
+        self._resident: dict[str, int] = {}
+        self._watermark = 0
+
+    # -- emission (every name literal, declared in LEDGER_SERIES: OBS02) ----
+
+    def _series(self, name: str):
+        m = self.metrics
+        registry = getattr(m, "registry", None) if m is not None else None
+        return registry.get(name) if registry is not None else None
+
+    # -- transfer ledger -----------------------------------------------------
+
+    def accounted_put(self, plane: str, tree, put, record=None):
+        """Host->device upload through the accounted seam.
+
+        `put` is the device placement function (jax.device_put); it is
+        applied per leaf, so the returned mirror has exactly the values,
+        dtypes and structure a direct `put` would produce — the seam is
+        bit-compatible by construction. Bytes are attributed to `plane`
+        (and to `record` when the upload belongs to a wave).
+        """
+        if isinstance(tree, dict):
+            out = {k: put(v) for k, v in tree.items()}
+        else:
+            out = put(tree)
+        self._account(UPLOAD, plane, tree_nbytes(tree), record)
+        return out
+
+    def accounted_fetch(self, plane: str, value, record=None):
+        """Device->host fetch through the accounted seam (np.asarray)."""
+        host = np.asarray(value)
+        self._account(FETCH, plane, int(host.nbytes), record)
+        return host
+
+    def account_upload(self, plane: str, nbytes: int, record=None) -> None:
+        """Accounting-only upload entry, for bytes a jit call transfers
+        implicitly (the wave's feature arrays cross with the dispatch)."""
+        self._account(UPLOAD, plane, nbytes, record)
+
+    def account_fetch(self, plane: str, nbytes: int, record=None) -> None:
+        """Accounting-only fetch entry (value already on host)."""
+        self._account(FETCH, plane, nbytes, record)
+
+    def _account(self, direction: str, plane: str, nbytes, record) -> None:
+        if not self.enabled:
+            return
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        with self._lock:
+            by_plane = self._transfers[direction]
+            by_plane[plane] = by_plane.get(plane, 0) + nbytes
+            self._totals[direction] += nbytes
+        if record is not None:
+            if direction == UPLOAD:
+                record.upload_bytes += nbytes
+                record.upload_by_plane[plane] = (
+                    record.upload_by_plane.get(plane, 0) + nbytes)
+            else:
+                record.fetch_bytes += nbytes
+                record.fetch_by_plane[plane] = (
+                    record.fetch_by_plane.get(plane, 0) + nbytes)
+            self.stamp_watermark(record)
+        counter = self._series("scheduler_tpu_transfer_bytes_total")
+        if counter is not None:
+            counter.inc(direction, plane, by=float(nbytes))
+
+    # -- compile tracker -----------------------------------------------------
+
+    @contextlib.contextmanager
+    def compile_span(self, kernel: str, signature, label: str | None = None,
+                     record=None):
+        """Wrap a jitted entry point; first-seen signature == cache miss.
+
+        jax's jit cache keys on static args + array avals; `signature`
+        is the host-side mirror of that key, so the first call with a
+        fresh signature pays tracing + XLA compilation and its wall time
+        is recorded as the `compile/<kernel>` phase. Later calls with a
+        seen signature yield with zero overhead beyond a set lookup.
+        """
+        if not self.enabled:
+            yield
+            return
+        key = (kernel, signature)
+        with self._lock:
+            seen = key in self._compiled
+        if seen:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            shape = label if label is not None else _shape_label(signature)
+            with self._lock:
+                first = key not in self._compiled
+                if first:
+                    self._compiled.add(key)
+                    self._compiles[kernel] = self._compiles.get(kernel, 0) + 1
+                    self._compile_seconds[kernel] = (
+                        self._compile_seconds.get(kernel, 0.0) + elapsed)
+                    self._shapes.setdefault(kernel, set()).add(shape)
+            if first:
+                if record is not None:
+                    phase = f"compile/{kernel}"
+                    record.phases[phase] = (
+                        record.phases.get(phase, 0.0) + elapsed)
+                counter = self._series("scheduler_tpu_compiles_total")
+                if counter is not None:
+                    counter.inc(kernel, shape)
+
+    def compile_count(self, kernel: str | None = None) -> int:
+        with self._lock:
+            if kernel is not None:
+                return self._compiles.get(kernel, 0)
+            return sum(self._compiles.values())
+
+    def compiled_shapes(self, kernel: str) -> list[str]:
+        with self._lock:
+            return sorted(self._shapes.get(kernel, ()))
+
+    # -- memory watermark ----------------------------------------------------
+
+    def note_resident(self, group: str, nbytes: int, record=None) -> None:
+        """Record that buffer `group` now holds `nbytes` on the device
+        (0 == freed). Live bytes are the sum across groups; the
+        watermark is the running max of the live total."""
+        if not self.enabled:
+            return
+        nbytes = max(int(nbytes), 0)
+        with self._lock:
+            self._resident[group] = nbytes
+            live = sum(self._resident.values())
+            if live > self._watermark:
+                self._watermark = live
+        if record is not None:
+            self.stamp_watermark(record)
+
+    def stamp_watermark(self, record) -> None:
+        """Fold the current live total into the wave's high-water mark."""
+        if not self.enabled or record is None:
+            return
+        with self._lock:
+            live = sum(self._resident.values())
+        if live > record.mem_watermark_bytes:
+            record.mem_watermark_bytes = live
+
+    def _jax_memory_bytes(self) -> int | None:
+        """Device bytes_in_use per jax, as a cross-check on the ledger.
+
+        Reads sys.modules only — this module must never import jax
+        (the flight-recorder CLI demo runs without it)."""
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return None
+        try:
+            total, found = 0, False
+            for dev in jax.local_devices():
+                stats = getattr(dev, "memory_stats", None)
+                stats = stats() if callable(stats) else None
+                if stats and "bytes_in_use" in stats:
+                    total += int(stats["bytes_in_use"])
+                    found = True
+            return total if found else None
+        except Exception:
+            return None
+
+    # -- gauges (once per wave, from FlightRecorder.end_wave) ----------------
+
+    def update_gauges(self) -> None:
+        mem = self._series("scheduler_tpu_device_memory_bytes")
+        shapes = self._series("scheduler_tpu_compiled_shapes")
+        if mem is None and shapes is None:
+            return
+        with self._lock:
+            live = sum(self._resident.values())
+            shape_counts = {k: len(v) for k, v in self._shapes.items()}
+        if mem is not None:
+            mem.set(float(live), "ledger")
+            jax_bytes = self._jax_memory_bytes()
+            if jax_bytes is not None:
+                mem.set(float(jax_bytes), "jax")
+        if shapes is not None:
+            for kernel, count in shape_counts.items():
+                shapes.set(float(count), kernel)
+
+    # -- queries / snapshots -------------------------------------------------
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "upload_bytes_total": self._totals[UPLOAD],
+                "fetch_bytes_total": self._totals[FETCH],
+                "compiles_total": sum(self._compiles.values()),
+                "distinct_shapes": {k: len(v)
+                                    for k, v in sorted(self._shapes.items())},
+                "mem_live_bytes": sum(self._resident.values()),
+                "mem_watermark_bytes": self._watermark,
+            }
+
+    def snapshot(self) -> dict:
+        """The /debug/devicetelemetry zpage payload (also embedded in
+        the flight-recorder dump and SIGUSR1 log line)."""
+        with self._lock:
+            out = {
+                "transfers": {
+                    UPLOAD: {
+                        "total_bytes": self._totals[UPLOAD],
+                        "by_plane": dict(sorted(
+                            self._transfers[UPLOAD].items())),
+                    },
+                    FETCH: {
+                        "total_bytes": self._totals[FETCH],
+                        "by_plane": dict(sorted(
+                            self._transfers[FETCH].items())),
+                    },
+                },
+                "compiles": {
+                    "total": sum(self._compiles.values()),
+                    "by_kernel": dict(sorted(self._compiles.items())),
+                    "seconds_by_kernel": {
+                        k: round(v, 6)
+                        for k, v in sorted(self._compile_seconds.items())},
+                    "distinct_shapes": {
+                        k: sorted(v)
+                        for k, v in sorted(self._shapes.items())},
+                },
+                "memory": {
+                    "resident_bytes": dict(sorted(self._resident.items())),
+                    "live_bytes": sum(self._resident.values()),
+                    "watermark_bytes": self._watermark,
+                },
+            }
+        jax_bytes = self._jax_memory_bytes()
+        if jax_bytes is not None:
+            out["memory"]["jax_bytes_in_use"] = jax_bytes
+        return out
+
+    def bench_columns(self, waves: int) -> dict:
+        """The three device columns bench.py/bench_suite.py report and
+        the regression gate compares (lower is better for all three)."""
+        with self._lock:
+            upload = self._totals[UPLOAD]
+            compiles = sum(self._compiles.values())
+            watermark = self._watermark
+        return {
+            "upload_bytes_per_wave": int(round(upload / waves)) if waves else 0,
+            "compile_count": compiles,
+            "mem_watermark_bytes": watermark,
+        }

@@ -36,6 +36,7 @@ from dataclasses import dataclass, field
 
 from ...utils import faultinject
 from ...utils.tracing import Tracer
+from .devicetelemetry import DeviceTelemetry
 from .podlatency import PodLatencyLedger
 
 # loop-level pipeline phases (the phase_profile bench.py reports)
@@ -87,6 +88,14 @@ class WaveRecord:
     # device (the pipelined overlap), and the per-wave ratio of prep hidden
     overlap_s: float = 0.0
     pipeline_overlap_ratio: float = 0.0
+    # device transfer ledger (devicetelemetry.py): bytes this wave moved
+    # across the host<->device boundary, attributed per TRANSFER_PLANES name
+    upload_bytes: int = 0
+    fetch_bytes: int = 0
+    upload_by_plane: dict = field(default_factory=dict)
+    fetch_by_plane: dict = field(default_factory=dict)
+    # per-wave high-water mark of device-resident plane-buffer bytes
+    mem_watermark_bytes: int = 0
     phases: dict = field(default_factory=dict)  # phase -> seconds
     duration_s: float = 0.0
     profile: str | None = None  # watchdog pprof capture, when triggered
@@ -118,6 +127,11 @@ class WaveRecord:
             "retries": self.retries,
             "overlap_s": round(self.overlap_s, 6),
             "pipeline_overlap_ratio": round(self.pipeline_overlap_ratio, 4),
+            "upload_bytes": self.upload_bytes,
+            "fetch_bytes": self.fetch_bytes,
+            "upload_by_plane": dict(self.upload_by_plane),
+            "fetch_by_plane": dict(self.fetch_by_plane),
+            "mem_watermark_bytes": self.mem_watermark_bytes,
             "phases": {k: round(v, 6) for k, v in self.phases.items()},
         }
         if self.profile is not None:
@@ -141,6 +155,9 @@ class FlightRecorder:
         self.metrics = metrics
         # per-pod e2e latency decomposition (README "Observability")
         self.pod_ledger = PodLatencyLedger(metrics=metrics)
+        # device-side accounting: transfer ledger, compile tracker,
+        # memory watermark (README "Device telemetry")
+        self.device_telemetry = DeviceTelemetry(metrics=metrics)
         self.slow_wave_deadline_s = slow_wave_deadline_s or None
         self.profile_seconds = profile_seconds
         # cumulative phase stopwatches (the dicts bench.py diffs)
@@ -347,8 +364,9 @@ class FlightRecorder:
                 m.wave_completed(rec)
             if hasattr(m, "update_sli_quantiles"):
                 m.update_sli_quantiles()
-        # ledger quantile gauges refresh once per wave, not per pod
+        # ledger/telemetry gauges refresh once per wave, not per pod
         self.pod_ledger.update_gauges()
+        self.device_telemetry.update_gauges()
         return rec
 
     def _capture_slow_wave(self, rec: WaveRecord) -> None:
@@ -432,6 +450,7 @@ class FlightRecorder:
             "wave_totals": {k: round(v, 6)
                             for k, v in self.wave_snapshot().items()},
             "pod_latency": self.pod_ledger.snapshot(slowest=8),
+            "device_telemetry": self.device_telemetry.snapshot(),
             "records": [r.to_dict() for r in self.records(last)],
         }, indent=2)
 
@@ -488,12 +507,23 @@ def _demo() -> FlightRecorder:
     (no device, no jax import) — the `make obs` smoke."""
     rec = FlightRecorder(capacity=8, slow_wave_deadline_s=0.05,
                          profile_seconds=0.05)
+    tel = rec.device_telemetry
     for i in range(10):
         wr = rec.begin_wave(pods=30 + i, pad=32)
         with rec.wave_phase("sync", wr):
             pass
+        # device telemetry, driven exactly as the backend drives it:
+        # accounted transfers per plane, a compile span per jit signature
+        # (only wave 0's is a cache miss), resident-buffer bytes
+        tel.account_upload("features", 4096, wr)
+        tel.account_upload("carry_scatter", 1024, wr)
+        tel.note_resident("planes", 1 << 20, wr)
+        with tel.compile_span("batched_assign", ("demo", 32),
+                              label="pad32", record=wr):
+            pass
         with rec.wave_phase("dispatch", wr):
             pass
+        tel.accounted_fetch("results", list(range(8)), wr)
         rec.note_launch(wr, signatures=3, dedup=True)
         rec.note_cross_wave(wr, hits=(3 if i else 0),
                             misses=(0 if i else 3), evictions=0)
@@ -537,6 +567,30 @@ def main(argv: list[str] | None = None) -> int:
     if args.demo:
         rec = _demo()
         payload = json.loads(rec.dump(last=args.last))
+        # smoke-assert the device-telemetry block's presence and schema
+        # (the `make obs` contract for the SIGUSR1/zpage payload)
+        telemetry = payload.get("device_telemetry")
+        if not isinstance(telemetry, dict):
+            print("FAIL: dump payload is missing 'device_telemetry'")
+            return 1
+        missing = [k for k in ("transfers", "compiles", "memory")
+                   if k not in telemetry]
+        records = payload.get("records", [])
+        bad_records = [r["wave_id"] for r in records
+                       if "upload_bytes" not in r
+                       or "mem_watermark_bytes" not in r
+                       or sum(r.get("upload_by_plane", {}).values())
+                       != r["upload_bytes"]]
+        if missing or bad_records:
+            print(f"FAIL: device telemetry schema: missing={missing} "
+                  f"bad_records={bad_records}")
+            return 1
+        if telemetry["transfers"]["upload"]["total_bytes"] <= 0 \
+                or telemetry["compiles"]["total"] != 1 \
+                or telemetry["memory"]["watermark_bytes"] <= 0:
+            print("FAIL: device telemetry totals: "
+                  + json.dumps(telemetry, indent=2))
+            return 1
     elif args.dump:
         import sys
 

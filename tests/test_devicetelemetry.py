@@ -1,0 +1,383 @@
+"""Device telemetry: transfer-ledger accounting math, compile-tracker
+once-per-signature semantics, memory watermark, the telemetry-on/off
+bit-compat golden, the wave-size-controller <-> compile-cache interaction,
+and the /debug/devicetelemetry zpage."""
+
+from __future__ import annotations
+
+import json
+from types import SimpleNamespace
+
+import numpy as np
+
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
+from kubernetes_tpu.scheduler.tpu.devicetelemetry import (
+    LEDGER_SERIES,
+    RESIDENT_GROUPS,
+    TRANSFER_PLANES,
+    DeviceTelemetry,
+    _shape_label,
+    tree_nbytes,
+)
+from kubernetes_tpu.scheduler.tpu.wavecontroller import _next_pow2
+from kubernetes_tpu.store import Store
+from tests.wrappers import make_node, make_pod
+
+
+def _record():
+    """Minimal stand-in exposing the WaveRecord fields telemetry writes."""
+    return SimpleNamespace(upload_bytes=0, fetch_bytes=0,
+                           upload_by_plane={}, fetch_by_plane={},
+                           mem_watermark_bytes=0, phases={})
+
+
+# ------------------------------------------------------------- unit: ledger
+
+
+class TestTransferLedger:
+    def test_accounted_put_is_bit_exact_and_accounted(self):
+        tel = DeviceTelemetry()
+        rec = _record()
+        tree = {"cpu": np.arange(8, dtype=np.float32),
+                "mem": np.arange(4, dtype=np.int32)}
+        out = tel.accounted_put("node_planes", tree, put=lambda a: a,
+                                record=rec)
+        # per-leaf put: same structure, same values, same dtypes
+        assert set(out) == set(tree)
+        for k in tree:
+            assert out[k] is tree[k]
+        want = sum(a.nbytes for a in tree.values())
+        assert rec.upload_bytes == want
+        assert rec.upload_by_plane == {"node_planes": want}
+        assert tel.summary()["upload_bytes_total"] == want
+
+    def test_accounted_fetch_returns_host_array(self):
+        tel = DeviceTelemetry()
+        rec = _record()
+        host = tel.accounted_fetch("results", np.arange(6, dtype=np.int64),
+                                   record=rec)
+        assert isinstance(host, np.ndarray)
+        assert rec.fetch_bytes == host.nbytes
+        assert rec.fetch_by_plane == {"results": host.nbytes}
+
+    def test_by_plane_sums_to_totals(self):
+        tel = DeviceTelemetry()
+        rec = _record()
+        tel.account_upload("features", 100, rec)
+        tel.account_upload("carry_scatter", 50, rec)
+        tel.account_upload("features", 25, rec)
+        tel.account_fetch("results", 40, rec)
+        snap = tel.snapshot()
+        up = snap["transfers"]["upload"]
+        assert up["total_bytes"] == 175
+        assert sum(up["by_plane"].values()) == up["total_bytes"]
+        assert up["by_plane"] == {"features": 125, "carry_scatter": 50}
+        assert sum(rec.upload_by_plane.values()) == rec.upload_bytes == 175
+        assert sum(rec.fetch_by_plane.values()) == rec.fetch_bytes == 40
+
+    def test_zero_and_negative_bytes_ignored(self):
+        tel = DeviceTelemetry()
+        tel.account_upload("features", 0)
+        tel.account_upload("features", -5)
+        assert tel.summary()["upload_bytes_total"] == 0
+
+    def test_disabled_seam_still_transfers_but_accounts_nothing(self):
+        tel = DeviceTelemetry()
+        tel.enabled = False
+        rec = _record()
+        out = tel.accounted_put("features", np.ones(4), put=lambda a: a,
+                                record=rec)
+        host = tel.accounted_fetch("results", np.ones(4), record=rec)
+        assert out.shape == (4,) and host.shape == (4,)
+        with tel.compile_span("k", ("sig",), record=rec):
+            pass
+        tel.note_resident("planes", 1 << 20, rec)
+        assert rec.upload_bytes == rec.fetch_bytes == 0
+        assert rec.mem_watermark_bytes == 0
+        s = tel.summary()
+        assert s["upload_bytes_total"] == 0 and s["compiles_total"] == 0
+
+    def test_tree_nbytes(self):
+        assert tree_nbytes(None) == 0
+        assert tree_nbytes(np.zeros(3, dtype=np.float32)) == 12
+        assert tree_nbytes({"a": np.zeros(2, dtype=np.int64),
+                            "b": None}) == 16
+
+
+# ----------------------------------------------------- unit: compile tracker
+
+
+class TestCompileTracker:
+    def test_first_seen_signature_counts_once(self):
+        tel = DeviceTelemetry()
+        rec = _record()
+        for _ in range(3):
+            with tel.compile_span("batched_assign", ("cfg", (64,), 16),
+                                  label="pad16", record=rec):
+                pass
+        assert tel.compile_count("batched_assign") == 1
+        assert tel.compiled_shapes("batched_assign") == ["pad16"]
+        assert "compile/batched_assign" in rec.phases
+
+    def test_distinct_signatures_count_separately(self):
+        tel = DeviceTelemetry()
+        for pad in (8, 16, 8, 32, 16):
+            with tel.compile_span("batched_assign", ("cfg", (64,), pad),
+                                  label=f"pad{pad}"):
+                pass
+        assert tel.compile_count("batched_assign") == 3
+        assert tel.compiled_shapes("batched_assign") == \
+            ["pad16", "pad32", "pad8"]
+        assert tel.compile_count() == 3
+
+    def test_shape_label_fallback_is_deterministic(self):
+        sig = ("cfg", (64, 128), 16, True)
+        assert _shape_label(sig) == _shape_label(sig)
+        assert _shape_label(sig) != _shape_label(("other",))
+        assert _shape_label(sig).startswith("sig-")
+
+
+# ---------------------------------------------------- unit: memory watermark
+
+
+class TestMemoryWatermark:
+    def test_watermark_is_running_max_of_live_total(self):
+        tel = DeviceTelemetry()
+        rec = _record()
+        tel.note_resident("planes", 1000, rec)
+        tel.note_resident("tables", 500, rec)
+        assert rec.mem_watermark_bytes == 1500
+        tel.note_resident("planes", 200, rec)  # shrink: watermark holds
+        snap = tel.snapshot()["memory"]
+        assert snap["live_bytes"] == 700
+        assert snap["watermark_bytes"] == 1500
+        assert rec.mem_watermark_bytes == 1500
+
+    def test_free_resets_live_not_watermark(self):
+        tel = DeviceTelemetry()
+        tel.note_resident("carry", 64)
+        tel.note_resident("carry", 0)
+        m = tel.snapshot()["memory"]
+        assert m["live_bytes"] == 0 and m["watermark_bytes"] == 64
+
+    def test_bench_columns(self):
+        tel = DeviceTelemetry()
+        tel.account_upload("features", 1000)
+        with tel.compile_span("k", ("s",)):
+            pass
+        tel.note_resident("planes", 77)
+        cols = tel.bench_columns(waves=4)
+        assert cols == {"upload_bytes_per_wave": 250, "compile_count": 1,
+                        "mem_watermark_bytes": 77}
+        assert tel.bench_columns(waves=0)["upload_bytes_per_wave"] == 0
+
+
+# ------------------------------------------------------------ declarations
+
+
+class TestDeclarations:
+    def test_series_and_planes_are_nonempty_string_tuples(self):
+        for decl in (LEDGER_SERIES, TRANSFER_PLANES, RESIDENT_GROUPS):
+            assert decl and all(isinstance(s, str) for s in decl)
+            assert len(set(decl)) == len(decl)
+
+
+# ------------------------------------------------------- wave-path telemetry
+
+
+class TestWavePathTelemetry:
+    def _sched(self, nodes=4, wave_size=8, seed=3):
+        store = Store()
+        for i in range(nodes):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        sched = Scheduler(
+            store,
+            profiles=[Profile(backend="tpu", wave_size=wave_size)],
+            metrics=SchedulerMetrics(),
+            seed=seed,
+        )
+        sched.start()
+        return store, sched
+
+    def test_wave_records_carry_attributed_bytes(self):
+        store, sched = self._sched()
+        for i in range(10):
+            store.create(make_pod(f"w{i}", cpu="500m", mem="256Mi"))
+        sched.pump()
+        sched.schedule_pending()
+        assert sum(1 for p in store.pods() if p.spec.node_name) == 10
+        records = [r for r in sched.flight_recorder.records() if r.pods]
+        assert records
+        for rec in records:
+            assert rec.upload_bytes > 0
+            assert sum(rec.upload_by_plane.values()) == rec.upload_bytes
+            assert sum(rec.fetch_by_plane.values()) == rec.fetch_bytes
+            for plane in list(rec.upload_by_plane) + list(rec.fetch_by_plane):
+                assert plane in TRANSFER_PLANES
+            assert rec.mem_watermark_bytes > 0
+        tel = sched.flight_recorder.device_telemetry
+        snap = tel.snapshot()
+        assert snap["transfers"]["upload"]["total_bytes"] > 0
+        assert snap["compiles"]["total"] > 0
+        # backend and recorder share one telemetry object
+        assert sched.flight_recorder.device_telemetry is \
+            sched.algorithms["default-scheduler"].backend.telemetry
+
+    def test_compile_count_flat_across_repeated_same_shape_waves(self):
+        """Same queue depth + same pod shapes wave after wave: after the
+        warm-up waves (first wave has no carry overlay, the second
+        introduces it) the compile tracker must go flat — a growing count
+        here is exactly the recompile storm the gate exists to catch."""
+        store, sched = self._sched(nodes=8, wave_size=16)
+        tel = sched.flight_recorder.device_telemetry
+        counts = []
+        for round_no in range(5):
+            for i in range(10):
+                store.create(make_pod(f"r{round_no}-{i}", cpu="100m",
+                                      mem="64Mi"))
+            sched.pump()
+            sched.schedule_pending()
+            counts.append(tel.compile_count())
+        assert counts[0] > 0
+        assert counts[2] == counts[3] == counts[4]
+
+    def test_dump_includes_device_telemetry_block(self):
+        store, sched = self._sched()
+        for i in range(6):
+            store.create(make_pod(f"d{i}", cpu="100m", mem="64Mi"))
+        sched.pump()
+        sched.schedule_pending()
+        dump = json.loads(sched.flight_recorder.dump())
+        block = dump["device_telemetry"]
+        assert set(block) >= {"transfers", "compiles", "memory"}
+        assert block["transfers"]["upload"]["total_bytes"] > 0
+        # per-wave attribution rides along in the dumped records too
+        assert any(r.get("upload_bytes", 0) > 0 for r in dump["records"])
+
+
+# ---------------------------------------------------------------- bit-compat
+
+
+class TestTelemetryBitCompat:
+    def test_placements_and_rng_identical_telemetry_on_vs_off(self):
+        """The telemetry consumes no rng and influences no decision: the
+        same seeded wave workload places identically — and leaves the
+        tie-break rng stream at the same point — with it on (production
+        default) and off."""
+
+        def run(telemetry_on: bool):
+            store = Store()
+            for i in range(8):
+                store.create(make_node(f"n{i}", cpu="4", mem="8Gi",
+                                       zone=f"z{i % 2}"))
+            sched = Scheduler(
+                store,
+                profiles=[Profile(backend="tpu", wave_size=16)],
+                metrics=SchedulerMetrics(),
+                seed=11,
+            )
+            sched.flight_recorder.device_telemetry.enabled = telemetry_on
+            sched.start()
+            for i in range(24):
+                kind = i % 3
+                cpu, mem = [("1", "1Gi"), ("900m", "900Mi"),
+                            ("800m", "800Mi")][kind]
+                store.create(make_pod(f"g{i:02d}", cpu=cpu, mem=mem,
+                                      labels={"app": "abc"[kind]}))
+            sched.pump()
+            sched.schedule_pending()
+            placements = {p.meta.key: p.spec.node_name
+                          for p in store.pods()}
+            rng_tail = [sched.algorithms["default-scheduler"].rng.random()
+                        for _ in range(5)]
+            return placements, rng_tail
+
+        on, off = run(True), run(False)
+        assert on[0] == off[0]  # identical bindings
+        assert on[1] == off[1]  # identical seeded tie-break stream
+        assert any(on[0].values())
+
+
+# ------------------------------------- wave sizing <-> compile-cache churn
+
+
+class TestWaveSizeCompileInteraction:
+    def test_churning_queue_depth_bounds_compiled_shapes(self):
+        """The adaptive controller pow2-buckets wave sizes precisely so
+        depth churn cannot fan out XLA program shapes. Feed identical
+        pods at churning depths and assert the batched-assign kernel
+        compiled at most 2x the reachable pow2 pads (the x2 covers the
+        cold/warm carry-overlay variants of each pad)."""
+        cap = 64
+        store = Store()
+        for i in range(8):
+            store.create(make_node(f"c{i}", cpu="16", mem="32Gi"))
+        sched = Scheduler(
+            store,
+            profiles=[Profile(backend="tpu", wave_size=cap)],
+            metrics=SchedulerMetrics(),
+            seed=5,
+        )
+        sched.start()
+        depths = [3, 9, 17, 40, 5, 33, 12, 60, 2, 25]
+        n = 0
+        for depth in depths:
+            for _ in range(depth):
+                store.create(make_pod(f"p{n}", cpu="100m", mem="64Mi"))
+                n += 1
+            sched.pump()
+            sched.schedule_pending()
+        assert sum(1 for p in store.pods() if p.spec.node_name) == n
+
+        buckets = set()
+        pad = _next_pow2(1, 8)
+        while pad <= cap:
+            buckets.add(pad)
+            pad <<= 1
+        shapes = sched.flight_recorder.device_telemetry.compiled_shapes(
+            "batched_assign")
+        assert shapes, "wave path never hit the compile tracker"
+        assert len(shapes) <= 2 * len(buckets), shapes
+        for label in shapes:  # every shape is a pow2-bucketed pad
+            pad = int(label.split("/", 1)[0].removeprefix("pad"))
+            assert pad in buckets, shapes
+
+
+# --------------------------------------------------------------------- zpage
+
+
+class TestDeviceTelemetryZpage:
+    def test_served(self):
+        import urllib.request
+
+        from kubernetes_tpu.cmd.scheduler import SchedulerServer
+        from kubernetes_tpu.config.types import SchedulerConfiguration
+
+        store = Store()
+        store.create(make_node("n0", cpu="8", mem="16Gi"))
+        for i in range(6):
+            store.create(make_pod(f"z{i}", cpu="500m", mem="256Mi"))
+        cfg = SchedulerConfiguration()
+        cfg.profiles[0].backend = "tpu"
+        cfg.profiles[0].wave_size = 4
+        server = SchedulerServer(store, cfg)
+        port = server.serve(0)
+        try:
+            server.scheduler.start()
+            server.scheduler.pump()
+            server.scheduler.schedule_pending()
+
+            url = f"http://127.0.0.1:{port}/debug/devicetelemetry"
+            with urllib.request.urlopen(url) as r:
+                assert r.status == 200
+                assert r.headers.get("Content-Type") == "application/json"
+                payload = json.loads(r.read())
+            assert set(payload) >= {"transfers", "compiles", "memory"}
+            up = payload["transfers"]["upload"]
+            assert up["total_bytes"] > 0
+            assert sum(up["by_plane"].values()) == up["total_bytes"]
+            assert payload["compiles"]["total"] > 0
+            assert payload["memory"]["watermark_bytes"] > 0
+        finally:
+            server.shutdown()

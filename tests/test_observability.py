@@ -278,7 +278,8 @@ class TestFlightRecorderZpage:
             assert code == 200 and ctype == "application/json"
             payload = json.loads(body)
             assert set(payload) == {"summary", "phase_totals",
-                                    "wave_totals", "pod_latency", "records"}
+                                    "wave_totals", "pod_latency",
+                                    "device_telemetry", "records"}
             assert payload["records"], "scheduled waves must show up"
             assert len(payload["records"]) <= 2
 

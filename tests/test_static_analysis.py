@@ -20,6 +20,7 @@ from kubernetes_tpu.analysis import (
     RetryDisciplineChecker,
     SignatureSyncChecker,
     SnapshotImmutabilityChecker,
+    TransferSeamChecker,
     check_file,
     known_rules,
     run_paths,
@@ -817,6 +818,99 @@ class TestLedgerSeriesSync:
     def test_repo_ledger_series_in_sync(self):
         """The shipped ledger's LEDGER_SERIES matches scheduler/metrics.py."""
         assert list(LedgerSeriesChecker().check_project(PKG)) == []
+
+
+# ------------------------------------------------------------------ OBS03
+
+
+TELEMETRY_DECL_SRC = """\
+TRANSFER_PLANES = (
+    "node_planes",
+    "features",
+)
+
+class DeviceTelemetry:
+    def accounted_put(self, plane, tree, put, record=None):
+        return put(tree)
+"""
+
+
+def write_seam_tree(root, backend_src, decl=TELEMETRY_DECL_SRC):
+    d = root / "scheduler/tpu/devicetelemetry.py"
+    d.parent.mkdir(parents=True, exist_ok=True)
+    d.write_text(decl)
+    b = root / "scheduler/tpu/backend.py"
+    b.write_text(textwrap.dedent(backend_src))
+    return root
+
+
+class TestTransferSeam:
+    def test_seam_routed_backend_clean(self, tmp_path):
+        write_seam_tree(tmp_path, """
+            class Backend:
+                def upload(self, planes, rec):
+                    return self.telemetry.accounted_put(
+                        "node_planes", planes, put=self._jax.device_put,
+                        record=rec)
+        """)
+        assert list(TransferSeamChecker().check_project(tmp_path)) == []
+
+    def test_raw_device_put_in_backend_flagged(self, tmp_path):
+        write_seam_tree(tmp_path, """
+            class Backend:
+                def upload(self, planes):
+                    return {k: self._jax.device_put(a)
+                            for k, a in planes.items()}
+        """)
+        fs = list(TransferSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS03"]
+        assert "raw device_put" in fs[0].message
+
+    def test_undeclared_plane_flagged(self, tmp_path):
+        write_seam_tree(tmp_path, """
+            class Backend:
+                def upload(self, planes, rec):
+                    self.telemetry.account_upload("mystery_plane", 64, rec)
+        """)
+        fs = list(TransferSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS03"]
+        assert "mystery_plane" in fs[0].message
+
+    def test_non_literal_plane_flagged(self, tmp_path):
+        write_seam_tree(tmp_path, """
+            class Backend:
+                def upload(self, plane, nbytes):
+                    self.telemetry.account_upload(plane, nbytes)
+        """)
+        fs = list(TransferSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS03"]
+        assert "string literal" in fs[0].message
+
+    def test_non_literal_declaration_flagged(self, tmp_path):
+        write_seam_tree(tmp_path, "x = 1\n",
+                        decl="TRANSFER_PLANES = tuple(make_planes())\n")
+        fs = list(TransferSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS03"]
+        assert "literal tuple" in fs[0].message
+
+    def test_seam_call_outside_backend_checked(self, tmp_path):
+        # plane-name discipline applies tree-wide, not just in backend.py
+        root = write_seam_tree(tmp_path, "x = 1\n")
+        p = root / "scheduler/schedule_one.py"
+        p.write_text("def f(algo, x):\n"
+                     "    return algo.backend.telemetry.accounted_fetch("
+                     "'undeclared', x)\n")
+        fs = list(TransferSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS03"]
+
+    def test_partial_tree_is_silent(self, tmp_path):
+        # fixture dirs without devicetelemetry.py can't be cross-checked
+        assert list(TransferSeamChecker().check_project(tmp_path)) == []
+
+    def test_repo_transfer_seam_in_sync(self):
+        """Every shipped seam call site uses a declared plane and the
+        shipped backend.py has no raw device_put."""
+        assert list(TransferSeamChecker().check_project(PKG)) == []
 
 
 # ------------------------------------------------------------------ SIG01
