@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint test obs chaos bench-smoke bench-gate verify
+.PHONY: lint test obs chaos bench-smoke bench-gate multichip-smoke verify
 
 # kubesched-lint: AST invariant checker (rule IDs in README "Invariants");
 # exits non-zero on any unsuppressed finding
@@ -38,12 +38,20 @@ obs:
 bench-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.perf.trace_bench --smoke
 
-# mechanical perf-regression gate: diff the newest two BENCH_* artifacts
-# in the repo root; >10% regression in any throughput/SLI row fails and
-# names the ledger segment whose p50 delta explains it
+# mechanical perf-regression gate: diff the newest two artifacts per
+# family (BENCH_* and MULTICHIP_BENCH_*, gated independently) in the repo
+# root; >10% regression in any throughput/SLI/device row fails and names
+# the ledger segment whose p50 delta explains it
 bench-gate:
 	$(PY) -m kubernetes_tpu.perf.regression_gate
 
+# sharded-mesh smoke: a small node sweep through the full backend on an
+# 8-virtual-CPU-device mesh, asserting per-burst upload bytes stay flat
+# (delta scatter, not full re-put) and pods place; no artifact written
+multichip-smoke:
+	$(PY) bench_multichip.py --nodes-sweep 512,1024 --bursts 3 --wave 8 --churn 16 --smoke
+
 # the full gate: invariants, tier-1 tests, chaos soaks (incl. the
-# arrival-trace runs), observability smoke, trace-bench smoke
-verify: lint test chaos obs bench-smoke
+# arrival-trace runs), observability smoke, trace-bench smoke, and the
+# sharded-mesh upload-flatness smoke
+verify: lint test chaos obs bench-smoke multichip-smoke

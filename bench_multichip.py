@@ -1,11 +1,29 @@
 """Sharded-wave throughput on a multi-device mesh (CPU-mesh evidence).
 
-Measures the ICI-sharded scheduling kernel (SURVEY §2.9 item 1: the
-pods×nodes feasibility/score program partitioned over the nodes axis, with
-the scan-carried batched assignment) at a scale where sharding matters —
-1024 nodes over 8 devices (128 bucket rows per shard), streaming 512-pod
-waves — and prints ONE JSON line with the steady-state sharded wave
-throughput plus the single-device number for the same program.
+Two modes:
+
+**Default** — measures the ICI-sharded scheduling kernel (SURVEY §2.9
+item 1: the pods×nodes feasibility/score program partitioned over the
+nodes axis, with the scan-carried batched assignment) at a scale where
+sharding matters — 1024 nodes over 8 devices (128 bucket rows per shard),
+streaming 512-pod waves — and prints ONE JSON line with the steady-state
+sharded wave throughput plus the single-device number for the same
+program.
+
+**`--nodes-sweep 5000,25000,50000,100000`** — the scale-out
+done-criterion: for each node count, run the FULL backend
+(`TPUBackend` on a `MeshContext`, launch/collect bursts with node churn
+between bursts) and emit one JSONL row per node count with the device
+columns the regression gate diffs (`upload_bytes_per_wave` /
+`compile_count` / `mem_watermark_bytes`) plus `upload_flat_ratio` —
+max/min per-burst upload bytes across the warm bursts. Flat (≤ ~1.1)
+means the delta row scatter holds: per-burst upload is O(churn rows),
+not O(nodes); only the first burst pays the sanctioned
+`_cold_start_upload` full re-put. Rows go to stdout and (unless
+`--smoke`) to the standing `MULTICHIP_BENCH_*.jsonl` artifact that
+`make bench-gate` diffs against the previous round; `--smoke` instead
+asserts flatness and placements inline (the `make verify` multichip
+smoke).
 
 The sharded program is an EXPLICIT jax.shard_map (parallel/mesh.py
 _sharded_assign_jit): per scan step the only cross-shard traffic is scalar
@@ -28,6 +46,7 @@ are exercised for real, even when only one physical chip is attached.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -38,8 +57,11 @@ N_NODES = 1024
 WAVE = 512
 ROUNDS = 4
 
+ARTIFACT = "MULTICHIP_BENCH_r08.jsonl"
 
-def main() -> None:
+
+def _boot() -> str:
+    """Path setup + virtual CPU mesh; returns the repo root."""
     base = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, base)
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -51,6 +73,149 @@ def main() -> None:
     from __graft_entry__ import _ensure_devices
 
     _ensure_devices(N_DEVICES)
+    return base
+
+
+def run_sweep(nodes_list: list[int], bursts: int, wave: int, churn: int,
+              artifact: str | None) -> None:
+    """Backend burst loop per node count; one JSONL row each."""
+    base = _boot()
+    import random
+
+    from kubernetes_tpu.api.resource import ResourceNames
+    from kubernetes_tpu.parallel.mesh import MeshContext, scheduler_mesh
+    from kubernetes_tpu.scheduler.tpu.backend import NeedResync, TPUBackend
+    from kubernetes_tpu.testing import make_pod, synthetic_cluster
+    from kubernetes_tpu.utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
+    rows = []
+    for n_nodes in nodes_list:
+        names = ResourceNames()
+        cache, snap = synthetic_cluster(n_nodes, n_zones=8, names=names)
+        backend = TPUBackend(
+            names, context=MeshContext(scheduler_mesh(N_DEVICES)))
+        rng = random.Random(0)
+        uploads, burst_s, placed = [], [], 0
+        seq = 0
+        for b in range(bursts):
+            pods = [make_pod(f"b{b}-p{i}", cpu="500m", mem="512Mi",
+                             labels={"app": f"g{i % 4}"})
+                    for i in range(wave)]
+            up0 = backend.telemetry.summary()["upload_bytes_total"]
+            t0 = time.perf_counter()
+            try:
+                flight = backend.launch_batched(pods, snap, rng=rng,
+                                                pad_to=wave)
+            except NeedResync:
+                # the scheduler-loop protocol after external churn: drop
+                # the carry (folding its rows into the pending dirty set)
+                # and retry — the relaunch repairs the base mirror with
+                # one delta row scatter, not a full re-put
+                backend.invalidate_carry()
+                flight = backend.launch_batched(pods, snap, rng=rng,
+                                                pad_to=wave)
+            hosts, _ = backend.collect(flight, rng=rng)
+            burst_s.append(time.perf_counter() - t0)
+            uploads.append(
+                backend.telemetry.summary()["upload_bytes_total"] - up0)
+            placed += sum(1 for h in hosts if h)
+            # churn: new running pods on a rotating slice of nodes — the
+            # next burst's sync dirties exactly those rows, so its upload
+            # must be the delta scatter, never a full re-put
+            for k in range(churn):
+                cache.add_pod(make_pod(
+                    f"churn-{seq}", cpu="100m", mem="64Mi",
+                    node_name=f"node-{(b * churn + k) % n_nodes}"))
+                seq += 1
+            snap = cache.update_snapshot(snap)
+            backend.mark_external()
+        warm_up = uploads[1:] or uploads
+        warm_s = burst_s[1:] or burst_s
+        cols = backend.telemetry.bench_columns(len(warm_up))
+        rows.append({
+            "metric": f"multichip_sweep_{n_nodes}_nodes",
+            "value": round(wave * len(warm_s) / sum(warm_s), 1),
+            "unit": "pods/s (backend burst loop)",
+            "devices": N_DEVICES,
+            "nodes": n_nodes,
+            "wave": wave,
+            "bursts": bursts,
+            "churn_rows": churn,
+            "placed": placed,
+            # steady state: warm-burst mean, not the ledger total (which
+            # would average the cold full upload in)
+            "upload_bytes_per_wave": int(sum(warm_up) / len(warm_up)),
+            "upload_bytes_cold": uploads[0],
+            "upload_bytes_by_burst": uploads,
+            "upload_flat_ratio": (
+                round(max(warm_up) / min(warm_up), 3)
+                if min(warm_up) else None),
+            "compile_count": cols["compile_count"],
+            "mem_watermark_bytes": cols["mem_watermark_bytes"],
+            "device": "cpu-mesh",
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    if artifact:
+        path = os.path.join(base, artifact)
+        with open(path, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        print(f"# wrote {path}", file=sys.stderr)
+
+
+def run_smoke(nodes_list: list[int], bursts: int, wave: int,
+              churn: int) -> None:
+    """make verify seam: small sweep, flatness asserted inline."""
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        run_sweep(nodes_list, bursts, wave, churn, artifact=None)
+    rows = [json.loads(line) for line in buf.getvalue().splitlines()
+            if line.startswith("{")]
+    assert len(rows) == len(nodes_list), rows
+    for row in rows:
+        assert row["placed"] > 0, row
+        ratio = row["upload_flat_ratio"]
+        assert ratio is not None and ratio <= 1.10, (
+            f"upload not flat at {row['nodes']} nodes: per-burst bytes "
+            f"{row['upload_bytes_by_burst']} (ratio {ratio}) — a full "
+            "node_planes re-put leaked out of _cold_start_upload")
+        assert row["upload_bytes_cold"] > row["upload_bytes_per_wave"], row
+        print(json.dumps(row))
+    print("multichip-smoke: PASS (upload flat burst-over-burst, "
+          f"{len(rows)} node counts)")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--nodes-sweep", default=None,
+                        help="comma-separated node counts; enables the "
+                             "backend burst-loop sweep mode")
+    parser.add_argument("--bursts", type=int, default=4)
+    parser.add_argument("--wave", type=int, default=16)
+    parser.add_argument("--churn", type=int, default=64,
+                        help="node rows churned between bursts")
+    parser.add_argument("--artifact", default=ARTIFACT,
+                        help="standing JSONL artifact name ('' disables)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="assert upload flatness, write no artifact")
+    args = parser.parse_args()
+    if args.nodes_sweep:
+        nodes = [int(x) for x in args.nodes_sweep.split(",") if x.strip()]
+        if args.smoke:
+            run_smoke(nodes, args.bursts, args.wave, args.churn)
+        else:
+            run_sweep(nodes, args.bursts, args.wave, args.churn,
+                      args.artifact or None)
+        return
+    run_headline()
+
+
+def run_headline() -> None:
+    _boot()
     import jax
     import numpy as np
 
@@ -128,7 +293,10 @@ def main() -> None:
             x = jax.lax.psum(x + i, NODE_AXIS) % 1000003
         return x
 
-    chained = jax.jit(jax.shard_map(
+    # mesh.py's version shim: jax.shard_map only exists on newer jax
+    from kubernetes_tpu.parallel.mesh import _shard_map
+
+    chained = jax.jit(_shard_map(
         chain, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P(NODE_AXIS),
     ))
     probe = jax.device_put(

@@ -19,6 +19,9 @@ and emits is registered in scheduler/metrics.py (OBS02),
 accounted device-transfer seam — no raw device_put in backend.py and every
 seam call names a declared TRANSFER_PLANES plane, so the transfer ledger
 sees every byte (OBS03),
+cold-start plane-upload seam — the full-plane re-put of the node planes is
+only legal inside backend.py's one sanctioned cold-start seam, so per-burst
+upload bytes cannot silently re-couple to cluster size (SHARD01),
 and retry/fault-injection discipline — no hand-rolled backoff loops or
 ad-hoc random flakes outside the shared helpers (RET01).
 
@@ -45,6 +48,7 @@ from .obs_purity import ObservabilityPurityChecker
 from .pipeline_state import PipelineStateChecker
 from .registry_sync import RegistrySyncChecker
 from .retry_discipline import RetryDisciplineChecker
+from .shard_seam import ShardSeamChecker
 from .signature_sync import SignatureSyncChecker
 from .snapshot_immutability import SnapshotImmutabilityChecker
 from .transfer_seam import TransferSeamChecker
@@ -63,6 +67,7 @@ __all__ = [
     "ProjectChecker",
     "RegistrySyncChecker",
     "RetryDisciplineChecker",
+    "ShardSeamChecker",
     "SignatureSyncChecker",
     "SnapshotImmutabilityChecker",
     "TransferSeamChecker",

@@ -114,6 +114,7 @@ def default_checkers() -> list[Checker]:
     from .pipeline_state import PipelineStateChecker
     from .registry_sync import RegistrySyncChecker
     from .retry_discipline import RetryDisciplineChecker
+    from .shard_seam import ShardSeamChecker
     from .signature_sync import SignatureSyncChecker
     from .snapshot_immutability import SnapshotImmutabilityChecker
     from .transfer_seam import TransferSeamChecker
@@ -131,6 +132,7 @@ def default_checkers() -> list[Checker]:
         FaultPointChecker(),
         LedgerSeriesChecker(),
         TransferSeamChecker(),
+        ShardSeamChecker(),
     ]
 
 

@@ -1,0 +1,121 @@
+"""Cold-start plane-upload seam rule (SHARD01).
+
+The delta-maintained device planes only deliver their flat upload curve if
+the full-plane re-put of the node planes stays demoted to the one
+sanctioned cold-start seam: `TPUBackend._cold_start_upload` in
+`scheduler/tpu/backend.py` (cold start, bucket reshape, builder full
+rebuild, or a dirty set so large a wholesale put beats the row scatter).
+A second full-plane upload site added in a refactor silently re-couples
+per-burst transfer volume to cluster size — `upload_bytes_per_wave` grows
+with node count again and the multichip done-criterion ("upload flat at
+25k-100k nodes") regresses without any test failing, because the result is
+still bit-identical. Nothing can enforce this at runtime (the scatter path
+and the full path produce the same mirror), so — like OBS03 for the
+accounted seam and FI01 for fault points — the enforcement is
+cross-parsing.
+
+SHARD01 flags any `accounted_put` / `account_upload` call whose plane
+literal is `"node_planes"` that is not lexically inside a function named
+`_cold_start_upload` in `scheduler/tpu/backend.py`. Per-row delta traffic
+must use the `"delta_rows"` / `"delta_idx"` planes instead; non-literal
+plane names are OBS03's concern and are not re-flagged here.
+
+Findings are project-scoped, so per-line suppressions do not apply —
+route the upload through `_cold_start_upload` (or scatter the dirty rows)
+instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding, ProjectChecker
+
+SHARD01 = "SHARD01"
+
+BACKEND_MODULE = "scheduler/tpu/backend.py"
+SEAM_FUNC = "_cold_start_upload"
+FULL_PLANE = "node_planes"
+UPLOAD_METHODS = {"accounted_put", "account_upload"}
+
+
+def _call_name(node: ast.Call) -> str | None:
+    """Last segment of the called name: `a.b.accounted_put(...)`."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _plane_literal(node: ast.Call) -> str | None:
+    """The call's plane argument when it is a string literal, else None."""
+    arg = None
+    if node.args:
+        arg = node.args[0]
+    else:
+        for kw in node.keywords:
+            if kw.arg == "plane":
+                arg = kw.value
+                break
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+class _UploadVisitor(ast.NodeVisitor):
+    """Collect full-plane upload calls with their enclosing function name."""
+
+    def __init__(self) -> None:
+        self.stack: list[str] = []
+        self.hits: list[tuple[ast.Call, str | None]] = []
+
+    def _visit_func(self, node: ast.AST) -> None:
+        self.stack.append(node.name)  # type: ignore[attr-defined]
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (_call_name(node) in UPLOAD_METHODS
+                and _plane_literal(node) == FULL_PLANE):
+            self.hits.append((node, self.stack[-1] if self.stack else None))
+        self.generic_visit(node)
+
+
+class ShardSeamChecker(ProjectChecker):
+    rules = {
+        SHARD01: "full-plane re-put of the node planes outside the one "
+                 "sanctioned cold-start seam (backend.py "
+                 f"{SEAM_FUNC}) — scatter dirty rows instead so "
+                 "upload bytes stay flat as node count grows",
+    }
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        backend_file = root / BACKEND_MODULE
+        if not backend_file.is_file():
+            return  # partial tree (fixture dirs) — nothing to cross-check
+        for path in sorted(root.rglob("*.py")):
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except (OSError, SyntaxError):
+                continue  # LINT01 reports unparseable files
+            is_backend = path.as_posix().endswith(BACKEND_MODULE)
+            visitor = _UploadVisitor()
+            visitor.visit(tree)
+            for node, func in visitor.hits:
+                if is_backend and func == SEAM_FUNC:
+                    continue
+                where = (f"function {func}()" if func else "module scope")
+                yield Finding(
+                    path.as_posix(), node.lineno, node.col_offset, SHARD01,
+                    f"full-plane upload of {FULL_PLANE!r} in {where} — the "
+                    "only sanctioned full re-put is backend.py "
+                    f"{SEAM_FUNC}(); churned rows must go through the "
+                    "'delta_rows'/'delta_idx' scatter path",
+                )

@@ -990,17 +990,28 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
             pts_fail = pts_fail | (active & ~has_key) | (
                 active & has_key & (skew > f["hard_skew"][c])
             )
+        # IPA masks are carry-dependent through the ipa_* planes (a
+        # placement in a topology domain can flip them at EVERY node of the
+        # domain), so — exactly like the hard-spread mask — they are
+        # recomputed every step and shared by both tiers; the resident row
+        # never caches IPA state, only the equality gate below decides
+        # whether the cached ew/fit/segs columns still apply.
+        if cfg.ipa_active:
+            ipa1, ipa2, ipa3 = _ipa_filters(cfg, p, f, comm)
+            ipa_fail = ipa1 | ipa2 | ipa3
+        else:
+            ipa_fail = jnp.zeros(p["valid"].shape[0], bool)
         row_in = (t_ew[sid], t_ffit[sid], t_feas[sid], t_segs[sid],
                   t_pcs[sid])
         replay = t_valid[sid]
-        if cfg.n_hard > 0:
+        if cfg.n_hard > 0 or cfg.ipa_active:
             # the resident t_ffit column is maintained exactly (placements
             # only change fit at their winner row, and every winner row is
-            # patched), so static_ok & ~t_ffit & ~pts_fail IS the full-tier
-            # feasibility; replay only when the resident row agrees with it.
-            # comm-reduced so every shard takes the same cond branch (the
-            # branches contain collectives)
-            feas_live = sp["static_ok"] & ~row_in[1] & ~pts_fail
+            # patched), so static_ok & ~t_ffit & ~pts_fail & ~ipa_fail IS
+            # the full-tier feasibility; replay only when the resident row
+            # agrees with it. comm-reduced so every shard takes the same
+            # cond branch (the branches contain collectives)
+            feas_live = sp["static_ok"] & ~row_in[1] & ~pts_fail & ~ipa_fail
             mismatch = comm.vsum(
                 (feas_live != row_in[2]).sum().astype(jnp.int32)) > 0
             replay = replay & ~mismatch
@@ -1013,7 +1024,7 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
             insufficient = insufficient.at[:, PODS].set(False)
             too_many = used[:, PODS] + 1 > p["alloc"][:, PODS]
             f_fit = insufficient.any(axis=1) | too_many
-            feasible = sp["static_ok"] & ~f_fit & ~pts_fail
+            feasible = sp["static_ok"] & ~f_fit & ~pts_fail & ~ipa_fail
             ew = (
                 _fit_score(cfg, p, f) * cfg.weight("NodeResourcesFit")
                 + _balanced_score(cfg, p, f)
@@ -1023,6 +1034,9 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
                 cfg, p, f, feasible, comm, capture_shape=capture_shape
             )
             total = _finish_total(cfg, ew, pts, f, sp, feasible, comm)
+            if cfg.ipa_active:
+                total = total + _ipa_score(cfg, p, f, feasible, comm) \
+                    * cfg.weight("InterPodAffinity")
             return total, (ew, f_fit, feasible, segs, pcs)
 
         def _cheap_tier(row):
@@ -1031,6 +1045,11 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
                 cfg, p, f, feasible, sel_counts, segs, pcs, comm
             )
             total = _finish_total(cfg, ew, pts, f, sp, feasible, comm)
+            if cfg.ipa_active:
+                # live recompute, same int32 op order as the non-dedup scan
+                # (the ipa planes ride the carry, never the resident row)
+                total = total + _ipa_score(cfg, p, f, feasible, comm) \
+                    * cfg.weight("InterPodAffinity")
             return total, row
 
         total, row = jax.lax.cond(replay, _cheap_tier, _full_tier, row_in)
@@ -1234,14 +1253,16 @@ def _assign_step(cfg: KernelConfig, planes: dict, present, tie_words, comm,
 def dedup_fast_capable(cfg: KernelConfig, comm=LOCAL_COMM) -> bool:
     """Whether the two-tier signature-replay scan is valid for this config:
     the winner-column patch covers the dynamic state of NodeResourcesFit +
-    spread scoring, hard spread divergence is caught by the per-step
-    feasibility gate (a mismatching row re-runs the full tier), and under
-    sharding the row columns are shard-local while the domain tables stay
-    replicated via psum'd deltas. Only IPA still mutates cross-node state
-    the patch can't track — those waves take full steps (still dedup's
-    static-pass savings, just no per-step shortcut)."""
-    del comm  # kept for API compat; the replay tier is now shard-safe
-    return not cfg.ipa_active
+    spread scoring; carry-dependent masks the patch can't track — hard
+    spread AND inter-pod affinity — are recomputed live each step with their
+    divergence caught by the per-step feasibility equality gate (a
+    mismatching row re-runs the full tier: a lost hit, never a wrong
+    replay). Under sharding the row columns are shard-local, the domain
+    tables stay replicated via psum'd deltas, and the replay predicate is
+    comm-reduced so every shard takes the same branch. No exclusions
+    remain; the signature fast tier applies to every kernelizable config."""
+    del cfg, comm  # kept for API compat; the replay tier covers all configs
+    return True
 
 
 def _batched_assign_core(cfg: KernelConfig, planes: dict, packed_f,

@@ -126,10 +126,30 @@ class PlaneBuilder:
         fp = _canonical_fingerprint(self.vocabs, self.names)
         buckets = self._bucket_sizes(len(nodes), fp)
         p = self._planes
-        if p is None or p.node_names != names or p.bucket_sizes != buckets:
+        # strict append within the same pow2 node bucket: joined nodes get
+        # new tail rows (existing rows keep their index), so membership
+        # growth stays an O(changed) row update with dirty-row tracking
+        # intact — the device mirror repairs it with a delta scatter, not a
+        # full re-put. Removals/reorders still rebuild (rare, sanctioned).
+        append = (
+            p is not None and p.bucket_sizes == buckets
+            and len(names) > len(p.node_names)
+            and names[: len(p.node_names)] == p.node_names
+        )
+        if p is None or (not append and p.node_names != names) \
+                or p.bucket_sizes != buckets:
             p = self._full_build(nodes, names, buckets, fp)
             self.dirty_rows: list[int] | None = None  # None = everything changed
         else:
+            if append and p.node_names != names:
+                old_n = p.n
+                p.node_names = names
+                for i in range(old_n, len(names)):
+                    p.node_index[names[i]] = i
+                p.n = len(names)
+                p.valid[old_n: p.n] = True
+                # new tail rows have no row-cache entry yet, so the loop
+                # below writes (and dirties) exactly them + changed rows
             dirty: list[int] = []
             for i, ni in enumerate(nodes):
                 cached = self._row_cache.get(ni.name)

@@ -9,6 +9,9 @@ API, multi-profile) stay host-side in kubernetes_tpu.scheduler.
 from .mesh import (
     NODE_AXIS,
     WAVE_AXIS,
+    LocalContext,
+    MeshContext,
+    context_from_env,
     replicate,
     scheduler_mesh,
     shard_planes,
@@ -18,6 +21,7 @@ from .mesh import (
 )
 
 __all__ = [
-    "NODE_AXIS", "WAVE_AXIS", "replicate", "scheduler_mesh", "shard_planes",
+    "NODE_AXIS", "WAVE_AXIS", "LocalContext", "MeshContext",
+    "context_from_env", "replicate", "scheduler_mesh", "shard_planes",
     "sharded_batched_assign", "sharded_fit_and_score", "wave_fit_and_score",
 ]

@@ -50,8 +50,10 @@ from ..ops.kernels import (
     KernelConfig,
     _batched_assign_core,
     _fit_and_score_jit,
+    batched_assign,
     dedup_fast_capable,
     filter_masks,
+    fit_and_score,
     scores,
 )
 
@@ -146,9 +148,18 @@ def sharded_fit_and_score(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict, f
     return _fit_and_score_jit(cfg, sharded_planes, replicate(mesh, f))
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 3, 6))
+# spec of the resident per-signature score-row table (sig_table): row
+# columns shard with the nodes, the domain tables ride replicated —
+# identical on the way out of one wave and back into the next chained one
+_SIG_TABLE_SPEC = {"ew": P(None, NODE_AXIS), "ffit": P(None, NODE_AXIS),
+                   "feas": P(None, NODE_AXIS), "segs": P(), "pcs": P()}
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 3, 6, 9))
 def _sharded_assign_jit(cfg: KernelConfig, mesh: Mesh, planes: dict, layout,
-                        packed_f, tie_words, dedup, sig_ids, uniq_idx):
+                        packed_f, tie_words, dedup, sig_ids, uniq_idx,
+                        xwave, cursor_init, frame_shift, carry_map,
+                        sig_table):
     """Explicit shard_map over the nodes axis: every plane arrives
     shard-local, features/tie stream replicated, and the scan step's only
     cross-shard traffic is the scalar collectives AxisComm emits (per-shard
@@ -159,15 +170,21 @@ def _sharded_assign_jit(cfg: KernelConfig, mesh: Mesh, planes: dict, layout,
     With dedup the signature-replay tier runs shard-safe: score-row columns
     stay shard-local while the replay predicate and domain-table deltas ride
     the same scalar/segment psums, so every shard takes the same cond
-    branch."""
+    branch. Cross-wave reuse (xwave) has full parity with the single-device
+    path: the previous chained wave's sig_table hands back in with the same
+    shard layout it came out with, and the tie cursor chains as a replicated
+    device scalar (cursor_init - frame_shift inside the trace)."""
     n_shards = mesh.shape[NODE_AXIS]
     comm = AxisComm(NODE_AXIS, n_shards)
 
-    def body(planes_l, packed_l, tie_l, sig_l, uniq_l):
+    def body(planes_l, packed_l, tie_l, sig_l, uniq_l, cur_l, fs_l,
+             cmap_l, stab_l):
         return _batched_assign_core(
             cfg, planes_l, packed_l, layout, tie_l,
-            np.int32(0), np.int32(0), comm,
+            cur_l, fs_l, comm,
             sig_ids=sig_l, uniq_idx=uniq_l, dedup=dedup,
+            carry_map=cmap_l if xwave else None,
+            sig_table=stab_l if xwave else None, xwave=xwave,
         )
 
     plane_specs = {}
@@ -186,44 +203,59 @@ def _sharded_assign_jit(cfg: KernelConfig, mesh: Mesh, planes: dict, layout,
             "sel_counts": P(NODE_AXIS), "tie_consumed": P(),
             "tie_overflow": P(), "packed": P(),
             **({"sig_scores": P(None, NODE_AXIS),
-                "sig_table": {"ew": P(None, NODE_AXIS),
-                              "ffit": P(None, NODE_AXIS),
-                              "feas": P(None, NODE_AXIS),
-                              "segs": P(), "pcs": P()}} if fast else {}),
+                "sig_table": dict(_SIG_TABLE_SPEC)} if fast else {}),
             **({"ipa_counts": P(NODE_AXIS), "ipa_anti": P(NODE_AXIS),
                 "ipa_pref": P(NODE_AXIS)} if cfg.ipa_active else {}),
         },
     )
     return _shard_map(
         body, mesh=mesh,
-        in_specs=(plane_specs, P(), P(), P(), P()),
+        in_specs=(plane_specs, P(), P(), P(), P(), P(), P(), P(),
+                  dict(_SIG_TABLE_SPEC) if xwave else P()),
         out_specs=out_specs,
         **{_SHARD_MAP_CHECK_KW: False},
-    )(planes, packed_f, tie_words, sig_ids, uniq_idx)
+    )(planes, packed_f, tie_words, sig_ids, uniq_idx, cursor_init,
+      frame_shift, carry_map, sig_table)
 
 
 def sharded_batched_assign(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
-                           batched_f: dict, tie_words=None, sig_ids=None,
-                           uniq_idx=None):
+                           batched_f: dict, tie_words=None, cursor_init=0,
+                           frame_shift=0, sig_ids=None, uniq_idx=None,
+                           carry_map=None, sig_table=None):
     """Sequential-greedy wave over node-sharded planes (lax.scan on pods),
     decisions bit-identical to the single-device batched_assign. sig_ids /
     uniq_idx (see batched_assign) enable signature dedup with the same
     bit-compat contract; the replay tier applies whenever the config is
-    dedup_fast_capable."""
+    dedup_fast_capable. cursor_init / frame_shift / carry_map / sig_table
+    mirror batched_assign exactly: pipelined launches chain their tie
+    cursor as a device scalar and hand the previous chained wave's resident
+    score-row table back for cross-wave signature replay."""
     from ..ops.planes import pack_features
 
     if tie_words is None:
         tie_words = ZERO_TIE_WORDS
     packed, layout = pack_features(batched_f)
     dedup = sig_ids is not None and uniq_idx is not None
+    xwave = bool(dedup and carry_map is not None and sig_table is not None)
     sig_r = (replicate(mesh, np.asarray(sig_ids, np.int32))
              if dedup else replicate(mesh, np.zeros(1, np.int32)))
     uniq_r = (replicate(mesh, np.asarray(uniq_idx, np.int32))
               if dedup else replicate(mesh, np.zeros(1, np.int32)))
+    if isinstance(cursor_init, (int, np.integer)):
+        # np.int32, not a weak python int: keeps the jit signature identical
+        # between first launches and chained ones (see batched_assign)
+        cursor_r = replicate(mesh, np.int32(cursor_init))
+    else:
+        cursor_r = cursor_init  # previous wave's tie_consumed, replicated
+    fs_r = replicate(mesh, np.int32(frame_shift))
+    cmap_r = (replicate(mesh, np.asarray(carry_map, np.int32))
+              if xwave else replicate(mesh, np.zeros(1, np.int32)))
+    stab = sig_table if xwave else replicate(mesh, np.zeros(1, np.int32))
     return _sharded_assign_jit(cfg, mesh, sharded_planes, layout,
                                replicate(mesh, packed),
                                replicate(mesh, tie_words),
-                               dedup, sig_r, uniq_r)
+                               dedup, sig_r, uniq_r, xwave, cursor_r,
+                               fs_r, cmap_r, stab)
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -259,3 +291,120 @@ def wave_fit_and_score(cfg: KernelConfig, mesh: Mesh, sharded_planes: dict,
             )
         bf[k] = jax.device_put(a, sh)
     return _wave_fit_and_score_jit(cfg, sharded_planes, bf)
+
+
+# -- execution-context seam ---------------------------------------------------
+#
+# ONE seam serves 1 device or a sharded mesh (SNIPPETS [2]'s
+# pjit-with-cpu-fallback shape): the backend holds a context and routes
+# every plane placement and kernel entry through it. LocalContext is the
+# fallback — plain device_put + the single-device jitted kernels, byte-for-
+# byte what the backend did before the seam existed — and MeshContext is
+# the NamedSharding path over a (wave, nodes) mesh. Decisions are
+# bit-identical across contexts (golden-tested); only placement and the
+# collective plumbing differ.
+
+
+class LocalContext:
+    """Single-device execution context: the cpu/1-chip fallback of the seam.
+
+    `put` ignores the plane name (everything lives on the one default
+    device) and the kernel entries are exactly ops.kernels' jitted
+    functions, so a backend holding a LocalContext is bit- and
+    compile-cache-identical to one predating the seam."""
+
+    mesh = None
+    n_shards = 1
+    is_sharded = False
+
+    def put(self, value, name=None):
+        del name
+        return jax.device_put(value)
+
+    # delta-scatter rows/indices are not node-shaped; on one device the
+    # distinction is moot but the seam keeps both entry points so sharded
+    # call sites read the same either way
+    put_replicated = put
+
+    def fit_and_score(self, cfg: KernelConfig, planes: dict, f: dict):
+        return fit_and_score(cfg, planes, f)
+
+    def batched_assign(self, cfg: KernelConfig, planes: dict, batched_f,
+                       tie_words=None, cursor_init=0, frame_shift=0,
+                       sig_ids=None, uniq_idx=None, carry_map=None,
+                       sig_table=None):
+        return batched_assign(cfg, planes, batched_f, tie_words,
+                              cursor_init, frame_shift, sig_ids=sig_ids,
+                              uniq_idx=uniq_idx, carry_map=carry_map,
+                              sig_table=sig_table)
+
+
+class MeshContext:
+    """Node-sharded execution context over a scheduler_mesh.
+
+    `put` consults _NODE_DIM so every plane lands with its node axis
+    sharded (NamedSharding) and globals replicated; the kernel entries are
+    the explicit shard_map programs above. One backend holds ONE context
+    for its lifetime — resident state (base mirror, carry overlay,
+    sig_table) all shares the mesh, so handles chain between waves without
+    resharding."""
+
+    is_sharded = True
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.n_shards = int(mesh.shape[NODE_AXIS])
+
+    def put(self, value, name=None):
+        a = np.asarray(value)
+        dim = _NODE_DIM.get(name)
+        if name not in _NODE_DIM or dim is None:
+            spec = P()
+        else:
+            if a.shape[dim] % self.n_shards:
+                raise ValueError(
+                    f"plane {name!r} node bucket {a.shape[dim]} not "
+                    f"divisible by {self.n_shards} node shards"
+                )
+            spec = P(*([None] * dim + [NODE_AXIS]))
+        return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+    def put_replicated(self, value, name=None):
+        del name
+        return jax.device_put(np.asarray(value),
+                              NamedSharding(self.mesh, P()))
+
+    def fit_and_score(self, cfg: KernelConfig, planes: dict, f: dict):
+        return sharded_fit_and_score(cfg, self.mesh, planes, f)
+
+    def batched_assign(self, cfg: KernelConfig, planes: dict, batched_f,
+                       tie_words=None, cursor_init=0, frame_shift=0,
+                       sig_ids=None, uniq_idx=None, carry_map=None,
+                       sig_table=None):
+        return sharded_batched_assign(cfg, self.mesh, planes, batched_f,
+                                      tie_words, cursor_init, frame_shift,
+                                      sig_ids=sig_ids, uniq_idx=uniq_idx,
+                                      carry_map=carry_map,
+                                      sig_table=sig_table)
+
+
+def context_from_env(environ=None):
+    """The deployment seam: KUBE_TPU_MESH_DEVICES=N asks for an N-way
+    node-sharded MeshContext; unset, 1, or more shards than visible
+    devices falls back to LocalContext (the cpu fallback — on a laptop or
+    a single-chip test box the same code path runs unsharded). On a CPU
+    box a virtual multi-device mesh comes from __graft_entry__'s
+    jax_num_cpu_devices guard (`_ensure_devices(N)`) before jax init."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    raw = env.get("KUBE_TPU_MESH_DEVICES", "").strip()
+    if not raw:
+        return LocalContext()
+    try:
+        n = int(raw)
+    except ValueError:
+        return LocalContext()
+    if n <= 1 or n > len(jax.devices()):
+        return LocalContext()
+    return MeshContext(scheduler_mesh(n))

@@ -1,8 +1,9 @@
 """Mechanical perf-regression gate over BENCH artifacts (`make bench-gate`).
 
-Diffs the newest two bench artifacts in the repo root (or two explicit
-paths) row-by-row and FAILS (exit 1) when any throughput or SLI row
-regressed by more than the tolerance (default 10%):
+Diffs the newest two bench artifacts per family (`BENCH_*` and
+`MULTICHIP_BENCH_*`, gated independently) in the repo root — or two
+explicit paths — row-by-row and FAILS (exit 1) when any throughput or SLI
+row regressed by more than the tolerance (default 10%):
 
 - throughput rows (unit "pods/s..."): regression = new < old * 0.9
 - latency keys  (sli_p50_s, sli_p99_s, trace_p50_s, trace_p99_s):
@@ -40,6 +41,12 @@ LATENCY_KEYS = ("sli_p50_s", "sli_p99_s", "trace_p50_s", "trace_p99_s")
 # device telemetry rows (devicetelemetry.py bench_columns): lower is better
 DEVICE_KEYS = ("upload_bytes_per_wave", "compile_count")
 OK_KEYS = ("sli_p50_ok", "sli_p99_ok")
+# artifact families gated independently: single-device rounds (BENCH_*)
+# and the sharded-mesh node sweep (MULTICHIP_BENCH_*; bench_multichip.py
+# --nodes-sweep). The BENCH_* glob cannot match MULTICHIP_BENCH_* names —
+# glob patterns anchor at the start of the basename — so each family
+# diffs only against its own history.
+FAMILIES = ("BENCH", "MULTICHIP_BENCH")
 
 
 def _rows_from_obj(obj: object) -> list[dict]:
@@ -85,11 +92,11 @@ def load_rows(path: str) -> dict[str, dict]:
     return out
 
 
-def newest_artifacts(root: str = ".") -> list[str]:
-    """BENCH_* artifacts, newest first by mtime (name as the tiebreak —
-    a fresh checkout stamps every artifact with the same mtime, and the
-    round-numbered names order correctly)."""
-    paths = [p for pat in ("BENCH_*.json", "BENCH_*.jsonl")
+def newest_artifacts(root: str = ".", family: str = "BENCH") -> list[str]:
+    """One family's artifacts, newest first by mtime (name as the
+    tiebreak — a fresh checkout stamps every artifact with the same
+    mtime, and the round-numbered names order correctly)."""
+    paths = [p for pat in (f"{family}_*.json", f"{family}_*.jsonl")
              for p in glob.glob(os.path.join(root, pat))]
     return sorted(paths, key=lambda p: (os.path.getmtime(p), p),
                   reverse=True)
@@ -200,14 +207,17 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     old_path, new_path = args.old, args.new
-    if old_path is None or new_path is None:
-        arts = newest_artifacts(args.root)
+    if old_path is not None and new_path is not None:
+        return run_gate(old_path, new_path, tolerance=args.tolerance)
+    rc = 0
+    for family in FAMILIES:
+        arts = newest_artifacts(args.root, family=family)
         if len(arts) < 2:
-            print("bench-gate: fewer than two BENCH_* artifacts found; "
-                  "nothing to compare (pass)")
-            return 0
-        new_path, old_path = arts[0], arts[1]
-    return run_gate(old_path, new_path, tolerance=args.tolerance)
+            print(f"bench-gate: fewer than two {family}_* artifacts "
+                  "found; nothing to compare (pass)")
+            continue
+        rc = max(rc, run_gate(arts[1], arts[0], tolerance=args.tolerance))
+    return rc
 
 
 if __name__ == "__main__":

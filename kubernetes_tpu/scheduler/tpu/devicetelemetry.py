@@ -69,7 +69,9 @@ LEDGER_SERIES = (
 # Keep it a literal tuple of string constants.
 TRANSFER_PLANES = (
     "node_planes",      # full base-mirror upload of every node plane
-    "carry_scatter",    # O(churn) row scatter repairing the base mirror
+    "carry_scatter",    # legacy name for the base-mirror row scatter
+    "delta_rows",       # O(churn) gathered rows of the delta scatter
+    "delta_idx",        # pow2-padded row-index vector of the delta scatter
     "affinity_tables",  # interned (anti-)affinity signature tables
     "ipa_term_key",     # global IPA term-key table refresh
     "features",         # the wave's stacked pod features + tie words
@@ -143,14 +145,17 @@ class DeviceTelemetry:
     def accounted_put(self, plane: str, tree, put, record=None):
         """Host->device upload through the accounted seam.
 
-        `put` is the device placement function (jax.device_put); it is
-        applied per leaf, so the returned mirror has exactly the values,
-        dtypes and structure a direct `put` would produce — the seam is
-        bit-compatible by construction. Bytes are attributed to `plane`
-        (and to `record` when the upload belongs to a wave).
+        `put` is the device placement function (a context's `put(value,
+        name=None)` seam, or bare jax.device_put for scalars/arrays); it
+        is applied per leaf — for a dict the leaf's key rides along as
+        `name` so a sharded context can look up the plane's node axis —
+        and the returned mirror has exactly the values, dtypes and
+        structure a direct put would produce: the seam is bit-compatible
+        by construction. Bytes are attributed to `plane` (and to
+        `record` when the upload belongs to a wave).
         """
         if isinstance(tree, dict):
-            out = {k: put(v) for k, v in tree.items()}
+            out = {k: put(v, k) for k, v in tree.items()}
         else:
             out = put(tree)
         self._account(UPLOAD, plane, tree_nbytes(tree), record)

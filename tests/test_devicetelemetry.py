@@ -41,9 +41,10 @@ class TestTransferLedger:
         rec = _record()
         tree = {"cpu": np.arange(8, dtype=np.float32),
                 "mem": np.arange(4, dtype=np.int32)}
-        out = tel.accounted_put("node_planes", tree, put=lambda a: a,
+        out = tel.accounted_put("node_planes", tree, put=lambda a, k=None: a,
                                 record=rec)
-        # per-leaf put: same structure, same values, same dtypes
+        # per-leaf put: same structure, same values, same dtypes (and the
+        # leaf key rides along so sharded contexts can pick a NamedSharding)
         assert set(out) == set(tree)
         for k in tree:
             assert out[k] is tree[k]
@@ -381,3 +382,68 @@ class TestDeviceTelemetryZpage:
             assert payload["memory"]["watermark_bytes"] > 0
         finally:
             server.shutdown()
+
+
+# ----------------------------------------------- compile-flat node ramp
+
+
+class TestCompileFlatNodeRamp:
+    def test_pod_churn_scatter_and_node_ramp_compile_flat(self):
+        """Two halves of the steady-state upload discipline.
+
+        Pod churn (bind pods to existing nodes) is vocab-neutral: only
+        the churned rows go dirty, so the repair must flow through the
+        delta_rows/delta_idx scatter and the node_planes base must not
+        be re-put.  Node appends are NOT vocab-neutral (each node's
+        hostname grows a domain vocab, moving the canonical fingerprint
+        and conservatively dirtying every row), so membership growth
+        legitimately pays a full re-put — but as long as the ramp stays
+        inside the pow2 node bucket (100 -> 108 -> 116, bucket 128) the
+        compile tracker must report ZERO new compiles across it."""
+        import random
+
+        from kubernetes_tpu.api.resource import ResourceNames
+        from kubernetes_tpu.scheduler.tpu.backend import TPUBackend
+        from kubernetes_tpu.testing import synthetic_cluster
+        from kubernetes_tpu.testing.wrappers import make_node as mk_node
+
+        names = ResourceNames()
+        cache, snap = synthetic_cluster(100, n_zones=4, names=names)
+        backend = TPUBackend(names)
+
+        def burst(tag, snap):
+            pods = [make_pod(f"{tag}-{i}", cpu="100m", mem="64Mi",
+                             labels={"app": "ramp"}) for i in range(8)]
+            got, _ = backend.run_batched(pods, snap, rng=random.Random(0))
+            assert any(got)
+
+        burst("w0", snap)                      # cold: full upload + compile
+        up_plane = backend.telemetry.snapshot()["transfers"]["upload"]
+        full_bytes = up_plane["by_plane"]["node_planes"]
+        assert "delta_rows" not in up_plane["by_plane"]
+
+        # pod churn: dirty a handful of rows without touching any vocab
+        for k in range(8):
+            cache.add_pod(make_pod(f"churn-{k}", cpu="100m", mem="64Mi",
+                                   node_name=f"node-{k}"))
+        snap = cache.update_snapshot(snap)
+        burst("w1", snap)
+        up_plane = backend.telemetry.snapshot()["transfers"]["upload"]
+        assert up_plane["by_plane"].get("delta_rows", 0) > 0
+        assert up_plane["by_plane"].get("delta_idx", 0) > 0
+        assert up_plane["by_plane"]["node_planes"] == full_bytes
+        warm_compiles = backend.telemetry.compile_count()
+
+        # the ramp: 8-node appends, same pow2 bucket -> nothing recompiles
+        for k in range(1, 3):
+            for j in range(8):
+                cache.add_node(mk_node(f"r{k}-{j}", cpu="32", mem="64Gi",
+                                       zone=f"zone-{j % 4}"))
+            snap = cache.update_snapshot(snap)
+            burst(f"w{k + 1}", snap)
+            assert backend.telemetry.compile_count() == warm_compiles, (
+                backend.telemetry.snapshot()["compiles"])
+        # membership growth paid full re-puts (fingerprint moved), but
+        # the scatter total is untouched — pod churn is the only client
+        up_plane = backend.telemetry.snapshot()["transfers"]["upload"]
+        assert up_plane["by_plane"]["node_planes"] > full_bytes

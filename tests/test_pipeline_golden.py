@@ -29,6 +29,7 @@ from kubernetes_tpu.scheduler import Profile, Scheduler
 from kubernetes_tpu.scheduler.tpu.circuitbreaker import CLOSED, OPEN
 from kubernetes_tpu.store.store import Store
 from kubernetes_tpu.testing import with_spread
+from kubernetes_tpu.testing.wrappers import with_pod_affinity
 from kubernetes_tpu.utils import faultinject
 from kubernetes_tpu.utils.faultinject import ERROR, FaultSpec
 from tests.wrappers import make_node, make_pod
@@ -43,10 +44,13 @@ def _clean_registry():
     faultinject.registry().reset(seed=0)
 
 
-def mixed_pods(lo, hi, spread=False):
+def mixed_pods(lo, hi, spread=False, ipa=False):
     """Three interleaved signatures (same shape as test_dedup_golden):
     every clone run is split across other signatures' steps, so the dedup
-    fast tier re-enters mid-wave under the pipelined loop too."""
+    fast tier re-enters mid-wave under the pipelined loop too. With
+    `ipa`, every third pod carries required zone-scoped pod affinity (and
+    every sixth anti-affinity), making the wave IPA-active — the
+    carry-coupled constraint the fast tier recomputes live."""
     pods = []
     for i in range(lo, hi):
         kind = i % 3
@@ -63,19 +67,31 @@ def mixed_pods(lo, hi, spread=False):
             p = with_spread(p, max_skew=5,
                             key="topology.kubernetes.io/zone",
                             when="DoNotSchedule")
+        if ipa and kind == 0:
+            p = with_pod_affinity(p, "app", "a",
+                                  "topology.kubernetes.io/zone",
+                                  anti=(i % 6 == 0))
         pods.append(p)
     return pods
 
 
-def _run_stream(monkeypatch, depth, dedup=True, spread=False,
+def _run_stream(monkeypatch, depth, dedup=True, spread=False, ipa=False,
                 nodes=6, zones=2, cpu="4",
-                bursts=((0, 15), (15, 30), (30, 42))):
+                bursts=((0, 15), (15, 30), (30, 42)),
+                mesh=0, churn_nodes=0):
     """One streamed scenario: pods arrive in bursts, each burst drained by
     `schedule_pending` so waves within a burst genuinely pipeline (wave
     k+1 preps from the carry overlay while wave k is on the device).
-    Returns the binding stream fingerprint plus the live Scheduler for
-    telemetry assertions."""
+    `mesh=N` runs the backend on a NamedSharding mesh over N virtual
+    devices (the `context_from_env` seam); `churn_nodes=K` appends K
+    fresh nodes before every burst after the first — external churn the
+    delta scatter must absorb. Returns the binding stream fingerprint
+    plus the live Scheduler for telemetry assertions."""
     monkeypatch.setenv("KUBE_TPU_PIPELINE_DEPTH", str(depth))
+    if mesh:
+        monkeypatch.setenv("KUBE_TPU_MESH_DEVICES", str(mesh))
+    else:
+        monkeypatch.delenv("KUBE_TPU_MESH_DEVICES", raising=False)
     store = Store()
     for i in range(nodes):
         store.create(make_node(f"n{i}", cpu=cpu, mem="8Gi",
@@ -84,10 +100,15 @@ def _run_stream(monkeypatch, depth, dedup=True, spread=False,
                   seed=11)
     algo = s.algorithms["default-scheduler"]
     algo.backend.dedup_enabled = dedup
+    assert algo.backend._ctx.is_sharded == bool(mesh)
     s.start()
     assert s.loop.pipeline_depth == depth
-    for lo, hi in bursts:
-        for p in mixed_pods(lo, hi, spread=spread):
+    for k, (lo, hi) in enumerate(bursts):
+        if k and churn_nodes:
+            for j in range(churn_nodes):
+                store.create(make_node(f"cn{k}-{j}", cpu=cpu, mem="8Gi",
+                                       zone=f"z{j % zones}"))
+        for p in mixed_pods(lo, hi, spread=spread, ipa=ipa):
             store.create(p)
         s.schedule_pending()
     s.event_recorder.flush()
@@ -153,6 +174,59 @@ class TestPipelineGoldenTriple:
         _assert_identical(piped, serial, nodedup)
         assert sum(1 for v in piped[0].values() if v) == 60
         assert piped[3].flight_recorder.overlap_s_total > 0
+
+    def test_ipa_active_sharded_mesh_triple_identical(self, monkeypatch):
+        """IPA-active waves on an ACTUAL sharded mesh (4 virtual devices
+        via the context_from_env seam): pod affinity is the carry-coupled
+        constraint the dedup fast tier recomputes live — the last
+        dedup_fast_capable exclusion removed this PR — so the triple must
+        hold with signatures genuinely deduped, sharded."""
+        piped, serial, nodedup = _triple(
+            monkeypatch, ipa=True, nodes=40, zones=4, mesh=4,
+            bursts=((0, 30), (30, 60)))
+        _assert_identical(piped, serial, nodedup)
+        placed = piped[0]
+        assert sum(1 for v in placed.values() if v) > 0
+        stats = piped[3].algorithms["default-scheduler"].backend.dedup_stats
+        assert stats["waves"] > 0
+        assert 0 < stats["signatures"] < stats["pods"]
+
+
+class TestShardedDeltaGolden:
+    def test_mesh_delta_vs_forced_full_reput_identical(self, monkeypatch):
+        """External node churn between bursts on a sharded mesh: the
+        delta-maintained path (cold start + row scatters) must produce
+        the same binding stream, diagnoses, and rng position as the same
+        run forced through a full node_planes re-put at every device
+        input assembly — and as the unsharded LocalContext run."""
+        from kubernetes_tpu.scheduler.tpu.backend import TPUBackend
+
+        kw = dict(depth=2, spread=True, nodes=40, zones=4,
+                  bursts=((0, 24), (24, 48)), churn_nodes=4)
+        local = _run_stream(monkeypatch, **kw)
+        mesh = _run_stream(monkeypatch, mesh=4, **kw)
+        # the delta discipline actually held on the mesh run: node_planes
+        # (the sanctioned cold-start full re-put) was paid once, and the
+        # churned rows went through the delta scatter planes
+        up = (mesh[3].algorithms["default-scheduler"].backend.telemetry
+              .snapshot()["transfers"]["upload"]["by_plane"])
+        assert up.get("delta_rows", 0) > 0, up
+        baseline_full = up["node_planes"]
+
+        orig = TPUBackend.device_inputs
+
+        def forced(self, planes, rec=None):
+            self._pending_dirty = None  # lose row tracking: full path
+            return orig(self, planes, rec)
+
+        monkeypatch.setattr(TPUBackend, "device_inputs", forced)
+        full = _run_stream(monkeypatch, mesh=4, **kw)
+        up_full = (full[3].algorithms["default-scheduler"].backend.telemetry
+                   .snapshot()["transfers"]["upload"]["by_plane"])
+        assert up_full["node_planes"] > baseline_full
+        assert "delta_rows" not in up_full
+        _assert_identical(local, mesh, full)
+        assert sum(1 for v in local[0].values() if v) > 0
 
 
 class TestBreakerTripMidFlight:

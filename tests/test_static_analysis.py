@@ -18,6 +18,7 @@ from kubernetes_tpu.analysis import (
     LockDisciplineChecker,
     RegistrySyncChecker,
     RetryDisciplineChecker,
+    ShardSeamChecker,
     SignatureSyncChecker,
     SnapshotImmutabilityChecker,
     TransferSeamChecker,
@@ -911,6 +912,95 @@ class TestTransferSeam:
         """Every shipped seam call site uses a declared plane and the
         shipped backend.py has no raw device_put."""
         assert list(TransferSeamChecker().check_project(PKG)) == []
+
+
+# ---------------------------------------------------------------- SHARD01
+
+
+def write_shard_tree(root, backend_src, extra=None):
+    b = root / "scheduler/tpu/backend.py"
+    b.parent.mkdir(parents=True, exist_ok=True)
+    b.write_text(textwrap.dedent(backend_src))
+    if extra is not None:
+        name, src = extra
+        p = root / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return root
+
+
+class TestShardSeam:
+    def test_cold_start_seam_clean(self, tmp_path):
+        write_shard_tree(tmp_path, """
+            class Backend:
+                def _cold_start_upload(self, planes, rec=None):
+                    self._device_planes = self.telemetry.accounted_put(
+                        "node_planes", planes.as_dict(), put=self._ctx.put,
+                        record=rec)
+        """)
+        assert list(ShardSeamChecker().check_project(tmp_path)) == []
+
+    def test_full_reput_outside_seam_flagged(self, tmp_path):
+        write_shard_tree(tmp_path, """
+            class Backend:
+                def device_inputs(self, planes, rec=None):
+                    self._device_planes = self.telemetry.accounted_put(
+                        "node_planes", planes.as_dict(), put=self._ctx.put,
+                        record=rec)
+        """)
+        fs = list(ShardSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["SHARD01"]
+        assert "device_inputs" in fs[0].message
+
+    def test_accounting_only_full_upload_flagged(self, tmp_path):
+        # account_upload attributes the same full-plane bytes; the seam
+        # rule covers it too so the flat-upload invariant can't be dodged
+        # by accounting around the put.
+        write_shard_tree(tmp_path, """
+            class Backend:
+                def resync(self, nbytes, rec):
+                    self.telemetry.account_upload("node_planes", nbytes, rec)
+        """)
+        fs = list(ShardSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["SHARD01"]
+
+    def test_full_reput_outside_backend_flagged(self, tmp_path):
+        write_shard_tree(
+            tmp_path,
+            """
+            class Backend:
+                def _cold_start_upload(self, planes, rec=None):
+                    pass
+            """,
+            extra=("scheduler/warmup.py", """
+                def _cold_start_upload(tel, planes):
+                    # same function name, wrong module: still flagged
+                    return tel.accounted_put("node_planes", planes, put=id)
+            """))
+        fs = list(ShardSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["SHARD01"]
+
+    def test_delta_planes_not_flagged(self, tmp_path):
+        write_shard_tree(tmp_path, """
+            class Backend:
+                def _scatter(self, rows, idx, rec):
+                    self.telemetry.accounted_put(
+                        "delta_rows", rows, put=self._ctx.put_replicated,
+                        record=rec)
+                    self.telemetry.accounted_put(
+                        "delta_idx", idx, put=self._ctx.put_replicated,
+                        record=rec)
+        """)
+        assert list(ShardSeamChecker().check_project(tmp_path)) == []
+
+    def test_partial_tree_is_silent(self, tmp_path):
+        # fixture dirs without backend.py can't be cross-checked
+        assert list(ShardSeamChecker().check_project(tmp_path)) == []
+
+    def test_repo_cold_start_seam_in_sync(self):
+        """The shipped tree's only full-plane node_planes upload is
+        backend.py's _cold_start_upload."""
+        assert list(ShardSeamChecker().check_project(PKG)) == []
 
 
 # ------------------------------------------------------------------ SIG01
