@@ -17,12 +17,15 @@ test:
 # arrivals with a watch partition, a fleet-wide kubelet outage, and bind
 # latency armed; each must converge (no leaked assumes, breaker trip AND
 # recover, partition detect AND repair, evicted pods gone, late arrivals
-# bound) inside the wall-clock budget. Exits non-zero on divergence —
-# same seed replays the same schedule
+# bound) inside the wall-clock budget — then (3) the gang soak: a kubelet
+# killed mid-gang under bind/dispatcher flakes, all-or-nothing asserted
+# after convergence (no partially-bound gang, Required gangs single-zone).
+# Exits non-zero on divergence — same seed replays the same schedule
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --seed 7
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --trace --seed 7 --budget-s 60
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --trace --seed 1234 --budget-s 60
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --gang --seed 7
 
 # flight-recorder CLI smoke: synthetic multi-wave run (no device, no jax),
 # exercises ring buffer + watchdog + post-mortem formatting, and asserts

@@ -27,6 +27,9 @@ Reference rows, AT REFERENCE WORKLOAD SHAPE
                    5000pods_500nodes          >= 40    dra:129-141
   GangScheduling 500Nodes                     >= 100   (fork feature; floor
                                                         from our own r04 run)
+  GangSchedulingTopologyRequired 500Nodes     >= 100   (device gang wave;
+  GangSchedulingTopologyPreferred 500Nodes    >= 100    floors >=3x the host
+                                                        gang cycle's ~32)
 
 Wedge-proofing is shared with bench.py: subprocess device probe + labeled
 CPU fallback, so a dead accelerator tunnel degrades to a valid CPU number.
@@ -89,6 +92,12 @@ ROWS = [
     ("dra.yaml", "SchedulingWithResourceClaims", "5000pods_500nodes",
      "dra_5000pods_500nodes"),
     ("gang.yaml", "GangScheduling", "500Nodes", "gang_500"),
+    # topology-packed gangs through the device gang wave; floors hold the
+    # >=3x win over the per-pod host gang cycle (README "Gang waves")
+    ("gang.yaml", "GangSchedulingTopologyRequired", "500Nodes",
+     "gang_topo_required_500"),
+    ("gang.yaml", "GangSchedulingTopologyPreferred", "500Nodes",
+     "gang_topo_preferred_500"),
     # LAST: the preemption row's post-nomination retry churn makes it by
     # far the longest row (every victim deletion re-activates every parked
     # preemptor); running it last means a wall-clock cap can never starve

@@ -41,6 +41,7 @@ from .core import (
 )
 from .carry_coherence import CarryCoherenceChecker
 from .fault_points import FaultPointChecker
+from .gang_seam import GangSeamChecker
 from .jit_purity import JitPurityChecker
 from .ledger_series import LedgerSeriesChecker
 from .lock_discipline import LockDisciplineChecker
@@ -58,6 +59,7 @@ __all__ = [
     "Checker",
     "FaultPointChecker",
     "Finding",
+    "GangSeamChecker",
     "JitPurityChecker",
     "LedgerSeriesChecker",
     "LockDisciplineChecker",

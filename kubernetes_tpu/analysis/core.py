@@ -107,6 +107,7 @@ class ProjectChecker(Checker):
 def default_checkers() -> list[Checker]:
     from .carry_coherence import CarryCoherenceChecker
     from .fault_points import FaultPointChecker
+    from .gang_seam import GangSeamChecker
     from .jit_purity import JitPurityChecker
     from .ledger_series import LedgerSeriesChecker
     from .lock_discipline import LockDisciplineChecker
@@ -133,6 +134,7 @@ def default_checkers() -> list[Checker]:
         LedgerSeriesChecker(),
         TransferSeamChecker(),
         ShardSeamChecker(),
+        GangSeamChecker(),
     ]
 
 

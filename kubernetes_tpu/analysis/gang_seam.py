@@ -1,0 +1,97 @@
+"""Gang admission/placement seam rule (GANG01).
+
+The gang-wave fast path stays bit-compatible with the host pod-group cycle
+only because every piece of group admission/placement state — the GangPlan
+fields and the WaveRecord gang_* outcome fields — is produced in exactly
+two places: `scheduler/tpu/gangplanner.py` (the admission gate and
+placement enumeration) and `scheduler/tpu/backend.py` (`run_gang`, the
+device execution and outcome stamping). A third writer — a plugin caching
+a "better" domain choice, a test helper patching gang_outcome, a refactor
+moving admission into the wave loop — silently forks the decision state
+from the host `_pod_group_algorithm` it must mirror, and the parity
+goldens only catch it for the configs they happen to cover. Nothing can
+enforce the seam at runtime (a rogue write still produces a plausible
+outcome), so — like SHARD01 for the cold-start upload and OBS03 for the
+accounted transfer seam — the enforcement is cross-parsing.
+
+GANG01 flags any attribute assignment (plain or augmented) whose target
+attribute is one of the protected gang-state names in a module other than
+the two seam files. Reading the state anywhere is fine — WaveRecord
+serialization, metrics, dashboards and tests all observe; dataclass field
+declarations (annotated class-level names) are not assignments and are
+not flagged.
+
+Findings are project-scoped, so per-line suppressions do not apply —
+route the write through gangplanner.py/backend.py instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding, ProjectChecker
+
+GANG01 = "GANG01"
+
+# the two sanctioned writer modules (path suffixes)
+SEAM_MODULES = (
+    "scheduler/tpu/gangplanner.py",
+    "scheduler/tpu/backend.py",
+)
+
+# GangPlan admission state + WaveRecord gang outcome fields
+PROTECTED_ATTRS = {
+    "gang_placements",
+    "gang_n_constrained",
+    "gang_has_fallback",
+    "gang_required",
+    "gang_groups",
+    "gang_pods",
+    "gang_fallback_pods",
+    "gang_outcome",
+}
+
+
+def _attr_targets(node: ast.AST) -> Iterable[ast.Attribute]:
+    """Attribute nodes written by an Assign/AugAssign, through tuples."""
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, ast.AugAssign):
+        targets = [node.target]
+    else:
+        return
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Attribute):
+                yield sub
+
+
+class GangSeamChecker(ProjectChecker):
+    rules = {
+        GANG01: "gang admission/placement state written outside the "
+                "sanctioned seam (gangplanner.py / backend.py) — a third "
+                "writer forks the device decision state from the host "
+                "pod-group cycle it must mirror",
+    }
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        for path in sorted(root.rglob("*.py")):
+            posix = path.as_posix()
+            if any(posix.endswith(m) for m in SEAM_MODULES):
+                continue
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except (OSError, SyntaxError):
+                continue  # LINT01 reports unparseable files
+            for node in ast.walk(tree):
+                for attr in _attr_targets(node):
+                    if attr.attr in PROTECTED_ATTRS:
+                        yield Finding(
+                            posix, node.lineno, node.col_offset, GANG01,
+                            f"assignment to gang state {attr.attr!r} outside "
+                            "scheduler/tpu/gangplanner.py and "
+                            "scheduler/tpu/backend.py — the gang seam owns "
+                            "this state; everything else observes",
+                        )

@@ -1428,3 +1428,151 @@ def batched_assign(cfg: KernelConfig, planes: dict, batched_f: dict,
                                np.asarray(carry_map, np.int32) if xwave else None,
                                sig_table if xwave else None,
                                xwave)
+
+
+# --------------------------------------------------------------------------
+# gang waves: whole-PodGroup placement over topology-domain masks
+# --------------------------------------------------------------------------
+#
+# The device-side half of the pod-group cycle (schedule_one_podgroup.go:520
+# podGroupSchedulingPlacementAlgorithm): instead of dry-running the gang
+# once per topology domain on the host — each dry run a full sequence of
+# single-pod kernel dispatches against a placement-narrowed snapshot
+# rebuild — ONE program vmaps the member scan over a [D, Nb] stack of
+# domain masks. Narrowing a placement is exactly `valid &= mask`: every
+# filter/score reduction in this module already gates on planes["valid"]
+# (filters, normalizations, domain counts, IPA parts), so a masked scan is
+# bit-identical to the host dry run in the narrowed snapshot.
+
+
+def _gang_placement_score(planes, mask):
+    """Device replica of TopologyPlacementGenerator.score_placement: mean
+    free-capacity score (0-100, LeastAllocated shape) of the mask's nodes,
+    same int32 floor math as the host plugin, computed on the PRE-scan
+    planes (the host scores after the dry run reverted its assumes)."""
+    alloc = planes["alloc"]
+    used = planes["used"]
+    score = jnp.zeros(mask.shape[0], jnp.int32)
+    parts = jnp.zeros(mask.shape[0], jnp.int32)
+    for col in (CPU, MEM):
+        cap = alloc[:, col]
+        ok = cap > 0
+        req = jnp.minimum(used[:, col], cap)
+        s = (cap - req) * MAX_NODE_SCORE // jnp.maximum(cap, 1)
+        score = score + jnp.where(ok, s, 0)
+        parts = parts + ok.astype(jnp.int32)
+    node_val = jnp.where(parts > 0, score // jnp.maximum(parts, 1), 0)
+    counted = mask & (parts > 0)
+    n = jnp.sum(counted.astype(jnp.int32))
+    total = jnp.sum(jnp.where(counted, node_val, 0))
+    return jnp.where(n > 0, total // jnp.maximum(n, 1), 0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 6, 7))
+def _gang_assign_jit(cfg: KernelConfig, planes: dict, packed_f, layout,
+                     masks, tie_words, n_constrained, has_fallback):
+    from .planes import unpack_features
+
+    batched_f = unpack_features(packed_f, layout)
+    n_active = jnp.sum(batched_f["active"].astype(jnp.int32))
+
+    def one_domain(mask):
+        # a placement-narrowed snapshot IS the base planes with valid
+        # restricted to the placement's rows: every reduction downstream
+        # gates on valid, so the scan below replays the host dry run
+        p = dict(planes)
+        p["valid"] = planes["valid"] & mask
+        static = jax.vmap(
+            lambda f: _static_pod_parts(cfg, p, f)
+        )(batched_f)
+        dom_counts, present = _dom_counts_init(cfg, p)
+        ipa = ((p["ipa_counts"], p["ipa_anti"], p["ipa_pref"])
+               if cfg.ipa_active else None)
+        # every domain replays the SAME tie-word stream from cursor 0: the
+        # host dry-runs restore the rng after each placement, so only the
+        # winning domain's consumption ever advances the live stream
+        init = (p["used"], p["nonzero_used"], p["sel_counts"], dom_counts,
+                ipa, jnp.int32(0), jnp.bool_(False), None, None)
+        step = functools.partial(_assign_step, cfg, p, present, tie_words,
+                                 LOCAL_COMM)
+        (_, _, _, _, _, cursor, overflow, _, _), winners = jax.lax.scan(
+            step, init, (batched_f, static), unroll=4
+        )
+        placed = jnp.sum(
+            ((winners >= 0) & batched_f["active"]).astype(jnp.int32)
+        )
+        return winners.astype(jnp.int32), cursor, overflow, placed
+
+    winners, consumed, overflow, placed = jax.vmap(one_domain)(masks)
+    scores = jax.vmap(
+        lambda m: _gang_placement_score(planes, m)
+    )(masks)
+
+    # host winner semantics (schedule_one.py _pod_group_algorithm): best
+    # CONSTRAINED domain by placement score, strict > over placement order
+    # (argmax == first max); only when none fits does the Preferred /
+    # unconstrained fallback row (index n_constrained) get the gang
+    all_placed = placed == n_active
+    key = jnp.where(all_placed & ~overflow, scores, -1)
+    if n_constrained > 0:
+        d_ids = jnp.arange(masks.shape[0], dtype=jnp.int32)
+        ckey = jnp.where(d_ids < n_constrained, key, -1)
+        cbest = jnp.max(ckey)
+        cwin = jnp.argmax(ckey).astype(jnp.int32)
+    else:
+        cbest = jnp.int32(-1)
+        cwin = jnp.int32(0)
+    if has_fallback:
+        fb = jnp.int32(n_constrained)
+        fb_ok = all_placed[n_constrained] & ~overflow[n_constrained]
+        win_d = jnp.where(cbest >= 0, cwin, fb)
+        ok = (cbest >= 0) | fb_ok
+    else:
+        win_d = cwin
+        ok = cbest >= 0
+
+    # single-transfer result: winners per domain ++ per-domain telemetry
+    # rows (consumed/overflow/placed/score) ++ [win_d, ok, n_active]
+    return jnp.concatenate([
+        winners.reshape(-1),
+        consumed.astype(jnp.int32),
+        overflow.astype(jnp.int32),
+        placed.astype(jnp.int32),
+        scores.astype(jnp.int32),
+        jnp.stack([win_d, ok.astype(jnp.int32), n_active]),
+    ])
+
+
+def gang_assign(cfg: KernelConfig, planes: dict, batched_f: dict, masks,
+                tie_words=None, n_constrained: int = 0,
+                has_fallback: bool = True):
+    """Whole-gang placement: one program scans the gang's members over
+    every topology-domain mask at once and picks the domain that holds the
+    ENTIRE group (all-or-nothing — a domain where any member fails to
+    place scores -1 and can never win).
+
+    masks is a [D, Nb] bool stack in the host placement order: rows
+    [0, n_constrained) are the PlacementGenerate domains, row n_constrained
+    (when has_fallback) is the unconstrained full-snapshot row Preferred
+    topology and plugin-less gangs fall back to, and any remaining rows are
+    all-False padding (an empty valid set places nobody, so a pad row can
+    never be selected).
+
+    Tie-break parity: every domain replays the same tie_words stream from
+    cursor 0, mirroring the host's rng save/restore around each placement
+    dry run; the caller advances the live rng by the winning domain's
+    consumed count only, and MUST fall back to the host path when any real
+    domain reports tie overflow (a truncated draw desynchronizes that
+    domain's verdict, not just its stream).
+
+    Returns the packed int32 result vector: winners [D*P] ++ consumed [D]
+    ++ overflow [D] ++ placed [D] ++ score [D] ++ [win_d, ok, n_active] —
+    ONE device->host fetch carries the whole gang verdict."""
+    from .planes import pack_features
+
+    if tie_words is None:
+        tie_words = ZERO_TIE_WORDS
+    packed, layout = pack_features(batched_f)
+    return _gang_assign_jit(cfg, planes, packed, layout,
+                            jnp.asarray(masks), tie_words,
+                            int(n_constrained), bool(has_fallback))

@@ -434,10 +434,24 @@ class WorkloadExecutor:
         self.scheduler.pump()
 
     def _op_createPodGroups(self, op: dict) -> None:
-        """Gang workloads: one PodGroup + minCount member pods per group."""
+        """Gang workloads: one PodGroup + minCount member pods per group.
+        `topologyKey` (+ `topologyMode`, default Required) adds a KEP-5732
+        topology constraint so the gang must pack into one domain —
+        createNodes labels nodes `topology.kubernetes.io/zone` round-robin
+        over its `zones` param."""
+        from ..api.types import SchedulingConstraints, TopologyConstraint
+
         n = self._count(op)
         size = int(_resolve(op.get("podsPerGroup", 2), self.params))
         template = op.get("podTemplate", self.pod_template)
+        topo_key = op.get("topologyKey")
+        constraints = SchedulingConstraints()
+        if topo_key:
+            constraints = SchedulingConstraints(topology=(
+                TopologyConstraint(key=str(topo_key),
+                                   mode=str(op.get("topologyMode",
+                                                   "Required"))),
+            ))
         if op.get("collectMetrics") and not self._collecting:
             self._start_collecting()
         if op.get("collectMetrics"):
@@ -447,7 +461,8 @@ class WorkloadExecutor:
             self.store.create(
                 PodGroup(
                     meta=ObjectMeta(name=name),
-                    spec=PodGroupSpec(policy=GangPolicy(min_count=size)),
+                    spec=PodGroupSpec(policy=GangPolicy(min_count=size),
+                                      constraints=constraints),
                 )
             )
             for _ in range(size):

@@ -162,6 +162,13 @@ class SchedulerMetrics:
             "Pods scheduled by the device kernel vs host fallback",
             labels=("path",),
         )
+        # gang waves (README "Gang waves"): fast-path coverage of PodGroup
+        # members — "device" rode a gang wave, "host" the per-pod gang cycle
+        self.gang_pods_total = r.counter(
+            "scheduler_tpu_gang_pods_total",
+            "Gang members placed by the device gang wave vs host gang cycle",
+            labels=("path",),
+        )
         # wave flight recorder (new: per-wave telemetry, README "Observability")
         self.wave_phase_duration = r.histogram(
             "scheduler_tpu_wave_phase_duration_seconds",
@@ -363,6 +370,12 @@ class SchedulerMetrics:
         if upload or fetch:
             self.tpu_wave_transfer_bytes.observe(float(upload), "upload")
             self.tpu_wave_transfer_bytes.observe(float(fetch), "fetch")
+
+    def gang_pods(self, path: str, n: int) -> None:
+        """Gang members routed down `path` (flightrecorder.count_gang_pods
+        is the one caller — wave_completed never lands this counter, so a
+        gang wave's record can't double-count its members)."""
+        self.gang_pods_total.inc(path, by=float(n))
 
     def breaker_transition(self, old_state: str, new_state: str) -> None:
         """TPU circuit-breaker state change (flightrecorder fan-out). The

@@ -10,12 +10,16 @@ cache state otherwise (:185-190).
 
 from __future__ import annotations
 
+import os
+
 from ...api.types import Pod
 from ..framework import events as ev
 from ..framework.events import ClusterEvent, ClusterEventWithHint, QUEUE
 from ..framework.interface import Plugin, Status
 
-GANG_WAIT_TIMEOUT = 300.0  # gangscheduling.go:41 — 5 minutes
+# gangscheduling.go:41 — 5 minutes; env-overridable so soak rigs can shrink
+# the starvation window (see README "Gang waves" runbook) without a rebuild
+GANG_WAIT_TIMEOUT = float(os.environ.get("KUBE_TPU_GANG_WAIT_S", "300"))
 
 
 class GangScheduling(Plugin):

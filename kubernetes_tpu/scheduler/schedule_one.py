@@ -363,6 +363,10 @@ class ScheduleOneLoop:
         self.pipeline_depth = max(
             1, int(os.environ.get("KUBE_TPU_PIPELINE_DEPTH", "2"))
         )
+        # gang waves (README "Gang waves"): whole-PodGroup device placement
+        # instead of the per-placement host dry-run loop; env-gated so
+        # parity tests and the chaos soak can pin either path per instance
+        self.gang_waves = os.environ.get("KUBE_TPU_GANG_WAVES", "1") != "0"
         # adaptive wave sizing: queue depth decides the next wave's pow2
         # target within the caller's max_pods cap (the breaker's HALF_OPEN
         # probe break below stays authoritative over both)
@@ -935,8 +939,60 @@ class ScheduleOneLoop:
         qpis.sort(key=lambda q: (-q.pod.spec.priority, q.timestamp))
 
         self.cache.update_snapshot(self.snapshot)
-        outcome = self._pod_group_algorithm(fw, gk, qpis)
+        outcome = self._pod_group_wave_algorithm(fw, gk, qpis)
+        if outcome is None:
+            outcome = self._pod_group_algorithm(fw, gk, qpis)
         self._submit_pod_group_result(fw, gk, qpis, outcome)
+
+    def _pod_group_wave_algorithm(self, fw: Framework, gk: str, qpis: list):
+        """Gang wave (README "Gang waves"): whole-group device placement —
+        one batched kernel scans the gang over every topology-domain mask
+        and picks the best feasible domain, replacing the per-placement
+        dry-run loop of _pod_group_algorithm. Returns an outcome tuple for
+        _submit_pod_group_result, or None when the group must ride the
+        host path; every None leaves rng/snapshot/cache untouched, so the
+        host cycle then runs bit-identically to a no-device build."""
+        if not self.gang_waves:
+            return None
+        algo = self.algorithms.get(fw.profile_name)
+        if algo is None or getattr(algo, "backend", None) is None:
+            return None
+        from .tpu.gangplanner import try_gang_wave
+
+        hosts = try_gang_wave(self, fw, algo, gk, qpis)
+        if hosts is None:
+            return None
+        return self._pod_group_apply_wave(fw, gk, qpis, hosts)
+
+    def _pod_group_apply_wave(self, fw: Framework, gk: str, qpis: list,
+                              hosts: list):
+        """The apply half of _pod_group_default_algorithm with the device
+        wave's precomputed hosts: in-snapshot assume + reserve + permit per
+        member, full revert on any failure — outcome statuses are the host
+        path's, so _submit_pod_group_result is shared unchanged."""
+        placed: list[tuple] = []  # (qpi, state, result, pod_info)
+        gsnap = self.snapshot.pod_group_states.get(gk)
+        evaluated = self.snapshot.num_nodes()
+        for q, host in zip(qpis, hosts):
+            state = CycleState()
+            state.is_pod_group_scheduling_cycle = True
+            result = ScheduleResult(suggested_host=host,
+                                    evaluated_nodes=evaluated,
+                                    feasible_nodes=1)
+            pi = PodInfo(q.pod, self.names)
+            self.snapshot.assume_pod(pi, host)  # kubesched-lint: disable=SNAP01
+            if gsnap is not None:
+                gsnap.unscheduled.discard(q.pod.meta.key)
+                gsnap.assumed.add(q.pod.meta.key)
+            st = fw.run_reserve_plugins_reserve(state, q.pod, host)
+            if st.is_success:
+                st = fw.run_permit_plugins(state, q.pod, host)
+            if not (st.is_success or st.is_wait):
+                placed.append((q, state, result, pi))
+                self._revert_pod_group(fw, gk, placed)
+                return ("unschedulable" if st.is_rejected else "error", q, st)
+            placed.append((q, state, result, pi))
+        return ("success", placed, None)
 
     def _pod_group_algorithm(self, fw: Framework, gk: str, qpis: list):
         """podGroupSchedulingAlgorithm (:573): placement enumeration when
@@ -1217,7 +1273,15 @@ class ScheduleOneLoop:
         pod = qpi.pod
         host = result.suggested_host
 
+        # gang Permit wait is the dominant binding-cycle stall for gang
+        # members — surface it as its own ledger segment (OBS02: segment
+        # names come from podlatency.SEGMENTS, no new series needed)
+        gang_waiting = fw.waiting_pod(pod.meta.key) is not None
+        if gang_waiting:
+            self.recorder.pod_ledger.stamp(pod.meta.key, "gang_wait_start")
         st = fw.wait_on_permit(pod)
+        if gang_waiting:
+            self.recorder.pod_ledger.stamp(pod.meta.key, "gang_wait_end")
         if not st.is_success:
             self._handle_binding_failure(state, fw, qpi, host, st)
             return

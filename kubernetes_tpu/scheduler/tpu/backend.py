@@ -679,6 +679,121 @@ class TPUBackend:
             )
         return sig_ids, uniq, sig_bytes
 
+    # -- gang wave -------------------------------------------------------------
+
+    def run_gang(self, pods: list[Pod], snapshot, placements,
+                 n_constrained: int, has_fallback: bool, rng):
+        """Whole-PodGroup device placement (README "Gang waves"): ONE
+        program scans the gang over every topology-domain mask at once
+        (ops.kernels.gang_assign) instead of the host cycle's per-domain
+        dry runs — each of which pays a full sequence of single-pod kernel
+        dispatches against a placement-narrowed snapshot rebuild.
+
+        `placements` is the host PlacementGenerate output in plugin order:
+        rows [0, n_constrained) are the topology domains, and when
+        has_fallback row n_constrained is the unconstrained parent
+        (Preferred topology / no placement plugins). Returns (hosts,
+        win_row, record) on success — hosts aligned with `pods`, win_row
+        an index into `placements` — or None when the gang must ride the
+        host cycle (no feasible domain, tie overflow, non-kernel feature).
+        The live rng advances by the winning domain's tie draws ONLY on
+        success; every fallback leaves it untouched, so the host cycle
+        re-derives bit-identical decisions from the same stream."""
+        from ...ops import pad_features
+        from ...ops.kernels import MAX_TIE_DRAWS, gang_assign
+        from ...ops.planes import placement_masks
+        from ...ops.vocab import next_pow2
+
+        rec = self.recorder.begin_wave(pods=len(pods))
+        rec.gang_groups = 1
+        rec.gang_pods = len(pods)
+        try:
+            with self.recorder.wave_phase("sync", rec):
+                for pod in pods:
+                    self.extractor.register(pod)
+                planes = self.sync(snapshot)
+            with self.recorder.wave_phase("features", rec):
+                feats = stack_features(
+                    [self.extractor.features_cached(p, planes) for p in pods]
+                )
+        except FallbackNeeded as e:
+            rec.gang_fallback_pods = len(pods)
+            rec.gang_outcome = f"fallback:{e}"
+            self.recorder.end_wave(rec, fallback_reason=str(e))
+            return None
+        pad_to = next_pow2(len(pods), floor=4)
+        if pad_to > len(pods):
+            feats = pad_features(feats, pad_to)
+        # masks ride in host placement order; pad rows (pow2 program shape)
+        # stay all-False and can never win (empty valid set places nobody)
+        n_rows = next_pow2(len(placements), floor=2)
+        masks = placement_masks(
+            planes, [list(p.node_names) for p in placements], n_rows
+        )
+        dev = self._carry_view(planes)
+        cfg = self.kernel_config(planes, feats)
+        # one frame covers the WORST single domain (every domain replays
+        # the stream from cursor 0, mirroring the host's dry-run restores)
+        tie_words = clone_tie_words(
+            rng, pad_to * MAX_TIE_DRAWS + MAX_TIE_DRAWS
+        )
+        self.telemetry.account_upload(
+            "features", tree_nbytes(feats) + tree_nbytes(tie_words), rec)
+        self.telemetry.account_upload("gang_masks", masks.nbytes, rec)
+        with self.recorder.wave_phase("kernel", rec), \
+                self.telemetry.compile_span(
+                    "gang_assign",
+                    (cfg, planes.bucket_sizes, pad_to, n_rows,
+                     int(n_constrained), bool(has_fallback),
+                     self._ctx.n_shards),
+                    label=(f"gang{pad_to}/d{n_rows}/"
+                           f"{_bucket_label(planes.bucket_sizes)}"),
+                    record=rec):
+            packed_dev = gang_assign(
+                cfg, dev, feats, masks, tie_words,
+                n_constrained=n_constrained, has_fallback=has_fallback
+            )
+        with self.recorder.wave_phase("wait", rec):
+            packed = self.telemetry.accounted_fetch("results", packed_dev,
+                                                    rec)
+        d, p = n_rows, pad_to
+        winners = packed[: d * p].reshape(d, p)
+        consumed = packed[d * p: d * p + d]
+        overflow = packed[d * p + d: d * p + 2 * d]
+        placed = packed[d * p + 2 * d: d * p + 3 * d]
+        win_d, ok = int(packed[-3]), bool(packed[-2])
+        n_real = len(placements)
+        if overflow[:n_real].any():
+            # a truncated draw desynchronizes that domain's VERDICT, not
+            # just its stream — the whole gang verdict is untrustworthy
+            rec.gang_fallback_pods = len(pods)
+            rec.gang_outcome = "fallback:tie-break draw overflow"
+            self.recorder.end_wave(
+                rec, fallback_reason="gang tie-break draw overflow")
+            return None
+        if not ok:
+            # group-level nomination hint: the domain that placed the most
+            # members is the near-miss — recorded on the wave record so
+            # operators (and the host cycle's preemption) see WHERE the
+            # gang almost fit; actual preemption stays host-side
+            near = int(np.argmax(placed[:n_real])) if n_real else -1
+            hint = ""
+            if near >= 0:
+                hint = (f" near={placements[near].name}"
+                        f" placed={int(placed[near])}/{len(pods)}")
+            rec.gang_fallback_pods = len(pods)
+            rec.gang_outcome = "fallback:no-domain" + hint
+            self.recorder.end_wave(
+                rec, fallback_reason="gang: no feasible domain")
+            return None
+        hosts = [planes.node_names[int(w)]
+                 for w in winners[win_d][: len(pods)]]
+        advance_rng(rng, int(consumed[win_d]))
+        rec.gang_outcome = f"device:{placements[win_d].name}"
+        self.recorder.end_wave(rec)
+        self.recorder.count_gang_pods("device", len(pods))
+        return hosts, win_d, rec
+
     # -- pipelined wave launch/collect ----------------------------------------
 
     def invalidate_carry(self) -> None:

@@ -75,6 +75,7 @@ TRANSFER_PLANES = (
     "affinity_tables",  # interned (anti-)affinity signature tables
     "ipa_term_key",     # global IPA term-key table refresh
     "features",         # the wave's stacked pod features + tie words
+    "gang_masks",       # gang wave's [D, Nb] topology-domain mask stack
     "results",          # packed winners/cursor fetch at collect
     "scores",           # per-node score/fail rows (single-pod, sig export)
 )

@@ -82,6 +82,13 @@ class WaveRecord:
     xwave_misses: int = 0
     xwave_evictions: int = 0
     fallback_reason: str | None = None  # resync/fallback diagnosis, if any
+    # gang waves (README "Gang waves"): PodGroups admitted to this wave,
+    # their member counts, members that fell back to the host gang cycle,
+    # and the per-group outcome ("device:<domain>" | "fallback:<reason>")
+    gang_groups: int = 0
+    gang_pods: int = 0
+    gang_fallback_pods: int = 0
+    gang_outcome: str | None = None
     injected_faults: int = 0  # chaos faults fired during this wave's flight
     retries: int = 0  # dispatcher retry attempts during this wave's flight
     # host prep seconds that ran while a predecessor wave was in flight on
@@ -123,6 +130,10 @@ class WaveRecord:
             "xwave_misses": self.xwave_misses,
             "xwave_evictions": self.xwave_evictions,
             "fallback_reason": self.fallback_reason,
+            "gang_groups": self.gang_groups,
+            "gang_pods": self.gang_pods,
+            "gang_fallback_pods": self.gang_fallback_pods,
+            "gang_outcome": self.gang_outcome,
             "injected_faults": self.injected_faults,
             "retries": self.retries,
             "overlap_s": round(self.overlap_s, 6),
@@ -171,6 +182,8 @@ class FlightRecorder:
         self._wave_seq = 0
         self.invalidations = 0  # cumulative carry invalidations
         self.retries_total = 0  # cumulative dispatcher retry attempts
+        # gang routing totals: path ("device" | "host") -> member count
+        self.gang_pod_totals: dict = {}
         # streaming-wave pipeline accounting: cumulative launch-side host
         # prep seconds, and how many of them ran under an in-flight
         # predecessor (see note_pipeline); wave-size histogram by pad
@@ -317,6 +330,20 @@ class FlightRecorder:
         threads); open wave records count retries in their window."""
         with self._lock:
             self.retries_total += n
+
+    def count_gang_pods(self, path: str, n: int) -> None:
+        """Count gang members routed down `path` ("device" = admitted to a
+        gang wave, "host" = fell back to the per-pod host gang cycle). The
+        ONE emission point for scheduler_tpu_gang_pods_total — the wave
+        record's gang_fallback_pods field is set by the backend separately
+        so a record never double-lands the counter."""
+        if n <= 0:
+            return
+        with self._lock:
+            self.gang_pod_totals[path] = self.gang_pod_totals.get(path, 0) + n
+        m = self.metrics
+        if m is not None and hasattr(m, "gang_pods"):
+            m.gang_pods(path, n)
 
     def breaker_transition(self, old: str, new: str, reason: str) -> None:
         """Record a TPU circuit-breaker state transition and land it on the

@@ -1,0 +1,170 @@
+"""Gang-wave planner: whole-PodGroup admission onto the device gang kernel.
+
+The host pod-group cycle (schedule_one.py schedule_pod_group) reproduces
+the reference's scheduleOnePodGroup: enumerate topology placements, dry-run
+the whole gang once per placement in a narrowed snapshot, score the fitting
+domains, then run the default algorithm under the winner. Every dry run is
+a sequence of single-pod kernel dispatches plus a full snapshot plane
+rebuild per placement — the slow path for exactly the workload this
+scheduler exists for (PAPER.md: GenericWorkload gangs + KEP-5732 packing).
+
+This module is the admission gate and host-side half of the fast path: it
+decides whether a popped gang is fully device-placeable, replicates the
+host's placement enumeration (the SAME PlacementGenerate plugin calls, so
+domain set, order, requiredDomain pin and error statuses can never
+diverge), and hands the resolved GangPlan to TPUBackend.run_gang — one
+program that scans the gang over every domain mask at once.
+
+Fallback contract: the device path handles ONLY the success case. Every
+odd case — no feasible domain in Required mode, tie-word overflow, plugin
+error status, hybrid/host-compose members, nominated pods, open breaker,
+sharded mesh, too many domains — returns None with the rng and snapshot
+untouched, and the full host `_pod_group_algorithm` runs as if the device
+attempt never happened. That is what makes gang-on device placement
+bit-compatible: the host path IS the semantics; the device path is an
+equal-output shortcut for the common case.
+
+GANG01 (analysis/gang_seam.py): the gang admission/placement state — the
+GangPlan fields and the WaveRecord gang_* outcome fields — is writable
+only in this module and in backend.py; everything else observes.
+"""
+
+from __future__ import annotations
+
+from ...utils.logging import get_logger
+from ..cache.snapshot import Placement
+from ..framework.cycle_state import CycleState
+
+_log = get_logger("gangplanner")
+
+# program-shape guards: a gang spanning more domains than this (pow2-padded
+# mask rows) or more members than this rides the host cycle — huge domain
+# fans are rare and the masked vmap's memory grows with D * the scan state
+MAX_GANG_DOMAINS = 32
+MAX_GANG_MEMBERS = 128
+
+
+class GangPlan:
+    """One PodGroup's resolved device placement plan.
+
+    gang_placements holds the host PlacementGenerate output in plugin
+    order — rows [0, gang_n_constrained) are topology domains, and when
+    gang_has_fallback the final row is the unconstrained parent placement
+    (Preferred topology / plugin-less gangs). These attributes are the
+    GANG01-protected group admission state."""
+
+    __slots__ = ("gang_placements", "gang_n_constrained",
+                 "gang_has_fallback", "gang_required")
+
+    def __init__(self, placements, n_constrained, has_fallback, required):
+        self.gang_placements = placements
+        self.gang_n_constrained = n_constrained
+        self.gang_has_fallback = has_fallback
+        self.gang_required = required
+
+
+def _member_device_eligible(algo, pod) -> bool:
+    """Is this member's decision FULLY modeled by the gang kernel?
+
+    Anything needing a host stage — volume claims, DRA, declared features,
+    extenders (the hybrid path), nominated-pod simulation, a nominee fast
+    path — sends the whole group to the host cycle: all-or-nothing applies
+    to the placement algorithm too, a gang must not split across tiers."""
+    if pod.status.nominated_node_name:
+        return False
+    if algo._has_relevant_nominations(pod):
+        return False
+    if algo._needs_host_compose(pod):
+        return False
+    return True
+
+
+def plan_gang(sched, fw, qpis) -> GangPlan | None:
+    """Replicate _pod_group_algorithm's placement enumeration exactly.
+
+    Runs the same run_placement_generate_plugins call on a scratch cycle
+    state (the plugins are pure reads of store/cache), applies the same
+    `narrowed = placements != [parent]` single-placement-still-constrains
+    rule, and derives Required mode from the same topology_mode probe.
+    A plugin error status returns None — the host cycle re-runs the
+    plugins and surfaces the identical error outcome."""
+    pods = [q.pod for q in qpis]
+    parent = Placement(
+        "all", [ni.name for ni in sched.snapshot.list_nodes()]
+    )
+    placements = None
+    narrowed = False
+    required = False
+    if fw.placement_generate_plugins:
+        pstate = CycleState()
+        placements, st = fw.run_placement_generate_plugins(
+            pstate, pods, parent
+        )
+        if not st.is_success and not st.is_skip:
+            return None  # host cycle reproduces the error status
+        narrowed = placements != [parent]
+        for p in fw.placement_generate_plugins:
+            mode = getattr(p, "topology_mode", lambda _p: None)(pods)
+            required = required or mode == "Required"
+    if placements is not None and narrowed:
+        constrained = list(placements)
+        if required:
+            # Required topology: no unconstrained fallback row — a gang no
+            # domain holds is unschedulable (host status reproduced on
+            # the fallback path)
+            return GangPlan(constrained, len(constrained), False, True)
+        return GangPlan(constrained + [parent], len(constrained), True,
+                        False)
+    # no placement plugins / skipped / not narrowed: the host runs the
+    # default algorithm on the whole snapshot — one unconstrained row
+    return GangPlan([parent], 0, True, required)
+
+
+def try_gang_wave(sched, fw, algo, gk: str, qpis: list):
+    """Attempt whole-gang device placement; returns hosts aligned with
+    `qpis` on success, else None (the host cycle takes the group).
+
+    Every None path leaves the rng, snapshot and cache untouched and
+    counts the members on the "host" side of the gang routing metric; the
+    backend counts the "device" side on success."""
+    from .backend import TPUSchedulingAlgorithm
+
+    if not isinstance(algo, TPUSchedulingAlgorithm):
+        return None
+    backend = algo.backend
+    recorder = backend.recorder
+
+    def host_path():
+        recorder.count_gang_pods("host", len(qpis))
+        return None
+
+    if not qpis or sched.snapshot.num_nodes() == 0:
+        return host_path()
+    if backend._ctx.n_shards != 1:
+        # mesh seam: domain masks aren't sharded over the node axis yet
+        return host_path()
+    if algo.breaker.device_blocked():
+        return host_path()
+    if len(qpis) > MAX_GANG_MEMBERS:
+        return host_path()
+    if not all(_member_device_eligible(algo, q.pod) for q in qpis):
+        return host_path()
+    plan = plan_gang(sched, fw, qpis)
+    if plan is None or len(plan.gang_placements) > MAX_GANG_DOMAINS:
+        return host_path()
+    try:
+        res = backend.run_gang(
+            [q.pod for q in qpis], sched.snapshot, plan.gang_placements,
+            plan.gang_n_constrained, plan.gang_has_fallback, algo.rng,
+        )
+    except Exception as e:  # noqa: BLE001 — degrade, never break the cycle
+        _log.error("gang wave failed; host cycle takes the group",
+                   group=gk, members=len(qpis), error=str(e))
+        algo.fallback_count += len(qpis)
+        return host_path()
+    if res is None:
+        algo.fallback_count += len(qpis)
+        return host_path()
+    hosts, _win_d, _rec = res
+    algo.kernel_count += len(qpis)
+    return hosts

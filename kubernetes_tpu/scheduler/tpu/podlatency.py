@@ -47,6 +47,8 @@ EDGES = (
     "queue_admission",  # pod entered the scheduling queue
     "wave_admission",   # pod popped into a batched wave (or host cycle)
     "kernel_verdict",   # device kernel / host algorithm picked a node
+    "gang_wait_start",  # gang member entered the Permit wait (gang pods only)
+    "gang_wait_end",    # gang quorum allowed the member (or wait rejected)
     "bind_dispatch",    # bind call handed to the dispatcher
     "bind_commit",      # bind durably applied to the store
     "status_ack",       # kubelet reported the pod Running (when present)
@@ -57,6 +59,9 @@ SEGMENTS = (
     ("informer", "watch_arrival", "queue_admission"),
     ("queue_wait", "queue_admission", "wave_admission"),
     ("kernel", "wave_admission", "kernel_verdict"),
+    # gang pods only: time parked at Permit until quorum (subset of the
+    # bind_dispatch segment, which keeps its kernel_verdict anchor)
+    ("gang_wait", "gang_wait_start", "gang_wait_end"),
     ("bind_dispatch", "kernel_verdict", "bind_dispatch"),
     ("bind_commit", "bind_dispatch", "bind_commit"),
     ("status_ack", "bind_commit", "status_ack"),

@@ -459,6 +459,252 @@ def run_trace_soak(seed: int = 7, pods: int = 96, nodes: int = 12,
     return report
 
 
+# -- gang soak: kubelet killed mid-gang, all-or-nothing must hold --------------
+
+
+def gang_schedule(registry: faultinject.FaultRegistry) -> None:
+    """Transient flakes aimed at the gang binding window: per-binding bind
+    errors and dispatcher flakes land INSIDE a gang's member-by-member
+    bind fan-out, store conflicts hit the status writes. Bounded times, so
+    convergence is eventually fault-free."""
+    registry.register(FaultSpec(
+        "store.bind_pod", mode=ERROR, transient=True,
+        probability=0.15, times=12, message="bind flake"))
+    registry.register(FaultSpec(
+        "dispatcher.execute", mode=ERROR, transient=True,
+        probability=0.1, times=20, message="dispatcher flake"))
+    registry.register(FaultSpec(
+        "store.update", mode=ERROR, probability=0.1, times=15,
+        exc=ConflictError, message="injected conflict"))
+
+
+@dataclasses.dataclass
+class GangSoakReport:
+    seed: int
+    gangs: int
+    created: int = 0
+    bound: int = 0
+    unbound: int = 0
+    evicted: int = 0
+    recreated: int = 0
+    partial_gangs_final: int = 0
+    zone_violations: int = 0
+    leaked_assumes: int = 0
+    queue_pending: int = 0
+    device_gang_pods: int = 0
+    host_gang_pods: int = 0
+    faults_fired: int = 0
+    wall_clock_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return (
+            self.unbound == 0
+            and self.partial_gangs_final == 0
+            and self.zone_violations == 0
+            and self.leaked_assumes == 0
+            and self.queue_pending == 0
+            # the kill must bite (members evicted + recreated) and the
+            # device gang path must have actually carried groups
+            and self.evicted >= 1
+            and self.recreated >= 1
+            and self.device_gang_pods >= 1
+            and self.faults_fired > 0
+        )
+
+    def render(self) -> str:
+        verdict = "PASS" if self.ok else "FAIL"
+        return (
+            f"gang soak [{verdict}] seed={self.seed} gangs={self.gangs}: "
+            f"created={self.created} bound={self.bound} "
+            f"unbound={self.unbound} evicted={self.evicted} "
+            f"recreated={self.recreated} "
+            f"partial_gangs_final={self.partial_gangs_final} "
+            f"zone_violations={self.zone_violations} "
+            f"leaked_assumes={self.leaked_assumes} "
+            f"queue_pending={self.queue_pending} "
+            f"device_gang_pods={self.device_gang_pods} "
+            f"host_gang_pods={self.host_gang_pods} "
+            f"faults_fired={self.faults_fired} "
+            f"wall_clock_s={self.wall_clock_s:.2f}"
+        )
+
+
+def run_gang_soak(seed: int = 7, gangs: int = 6, min_count: int = 3,
+                  nodes: int = 12, zones: int = 3, rounds: int = 3,
+                  kill_round: int = 1, tick_s: float = 0.02,
+                  grace_period_s: float = 0.3) -> GangSoakReport:
+    """Kubelet killed mid-gang (README "Gang waves" runbook): PodGroups
+    with Required/Preferred/no topology arrive in rounds under bind and
+    dispatcher flakes; right after one round's waves dispatch (async binds
+    still in flight) a node agent hosting a gang member stops
+    heartbeating. The lifecycle controller taints + evicts, a minimal
+    workload controller recreates the missing members, and after
+    fault-free convergence the all-or-nothing contract must hold: every
+    gang fully bound, no gang partially placed, Required gangs in exactly
+    one zone (the requiredDomain pin re-anchors recreated members into the
+    surviving siblings' domain). Leaves the registry disarmed + reset."""
+    from ..api.meta import ObjectMeta
+    from ..api.types import (
+        GangPolicy,
+        PodGroup,
+        PodGroupSpec,
+        SchedulingConstraints,
+        TopologyConstraint,
+    )
+    from ..controllers.lifecycle import NodeLifecycleController
+    from ..kubelet.hollow import HollowKubelet
+    from ..scheduler import Profile, Scheduler
+    from ..scheduler.metrics import SchedulerMetrics
+    from .wrappers import with_gang
+
+    ZONE_KEY = "topology.kubernetes.io/zone"
+    report = GangSoakReport(seed=seed, gangs=gangs)
+    t_start = time.monotonic()
+    registry = faultinject.registry()
+    registry.reset(seed=seed)
+    gang_schedule(registry)
+
+    store = Store()
+    sched = Scheduler(
+        store,
+        profiles=[Profile(backend="tpu", wave_size=8)],
+        feature_gates={"GenericWorkload": True,
+                       "TopologyAwareWorkloadScheduling": True,
+                       "SchedulerAsyncAPICalls": True},
+        async_api_calls=True,
+        metrics=SchedulerMetrics(),
+        seed=seed,
+    )
+    sched.queue._initial_backoff = 0.02
+    sched.queue._max_backoff = 0.1
+
+    kubelets = []
+    for i in range(nodes):
+        node = make_node(f"gn{i}", cpu="16", mem="32Gi",
+                         zone=f"z{i % zones}")
+        k = HollowKubelet(store, node)
+        k.register()
+        kubelets.append(k)
+    lifecycle = NodeLifecycleController(store)
+    lifecycle.grace_period = grace_period_s
+    lifecycle.start()
+    lifecycle.sweep()
+    sched.start()
+
+    gang_specs: dict[str, tuple[int, str | None]] = {}
+    killed: set[str] = set()
+
+    def member_name(gang: str, i: int) -> str:
+        return f"{gang}-m{i}"
+
+    def make_member(gang: str, i: int):
+        return with_gang(make_pod(member_name(gang, i), cpu="200m",
+                                  mem="128Mi"), gang)
+
+    def recreate_missing() -> None:
+        """The workload controller's job: evicted gang members come back
+        (same name, fresh object) so the gang can re-reach quorum."""
+        have = {p.meta.name for p in store.pods()}
+        for gang, (size, _mode) in gang_specs.items():
+            for i in range(size):
+                if member_name(gang, i) not in have:
+                    store.create(make_member(gang, i))
+                    report.recreated += 1
+
+    def drive(ticks: int) -> None:
+        for _ in range(ticks):
+            for k in kubelets:
+                if k.node_name not in killed:
+                    k.sync_once()
+            lifecycle.sync_once()
+            recreate_missing()
+            sched.schedule_pending()
+            time.sleep(tick_s)
+
+    registry.arm()
+    g = 0
+    try:
+        for rnd in range(rounds):
+            per_round = gangs // rounds + (1 if rnd < gangs % rounds else 0)
+            for _ in range(per_round):
+                mode = ("Required", "Preferred", None)[g % 3]
+                constraints = SchedulingConstraints()
+                if mode is not None:
+                    constraints = SchedulingConstraints(topology=(
+                        TopologyConstraint(key=ZONE_KEY, mode=mode),))
+                gang = f"gang-{g}"
+                store.create(PodGroup(
+                    meta=ObjectMeta(name=gang),
+                    spec=PodGroupSpec(
+                        policy=GangPolicy(min_count=min_count),
+                        constraints=constraints),
+                ))
+                gang_specs[gang] = (min_count, mode)
+                for i in range(min_count):
+                    store.create(make_member(gang, i))
+                g += 1
+            report.created += per_round * min_count
+            sched.schedule_pending()
+            if rnd == kill_round:
+                # mid-gang kubelet kill: async binds of this round's gangs
+                # may still be in flight; the victim hosts a gang member
+                victim = next(
+                    (p.spec.node_name for p in store.pods()
+                     if p.spec.scheduling_group is not None
+                     and p.spec.node_name), kubelets[0].node_name)
+                killed.add(victim)
+            drive(ticks=int(grace_period_s / tick_s) + 8)
+    finally:
+        registry.disarm()
+    report.faults_fired = registry.fired_total
+
+    # fault-free convergence: evictions drain, recreated members re-reach
+    # quorum, every gang binds whole
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        for k in kubelets:
+            if k.node_name not in killed:
+                k.sync_once()
+        lifecycle.sync_once()
+        recreate_missing()
+        sched.schedule_pending()
+        pending = [p for p in store.pods() if not p.spec.node_name]
+        active, backoff, unsched = sched.queue.pending_pods()
+        if (not pending and sched.cache.assumed_pod_count() == 0
+                and active + backoff + unsched == 0):
+            break
+        time.sleep(tick_s)
+
+    node_zone = {n.meta.name: n.meta.labels.get(ZONE_KEY)
+                 for n in store.nodes()}
+    pods_now = {p.meta.name: p for p in store.pods()}
+    report.bound = sum(1 for p in pods_now.values() if p.spec.node_name)
+    report.unbound = len(pods_now) - report.bound
+    total_members = sum(size for size, _ in gang_specs.values())
+    report.evicted = report.recreated  # every recreation followed an eviction
+    for gang, (size, mode) in gang_specs.items():
+        hosts = [pods_now[member_name(gang, i)].spec.node_name
+                 for i in range(size) if member_name(gang, i) in pods_now]
+        n_bound = sum(1 for h in hosts if h)
+        if n_bound not in (0, size):
+            report.partial_gangs_final += 1
+        if mode == "Required" and n_bound == size:
+            if len({node_zone.get(h) for h in hosts}) > 1:
+                report.zone_violations += 1
+    report.created = max(report.created, total_members)
+    report.leaked_assumes = sched.cache.assumed_pod_count()
+    active, backoff, unsched = sched.queue.pending_pods()
+    report.queue_pending = active + backoff + unsched
+    totals = sched.flight_recorder.gang_pod_totals
+    report.device_gang_pods = totals.get("device", 0)
+    report.host_gang_pods = totals.get("host", 0)
+    sched.api_dispatcher.close()
+    registry.reset()
+    report.wall_clock_s = time.monotonic() - t_start
+    return report
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -481,9 +727,19 @@ def main(argv: list[str] | None = None) -> int:
                         help="total arrivals for --trace")
     parser.add_argument("--budget-s", type=float, default=60.0,
                         help="wall-clock budget asserted by --trace")
+    parser.add_argument("--gang", action="store_true",
+                        help="run the gang soak (kubelet killed mid-gang "
+                             "under bind/dispatcher flakes; all-or-nothing "
+                             "asserted after convergence) instead of the "
+                             "scale-churn soak")
+    parser.add_argument("--gangs", type=int, default=6,
+                        help="PodGroup count for --gang")
     args = parser.parse_args(argv)
 
-    if args.trace:
+    if args.gang:
+        report = run_gang_soak(seed=args.seed, gangs=args.gangs,
+                               nodes=min(args.nodes, 12))
+    elif args.trace:
         report = run_trace_soak(seed=args.seed, pods=args.pods,
                                 nodes=min(args.nodes, 12),
                                 wave_size=args.wave_size,

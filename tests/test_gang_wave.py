@@ -1,0 +1,141 @@
+"""Gang waves: batched all-or-nothing PodGroup placement on device.
+
+The contract under test (README "Gang waves"): with `KUBE_TPU_GANG_WAVES`
+on, a popped gang is placed by ONE batched kernel launch that scans the
+group over every topology-domain mask and picks the best feasible domain
+— and the result is BIT-IDENTICAL to the host pod-group cycle
+(per-placement dry-run + score + default algorithm): same bindings, same
+unschedulable statuses, same tie-break rng stream position afterwards.
+Required and Preferred topology modes both ride the device; every odd
+case falls back to the host cycle with rng/snapshot untouched.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import (
+    GangPolicy,
+    PodGroup,
+    PodGroupSpec,
+    SchedulingConstraints,
+    TopologyConstraint,
+)
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testing.wrappers import make_node, make_pod, with_gang
+
+GATES = {"GenericWorkload": True, "TopologyAwareWorkloadScheduling": True}
+ZONE_KEY = "topology.kubernetes.io/zone"
+
+
+def _constraints(mode):
+    if mode is None:
+        return SchedulingConstraints()
+    return SchedulingConstraints(
+        topology=(TopologyConstraint(key=ZONE_KEY, mode=mode),)
+    )
+
+
+def _run(monkeypatch, waves, modes=("Required", "Preferred", None),
+         nodes=12, zones=3, cpu="8", pod_cpu="1", sizes=(3, 2, 4)):
+    """One gang scenario on the tpu backend; waves=False pins the host
+    pod-group cycle. Returns (bindings, diagnoses, rng_state, scheduler)."""
+    monkeypatch.setenv("KUBE_TPU_GANG_WAVES", "1" if waves else "0")
+    store = Store()
+    for i in range(nodes):
+        store.create(make_node(f"n{i}", cpu=cpu, mem="16Gi",
+                               zone=f"z{i % zones}"))
+    s = Scheduler(store, profiles=[Profile(backend="tpu")], seed=7,
+                  feature_gates=GATES)
+    s.start()
+    for g, (size, mode) in enumerate(zip(sizes, list(modes)[:len(sizes)])):
+        store.create(PodGroup(
+            meta=ObjectMeta(name=f"gang{g}"),
+            spec=PodGroupSpec(policy=GangPolicy(min_count=size),
+                              constraints=_constraints(mode)),
+        ))
+        for i in range(size):
+            store.create(with_gang(
+                make_pod(f"gang{g}-{i}", cpu=pod_cpu), f"gang{g}"))
+        store.create(make_pod(f"plain{g}", cpu="500m"))
+        s.schedule_pending()
+    s.event_recorder.flush()
+    placed = {p.meta.name: p.spec.node_name for p in store.pods()}
+    diags = {}
+    for p in store.pods():
+        for c in p.status.conditions:
+            if c.type == "PodScheduled" and c.status == "False":
+                diags[p.meta.name] = f"{c.reason}: {c.message}"
+    algo = s.algorithms["default-scheduler"]
+    return placed, diags, algo.rng.getstate(), s
+
+
+class TestGangWaveParity:
+    def test_on_off_identical(self, monkeypatch):
+        """The whole contract in one assertion: flipping the gang-wave
+        env knob must not change a single binding, diagnosis, or the rng
+        stream — and the on-run must actually have used the device."""
+        on = _run(monkeypatch, waves=True)
+        off = _run(monkeypatch, waves=False)
+        assert on[0] == off[0]
+        assert on[1] == off[1]
+        assert on[2] == off[2]
+        assert on[3].flight_recorder.gang_pod_totals.get("device", 0) == 9
+        assert off[3].flight_recorder.gang_pod_totals == {}
+
+    @pytest.mark.parametrize("mode", ["Required", "Preferred"])
+    def test_single_mode_parity(self, monkeypatch, mode):
+        """Device domain selection agrees with the host dry-run in both
+        topology modes (Preferred adds the unconstrained fallback row)."""
+        on = _run(monkeypatch, waves=True, modes=(mode, mode, mode))
+        off = _run(monkeypatch, waves=False, modes=(mode, mode, mode))
+        assert on[0] == off[0]
+        assert on[1] == off[1]
+        assert on[2] == off[2]
+        # every gang fully placed in ONE zone when Required (nodes are
+        # created round-robin: n{i} lives in z{i % 3})
+        if mode == "Required":
+            for g in range(3):
+                zones = {
+                    f"z{int(node[1:]) % 3}"
+                    for name, node in on[0].items()
+                    if name.startswith(f"gang{g}-")
+                }
+                assert len(zones) == 1, f"gang{g} spans {zones}"
+
+    def test_required_no_fit_all_or_nothing(self, monkeypatch):
+        """A gang no single zone can hold, in Required mode: both paths
+        leave EVERY member unbound with the host's unschedulable status
+        (the device run falls back; no partial placement ever lands)."""
+        kw = dict(nodes=4, zones=2, cpu="2", pod_cpu="1500m",
+                  sizes=(3,), modes=("Required",))
+        on = _run(monkeypatch, waves=True, **kw)
+        off = _run(monkeypatch, waves=False, **kw)
+        assert on[0] == off[0]
+        assert on[1] == off[1]
+        assert on[2] == off[2]
+        for i in range(3):
+            assert not on[0][f"gang0-{i}"], "partial gang placement"
+            assert f"gang0-{i}" in on[1], "missing unschedulable diagnosis"
+        # the group rode the host cycle (fallback), not the device
+        assert on[3].flight_recorder.gang_pod_totals.get("device", 0) == 0
+        assert on[3].flight_recorder.gang_pod_totals.get("host", 0) >= 3
+
+    def test_wave_record_outcome(self, monkeypatch):
+        """The flight recorder's gang wave carries the group shape and a
+        device outcome naming the winning placement."""
+        on = _run(monkeypatch, waves=True, sizes=(3,), modes=("Required",))
+        recs = [r for r in on[3].flight_recorder._records
+                if getattr(r, "gang_pods", 0)]
+        assert recs, "no gang WaveRecord retained"
+        rec = recs[0]
+        assert rec.gang_groups == 1
+        assert rec.gang_pods == 3
+        assert rec.gang_fallback_pods == 0
+        assert rec.gang_outcome.startswith("device:")
+        assert f"{ZONE_KEY}=" in rec.gang_outcome
+        d = rec.to_dict()
+        assert d["gang_outcome"] == rec.gang_outcome
+        assert d["gang_pods"] == 3

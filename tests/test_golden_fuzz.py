@@ -202,10 +202,18 @@ def gang_assignments(backend: str, seed: int) -> dict[str, str]:
     for g in range(3):
         size = rng.randint(2, 4)
         constraints = SchedulingConstraints()
-        if rng.random() < 0.5:
+        roll = rng.random()
+        if roll < 0.4:
             constraints = SchedulingConstraints(topology=(
                 TopologyConstraint(key="topology.kubernetes.io/zone",
                                    mode="Required"),
+            ))
+        elif roll < 0.7:
+            # Preferred exercises the gang wave's unconstrained fallback
+            # row (constrained domains first, whole snapshot last)
+            constraints = SchedulingConstraints(topology=(
+                TopologyConstraint(key="topology.kubernetes.io/zone",
+                                   mode="Preferred"),
             ))
         store.create(PodGroup(
             meta=ObjectMeta(name=f"gang{g}"),
@@ -223,7 +231,7 @@ def gang_assignments(backend: str, seed: int) -> dict[str, str]:
     return {p.meta.name: p.spec.node_name for p in store.pods()}
 
 
-@pytest.mark.parametrize("seed", [5, 9])
+@pytest.mark.parametrize("seed", [5, 9, 13, 17])
 def test_gang_parity_host_vs_tpu(seed):
     host = gang_assignments("host", seed)
     tpu = gang_assignments("tpu", seed)

@@ -78,7 +78,7 @@ def mixed_pods(lo, hi, spread=False, ipa=False):
 def _run_stream(monkeypatch, depth, dedup=True, spread=False, ipa=False,
                 nodes=6, zones=2, cpu="4",
                 bursts=((0, 15), (15, 30), (30, 42)),
-                mesh=0, churn_nodes=0):
+                mesh=0, churn_nodes=0, gates=None):
     """One streamed scenario: pods arrive in bursts, each burst drained by
     `schedule_pending` so waves within a burst genuinely pipeline (wave
     k+1 preps from the carry overlay while wave k is on the device).
@@ -97,7 +97,7 @@ def _run_stream(monkeypatch, depth, dedup=True, spread=False, ipa=False,
         store.create(make_node(f"n{i}", cpu=cpu, mem="8Gi",
                                zone=f"z{i % zones}"))
     s = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=8)],
-                  seed=11)
+                  seed=11, feature_gates=gates or {})
     algo = s.algorithms["default-scheduler"]
     algo.backend.dedup_enabled = dedup
     assert algo.backend._ctx.is_sharded == bool(mesh)
@@ -152,6 +152,24 @@ class TestPipelineGoldenTriple:
         assert piped[3].flight_recorder.overlap_s_total > 0
         assert serial[3].flight_recorder.overlap_s_total == 0
         assert nodedup[3].flight_recorder.overlap_s_total > 0
+
+    def test_gang_registered_absent_triple_identical(self, monkeypatch):
+        """Gang plugins + gang waves registered (GenericWorkload and
+        TopologyAwareWorkloadScheduling gates on) but NO PodGroup in the
+        stream: the gang-wave machinery must be invisible — bindings,
+        diagnoses and the tie-break rng position bit-identical across
+        pipelined/serial/dedup-off, and identical to the ungated run."""
+        gates = {"GenericWorkload": True,
+                 "TopologyAwareWorkloadScheduling": True}
+        piped, serial, nodedup = _triple(monkeypatch, gates=gates)
+        _assert_identical(piped, serial, nodedup)
+        # no gang ever popped → the gang routing counter never moved
+        assert piped[3].flight_recorder.gang_pod_totals == {}
+        # and registering the gates alone must not perturb placement
+        base = _run_stream(monkeypatch, depth=2, dedup=True)
+        assert piped[0] == base[0]
+        assert piped[1] == base[1]
+        assert piped[2] == base[2]
 
     def test_hard_pts_triple_identical(self, monkeypatch):
         """DoNotSchedule zone spread makes every wave hard-PTS (n_hard >

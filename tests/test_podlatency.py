@@ -86,6 +86,8 @@ def stamp_all(ledger, key, t0=100.0, wave_id=None, clock=None):
         "bind_commit": t0 + 1.5,
     }
     for edge in EDGES[:-1]:
+        if edge not in offsets:  # gang_wait_*: gang pods only
+            continue
         clock.now = offsets[edge]
         ledger.stamp(key, edge, wave_id=wave_id)
     return offsets

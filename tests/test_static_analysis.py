@@ -17,6 +17,7 @@ from kubernetes_tpu.analysis import (
     LedgerSeriesChecker,
     LockDisciplineChecker,
     RegistrySyncChecker,
+    GangSeamChecker,
     RetryDisciplineChecker,
     ShardSeamChecker,
     SignatureSyncChecker,
@@ -1001,6 +1002,90 @@ class TestShardSeam:
         """The shipped tree's only full-plane node_planes upload is
         backend.py's _cold_start_upload."""
         assert list(ShardSeamChecker().check_project(PKG)) == []
+
+
+# ---------------------------------------------------------------- GANG01
+
+
+def write_gang_tree(root, files):
+    for name, src in files.items():
+        p = root / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return root
+
+
+class TestGangSeam:
+    def test_seam_writers_clean(self, tmp_path):
+        write_gang_tree(tmp_path, {
+            "scheduler/tpu/gangplanner.py": """
+                class GangPlan:
+                    def __init__(self, placements):
+                        self.gang_placements = placements
+                        self.gang_n_constrained = len(placements)
+            """,
+            "scheduler/tpu/backend.py": """
+                def run_gang(rec, pods):
+                    rec.gang_pods = len(pods)
+                    rec.gang_outcome = "device:z0"
+            """,
+        })
+        assert list(GangSeamChecker().check_project(tmp_path)) == []
+
+    def test_writer_outside_seam_flagged(self, tmp_path):
+        write_gang_tree(tmp_path, {
+            "scheduler/schedule_one.py": """
+                def schedule_pod_group(self, rec):
+                    rec.gang_outcome = "host-decided"
+            """,
+        })
+        fs = list(GangSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["GANG01"]
+        assert "gang_outcome" in fs[0].message
+
+    def test_aug_assign_flagged(self, tmp_path):
+        write_gang_tree(tmp_path, {
+            "scheduler/plugins/helper.py": """
+                def bump(rec, n):
+                    rec.gang_fallback_pods += n
+            """,
+        })
+        fs = list(GangSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["GANG01"]
+
+    def test_reads_and_declarations_not_flagged(self, tmp_path):
+        # observing the state and dataclass field declarations are fine —
+        # only assignments fork the seam
+        write_gang_tree(tmp_path, {
+            "scheduler/tpu/flightrecorder.py": """
+                from dataclasses import dataclass
+
+                @dataclass
+                class WaveRecord:
+                    gang_pods: int = 0
+                    gang_outcome: str | None = None
+
+                def to_dict(rec):
+                    return {"gang_pods": rec.gang_pods,
+                            "gang_outcome": rec.gang_outcome}
+            """,
+        })
+        assert list(GangSeamChecker().check_project(tmp_path)) == []
+
+    def test_unrelated_attrs_not_flagged(self, tmp_path):
+        write_gang_tree(tmp_path, {
+            "scheduler/loop.py": """
+                def setup(self):
+                    self.gang_waves = True
+                    self.gang_pod_totals = {}
+            """,
+        })
+        assert list(GangSeamChecker().check_project(tmp_path)) == []
+
+    def test_repo_gang_seam_in_sync(self):
+        """The shipped tree writes gang state only inside
+        gangplanner.py / backend.py."""
+        assert list(GangSeamChecker().check_project(PKG)) == []
 
 
 # ------------------------------------------------------------------ SIG01
