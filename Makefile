@@ -19,13 +19,18 @@ test:
 # recover, partition detect AND repair, evicted pods gone, late arrivals
 # bound) inside the wall-clock budget — then (3) the gang soak: a kubelet
 # killed mid-gang under bind/dispatcher flakes, all-or-nothing asserted
-# after convergence (no partially-bound gang, Required gangs single-zone).
+# after convergence (no partially-bound gang, Required gangs single-zone)
+# — then (4) the restart storm: seeded scheduler crashes mid-wave /
+# mid-bind-commit / mid-gang-permit with ungraceful teardown and warm
+# restarts over the same store (zero double binds, zero leaked assumes,
+# per-gang all-or-nothing, compile-free warm restart asserted).
 # Exits non-zero on divergence — same seed replays the same schedule
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --seed 7
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --trace --seed 7 --budget-s 60
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --trace --seed 1234 --budget-s 60
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --gang --seed 7
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --restart --seed 7
 
 # flight-recorder CLI smoke: synthetic multi-wave run (no device, no jax),
 # exercises ring buffer + watchdog + post-mortem formatting, and asserts

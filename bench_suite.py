@@ -30,6 +30,9 @@ Reference rows, AT REFERENCE WORKLOAD SHAPE
   GangSchedulingTopologyRequired 500Nodes     >= 100   (device gang wave;
   GangSchedulingTopologyPreferred 500Nodes    >= 100    floors >=3x the host
                                                         gang cycle's ~32)
+  WarmRestart (fork feature)                  warm_compile_count == 0
+                                                       (compile-free warm
+                                                        restart contract)
 
 Wedge-proofing is shared with bench.py: subprocess device probe + labeled
 CPU fallback, so a dead accelerator tunnel degrades to a valid CPU number.
@@ -192,6 +195,25 @@ def main() -> None:
             "git_rev": git_rev,
             "row_wall_s": round(row_wall_s, 2),
         })
+        print(json.dumps(line), flush=True)
+
+    # standing WarmRestart row: a restarted scheduler over an occupied
+    # store must re-enter service compile-free (README "Restart &
+    # recovery"); the gate's warm_compile_count key fails the artifact
+    # history the moment that count leaves 0
+    from kubernetes_tpu.perf.warm_restart_bench import run_warm_restart_bench
+
+    if not only or only in "warm_restart":
+        row_t0 = time.monotonic()
+        line = run_warm_restart_bench(seed=SUITE_SEED)
+        all_pass = all_pass and line["pass"]
+        line.update({
+            "device": platform,
+            "git_rev": git_rev,
+            "row_wall_s": round(time.monotonic() - row_t0, 2),
+        })
+        if fallback_reason:
+            line["fallback_reason"] = fallback_reason
         print(json.dumps(line), flush=True)
 
     print(json.dumps({

@@ -22,8 +22,12 @@ sees every byte (OBS03),
 cold-start plane-upload seam — the full-plane re-put of the node planes is
 only legal inside backend.py's one sanctioned cold-start seam, so per-burst
 upload bytes cannot silently re-couple to cluster size (SHARD01),
-and retry/fault-injection discipline — no hand-rolled backoff loops or
-ad-hoc random flakes outside the shared helpers (RET01).
+retry/fault-injection discipline — no hand-rolled backoff loops or
+ad-hoc random flakes outside the shared helpers (RET01),
+and reconcile-restored state ownership — the attributes a restart's
+reconcile() re-derives from store truth (RECONCILE_RESTORED_STATE in
+scheduler/scheduler.py) are writable only in their sanctioned owning
+modules, so crash recovery never races a stray writer (CRASH01).
 
 CLI: `python -m kubernetes_tpu.analysis [paths]` (exit 1 on findings);
 suppress a single line with `# kubesched-lint: disable=RULE`.
@@ -40,6 +44,7 @@ from .core import (
     run_paths,
 )
 from .carry_coherence import CarryCoherenceChecker
+from .crash_state import CrashStateChecker
 from .fault_points import FaultPointChecker
 from .gang_seam import GangSeamChecker
 from .jit_purity import JitPurityChecker
@@ -57,6 +62,7 @@ from .transfer_seam import TransferSeamChecker
 __all__ = [
     "CarryCoherenceChecker",
     "Checker",
+    "CrashStateChecker",
     "FaultPointChecker",
     "Finding",
     "GangSeamChecker",

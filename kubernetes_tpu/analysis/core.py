@@ -106,6 +106,7 @@ class ProjectChecker(Checker):
 
 def default_checkers() -> list[Checker]:
     from .carry_coherence import CarryCoherenceChecker
+    from .crash_state import CrashStateChecker
     from .fault_points import FaultPointChecker
     from .gang_seam import GangSeamChecker
     from .jit_purity import JitPurityChecker
@@ -135,6 +136,7 @@ def default_checkers() -> list[Checker]:
         TransferSeamChecker(),
         ShardSeamChecker(),
         GangSeamChecker(),
+        CrashStateChecker(),
     ]
 
 

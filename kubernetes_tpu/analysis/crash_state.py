@@ -1,0 +1,157 @@
+"""Reconcile-restored state rule (CRASH01).
+
+`scheduler/scheduler.py` declares, in one `RECONCILE_RESTORED_STATE`
+literal, every attribute a fresh scheduler's `reconcile()` re-derives from
+store truth after a crash — the assumed-pod set, the gang quorum table,
+and the wave pipeline's in-flight handles — together with the ONE module
+sanctioned to write each (its owning class). The restart contract
+(README "Restart & recovery") is only sound if that state has exactly one
+writer: a stray mutation from, say, a plugin or a controller would be
+invisible to reconcile's sweeps, and the next crash/restart would recover
+against state the store never agreed to.
+
+CRASH01 therefore flags, across the whole tree:
+
+- assignment (plain, augmented, annotated, tuple-unpacked) to a declared
+  attribute outside its sanctioned module;
+- `del` of such an attribute;
+- mutating method calls on one (`.clear()`, `.update()`, `.popleft()`,
+  ...).
+
+The declaring module itself (`scheduler/scheduler.py`) is exempt — it
+owns the contract and reconcile's sweeps go through the owners' methods
+anyway. Reads stay free everywhere: the rule polices writes, not
+observation. Like FI01, nothing imports the constant at the write sites,
+so cross-parsing is the only enforcement possible; findings are
+project-scoped and per-line suppressions do not apply — route the write
+through the owning module instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from .core import Finding, ProjectChecker
+
+CRASH01 = "CRASH01"
+
+SCHEDULER = "scheduler/scheduler.py"
+
+# method names that mutate their receiver in-place (the deque forms
+# included: _wave_completions is a deque)
+_MUTATORS = {
+    "clear", "update", "add", "discard", "pop", "remove", "append",
+    "extend", "setdefault", "store", "appendleft", "popleft", "insert",
+}
+
+
+def _parse_state(path: Path) -> dict[str, set[str]] | None:
+    """The RECONCILE_RESTORED_STATE literal as {attr: sanctioned files},
+    or None if it is not a literal tuple of (str, str) pairs."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "RECONCILE_RESTORED_STATE"
+            for t in node.targets
+        ):
+            value = node.value
+            if not isinstance(value, (ast.Tuple, ast.List)):
+                return None
+            out: dict[str, set[str]] = {}
+            for el in value.elts:
+                if not (isinstance(el, (ast.Tuple, ast.List))
+                        and len(el.elts) == 2
+                        and all(isinstance(c, ast.Constant)
+                                and isinstance(c.value, str)
+                                for c in el.elts)):
+                    return None
+                attr, owner = (c.value for c in el.elts)
+                out.setdefault(attr, set()).add(owner)
+            return out
+    return None
+
+
+def _guarded_attrs(
+    expr: ast.expr, guarded: set[str]
+) -> Iterator[tuple[int, str]]:
+    """(line, attr) for every guarded attribute access inside `expr`."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in guarded:
+            yield node.lineno, node.attr
+
+
+class CrashStateChecker(ProjectChecker):
+    rules = {
+        CRASH01: "reconcile-restored scheduler state written outside its "
+                 "sanctioned owner (see scheduler/scheduler.py "
+                 "RECONCILE_RESTORED_STATE) — crash recovery only re-derives "
+                 "state the owning module wrote",
+    }
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        decl = root / SCHEDULER
+        if not decl.is_file():
+            return  # partial tree (fixture dirs) — nothing to cross-check
+        state = _parse_state(decl)
+        if state is None:
+            yield Finding(
+                decl.as_posix(), 1, 0, CRASH01,
+                "could not parse RECONCILE_RESTORED_STATE for "
+                "cross-checking — keep it a literal tuple of "
+                "(attribute, sanctioned module) string pairs",
+            )
+            return
+        for path in sorted(root.rglob("*.py")):
+            posix = path.as_posix()
+            if posix.endswith(SCHEDULER):
+                continue  # the contract's declaration site
+            guarded = {
+                attr for attr, owners in state.items()
+                if not any(posix.endswith(owner) for owner in owners)
+            }
+            if not guarded:
+                continue
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except (OSError, SyntaxError):
+                continue  # LINT01 reports unparseable files
+            yield from self._check_tree(posix, tree, guarded)
+
+    def _check_tree(
+        self, path: str, tree: ast.AST, guarded: set[str]
+    ) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = list(node.targets)
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in _MUTATORS):
+                    for line, attr in _guarded_attrs(func.value, guarded):
+                        yield Finding(
+                            path, line, 0, CRASH01,
+                            f"mutating call .{func.attr}() on "
+                            f"reconcile-restored state {attr!r} outside its "
+                            "sanctioned owner — route the write through the "
+                            "owning module so crash recovery stays sound",
+                        )
+                continue
+            for tgt in targets:
+                for line, attr in _guarded_attrs(tgt, guarded):
+                    yield Finding(
+                        path, line, 0, CRASH01,
+                        f"write to reconcile-restored state {attr!r} outside "
+                        "its sanctioned owner (see RECONCILE_RESTORED_STATE) "
+                        "— a stray writer here is invisible to reconcile's "
+                        "sweeps and corrupts the next restart's recovery",
+                    )

@@ -326,6 +326,14 @@ class InformerFactory:
     def pump_all(self) -> int:
         return sum(inf.pump() for inf in self._informers.values())
 
+    def stop_all(self) -> None:
+        """Tear down every informer's watch stream. The chaos restart
+        driver uses this as its stand-in for process death: a crashed
+        scheduler's watch connections drop server-side, so the store must
+        stop queueing deliveries for a consumer that no longer exists."""
+        for inf in self._informers.values():
+            inf.stop()
+
     def resync_all(self) -> int:
         """Diff-repair every informer's cache (see SharedInformer.resync)."""
         return sum(inf.resync() for inf in self._informers.values())

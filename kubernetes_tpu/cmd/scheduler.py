@@ -55,6 +55,14 @@ class SchedulerServer:
 
         self.trace_exporter = InMemoryExporter(capacity=512)
         self.tracer = Tracer("tpu-scheduler", exporter=self.trace_exporter)
+        # AOT warm restart (README "Restart & recovery"): any device
+        # profile pre-lowers its wave kernels at start() so a restarted
+        # scheduler re-enters service compile-free; KUBE_TPU_WARMUP=0
+        # opts out (lazy compilation, first waves pay the tracing tax)
+        from ..utils.envknob import int_env
+
+        warm = (any(p.backend == "tpu" for p in profiles)
+                and int_env("KUBE_TPU_WARMUP", 1) != 0)
         self.scheduler = Scheduler(
             store,
             profiles=profiles,
@@ -64,6 +72,7 @@ class SchedulerServer:
             parallelism=config.parallelism,
             extenders=config.extenders,
             tracer=self.tracer,
+            warm_start=warm,
         )
         # SIGUSR2 → cache dump + cache/store comparison (the reference's
         # backend/cache/debugger wiring)
@@ -339,6 +348,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.leader_elect:
         config.leader_election.leader_elect = True
     config.health_bind_port = args.port
+    if any(p.backend == "tpu" for p in config.profiles):
+        # persistent XLA compilation cache: restarts replay lowerings from
+        # disk instead of recompiling (the warm-restart path assumes it)
+        from ..utils.jaxcache import enable_persistent_cache
+
+        enable_persistent_cache()
     server = SchedulerServer(Store(), config)
     server.flags = {k: v for k, v in vars(args).items()}
     server.run(block=True)

@@ -8,9 +8,10 @@ row regressed by more than the tolerance (default 10%):
 - throughput rows (unit "pods/s..."): regression = new < old * 0.9
 - latency keys  (sli_p50_s, sli_p99_s, trace_p50_s, trace_p99_s):
   regression = new > old * 1.1
-- device keys   (upload_bytes_per_wave, compile_count): lower is better —
-  growth past the tolerance means host->device transfer crept back in or
-  a kernel started recompiling per wave (a recompile storm)
+- device keys   (upload_bytes_per_wave, compile_count,
+  warm_compile_count): lower is better — growth past the tolerance means
+  host->device transfer crept back in, a kernel started recompiling per
+  wave (a recompile storm), or a warm restart stopped being compile-free
 - SLI pass flags (sli_p50_ok, sli_p99_ok): true -> false is a regression
   outright — a blown target never hides inside the tolerance band
 
@@ -38,8 +39,11 @@ import sys
 
 TOLERANCE = 0.10
 LATENCY_KEYS = ("sli_p50_s", "sli_p99_s", "trace_p50_s", "trace_p99_s")
-# device telemetry rows (devicetelemetry.py bench_columns): lower is better
-DEVICE_KEYS = ("upload_bytes_per_wave", "compile_count")
+# device telemetry rows (devicetelemetry.py bench_columns): lower is better.
+# warm_compile_count (warm_restart_bench.py) sits at 0 in every healthy
+# artifact, so ANY growth exceeds the relative tolerance — the gate fails
+# the moment a warm restart compiles anything
+DEVICE_KEYS = ("upload_bytes_per_wave", "compile_count", "warm_compile_count")
 OK_KEYS = ("sli_p50_ok", "sli_p99_ok")
 # artifact families gated independently: single-device rounds (BENCH_*)
 # and the sharded-mesh node sweep (MULTICHIP_BENCH_*; bench_multichip.py

@@ -1,0 +1,112 @@
+"""Standing WarmRestart bench row: cold vs warm restart over one store.
+
+The AOT warm-restart contract (README "Restart & recovery") is a perf
+claim, so it gets a standing bench row: incarnation A cold-starts on an
+empty cluster, pays its compiles, and binds traffic; incarnation B comes
+up over the SAME occupied store with `warm_start=True`, pre-lowers in its
+`warmup` phase, and must re-enter service compile-free —
+`compile_count_since_warm() == 0` after real traffic. The row records
+both incarnations' compile counts and time-to-first-bind; the suite fails
+(and `make bench-gate` guards the artifact history via the
+`warm_compile_count` lower-is-better key) the moment a warm restart
+compiles anything.
+
+Sized like the chaos restart soak (16 nodes, wave 8): the contract is
+shape-coverage, not throughput — any post-warm compile is a bug at any
+scale.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _first_bind_s(sched, store, name: str) -> float:
+    """Wall time for one pod to go queue → bound (the service re-entry
+    latency the restart runbook quotes)."""
+    from ..testing import make_pod
+
+    store.create(make_pod(name, cpu="100m", mem="64Mi"))
+    t0 = time.monotonic()
+    sched.schedule_pending()
+    dt = time.monotonic() - t0
+    assert store.get("Pod", f"default/{name}").spec.node_name, name
+    return dt
+
+
+def run_warm_restart_bench(nodes: int = 16, pods: int = 48,
+                           wave_size: int = 8, seed: int = 0) -> dict:
+    """One cold incarnation, one warm restart over the same store;
+    returns the bench row dict (never raises on a perf miss — `pass`
+    carries the verdict)."""
+    from ..scheduler import Profile, Scheduler
+    from ..testing import make_node, make_pod
+    from ..store.store import Store
+
+    store = Store()
+    for i in range(nodes):
+        store.create(make_node(f"wr{i}", cpu="16", mem="32Gi",
+                               zone=f"z{i % 4}"))
+
+    def incarnation():
+        s = Scheduler(store,
+                      profiles=[Profile(backend="tpu",
+                                        wave_size=wave_size)],
+                      seed=seed, warm_start=True)
+        t0 = time.monotonic()
+        s.start()
+        return s, time.monotonic() - t0
+
+    def traffic(s, prefix):
+        for i in range(pods):
+            store.create(make_pod(f"{prefix}-{i}", cpu="100m", mem="64Mi"))
+        s.schedule_pending()
+
+    # incarnation A: cold store, cold jit caches (modulo the persistent
+    # disk cache) — pays the tracing + lowering bill once
+    a, cold_start_s = incarnation()
+    tele_a = a.flight_recorder.device_telemetry
+    cold_first_bind_s = _first_bind_s(a, store, "cold-first")
+    traffic(a, "cold")
+    cold_compiles = tele_a.compile_count()
+
+    # crash: no drain, no flush — the corpse only stops consuming events
+    a.informers.stop_all()
+
+    # incarnation B: warm restart over the occupied store
+    b, warm_start_s = incarnation()
+    tele_b = b.flight_recorder.device_telemetry
+    warm_first_bind_s = _first_bind_s(b, store, "warm-first")
+    traffic(b, "warm")
+    warm_compiles = tele_b.compile_count_since_warm()
+    warmup_s = b.flight_recorder.phase_snapshot().get("warmup", 0.0)
+
+    bound = sum(1 for p in store.pods() if p.spec.node_name)
+    ok = warm_compiles == 0 and bound == 2 * pods + 2
+    return {
+        "metric": "warm_restart",
+        "value": round(warm_first_bind_s, 4),
+        "unit": "s (restart to first bind)",
+        "pass": ok,
+        "warm_compile_count": warm_compiles,
+        "cold_compile_count": cold_compiles,
+        "cold_first_bind_s": round(cold_first_bind_s, 4),
+        "warm_first_bind_s": round(warm_first_bind_s, 4),
+        "cold_start_s": round(cold_start_s, 4),
+        "warm_start_s": round(warm_start_s, 4),
+        "warmup_s": round(warmup_s, 4),
+        "scheduled": bound,
+        "nodes": nodes,
+        "pods_per_incarnation": pods,
+        "wave_size": wave_size,
+        "seed": seed,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    from ..utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
+    print(json.dumps(run_warm_restart_bench()), flush=True)

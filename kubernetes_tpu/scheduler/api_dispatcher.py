@@ -16,7 +16,6 @@ Semantics preserved:
 
 from __future__ import annotations
 
-import os
 import queue as _queue
 import random
 import threading
@@ -27,6 +26,7 @@ from typing import Any, Callable
 from ..store.store import ConflictError
 from ..utils import faultinject
 from ..utils.backoff import RetryPolicy, retry_call
+from ..utils.envknob import float_env, int_env
 
 # call-type relevance (api_calls.go Relevances): higher wins on conflict
 POD_STATUS_PATCH = "pod_status_patch"
@@ -48,9 +48,9 @@ def _default_retry_policy() -> RetryPolicy:
     NotFoundError (pod deleted mid-flight) and everything else must surface
     through on_finish unchanged."""
     return RetryPolicy(
-        max_attempts=int(os.environ.get("KUBE_TPU_RETRY_MAX", "4")),
-        base_s=float(os.environ.get("KUBE_TPU_RETRY_BASE_S", "0.002")),
-        cap_s=float(os.environ.get("KUBE_TPU_RETRY_CAP_S", "0.1")),
+        max_attempts=int_env("KUBE_TPU_RETRY_MAX", 4),
+        base_s=float_env("KUBE_TPU_RETRY_BASE_S", 0.002),
+        cap_s=float_env("KUBE_TPU_RETRY_CAP_S", 0.1),
         retryable=(ConflictError, faultinject.TransientFault),
     )
 

@@ -156,6 +156,16 @@ class SchedulerMetrics:
             labels=("kind",),
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
         )
+        # crash-restart recovery (README "Restart & recovery"): what a fresh
+        # scheduler's reconcile() resolved from the previous incarnation's
+        # mid-flight state, by recovery kind
+        self.restart_recoveries = r.counter(
+            "scheduler_restart_recoveries_total",
+            "Mid-flight crash state a startup reconcile resolved against "
+            "store truth, by recovery kind (adopted/forgotten/requeued/"
+            "gang_adopt/gang_release/permit_cleared)",
+            labels=("kind",),
+        )
         # TPU backend (new: kernel-vs-host path split)
         self.kernel_dispatches = r.counter(
             "scheduler_tpu_kernel_dispatches_total",
@@ -394,6 +404,12 @@ class SchedulerMetrics:
         (flightrecorder fan-out from the informer's partition observer)."""
         self.watch_partitions_detected.inc(kind)
         self.watch_partition_repair_latency.observe(latency_s, kind)
+
+    def restart_recovery(self, kind: str, n: int = 1) -> None:
+        """Startup reconcile resolved n pieces of mid-flight crash state of
+        the given kind (flightrecorder fan-out from Scheduler.reconcile)."""
+        if n:
+            self.restart_recoveries.inc(kind, by=float(n))
 
     def update_sli_quantiles(self) -> None:
         """Record exact p50/p99 over the recent-sample window (the SLO the

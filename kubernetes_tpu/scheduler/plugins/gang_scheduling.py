@@ -10,16 +10,15 @@ cache state otherwise (:185-190).
 
 from __future__ import annotations
 
-import os
-
 from ...api.types import Pod
+from ...utils.envknob import float_env
 from ..framework import events as ev
 from ..framework.events import ClusterEvent, ClusterEventWithHint, QUEUE
 from ..framework.interface import Plugin, Status
 
 # gangscheduling.go:41 — 5 minutes; env-overridable so soak rigs can shrink
 # the starvation window (see README "Gang waves" runbook) without a rebuild
-GANG_WAIT_TIMEOUT = float(os.environ.get("KUBE_TPU_GANG_WAIT_S", "300"))
+GANG_WAIT_TIMEOUT = float_env("KUBE_TPU_GANG_WAIT_S", 300.0)
 
 
 class GangScheduling(Plugin):

@@ -30,6 +30,8 @@ from .framework.interface import (
 from .framework.runtime import Framework
 from .nodeinfo import NodeInfo, PodInfo
 from .queue.scheduling_queue import QueuedPodInfo
+from ..utils import faultinject
+from ..utils.envknob import float_env, int_env
 from ..utils.logging import get_logger
 from ..utils.tracing import Span, threshold_log_exporter
 
@@ -49,14 +51,14 @@ MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # schedule_one.go:62
 # wave-size cap while the TPU circuit breaker is HALF_OPEN: a recovering
 # device probes with small waves instead of being handed a full one (a
 # probe failure then strands N pods, not max_pods)
-PROBE_WAVE_PODS = int(os.environ.get("KUBE_TPU_PROBE_WAVE_PODS", "8"))
+PROBE_WAVE_PODS = int_env("KUBE_TPU_PROBE_WAVE_PODS", 8)
 
 # async-bind completion budget: total seconds a binding cycle waits for the
 # dispatcher to land one bind call. Waited in short slices (so a stalled
 # dispatcher surfaces in the log before the budget burns down) instead of
 # one silent blocking wait that would freeze the pipelined loop's binding
 # thread for the whole budget with no diagnosis.
-BIND_WAIT_S = float(os.environ.get("KUBE_TPU_BIND_WAIT_S", "30"))
+BIND_WAIT_S = float_env("KUBE_TPU_BIND_WAIT_S", 30.0)
 _BIND_WAIT_SLICE_S = 5.0
 
 
@@ -361,7 +363,7 @@ class ScheduleOneLoop:
         from .tpu.wavecontroller import WaveSizeController
 
         self.pipeline_depth = max(
-            1, int(os.environ.get("KUBE_TPU_PIPELINE_DEPTH", "2"))
+            1, int_env("KUBE_TPU_PIPELINE_DEPTH", 2)
         )
         # gang waves (README "Gang waves"): whole-PodGroup device placement
         # instead of the per-placement host dry-run loop; env-gated so
@@ -699,6 +701,10 @@ class ScheduleOneLoop:
                 # different failure domain)
                 breaker.record_success()
             algo.kernel_count += len(wave)
+            # crash point: wave collected off the device but none of its
+            # per-pod finish cycles have run — a crash here strands the
+            # launch-time wave plan with nothing assumed in the cache yet
+            faultinject.fire("loop.wave")
             with rec.phase("finish", record):
                 exported = self._export_wave_signatures(algo, fl, planes)
                 if record is not None:
@@ -882,6 +888,11 @@ class ScheduleOneLoop:
 
     def _apply_wave_bind_results(self, ready: list[tuple], results, err) -> None:
         from ..store.store import ConflictError
+
+        # crash point: the store bind already executed (dispatcher worker or
+        # sync call), but the cache still carries assumes and queue.done has
+        # not run — the prepare/commit gap reconcile's adopt path must cover
+        faultinject.fire("loop.bind_commit")
 
         # one correlation token per wave: a 512-pod wave's Scheduled events
         # collapse to ~spill-threshold individual events + one aggregate,
@@ -1137,6 +1148,7 @@ class ScheduleOneLoop:
         if kind == "success":
             # gang placements mutate node state outside the wave pipeline
             self.mark_wave_external()
+            dispatchable: list[tuple] = []
             for q, state, result, _pi in outcome[1]:
                 try:
                     self.cache.assume_pod(q.pod, result.suggested_host)
@@ -1146,6 +1158,12 @@ class ScheduleOneLoop:
                     )
                     continue
                 self.cache.pod_group_states.pod_assumed(gk, q.pod.meta.key)
+                dispatchable.append((q, state, result))
+            # crash point: every member is assumed (cache + gang quorum
+            # state) but no binding has been dispatched — the stale-permit
+            # window reconcile's permit_cleared sweep must cover
+            faultinject.fire("gang.permit")
+            for q, state, result in dispatchable:
                 self._dispatch_binding(state, fw, q, result)
             return
         failing, err = outcome[1], outcome[2]

@@ -55,6 +55,20 @@ class Profile:
     wave_size: int = 0
 
 
+# Reconcile-restored state (kubesched-lint rule CRASH01): the attributes a
+# fresh scheduler's reconcile() re-derives from store truth after a crash.
+# Each entry names the attribute and the ONE module sanctioned to write it
+# (its owning class); CRASH01 cross-parses this literal and flags writes
+# anywhere else — restart recovery is only sound if nothing mutates this
+# state behind the reconcile contract's back.
+RECONCILE_RESTORED_STATE = (
+    ("_assumed_pods", "scheduler/cache/cache.py"),
+    ("_groups", "scheduler/cache/podgroup_state.py"),
+    ("_inflight_wave", "scheduler/schedule_one.py"),
+    ("_wave_completions", "scheduler/schedule_one.py"),
+)
+
+
 def _apply_plugin_set(plugins: list, prof: "Profile") -> list:
     """Per-profile enable/disable (apis/config Plugins semantics): names in
     disabled are removed; disabled=("*",) whitelists enabled_plugins. The
@@ -85,6 +99,7 @@ class Scheduler:
         event_recorder=None,
         extenders: list | None = None,
         tracer=None,
+        warm_start: bool = False,
     ):
         from ..utils.clock import Clock
         from .tpu.flightrecorder import FlightRecorder
@@ -92,6 +107,10 @@ class Scheduler:
         self.store = store
         self.names = names or ResourceNames()
         self.clock = clock or Clock()
+        # AOT warm restart (scheduler/tpu/warmup.py): start() pre-lowers the
+        # TPU wave kernels after informer sync. Default off — a cold-start
+        # scheduler (and every golden test) is bit-identical without it.
+        self.warm_start = warm_start
         self.metrics = metrics
         self.tracer = tracer
         # one wave flight recorder shared by the loop, every TPU backend,
@@ -414,18 +433,54 @@ class Scheduler:
 
     def start(self) -> None:
         """Sync informers (initial list), then reconcile half-applied state
-        a previous incarnation may have left behind."""
+        a previous incarnation may have left behind; with warm_start, end by
+        pre-lowering the TPU wave kernels (AOT warm restart) so the first
+        real wave pays zero compiles."""
         self.informers.start_all()
         self.reconcile()
+        if self.warm_start:
+            self._run_warmup()
+
+    def _run_warmup(self) -> None:
+        """Pre-lower every TPU profile's wave kernels against the live node
+        planes (must run AFTER informer sync: bucket sizes come from the
+        synced cache, and an empty snapshot has nothing to lower against)."""
+        from .tpu.warmup import warm_backend
+
+        self.cache.update_snapshot(self.snapshot)
+        for algo in self.algorithms.values():
+            backend = getattr(algo, "backend", None)
+            if backend is not None:
+                warm_backend(backend, self.snapshot, self.wave_size)
 
     def reconcile(self) -> dict:
-        """Startup crash recovery: resolve every assumed-but-unconfirmed pod
-        against store truth. A scheduler killed between assume and the
-        async store write leaves the cache claiming resources the cluster
-        never granted; one killed between the write and the confirming
-        watch event leaves a bound pod still marked assumed. Store truth
-        decides: bound → adopt; gone → forget; unbound → forget + requeue
-        (the bind never happened, the pod must be scheduled again)."""
+        """Startup crash recovery: resolve every piece of mid-flight state a
+        previous incarnation may have left behind against store truth (the
+        README "Restart & recovery" contract). Three sweeps:
+
+        1. Assumed-but-unconfirmed pods (orphaned assumes from in-flight
+           pipeline waves, dispatcher calls lost between prepare and
+           commit). A scheduler killed between assume and the async store
+           write leaves the cache claiming resources the cluster never
+           granted; one killed between the write and the confirming watch
+           event leaves a bound pod still marked assumed. Store truth
+           decides: bound → adopt; gone → forget; unbound → forget +
+           requeue (the bind never happened, the pod must be scheduled
+           again).
+        2. Half-bound PodGroups (a gang crash between members' binds):
+           all-or-nothing across restart — when the surviving members can
+           still reach quorum, adopt the remainder through the host gang
+           cycle (activate the pending members); when they cannot, release
+           every landed member (delete the bound pods) so the gang never
+           holds partial capacity forever.
+        3. Stale gang Permit quorum state: group-state `assumed` entries
+           backed by neither a live cache assume nor a store bind are
+           reverted (or promoted to scheduled when the bind landed), so a
+           fresh gang cycle starts from truthful quorum counts.
+
+        Every outcome lands on the flight recorder's restart_events and the
+        scheduler_restart_recoveries_total{kind} series. Gang/permit kinds
+        appear in the returned stats only when non-zero."""
         stats = {"adopted": 0, "forgotten": 0, "requeued": 0}
         for pod in self.cache.assumed_pods():
             key = pod.meta.key
@@ -449,7 +504,69 @@ class Scheduler:
             self.queue.done(key)
             self.queue.add(cur, PodInfo(cur, self.names))
             stats["requeued"] += 1
-        if stats["adopted"] or stats["forgotten"]:
+
+        # -- sweep 2: half-bound PodGroups against store truth ------------
+        # read-only listing duck-typed against the narrower RESTStore
+        # surface (list() only) so a scheduler fronted by the apiserver
+        # reconciles the same way as one on a native Store
+        if hasattr(self.store, "list_refs"):
+            _list = self.store.list_refs
+        else:
+            _list = lambda kind: self.store.list(kind)[0]  # noqa: E731
+        gang_adopt = gang_release = 0
+        members: dict[str, list] = {}
+        for p in _list("Pod"):
+            gk = self._group_key(p)
+            if gk is not None:
+                members.setdefault(gk, []).append(p)
+        for g in _list("PodGroup"):
+            gk = g.meta.key
+            mem = members.get(gk, [])
+            bound = [p for p in mem if p.spec.node_name]
+            if not bound or len(bound) >= g.spec.policy.min_count:
+                continue  # whole gang landed, or nothing did
+            if len(mem) >= g.spec.policy.min_count:
+                # salvageable: the pending remainder can still reach
+                # quorum — adopt through the host gang cycle (the permit
+                # plugin counts the already-scheduled members)
+                self.queue.activate([p for p in mem if not p.spec.node_name])
+                gang_adopt += 1
+            else:
+                # the remainder can never reach quorum: all-or-nothing
+                # demands the landed members be released
+                for p in bound:
+                    try:
+                        self.store.delete("Pod", p.meta.key)
+                    except Exception:  # noqa: BLE001 — racing deletion
+                        pass
+                gang_release += 1
+
+        # -- sweep 3: stale gang Permit quorum state ----------------------
+        permit_cleared = 0
+        live_assumes = {p.meta.key for p in self.cache.assumed_pods()}
+        for gk, gstate in self.cache.pod_group_states.snapshot().items():
+            for key in gstate.assumed:
+                if key in live_assumes:
+                    continue  # a real assume: sweep 1 owns its fate
+                cur = self.store.try_get("Pod", key)
+                if cur is not None and cur.spec.node_name:
+                    # the bind landed but the quorum state never advanced
+                    self.cache.pod_group_states.pod_scheduled(gk, key)
+                else:
+                    # assume died with the old incarnation: back to
+                    # unscheduled so quorum counts match reality
+                    self.cache.pod_group_states.pod_unassumed(gk, key)
+                permit_cleared += 1
+
+        if gang_adopt:
+            stats["gang_adopt"] = gang_adopt
+        if gang_release:
+            stats["gang_release"] = gang_release
+        if permit_cleared:
+            stats["permit_cleared"] = permit_cleared
+        for kind, n in stats.items():
+            self.flight_recorder.restart_recovery(kind, n)
+        if stats["adopted"] or stats["forgotten"] or gang_release:
             # node occupancy changed under any live device carry
             self._mark_external()
         return stats

@@ -24,10 +24,11 @@ machine, safe to construct anywhere.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Callable
+
+from ...utils.envknob import float_env, int_env
 
 CLOSED = "closed"
 OPEN = "open"
@@ -49,12 +50,12 @@ class CircuitBreaker:
         clock: Callable[[], float] = time.monotonic,
         on_transition: Callable[[str, str, str], None] | None = None,
     ):
-        self.threshold = threshold if threshold is not None else int(
-            os.environ.get("KUBE_TPU_BREAKER_THRESHOLD", "3"))
-        self.cooldown_s = cooldown_s if cooldown_s is not None else float(
-            os.environ.get("KUBE_TPU_BREAKER_COOLDOWN_S", "1.0"))
-        self.probes = probes if probes is not None else int(
-            os.environ.get("KUBE_TPU_BREAKER_PROBES", "2"))
+        self.threshold = (threshold if threshold is not None
+                          else int_env("KUBE_TPU_BREAKER_THRESHOLD", 3))
+        self.cooldown_s = (cooldown_s if cooldown_s is not None
+                           else float_env("KUBE_TPU_BREAKER_COOLDOWN_S", 1.0))
+        self.probes = (probes if probes is not None
+                       else int_env("KUBE_TPU_BREAKER_PROBES", 2))
         self._clock = clock
         self._on_transition = on_transition
         self._mu = threading.Lock()

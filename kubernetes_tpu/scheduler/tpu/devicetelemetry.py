@@ -133,6 +133,10 @@ class DeviceTelemetry:
         # memory watermark: group -> currently resident bytes
         self._resident: dict[str, int] = {}
         self._watermark = 0
+        # warm-restart baseline: compile count at the end of the warmup
+        # phase — compile_count_since_warm() is the "compile-free warm
+        # restart" assertion's zero
+        self._warm_compile_base = 0
 
     # -- emission (every name literal, declared in LEDGER_SERIES: OBS02) ----
 
@@ -255,6 +259,18 @@ class DeviceTelemetry:
     def compiled_shapes(self, kernel: str) -> list[str]:
         with self._lock:
             return sorted(self._shapes.get(kernel, ()))
+
+    def mark_warm(self) -> None:
+        """Snapshot the compile count as the warm baseline (called once,
+        at the end of the backend warmup phase)."""
+        with self._lock:
+            self._warm_compile_base = sum(self._compiles.values())
+
+    def compile_count_since_warm(self) -> int:
+        """Compiles paid AFTER warmup — a warm restart re-entering service
+        must keep this at 0 (the bench's warm_compile_count column)."""
+        with self._lock:
+            return sum(self._compiles.values()) - self._warm_compile_base
 
     # -- memory watermark ----------------------------------------------------
 

@@ -28,13 +28,13 @@ from __future__ import annotations
 
 import collections
 import json
-import os
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from ...utils import faultinject
+from ...utils.envknob import float_env, int_env
 from ...utils.tracing import Tracer
 from .devicetelemetry import DeviceTelemetry
 from .podlatency import PodLatencyLedger
@@ -51,13 +51,11 @@ WAVE_PHASES = ("sync", "features", "tie", "dispatch", "upload", "wait",
 PREP_PHASES = ("sync", "features", "upload", "dedup", "tie", "dispatch")
 
 # watchdog defaults; env knobs so production runs can tune without code
-DEFAULT_CAPACITY = int(os.environ.get("KUBE_TPU_FLIGHT_CAPACITY", "256"))
+DEFAULT_CAPACITY = int_env("KUBE_TPU_FLIGHT_CAPACITY", 256)
 # None/0 = watchdog off (the default: CPU-fallback bench waves legitimately
 # run long, and profile capture is not free)
-_deadline_env = os.environ.get("KUBE_TPU_SLOW_WAVE_S", "")
-DEFAULT_SLOW_WAVE_S = float(_deadline_env) if _deadline_env else None
-DEFAULT_PROFILE_S = float(os.environ.get("KUBE_TPU_SLOW_WAVE_PROFILE_S",
-                                         "0.25"))
+DEFAULT_SLOW_WAVE_S = float_env("KUBE_TPU_SLOW_WAVE_S", None)
+DEFAULT_PROFILE_S = float_env("KUBE_TPU_SLOW_WAVE_PROFILE_S", 0.25)
 
 
 @dataclass
@@ -198,6 +196,11 @@ class FlightRecorder:
         )
         # watch-partition detections (kind, repaired, latency_s), bounded
         self.partition_events: "collections.deque[tuple]" = collections.deque(
+            maxlen=64
+        )
+        # crash-restart reconcile outcomes (kind, count), bounded — one
+        # entry per recovery kind per reconcile pass, not per pod
+        self.restart_events: "collections.deque[tuple]" = collections.deque(
             maxlen=64
         )
 
@@ -365,6 +368,19 @@ class FlightRecorder:
         m = self.metrics
         if m is not None and hasattr(m, "partition_detected"):
             m.partition_detected(kind, latency_s)
+
+    def restart_recovery(self, kind: str, n: int = 1) -> None:
+        """A startup reconcile resolved n pieces of mid-flight crash state
+        of `kind` (adopted/forgotten/requeued/gang_adopt/gang_release/
+        permit_cleared); lands the restart-recovery counter on the metrics
+        registry. Wired as Scheduler.reconcile's outcome sink."""
+        if n <= 0:
+            return
+        with self._lock:
+            self.restart_events.append((kind, n))
+        m = self.metrics
+        if m is not None and hasattr(m, "restart_recovery"):
+            m.restart_recovery(kind, n)
 
     def end_wave(self, rec: WaveRecord,
                  fallback_reason: str | None = None) -> WaveRecord:

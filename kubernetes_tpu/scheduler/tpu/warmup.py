@@ -1,0 +1,178 @@
+"""AOT warm restart: pre-lower the wave kernels before the first real pod.
+
+A cold scheduler pays XLA compilation for every (kernel, shape) pair the
+first wave of each pow2 bucket touches — seconds of dead air exactly when a
+restarted scheduler should be re-entering service. With the persistent jax
+compilation cache (utils/jaxcache) those lowerings are disk artifacts: the
+FIRST incarnation pays them once, and every restart replays them as cache
+hits. What a restart still pays without this module is the host-side
+tracing + cache probe per signature — and, worse, any signature the crash
+window never reached. `warm_backend` walks the pow2 wave-size buckets
+through the REAL launch/collect path (both the cold-carry and the
+chained + cross-wave-replay jit signatures), the single-pod fit_and_score
+program, the delta-scatter row buckets, and the gang kernel shapes the
+workload uses, all inside a named `warmup` flight-recorder phase — so a
+warm restart's steady state runs with `compile_count_since_warm() == 0`.
+
+Everything here is best-effort: a warmup failure logs and degrades to lazy
+compilation; it never breaks scheduler construction. Warmup never touches
+host planes or the live rng (it draws from its own throwaway stream), and
+it ends by invalidating the carry, so the base device mirror remains exact
+host truth and the first real wave starts from a clean seam.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from ...ops.vocab import next_pow2
+from ...utils.jaxcache import enable_persistent_cache
+from ...utils.logging import get_logger
+
+_log = get_logger("kubernetes_tpu.tpu.warmup")
+
+# the smallest wave/scatter bucket the backend emits (pow2 floors)
+_FLOOR = 8
+
+# default gang shapes to pre-lower: (members, n_constrained, has_fallback)
+# — the plugin-less gang plan (GangPlan([parent], 0, True, ...)) with up
+# to 4 members is the shape every topology-free PodGroup produces
+DEFAULT_GANG_SHAPES = ((4, 0, True),)
+
+
+def _warm_pods(n: int, namespace: str = "default"):
+    """Label-less synthetic pods with a plain-pod kernel config — the same
+    cfg wave traffic compiles against. They ride the real register path, so
+    they must intern the SAME vocab entries traffic will: system-default
+    spread interns a (namespace, selector) pair per pod shape, and a warmup
+    namespace traffic never uses would leave the selector bucket one short
+    of steady state — the first real pod would grow it and recompile."""
+    from ...testing import make_pod
+
+    return [
+        make_pod(f"warm-{i}", namespace=namespace, cpu="100m", mem="128Mi")
+        for i in range(n)
+    ]
+
+
+def _pow2_buckets(top: int) -> list[int]:
+    buckets, b = [], _FLOOR
+    top = max(top, _FLOOR)
+    while b <= top:
+        buckets.append(b)
+        b *= 2
+    return buckets
+
+
+def warm_backend(backend, snapshot, wave_size: int, rng_seed: int = 0,
+                 gang_shapes=DEFAULT_GANG_SHAPES) -> dict:
+    """Pre-lower every jit entry point the wave pipeline dispatches.
+
+    Per pow2 bucket up to next_pow2(wave_size): TWO chained
+    launch_batched/collect rounds — the first compiles the cold-carry
+    batched_assign signature, the second (same signatures, carry live)
+    the cross-wave-replay variant. Then one single-pod `run`
+    (fit_and_score), the `_scatter_rows_jit` delta buckets, and one
+    `run_gang` per requested gang shape. Returns a summary dict; never
+    raises."""
+    summary: dict = {"buckets": [], "scatter": [], "gangs": [],
+                     "skipped": [], "cache_dir": None, "compiles": 0}
+    if snapshot.num_nodes() == 0:
+        # nothing to lower against — bucket sizes come from the node planes
+        summary["skipped"].append("no nodes in snapshot")
+        backend.telemetry.mark_warm()
+        return summary
+    summary["cache_dir"] = str(enable_persistent_cache())
+    tele = backend.telemetry
+    base_compiles = tele.compile_count()
+    rng = random.Random(rng_seed)  # throwaway: the live rng never moves
+    with backend.recorder.phase("warmup"):
+        for b in _pow2_buckets(next_pow2(max(wave_size, 1))):
+            try:
+                for _ in range(2):  # cold-carry, then chained + replay
+                    fl = backend.launch_batched(
+                        _warm_pods(2), snapshot, rng=rng, pad_to=b)
+                    backend.collect(fl, rng=rng)
+                summary["buckets"].append(b)
+            except Exception as e:  # noqa: BLE001 — degrade to lazy compile
+                backend.invalidate_carry()
+                summary["skipped"].append(f"wave{b}: {e}")
+        try:
+            backend.run(_warm_pods(1)[0], snapshot)
+        except Exception as e:  # noqa: BLE001
+            summary["skipped"].append(f"single: {e}")
+        _warm_scatter(backend, snapshot, wave_size, summary)
+        for shape in gang_shapes:
+            _warm_gang(backend, snapshot, shape, rng, summary)
+        # the carry holds warmup placements no host state backs: drop it so
+        # the base mirror (untouched — warmup binds nothing) stays truth
+        backend.invalidate_carry()
+    summary["compiles"] = tele.compile_count() - base_compiles
+    tele.mark_warm()
+    _log.info("warm restart pre-lowering done",
+              compiles=summary["compiles"], buckets=summary["buckets"],
+              gangs=summary["gangs"], skipped=summary["skipped"] or None)
+    return summary
+
+
+def _warm_scatter(backend, snapshot, wave_size: int, summary: dict) -> None:
+    """Pre-lower the fused delta-scatter for each pow2 row bucket a wave's
+    binds can dirty (device_inputs pads dirty-row counts the same way).
+    Scatters node rows onto themselves — content is a no-op, only the
+    (bucket_sizes, idx-length) program shape matters."""
+    from .backend import _scatter_rows_jit
+
+    try:
+        planes = backend.sync(snapshot)
+        dev = backend._device_planes
+        if dev is None:
+            summary["skipped"].append("scatter: no device planes")
+            return
+        host = planes.as_dict()
+        # binds dirty up to ~wave_size rows between uploads; one extra
+        # bucket covers a wave of stragglers accumulating on top
+        for size in _pow2_buckets(2 * next_pow2(max(wave_size, 1))):
+            scatter_in = {k: v for k, v in dev.items() if k != "ipa_term_key"}
+            idx = np.zeros(size, np.int32)
+            rows_host = {k: host[k][idx] for k in scatter_in}
+            rows_dev = backend.telemetry.accounted_put(
+                "delta_rows", rows_host, put=backend._ctx.put_replicated)
+            idx_dev = backend.telemetry.accounted_put(
+                "delta_idx", idx, put=backend._ctx.put_replicated)
+            with backend.telemetry.compile_span(
+                    "scatter_rows", ("scatter", planes.bucket_sizes, size),
+                    label=f"rows{size}"):
+                updated = _scatter_rows_jit(scatter_in, rows_dev, idx_dev)
+            # arg 0 is donated: the old buffers are dead — adopt the result
+            # (same values: we scattered truth rows onto themselves, so the
+            # mirror stays exact and warmup's closing invalidate_carry
+            # covers the signature cache)
+            updated["ipa_term_key"] = dev["ipa_term_key"]
+            backend._device_planes = updated  # kubesched-lint: disable=SIG02
+            dev = updated
+            summary["scatter"].append(size)
+    except Exception as e:  # noqa: BLE001
+        summary["skipped"].append(f"scatter: {e}")
+
+
+def _warm_gang(backend, snapshot, shape, rng, summary: dict) -> None:
+    """Pre-lower one gang_assign program shape: `shape` is (members,
+    n_constrained, has_fallback) mirroring GangPlan — domain rows are
+    fabricated all-node placements (mask content never changes the
+    compiled program, only the row count does)."""
+    from ..cache.snapshot import Placement
+
+    n_pods, n_constrained, has_fallback = shape
+    try:
+        names = [ni.name for ni in snapshot.list_nodes()]
+        placements = [Placement(f"warm-d{i}", names)
+                      for i in range(n_constrained)]
+        if has_fallback:
+            placements.append(Placement("warm-all", names))
+        backend.run_gang(_warm_pods(n_pods), snapshot, placements,
+                         n_constrained, bool(has_fallback), rng)
+        summary["gangs"].append(shape)
+    except Exception as e:  # noqa: BLE001
+        summary["skipped"].append(f"gang{shape}: {e}")

@@ -705,6 +705,235 @@ def run_gang_soak(seed: int = 7, gangs: int = 6, min_count: int = 3,
     return report
 
 
+# -- restart storm: seeded scheduler crashes mid-traffic -----------------------
+
+
+# one crash per cycle, rotating through the three mid-flight windows the
+# reconcile contract hardens: mid-wave (collected, not finished), inside
+# the bind-commit window (store bind landed, queue/cache not settled),
+# and mid-gang-permit (every member assumed, nothing dispatched)
+CRASH_POINTS = ("loop.wave", "loop.bind_commit", "gang.permit")
+
+
+@dataclasses.dataclass
+class RestartSoakReport:
+    seed: int
+    cycles: int
+    crashes: int = 0
+    crash_points: tuple = ()
+    created: int = 0
+    bound: int = 0
+    unbound: int = 0
+    double_binds: int = 0
+    leaked_assumes: int = 0
+    partial_gangs_final: int = 0
+    queue_pending: int = 0
+    warm_compiles: int = 0
+    recoveries: dict = dataclasses.field(default_factory=dict)
+    faults_fired: int = 0
+    wall_clock_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return (
+            self.crashes >= self.cycles
+            and self.unbound == 0
+            and self.double_binds == 0
+            and self.leaked_assumes == 0
+            and self.partial_gangs_final == 0
+            and self.queue_pending == 0
+            # every warm-restarted scheduler must re-enter service without
+            # compiling anything the warmup phase didn't already lower
+            and self.warm_compiles == 0
+        )
+
+    def render(self) -> str:
+        verdict = "PASS" if self.ok else "FAIL"
+        rec = ",".join(f"{k}={v}" for k, v in sorted(self.recoveries.items()))
+        return (
+            f"restart soak [{verdict}] seed={self.seed} "
+            f"cycles={self.cycles}: crashes={self.crashes} "
+            f"points={'/'.join(self.crash_points)} "
+            f"created={self.created} bound={self.bound} "
+            f"unbound={self.unbound} double_binds={self.double_binds} "
+            f"leaked_assumes={self.leaked_assumes} "
+            f"partial_gangs_final={self.partial_gangs_final} "
+            f"queue_pending={self.queue_pending} "
+            f"warm_compiles={self.warm_compiles} "
+            f"recoveries=[{rec}] faults_fired={self.faults_fired} "
+            f"wall_clock_s={self.wall_clock_s:.2f}"
+        )
+
+
+def run_restart_soak(seed: int = 7, cycles: int = 3, pods_per_cycle: int = 24,
+                     min_count: int = 3, nodes: int = 16,
+                     wave_size: int = 8) -> RestartSoakReport:
+    """Seeded restart storm (README "Restart & recovery"): each cycle arms
+    ONE seeded CRASH point mid-traffic, lets SchedulerCrashed rip through
+    `schedule_pending`, tears the dead scheduler down ungracefully (the
+    dispatcher's queued calls fail, its watches drop — no drain, no flush),
+    and constructs a fresh warm-started scheduler over the same store.
+    After the storm, fault-free convergence must restore every invariant:
+    all pods bound exactly once (the store's bind path is the double-bind
+    oracle), zero leaked assumes, per-gang all-or-nothing, and a
+    compile-free warm restart (`compile_count_since_warm() == 0` on every
+    restarted scheduler). Leaves the global registry disarmed + reset."""
+    from ..api.meta import ObjectMeta
+    from ..api.types import GangPolicy, PodGroup, PodGroupSpec
+    from ..scheduler import Profile, Scheduler
+    from ..scheduler.metrics import SchedulerMetrics
+    from ..utils.faultinject import CRASH, SchedulerCrashed
+    from .wrappers import with_gang
+
+    report = RestartSoakReport(seed=seed, cycles=cycles)
+    t_start = time.monotonic()
+    registry = faultinject.registry()
+    registry.reset(seed=seed)
+
+    store = Store()
+    for i in range(nodes):
+        store.create(make_node(f"rn{i}", cpu="16", mem="32Gi",
+                               zone=f"z{i % 4}"))
+
+    # double-bind oracle: every SUCCESSFUL bind lands here; the soak never
+    # deletes pods, so any key bound twice is a restart double-placing a
+    # pod the crashed incarnation had already placed
+    bind_ledger: dict[str, int] = {}
+    orig_bind_pods, orig_bind_pod = store.bind_pods, store.bind_pod
+
+    def ledgered_bind_pods(bindings):
+        out = orig_bind_pods(bindings)
+        for (key, _node), status in zip(bindings, out):
+            if status == "bound":
+                bind_ledger[key] = bind_ledger.get(key, 0) + 1
+        return out
+
+    def ledgered_bind_pod(key, node_name):
+        obj = orig_bind_pod(key, node_name)
+        bind_ledger[key] = bind_ledger.get(key, 0) + 1
+        return obj
+
+    store.bind_pods = ledgered_bind_pods
+    store.bind_pod = ledgered_bind_pod
+
+    def make_scheduler(warm: bool) -> Scheduler:
+        s = Scheduler(
+            store,
+            profiles=[Profile(backend="tpu", wave_size=wave_size)],
+            feature_gates={"GenericWorkload": True,
+                           "SchedulerAsyncAPICalls": True},
+            async_api_calls=True,
+            metrics=SchedulerMetrics(),
+            seed=seed,
+            warm_start=warm,
+        )
+        s.queue._initial_backoff = 0.02
+        s.queue._max_backoff = 0.1
+        s.start()
+        return s
+
+    def crash_teardown(s: Scheduler) -> None:
+        """Process death, in-process: no drain, no flush. Queued dispatcher
+        calls die with DispatcherClosedError (the lost prepare/commit
+        window), watch streams drop. Nothing here is allowed to rescue
+        state — that is reconcile's job on the next incarnation."""
+        try:
+            s.api_dispatcher.close()
+        except Exception:  # noqa: BLE001 — the corpse may be inconsistent
+            pass
+        try:
+            s.informers.stop_all()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def collect_recoveries(s: Scheduler) -> None:
+        for kind, n in list(s.flight_recorder.restart_events):
+            report.recoveries[kind] = report.recoveries.get(kind, 0) + n
+
+    sched = make_scheduler(warm=False)
+    gang_specs: list[tuple[str, int]] = []
+    seq = 0
+    registry.arm()
+    try:
+        for cycle in range(cycles):
+            point = CRASH_POINTS[cycle % len(CRASH_POINTS)]
+            # aim past the visits the storm has already spent at this
+            # point; one extra wave-shaped visit for the loop.* points so
+            # the crash lands MID-traffic, not on its first wave
+            visits = registry.snapshot()["visits"].get(point, 0)
+            offset = 1 if point.startswith("loop.") else 0
+            registry.register(FaultSpec(
+                point, mode=CRASH, times=1, start_after=visits + offset,
+                message="restart storm"))
+
+            gang = f"rgang-{cycle}"
+            store.create(PodGroup(
+                meta=ObjectMeta(name=gang),
+                spec=PodGroupSpec(policy=GangPolicy(min_count=min_count)),
+            ))
+            for i in range(min_count):
+                store.create(with_gang(
+                    make_pod(f"{gang}-m{i}", cpu="200m", mem="128Mi"), gang))
+            gang_specs.append((gang, min_count))
+            for _ in range(pods_per_cycle):
+                store.create(make_pod(f"restart-{seq}", cpu="100m",
+                                      mem="64Mi"))
+                seq += 1
+            report.created += min_count + pods_per_cycle
+
+            try:
+                sched.schedule_pending()
+            except SchedulerCrashed:
+                report.crashes += 1
+                report.crash_points += (point,)
+                if sched.warm_start:
+                    # this incarnation was warm-started: it must not have
+                    # compiled anything between its warmup and its death
+                    report.warm_compiles += (
+                        sched.flight_recorder.device_telemetry
+                        .compile_count_since_warm())
+                crash_teardown(sched)
+                sched = make_scheduler(warm=True)
+                collect_recoveries(sched)
+    finally:
+        registry.disarm()
+    report.faults_fired = registry.fired_total
+
+    # fault-free convergence: the surviving incarnation adopts/finishes
+    # everything the storm stranded
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        sched.schedule_pending()
+        pending = [p for p in store.pods() if not p.spec.node_name]
+        active, backoff, unsched = sched.queue.pending_pods()
+        if (not pending and sched.cache.assumed_pod_count() == 0
+                and active + backoff + unsched == 0):
+            break
+        time.sleep(0.02)
+
+    pods_now = {p.meta.name: p for p in store.pods()}
+    report.bound = sum(1 for p in pods_now.values() if p.spec.node_name)
+    report.unbound = len(pods_now) - report.bound
+    report.double_binds = sum(1 for n in bind_ledger.values() if n > 1)
+    report.leaked_assumes = sched.cache.assumed_pod_count()
+    active, backoff, unsched = sched.queue.pending_pods()
+    report.queue_pending = active + backoff + unsched
+    for gang, size in gang_specs:
+        n_bound = sum(
+            1 for i in range(size)
+            if (p := pods_now.get(f"{gang}-m{i}")) is not None
+            and p.spec.node_name)
+        if n_bound not in (0, size):
+            report.partial_gangs_final += 1
+    if sched.warm_start:
+        report.warm_compiles += (
+            sched.flight_recorder.device_telemetry.compile_count_since_warm())
+    sched.api_dispatcher.close()
+    registry.reset()
+    report.wall_clock_s = time.monotonic() - t_start
+    return report
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -734,9 +963,28 @@ def main(argv: list[str] | None = None) -> int:
                              "scale-churn soak")
     parser.add_argument("--gangs", type=int, default=6,
                         help="PodGroup count for --gang")
+    parser.add_argument("--restart", action="store_true",
+                        help="run the restart-storm soak (seeded scheduler "
+                             "crashes mid-wave / mid-bind-commit / "
+                             "mid-gang-permit, warm restarts over the same "
+                             "store; double binds, leaked assumes, partial "
+                             "gangs, and post-warmup compiles asserted "
+                             "zero) instead of the scale-churn soak")
+    parser.add_argument("--cycles", type=int, default=3,
+                        help="crash/restart cycles for --restart")
     args = parser.parse_args(argv)
 
-    if args.gang:
+    # every soak benefits from the persistent jax compilation cache: the
+    # restart soak's warm restarts replay lowerings from disk, and repeat
+    # chaos runs skip their cold-compile tax entirely
+    from ..utils.jaxcache import enable_persistent_cache
+    enable_persistent_cache()
+
+    if args.restart:
+        report = run_restart_soak(seed=args.seed, cycles=args.cycles,
+                                  nodes=min(args.nodes, 16),
+                                  wave_size=min(args.wave_size, 8))
+    elif args.gang:
         report = run_gang_soak(seed=args.seed, gangs=args.gangs,
                                nodes=min(args.nodes, 12))
     elif args.trace:

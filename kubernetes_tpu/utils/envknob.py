@@ -1,0 +1,47 @@
+"""Centralized KUBE_TPU_* environment-knob parsing.
+
+Every tunable the scheduler reads from the environment used to be a bare
+`int(os.environ.get(...))` / `float(os.environ.get(...))` at module import
+time — a malformed value (`KUBE_TPU_RETRY_MAX=three`) raised ValueError
+during import and killed the process before any logging was configured.
+A bad knob should never be fatal: these helpers log one warning naming the
+variable, the rejected value, and the default they fell back to, then
+return the default. An unset or empty variable silently yields the default
+(empty string is how ops "unset" a knob in some launchers).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_log = logging.getLogger("kubernetes_tpu.envknob")
+
+
+def int_env(name: str, default: int) -> int:
+    """Parse env var `name` as int; warn and fall back on malformed input."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        _log.warning("ignoring malformed %s=%r; using default %r",
+                     name, raw, default)
+        return default
+
+
+def float_env(name: str, default: float | None) -> float | None:
+    """Parse env var `name` as float; warn and fall back on malformed input.
+
+    `default` may be None (e.g. KUBE_TPU_SLOW_WAVE_S, where unset/empty
+    means "watchdog off") — unset, empty, and malformed all yield it."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        _log.warning("ignoring malformed %s=%r; using default %r",
+                     name, raw, default)
+        return default

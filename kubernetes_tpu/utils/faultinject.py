@@ -45,10 +45,25 @@ class PermanentFault(FaultInjected):
     """An injected failure that must surface through the failure handler."""
 
 
+class SchedulerCrashed(FaultInjected):
+    """Injected scheduler death: rips straight through the scheduling loop.
+
+    Deliberately NOT transient — the dispatcher's bounded retry and the
+    device path's DeviceFlakeError wrapping must never absorb it. The
+    chaos restart soak catches it above `schedule_pending`, tears the
+    scheduler down ungracefully (no drain, no flush) and constructs a
+    fresh one over the same store."""
+
+    transient = False
+
+
 # fault modes
 ERROR = "error"
 LATENCY = "latency"
 DROP = "drop"
+# the process dies mid-flight: fire() raises SchedulerCrashed, which no
+# retry layer may absorb — only the restart soak driver catches it
+CRASH = "crash"
 # a long-lived gap: once triggered, the spec drops `window` CONSECUTIVE
 # visits unconditionally — on a watch point that is a contiguous
 # revision-range loss the informer must detect by itself (bookmark
@@ -77,6 +92,13 @@ FAULT_POINTS = (
     "controller.reconcile",
     "controller.lifecycle",
     "controller.workloads",
+    # crash points on the main scheduling thread: unlike tpu.* (whose
+    # FaultInjected raises are caught locally and wrapped as device
+    # flakes) these sit where SchedulerCrashed can propagate cleanly up
+    # through schedule_pending to the restart soak driver
+    "loop.wave",
+    "loop.bind_commit",
+    "gang.permit",
 )
 # historical alias (pre-FI01 name); same object, never diverges
 POINTS = FAULT_POINTS
@@ -205,6 +227,10 @@ class FaultRegistry:
                 self.fired_by_point[point] += 1
                 if spec.mode == ERROR:
                     err = spec.make_error()
+                elif spec.mode == CRASH:
+                    err = SchedulerCrashed(
+                        f"{point}: {spec.message} (seed {self.seed})"
+                    )
                 elif spec.mode == LATENCY:
                     sleep_s = spec.latency_s
                 elif spec.mode == DROP:

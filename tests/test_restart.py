@@ -1,0 +1,316 @@
+"""Crash-restart recovery: the reconcile() contract for every mid-flight
+shape a killed scheduler can leave behind (README "Restart & recovery").
+
+Half-bound PodGroups resolve all-or-nothing across restart (adopt when the
+remainder can still reach quorum, release every landed member when it
+cannot); a bind prepared but never committed is forgotten and requeued; a
+bind the store DID execute before the crash is adopted; dispatcher calls
+lost between prepare and commit terminate with DispatcherClosedError and
+the pod reschedules after reconcile; stale gang Permit quorum entries are
+promoted or reverted against store truth; and registering CRASH specs at
+every crash point (disarmed) leaves the golden pipeline bit-identical.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.api.meta import ObjectMeta
+from kubernetes_tpu.api.types import GangPolicy, PodGroup, PodGroupSpec
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.api_dispatcher import (
+    APICall,
+    APIDispatcher,
+    DispatcherClosedError,
+    POD_BINDING,
+)
+from kubernetes_tpu.store.store import Store
+from kubernetes_tpu.testing import make_node, make_pod, with_gang
+from kubernetes_tpu.utils import faultinject
+from kubernetes_tpu.utils.faultinject import (
+    CRASH,
+    FaultInjected,
+    FaultSpec,
+    SchedulerCrashed,
+)
+
+GATES = {"GenericWorkload": True}
+
+CRASH_POINTS = ("loop.wave", "loop.bind_commit", "gang.permit")
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Every test starts and ends with the process-wide registry disarmed
+    and empty — an armed leftover would poison unrelated tests."""
+    faultinject.registry().reset(seed=0)
+    yield
+    faultinject.registry().reset(seed=0)
+
+
+def _cluster(nodes=2, **sched_kw):
+    store = Store()
+    for i in range(nodes):
+        store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+    sched_kw.setdefault("profiles", [Profile(backend="tpu", wave_size=4)])
+    sched_kw.setdefault("seed", 3)
+    sched = Scheduler(store, **sched_kw)
+    sched.start()
+    return store, sched
+
+
+def _bind_in_store(store, key, node):
+    """A prior incarnation's landed bind: the store write executed, but the
+    scheduler died before any of its bookkeeping ran."""
+    cur = store.get("Pod", key)
+    cur.spec.node_name = node
+    store.update(cur, check_version=False)
+
+
+def _gang(store, name, min_count, members, namespace="default"):
+    store.create(PodGroup(
+        meta=ObjectMeta(name=name, namespace=namespace),
+        spec=PodGroupSpec(policy=GangPolicy(min_count=min_count)),
+    ))
+    pods = [with_gang(make_pod(f"{name}-{i}", cpu="200m", mem="128Mi"), name)
+            for i in range(members)]
+    for p in pods:
+        store.create(p)
+    return pods
+
+
+# --------------------------------------------- half-bound PodGroup sweeps
+
+
+class TestHalfBoundGangReconcile:
+    def test_salvageable_gang_adopted(self):
+        """One member's bind landed before the crash; the two pending
+        members can still reach min_count=2 — reconcile adopts: the
+        remainder is activated and the gang completes, nothing released."""
+        store, sched = _cluster(feature_gates=GATES)
+        _gang(store, "gadopt", min_count=2, members=3)
+        _bind_in_store(store, "default/gadopt-0", "n0")
+        sched.pump()
+        stats = sched.reconcile()
+        assert stats == {"adopted": 0, "forgotten": 0, "requeued": 0,
+                         "gang_adopt": 1}
+        sched.schedule_pending()
+        bound = [p for p in store.pods() if p.meta.name.startswith("gadopt")]
+        assert len(bound) == 3
+        assert all(p.spec.node_name for p in bound), \
+            {p.meta.name: p.spec.node_name for p in bound}
+
+    def test_unsalvageable_gang_released(self):
+        """One member landed but the surviving members can never reach
+        quorum (min_count=3, only 2 members exist) — all-or-nothing
+        demands the landed bind be released, not held forever."""
+        store, sched = _cluster(feature_gates=GATES)
+        _gang(store, "grel", min_count=3, members=2)
+        _bind_in_store(store, "default/grel-0", "n0")
+        sched.pump()
+        stats = sched.reconcile()
+        assert stats.get("gang_release") == 1
+        assert "gang_adopt" not in stats
+        # the landed member is gone; the pending one holds no capacity
+        assert store.try_get("Pod", "default/grel-0") is None
+        remaining = store.try_get("Pod", "default/grel-1")
+        assert remaining is not None and not remaining.spec.node_name
+
+    def test_fully_bound_gang_untouched(self):
+        """A gang whose every member landed is NOT a crash shape: the
+        sweep must leave it alone (no adopt, no release)."""
+        store, sched = _cluster(feature_gates=GATES)
+        _gang(store, "gdone", min_count=2, members=2)
+        _bind_in_store(store, "default/gdone-0", "n0")
+        _bind_in_store(store, "default/gdone-1", "n1")
+        sched.pump()
+        stats = sched.reconcile()
+        assert "gang_adopt" not in stats and "gang_release" not in stats
+        assert store.get("Pod", "default/gdone-0").spec.node_name == "n0"
+
+
+# ------------------------------------------------- bind prepare/commit gap
+
+
+class TestBindCommitGap:
+    def test_prepared_but_uncommitted_bind_forgotten_and_requeued(self):
+        """Killed between assume and the store write: the cache claims
+        resources the cluster never granted. Store truth (unbound) wins —
+        forget + requeue, and the pod lands on the next cycle."""
+        store, sched = _cluster()
+        store.create(make_pod("prep", cpu="100m", mem="64Mi"))
+        sched.pump()
+        sched.queue.pop_specific("default/prep")
+        sched.cache.assume_pod(store.get("Pod", "default/prep"), "n0")
+        stats = sched.reconcile()
+        assert stats == {"adopted": 0, "forgotten": 1, "requeued": 1}
+        assert sched.cache.assumed_pod_count() == 0
+        sched.schedule_pending()
+        assert store.get("Pod", "default/prep").spec.node_name
+
+    def test_crash_at_bind_commit_adopts_executed_binds(self):
+        """CRASH armed at loop.bind_commit: the store bind EXECUTED, then
+        SchedulerCrashed tore through before queue.done/cache-confirm ran.
+        reconcile must adopt every landed bind (store truth), never requeue
+        one — a requeue here would double-bind."""
+        store, sched = _cluster()
+        for i in range(4):
+            store.create(make_pod(f"cb{i}", cpu="100m", mem="64Mi"))
+        reg = faultinject.registry()
+        reg.reset(seed=11)
+        reg.register(FaultSpec("loop.bind_commit", mode=CRASH, times=1))
+        reg.arm()
+        with pytest.raises(SchedulerCrashed):
+            sched.schedule_pending()
+        reg.disarm()
+        landed = [p for p in store.pods() if p.spec.node_name]
+        assert landed, "the wave's store bind must have executed"
+        assert sched.cache.assumed_pod_count() >= len(landed)
+        stats = sched.reconcile()
+        assert stats["adopted"] == len(landed)
+        assert stats["requeued"] + sched.cache.assumed_pod_count() \
+            == 4 - len(landed)
+        sched.schedule_pending()
+        assert all(p.spec.node_name for p in store.pods())
+        active, backoff, unsched = sched.queue.pending_pods()
+        assert active + backoff + unsched == 0
+
+    def test_crash_at_wave_then_fresh_scheduler_converges(self):
+        """CRASH at loop.wave kills incarnation A mid-cycle; a FRESH
+        scheduler over the same store (empty cache — real restart) must
+        bind everything exactly once with no leaked assumes."""
+        store = Store()
+        for i in range(2):
+            store.create(make_node(f"n{i}", cpu="8", mem="16Gi"))
+        for i in range(6):
+            store.create(make_pod(f"w{i}", cpu="100m", mem="64Mi"))
+        a = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=4)],
+                      seed=3)
+        a.start()
+        reg = faultinject.registry()
+        reg.reset(seed=11)
+        reg.register(FaultSpec("loop.wave", mode=CRASH, times=1))
+        reg.arm()
+        with pytest.raises(SchedulerCrashed):
+            a.schedule_pending()
+        reg.disarm()
+        # ungraceful teardown: no drain, no flush — the corpse only stops
+        # consuming store events
+        a.informers.stop_all()
+        b = Scheduler(store, profiles=[Profile(backend="tpu", wave_size=4)],
+                      seed=3)
+        b.start()
+        b.schedule_pending()
+        assert all(p.spec.node_name for p in store.pods())
+        assert b.cache.assumed_pod_count() == 0
+        active, backoff, unsched = b.queue.pending_pods()
+        assert active + backoff + unsched == 0
+
+
+# --------------------------------------------- dispatcher calls lost
+
+
+class TestDispatcherCallsLost:
+    def test_closed_dispatcher_fails_queued_bind_then_reconcile_requeues(self):
+        """The async crash shape: a bind call sat queued in the dispatcher
+        when the process died. close() terminates it with
+        DispatcherClosedError (the store write never ran), so reconcile
+        sees an unbound pod under a live assume — forget + requeue."""
+        store, sched = _cluster()
+        store.create(make_pod("lostcall", cpu="100m", mem="64Mi"))
+        sched.pump()
+        sched.queue.pop_specific("default/lostcall")
+        cur = store.get("Pod", "default/lostcall")
+        sched.cache.assume_pod(cur, "n0")
+        # the prior incarnation's dispatcher with the bind still queued
+        d = APIDispatcher(parallelism=0)  # no workers: the call never runs
+        finishes: list = []
+        call = d.add(APICall(
+            POD_BINDING, "default/lostcall",
+            lambda: _bind_in_store(store, "default/lostcall", "n0"),
+            on_finish=finishes.append,
+        ))
+        d.close()
+        assert call.done.is_set()
+        assert isinstance(call.error, DispatcherClosedError)
+        assert len(finishes) == 1
+        assert not store.get("Pod", "default/lostcall").spec.node_name
+        stats = sched.reconcile()
+        assert stats == {"adopted": 0, "forgotten": 1, "requeued": 1}
+        sched.schedule_pending()
+        assert store.get("Pod", "default/lostcall").spec.node_name
+
+
+# ------------------------------------------------ stale permit quorum
+
+
+class TestStalePermitQuorum:
+    def test_dead_assume_reverted_to_unscheduled(self):
+        """A group-state `assumed` entry whose assume died with the old
+        incarnation (store unbound, no live cache assume) reverts to
+        unscheduled so quorum counts match reality."""
+        store, sched = _cluster(feature_gates=GATES)
+        _gang(store, "gperm", min_count=2, members=2)
+        sched.pump()
+        gs = sched.cache.pod_group_states
+        gs.pod_assumed("default/gperm", "default/gperm-0")
+        stats = sched.reconcile()
+        assert stats.get("permit_cleared") == 1
+        st = gs.get("default/gperm")
+        assert "default/gperm-0" not in st.assumed
+        assert "default/gperm-0" in st.unscheduled
+        # quorum state is truthful again: the gang schedules all-or-nothing
+        sched.schedule_pending()
+        assert all(p.spec.node_name for p in store.pods()
+                   if p.meta.name.startswith("gperm"))
+
+    def test_landed_assume_promoted_to_scheduled(self):
+        """The inverse half: the bind landed but the quorum state never
+        advanced past `assumed` — promote to scheduled, don't revert."""
+        store, sched = _cluster(feature_gates=GATES)
+        _gang(store, "gland", min_count=2, members=2)
+        _bind_in_store(store, "default/gland-0", "n0")
+        sched.pump()
+        gs = sched.cache.pod_group_states
+        # pump marked it scheduled via the watch event; force the stale
+        # shape a crash leaves (assumed, never advanced)
+        st = gs.get("default/gland")
+        st.scheduled.discard("default/gland-0")
+        st.assumed.add("default/gland-0")
+        stats = sched.reconcile()
+        assert stats.get("permit_cleared") == 1
+        st = gs.get("default/gland")
+        assert "default/gland-0" in st.scheduled
+        assert "default/gland-0" not in st.assumed
+
+
+# ------------------------------------------- disarmed CRASH points golden
+
+
+class TestDisarmedCrashGolden:
+    def test_crash_points_declared(self):
+        for p in CRASH_POINTS:
+            assert p in faultinject.FAULT_POINTS, p
+        assert issubclass(SchedulerCrashed, FaultInjected)
+
+    def test_disarmed_crash_specs_leave_golden_bit_identical(self):
+        """A CRASH spec registered at every crash point but never armed is
+        free and invisible: the full golden pipeline schedules
+        byte-identically to the clean-registry baseline — same bindings,
+        same diagnoses, same rng stream position."""
+        from tests.test_dedup_golden import TestFullPipelineGolden
+
+        reg = faultinject.registry()
+        reg.reset(seed=0)
+        placed_ref, diags_ref, rng_ref, _ = TestFullPipelineGolden._run(
+            dedup=True)
+        reg.reset(seed=99)
+        for point in CRASH_POINTS:
+            reg.register(FaultSpec(point, mode=CRASH))
+        assert reg.armed is False
+        placed, diags, rng, _ = TestFullPipelineGolden._run(dedup=True)
+        assert placed == placed_ref
+        assert diags == diags_ref
+        assert rng == rng_ref
+        assert sum(1 for v in placed.values() if v) > 0
+        assert reg.fired_total == 0

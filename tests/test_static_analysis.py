@@ -12,6 +12,7 @@ import textwrap
 from pathlib import Path
 
 from kubernetes_tpu.analysis import (
+    CrashStateChecker,
     FaultPointChecker,
     JitPurityChecker,
     LedgerSeriesChecker,
@@ -1532,6 +1533,94 @@ class TestRetryDiscipline:
         assert fs == []
 
 
+# ---------------------------------------------------------------- CRASH01
+
+
+CRASH_DECL_SRC = """\
+RECONCILE_RESTORED_STATE = (
+    ("_assumed_pods", "scheduler/cache/cache.py"),
+    ("_wave_completions", "scheduler/schedule_one.py"),
+)
+"""
+
+
+def write_crash_tree(root, caller_src, caller="scheduler/plugins/rogue.py",
+                     decl=CRASH_DECL_SRC):
+    p = root / "scheduler/scheduler.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(decl)
+    c = root / caller
+    c.parent.mkdir(parents=True, exist_ok=True)
+    c.write_text(textwrap.dedent(caller_src))
+    return root
+
+
+class TestCrashState:
+    def test_owner_writes_clean(self, tmp_path):
+        write_crash_tree(tmp_path, """
+            class Cache:
+                def __init__(self):
+                    self._assumed_pods = set()
+
+                def assume(self, key):
+                    self._assumed_pods.add(key)
+
+                def forget(self, key):
+                    self._assumed_pods.discard(key)
+        """, caller="scheduler/cache/cache.py")
+        assert list(CrashStateChecker().check_project(tmp_path)) == []
+
+    def test_outside_assignment_flagged(self, tmp_path):
+        write_crash_tree(tmp_path, """
+            def hijack(cache):
+                cache._assumed_pods = set()
+        """)
+        fs = list(CrashStateChecker().check_project(tmp_path))
+        assert rules(fs) == ["CRASH01"]
+        assert "_assumed_pods" in fs[0].message
+
+    def test_outside_mutator_call_flagged(self, tmp_path):
+        write_crash_tree(tmp_path, """
+            def hijack(loop):
+                loop._wave_completions.popleft()
+        """)
+        fs = list(CrashStateChecker().check_project(tmp_path))
+        assert rules(fs) == ["CRASH01"]
+        assert "_wave_completions" in fs[0].message
+
+    def test_reads_stay_free(self, tmp_path):
+        write_crash_tree(tmp_path, """
+            def observe(cache, loop):
+                n = len(cache._assumed_pods)
+                return n + len(loop._wave_completions)
+        """)
+        assert list(CrashStateChecker().check_project(tmp_path)) == []
+
+    def test_declaring_module_exempt(self, tmp_path):
+        write_crash_tree(tmp_path, "x = 1\n", decl=CRASH_DECL_SRC + """
+
+def reconcile(cache):
+    cache._assumed_pods = set()
+""")
+        assert list(CrashStateChecker().check_project(tmp_path)) == []
+
+    def test_partial_tree_is_silent(self, tmp_path):
+        # fixture dirs without the declaration file can't be cross-checked
+        assert list(CrashStateChecker().check_project(tmp_path)) == []
+
+    def test_unparseable_declaration_flagged(self, tmp_path):
+        write_crash_tree(tmp_path, "x = 1\n",
+                         decl="RECONCILE_RESTORED_STATE = tuple(derive())\n")
+        fs = list(CrashStateChecker().check_project(tmp_path))
+        assert rules(fs) == ["CRASH01"]
+        assert "literal" in fs[0].message
+
+    def test_repo_restored_state_writers_sanctioned(self):
+        """Every write to reconcile-restored state in the shipped tree
+        lives in its sanctioned owning module."""
+        assert list(CrashStateChecker().check_project(PKG)) == []
+
+
 # -------------------------------------------------------------- CLI + repo
 
 
@@ -1553,7 +1642,7 @@ class TestCli:
         out = capsys.readouterr().out
         for rule in ("JIT01", "JIT02", "JIT03", "JIT04", "LOCK01", "LOCK02",
                      "LOCK03", "SNAP01", "REG01", "REG02", "SIG01", "SIG02",
-                     "PIPE01", "OBS01", "RET01", "LINT00"):
+                     "PIPE01", "OBS01", "RET01", "CRASH01", "LINT00"):
             assert rule in out
 
     def test_rule_ids_documented_in_readme(self):
