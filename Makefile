@@ -2,12 +2,22 @@
 
 PY ?= python
 
-.PHONY: lint test obs chaos bench-smoke bench-gate multichip-smoke verify
+.PHONY: lint lint-graph test obs chaos bench-smoke bench-gate multichip-smoke verify
 
 # kubesched-lint: AST invariant checker (rule IDs in README "Invariants");
-# exits non-zero on any unsuppressed finding
+# runs the whole-program pass (call-graph-transitive EFF01/EFF02, LOCK05,
+# RNG01, transitive ownership) by default, memoized under
+# .kubesched_lint_cache/; then audits the suppression trail for dead
+# disables (LINT02). Exits non-zero on any unsuppressed finding
 lint:
 	$(PY) -m kubernetes_tpu.analysis kubernetes_tpu/
+	$(PY) -m kubernetes_tpu.analysis --audit-suppressions kubernetes_tpu/
+
+# debugging aid for rule authors: dump one function's call-graph slice +
+# inferred effect sets (direct and transitive, with provenance chains).
+# Usage: make lint-graph FN=TPUBackend.collect
+lint-graph:
+	$(PY) -m kubernetes_tpu.analysis --graph $(FN)
 
 test:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

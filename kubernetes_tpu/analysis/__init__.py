@@ -29,8 +29,24 @@ reconcile() re-derives from store truth (RECONCILE_RESTORED_STATE in
 scheduler/scheduler.py) are writable only in their sanctioned owning
 modules, so crash recovery never races a stray writer (CRASH01).
 
+On top of the per-file rules sits a whole-program pass (callgraph.py +
+effects.py + whole_program.py): a project-wide symbol table and
+conservative call graph over which per-function effect sets — host
+syncs, telemetry, rng consumption, lock acquisition, guarded-state
+writes, device transfers, fault points — are propagated to a fixpoint.
+It powers the transitive rules: EFF01/EFF02 (host-sync or telemetry
+reached from inside a traced region ACROSS a module boundary — the
+closure of JIT01-03/OBS01), LOCK05 (lock-ordering cycles, the deadlock
+half LOCK01-04 can't see), RNG01 (the seeded tie-break stream consumed
+outside the sanctioned scheduling core), and a transitive mode for the
+ownership rules (SIG02/PIPE01/GANG01/CRASH01/SHARD01: calling a
+mutating helper cross-module is flagged, not just the direct write).
+
 CLI: `python -m kubernetes_tpu.analysis [paths]` (exit 1 on findings);
-suppress a single line with `# kubesched-lint: disable=RULE`.
+suppress a single line with `# kubesched-lint: disable=RULE`. Extra
+modes: `--format=json`, `--audit-suppressions` (dead-disable report,
+LINT02), `--graph FUNC` (dump one function's call-graph slice + effect
+sets), `--no-cache` (bypass `.kubesched_lint_cache/`).
 """
 
 from .core import (
@@ -38,11 +54,14 @@ from .core import (
     Finding,
     ModuleContext,
     ProjectChecker,
+    audit_suppressions,
     check_file,
     default_checkers,
     known_rules,
     run_paths,
 )
+from .callgraph import ProjectIndex, build_index
+from .effects import EffectEngine
 from .carry_coherence import CarryCoherenceChecker
 from .crash_state import CrashStateChecker
 from .fault_points import FaultPointChecker
@@ -58,11 +77,13 @@ from .shard_seam import ShardSeamChecker
 from .signature_sync import SignatureSyncChecker
 from .snapshot_immutability import SnapshotImmutabilityChecker
 from .transfer_seam import TransferSeamChecker
+from .whole_program import WholeProgramChecker
 
 __all__ = [
     "CarryCoherenceChecker",
     "Checker",
     "CrashStateChecker",
+    "EffectEngine",
     "FaultPointChecker",
     "Finding",
     "GangSeamChecker",
@@ -73,12 +94,16 @@ __all__ = [
     "ObservabilityPurityChecker",
     "PipelineStateChecker",
     "ProjectChecker",
+    "ProjectIndex",
     "RegistrySyncChecker",
     "RetryDisciplineChecker",
     "ShardSeamChecker",
     "SignatureSyncChecker",
     "SnapshotImmutabilityChecker",
     "TransferSeamChecker",
+    "WholeProgramChecker",
+    "audit_suppressions",
+    "build_index",
     "check_file",
     "default_checkers",
     "known_rules",

@@ -2,15 +2,67 @@
 
 Exit status 0 when clean, 1 when any unsuppressed finding remains, 2 on
 usage errors. Default path is the kubernetes_tpu package itself.
+
+Modes beyond the plain lint run:
+  --list-rules          print every rule id + description
+  --format=json         machine-readable findings (one object per finding)
+  --audit-suppressions  report dead `# kubesched-lint: disable=` comments
+  --graph FUNC          dump call graph + inferred effects for a function
+  --no-cache            bypass the content-hash result cache
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
-from .core import default_checkers, known_rules, run_paths
+from .core import (
+    _infer_package_root, audit_suppressions, default_checkers, known_rules,
+    run_paths,
+)
+
+
+def _dump_graph(needle: str, paths: list[str]) -> int:
+    """Debugging aid for rule authors: one function's slice of the graph."""
+    from .whole_program import indexed
+
+    root = _infer_package_root(paths, None)
+    if root is None:
+        print(f"--graph: no kubernetes_tpu package root under {paths}",
+              file=sys.stderr)
+        return 2
+    index, engine = indexed(root)
+    hits = index.lookup(needle)
+    if not hits:
+        print(f"--graph: no function matches {needle!r}", file=sys.stderr)
+        return 2
+    for fi in hits:
+        print(f"{fi.qualname}  ({fi.path}:{fi.lineno})")
+        if fi.traced_root:
+            print("  traced root (jit/vmap/pmap/shard_map)")
+        direct = engine.direct.get(fi.qualname, {})
+        trans = engine.effects.get(fi.qualname, {})
+        print(f"  direct effects ({len(direct)}):")
+        for eff in sorted(direct, key=lambda e: (e.kind, e.detail)):
+            print(f"    {eff.render()}  @ line {direct[eff].origin_line}")
+        inherited = {e: p for e, p in trans.items() if e not in direct}
+        print(f"  transitive effects ({len(inherited)}):")
+        for eff in sorted(inherited, key=lambda e: (e.kind, e.detail)):
+            print(f"    {eff.render()}  via "
+                  f"{engine.render_chain(fi.qualname, eff)}")
+        print(f"  calls out ({len(fi.calls)}):")
+        for c in fi.calls:
+            held = f"  [holding {', '.join(sorted(c.held))}]" if c.held else ""
+            print(f"    line {c.line}: {c.expr}() -> {c.callee} "
+                  f"({c.kind}){held}")
+        callers = list(index.callers_of(fi.qualname))
+        print(f"  called from ({len(callers)}):")
+        for caller, c in callers:
+            print(f"    {caller.qualname}:{c.line}")
+        print()
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -27,6 +79,25 @@ def main(argv: list[str] | None = None) -> int:
         "--list-rules", action="store_true",
         help="print every rule id and description, then exit",
     )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="finding output format (json: one object per finding with "
+             "path/line/col/rule/message keys)",
+    )
+    parser.add_argument(
+        "--audit-suppressions", action="store_true",
+        help="report dead suppressions (LINT02) instead of linting",
+    )
+    parser.add_argument(
+        "--graph", metavar="FUNC",
+        help="dump the call graph + inferred effect sets for a named "
+             "function (suffix match, e.g. TPUBackend.collect), then exit",
+    )
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="bypass the content-hash result cache "
+             "(.kubesched_lint_cache/)",
+    )
     args = parser.parse_args(argv)
 
     checkers = default_checkers()
@@ -36,9 +107,22 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     paths = args.paths or [str(Path(__file__).resolve().parent.parent)]
-    findings = run_paths(paths, checkers)
-    for f in findings:
-        print(f.render())
+    if args.graph:
+        return _dump_graph(args.graph, paths)
+    if args.audit_suppressions:
+        findings = audit_suppressions(paths, checkers)
+    else:
+        # checkers=None keeps the default set, which is what the result
+        # cache is keyed for
+        findings = run_paths(paths, None, use_cache=not args.no_cache)
+    if args.format == "json":
+        print(json.dumps(
+            [{"path": f.path, "line": f.line, "col": f.col,
+              "rule": f.rule, "message": f.message} for f in findings],
+            indent=2))
+    else:
+        for f in findings:
+            print(f.render())
     if findings:
         print(f"{len(findings)} finding(s)", file=sys.stderr)
         return 1

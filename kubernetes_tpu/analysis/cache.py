@@ -1,0 +1,118 @@
+"""Content-hash result cache for the whole-program lint pass.
+
+The whole-program checker re-parses every file under the project root, so
+`make lint` pays the full parse + fixpoint cost even when nothing changed.
+This cache keys the final finding list on a digest of (a) every `.py`
+file's content under the linted paths AND the project root, and (b) the
+analysis package's own sources — editing a rule invalidates every entry,
+so a stale cache can never mask a new rule's findings.
+
+Entries live under `.kubesched_lint_cache/` next to the project root
+(override with `$KUBESCHED_LINT_CACHE`); the directory is disposable and
+gitignored. `--no-cache` bypasses it entirely. Only the default checker
+set is ever cached — a custom checker list computes fresh.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding, iter_python_files
+
+ENV_DIR = "KUBESCHED_LINT_CACHE"
+DIR_NAME = ".kubesched_lint_cache"
+MAX_ENTRIES = 32
+_SCHEMA = 1
+
+
+def cache_dir(project_root: Path | None) -> Path:
+    env = os.environ.get(ENV_DIR)
+    if env:
+        return Path(env)
+    base = project_root.parent if project_root is not None else Path(".")
+    return base / DIR_NAME
+
+
+def _file_digest(path: Path) -> str | None:
+    try:
+        return hashlib.sha256(path.read_bytes()).hexdigest()
+    except OSError:
+        return None
+
+
+def tree_digest(
+    paths: Iterable[str | Path], project_root: Path | None
+) -> str:
+    """Digest of every .py under `paths` + root, salted with rule sources."""
+    h = hashlib.sha256(f"schema={_SCHEMA}".encode())
+    seen: set[Path] = set()
+    roots: list[Path] = [Path(p) for p in paths]
+    if project_root is not None:
+        roots.append(Path(project_root))
+    entries: list[tuple[str, str]] = []
+    for f in iter_python_files(roots):
+        rp = f.resolve()
+        if rp in seen:
+            continue
+        seen.add(rp)
+        d = _file_digest(rp)
+        if d is not None:
+            entries.append((rp.as_posix(), d))
+    # salt: the analysis package's own sources — rule edits invalidate all
+    for f in sorted(Path(__file__).resolve().parent.glob("*.py")):
+        d = _file_digest(f)
+        if d is not None:
+            entries.append((f"salt:{f.name}", d))
+    for name, digest in sorted(entries):
+        h.update(f"{name}={digest}\n".encode())
+    return h.hexdigest()
+
+
+def load(key: str, project_root: Path | None) -> list[Finding] | None:
+    entry = cache_dir(project_root) / f"{key}.json"
+    try:
+        data = json.loads(entry.read_text())
+    except (OSError, ValueError):
+        return None
+    if data.get("schema") != _SCHEMA:
+        return None
+    try:
+        return [Finding(p, ln, col, rule, msg)
+                for p, ln, col, rule, msg in data["findings"]]
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def store(
+    key: str, findings: list[Finding], project_root: Path | None
+) -> None:
+    d = cache_dir(project_root)
+    try:
+        d.mkdir(parents=True, exist_ok=True)
+        payload = {
+            "schema": _SCHEMA,
+            "findings": [[f.path, f.line, f.col, f.rule, f.message]
+                         for f in findings],
+        }
+        tmp = d / f".{key}.tmp"
+        tmp.write_text(json.dumps(payload))
+        tmp.replace(d / f"{key}.json")
+        _prune(d)
+    except OSError:
+        pass  # cache is best-effort; lint results never depend on it
+
+
+def _prune(d: Path) -> None:
+    entries = sorted(
+        (p for p in d.glob("*.json")),
+        key=lambda p: p.stat().st_mtime if p.exists() else 0.0,
+    )
+    for stale in entries[:-MAX_ENTRIES]:
+        try:
+            stale.unlink()
+        except OSError:
+            pass

@@ -1,0 +1,481 @@
+"""Project-wide symbol table + call graph for the whole-program pass.
+
+Per-file checkers (JIT01-03, LOCK01-04, OBS01) stop at the module
+boundary: a `time.sleep` reached through a helper in another module is
+invisible to them. This module parses every file under the project root
+once, builds a symbol table (modules, classes, functions at every nesting
+level, lock attributes), and resolves call sites into a conservative call
+graph the effect engine (`effects.py`) propagates over.
+
+Resolution is deliberately conservative — an edge is only added when the
+callee is unambiguous:
+
+- bare-name calls resolve through the lexical scope chain: enclosing
+  functions' nested defs, module-level functions, `from X import f`
+  imports, local classes (instantiation edges to `__init__`);
+- `self.method(...)` resolves within the receiver's class, then its base
+  classes (bases resolved through local classes and from-imports);
+- module-qualified calls (`backend.collect(...)` where `backend` names an
+  imported module, via `import a.b as backend` or `from a import backend`)
+  resolve to that module's functions;
+- any other attribute call (`obj.method(...)`) resolves only when exactly
+  one function in the whole project defines that method name AND the name
+  is not a ubiquitous container/stdlib verb (`get`, `put`, `update`, ...)
+  — the "unique-name" tier. Ambiguity means no edge, never a guess.
+
+Nested defs get an implicit `nested` edge from their enclosing function:
+a shard_map body or callback defined inside `f` is treated as running
+with f's effects (a conservative over-approximation, documented in the
+README "Whole-program analysis" subsection).
+
+Every call site records the set of locks lexically held around it
+(`with self._lock:` blocks, aliased `Condition(self._lock)` included),
+which is what the LOCK05 acquisition-order graph is built from.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from .core import _parse_suppressions
+
+# traced-region roots: decorators that put a function on the device path
+TRACED_DECORATORS = {"jit", "vmap", "pmap", "shard_map"}
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+# attribute-call names too generic for unique-name resolution: linking
+# `x.get()` to the one project-defined `get` would be a guess, not a fact
+UNIQUE_NAME_BLOCKLIST = {
+    "get", "put", "pop", "update", "add", "remove", "clear", "append",
+    "extend", "insert", "discard", "setdefault", "items", "keys", "values",
+    "copy", "close", "open", "read", "write", "run", "start", "stop",
+    "send", "join", "wait", "notify", "acquire", "release", "fire",
+    "result", "cancel", "done", "set", "next", "sort", "count", "index",
+    "format", "strip", "split", "encode", "decode", "render", "name",
+    "submit", "shutdown", "flush", "reset", "register", "create", "delete",
+    "list", "watch", "apply", "exists", "match", "check", "handle",
+}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """a.b.c attribute chain as a string, None for anything fancier."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One resolved call edge leaving a function."""
+
+    line: int
+    col: int
+    callee: str               # qualname of the resolved FunctionInfo
+    kind: str                 # local|import|module|self|unique|nested|class
+    expr: str                 # rendered callee expression ("backend.collect")
+    held: frozenset[str] = frozenset()   # lock ids lexically held here
+
+
+@dataclasses.dataclass
+class Acquire:
+    """One `with <lock>:` entry inside a function body."""
+
+    line: int
+    lock: str                 # lock id ("path::Class.attr" / "path::name")
+    held: frozenset[str] = frozenset()   # locks already held at entry
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str             # "<posix path>::Outer.inner" dotted nesting
+    path: str                 # posix path of the defining module
+    name: str
+    cls: str | None           # immediately enclosing class name, if any
+    node: ast.AST
+    lineno: int
+    traced_root: bool = False
+    nested_in: str | None = None          # enclosing function's qualname
+    calls: list[CallSite] = dataclasses.field(default_factory=list)
+    acquires: list[Acquire] = dataclasses.field(default_factory=list)
+    nested: dict[str, "FunctionInfo"] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    path: str
+    bases: list[str]
+    methods: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    lock_attrs: set[str] = dataclasses.field(default_factory=set)
+    lock_aliases: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class ModuleInfo:
+    def __init__(self, rel: str, tree: ast.Module, source: str):
+        self.rel = rel                      # posix path relative to root
+        self.tree = tree
+        self.suppressions = _parse_suppressions(source)
+        self.imports: dict[str, str] = {}   # alias -> module rel path
+        # local name -> (module rel path, symbol name) for `from X import f`
+        self.from_syms: dict[str, tuple[str, str]] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.module_locks: set[str] = set()
+
+
+def _is_traced_decorator(dec: ast.expr) -> bool:
+    def is_ref(node: ast.AST) -> bool:
+        d = _dotted(node)
+        return d is not None and d.split(".")[-1] in TRACED_DECORATORS
+
+    if is_ref(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        d = _dotted(dec.func)
+        if d is not None and d.split(".")[-1] == "partial":
+            return bool(dec.args) and is_ref(dec.args[0])
+        return is_ref(dec.func)
+    return False
+
+
+class ProjectIndex:
+    """Symbol table + call graph for every .py under one project root."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}  # qualname -> fi
+        # terminal method/function name -> qualnames (unique-name tier)
+        self._by_name: dict[str, list[str]] = {}
+        self._parse_all()
+        self._resolve_all()
+
+    # -- construction ---------------------------------------------------
+    def _parse_all(self) -> None:
+        for path in sorted(self.root.rglob("*.py")):
+            rel = path.relative_to(self.root).as_posix()
+            try:
+                source = path.read_text()
+                tree = ast.parse(source, filename=str(path))
+            except (OSError, SyntaxError, UnicodeDecodeError):
+                continue  # LINT01 reports unparseable files
+            mod = ModuleInfo(rel, tree, source)
+            self.modules[rel] = mod
+            self._collect_imports(mod)
+            self._collect_defs(mod)
+
+    def _module_rel(self, parts: list[str]) -> str | None:
+        """Resolve dotted module parts (relative to root) to a file."""
+        if not parts:
+            return None
+        cand = self.root.joinpath(*parts)
+        if cand.with_suffix(".py").is_file():
+            return cand.with_suffix(".py").relative_to(self.root).as_posix()
+        if (cand / "__init__.py").is_file():
+            return (cand / "__init__.py").relative_to(self.root).as_posix()
+        return None
+
+    def _abs_parts(self, mod: ModuleInfo, node: ast.ImportFrom) -> list[str] | None:
+        """Dotted parts (relative to root) of an import's source module."""
+        if node.level == 0:
+            parts = (node.module or "").split(".")
+            # absolute imports of the package itself: strip the root name
+            if parts and parts[0] == self.root.name:
+                return parts[1:]
+            return None  # stdlib / third-party
+        # relative: level 1 = this file's package, each extra level up one
+        base = Path(mod.rel).parent.parts
+        up = node.level - 1
+        if up > len(base):
+            return None
+        base = list(base[:len(base) - up]) if up else list(base)
+        return base + (node.module.split(".") if node.module else [])
+
+    def _collect_imports(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    parts = alias.name.split(".")
+                    if parts[0] != self.root.name:
+                        continue
+                    rel = self._module_rel(parts[1:])
+                    if rel is not None:
+                        mod.imports[alias.asname or parts[-1]] = rel
+            elif isinstance(node, ast.ImportFrom):
+                parts = self._abs_parts(mod, node)
+                if parts is None:
+                    continue
+                src = self._module_rel(parts)
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # `from pkg import submodule` vs `from mod import sym`
+                    sub = self._module_rel(parts + [alias.name])
+                    if sub is not None:
+                        mod.imports[local] = sub
+                    elif src is not None:
+                        mod.from_syms[local] = (src, alias.name)
+
+    def _collect_defs(self, mod: ModuleInfo) -> None:
+        """Register every function/class at every nesting level."""
+
+        def visit(body, cls: ClassInfo | None, fn: FunctionInfo | None,
+                  prefix: str):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{node.name}"
+                    qualname = f"{mod.rel}::{qual}"
+                    if qualname in self.functions:  # redefinition: keep 1st
+                        qualname = f"{qualname}@{node.lineno}"
+                    fi = FunctionInfo(
+                        qualname=qualname, path=mod.rel, name=node.name,
+                        cls=cls.name if cls is not None else None,
+                        node=node, lineno=node.lineno,
+                        traced_root=any(_is_traced_decorator(d)
+                                        for d in node.decorator_list),
+                        nested_in=fn.qualname if fn is not None else None,
+                    )
+                    self.functions[fi.qualname] = fi
+                    self._by_name.setdefault(node.name, []).append(
+                        fi.qualname)
+                    if fn is not None:
+                        fn.nested[node.name] = fi
+                    elif cls is not None:
+                        cls.methods[node.name] = fi
+                    else:
+                        mod.functions.setdefault(node.name, fi)
+                    visit(node.body, None, fi, f"{qual}.")
+                elif isinstance(node, ast.ClassDef):
+                    ci = ClassInfo(name=node.name, path=mod.rel,
+                                   bases=[b for b in
+                                          (_dotted(x) for x in node.bases)
+                                          if b is not None])
+                    if fn is None:
+                        mod.classes.setdefault(node.name, ci)
+                    self._find_lock_attrs(ci, node)
+                    visit(node.body, ci, None, f"{prefix}{node.name}.")
+                else:
+                    # module/class-level statements may nest defs (rare);
+                    # only descend into compound statements
+                    for sub in ast.iter_child_nodes(node):
+                        if isinstance(sub, ast.stmt):
+                            visit([sub], cls, fn, prefix)
+
+        visit(mod.tree.body, None, None, "")
+        # module-level locks: `_lock = threading.Lock()`
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                d = _dotted(node.value.func)
+                if d is not None and d.split(".")[-1] in _LOCK_FACTORIES:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            mod.module_locks.add(tgt.id)
+
+    @staticmethod
+    def _find_lock_attrs(ci: ClassInfo, cls: ast.ClassDef) -> None:
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            d = _dotted(node.value.func)
+            if d is None or d.split(".")[-1] not in _LOCK_FACTORIES:
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    ci.lock_attrs.add(tgt.attr)
+                    # Condition(self._lock): alias onto the wrapped lock
+                    if (d.split(".")[-1] == "Condition"
+                            and node.value.args
+                            and isinstance(node.value.args[0], ast.Attribute)
+                            and isinstance(node.value.args[0].value, ast.Name)
+                            and node.value.args[0].value.id == "self"):
+                        ci.lock_aliases[tgt.attr] = node.value.args[0].attr
+
+    # -- call resolution ------------------------------------------------
+    def _resolve_all(self) -> None:
+        for mod in self.modules.values():
+            for fi in self._module_functions(mod):
+                self._resolve_function(mod, fi)
+
+    def _module_functions(self, mod: ModuleInfo) -> Iterator[FunctionInfo]:
+        for fi in self.functions.values():
+            if fi.path == mod.rel:
+                yield fi
+
+    def _class_of(self, mod: ModuleInfo, name: str) -> ClassInfo | None:
+        if name in mod.classes:
+            return mod.classes[name]
+        sym = mod.from_syms.get(name)
+        if sym is not None:
+            src = self.modules.get(sym[0])
+            if src is not None:
+                return src.classes.get(sym[1])
+        return None
+
+    def _method_in_class(self, mod: ModuleInfo, ci: ClassInfo, name: str,
+                         seen: set[str] | None = None) -> FunctionInfo | None:
+        """Method lookup through the (project-resolvable) MRO."""
+        seen = seen or set()
+        if ci.name in seen:
+            return None
+        seen.add(ci.name)
+        if name in ci.methods:
+            return ci.methods[name]
+        owner_mod = self.modules.get(ci.path)
+        for base in ci.bases:
+            base_ci = self._class_of(owner_mod or mod, base.split(".")[-1])
+            if base_ci is not None:
+                hit = self._method_in_class(mod, base_ci, name, seen)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _unique_by_name(self, name: str) -> FunctionInfo | None:
+        if name in UNIQUE_NAME_BLOCKLIST or name.startswith("__"):
+            return None
+        quals = self._by_name.get(name, ())
+        if len(quals) == 1:
+            return self.functions[quals[0]]
+        return None
+
+    def _lock_id(self, mod: ModuleInfo, cls: ClassInfo | None,
+                 ctx: ast.expr) -> str | None:
+        """Lock id for a with-item context expression, or None."""
+        if (isinstance(ctx, ast.Attribute)
+                and isinstance(ctx.value, ast.Name)
+                and ctx.value.id == "self" and cls is not None):
+            attr = cls.lock_aliases.get(ctx.attr, ctx.attr)
+            if attr in cls.lock_attrs or ctx.attr in cls.lock_attrs:
+                return f"{mod.rel}::{cls.name}.{attr}"
+        elif isinstance(ctx, ast.Name) and ctx.id in mod.module_locks:
+            return f"{mod.rel}::{ctx.id}"
+        return None
+
+    def _resolve_function(self, mod: ModuleInfo, fi: FunctionInfo) -> None:
+        cls = mod.classes.get(fi.cls) if fi.cls else None
+        # lexical scope chain of enclosing functions' nested defs
+        scopes: list[dict[str, FunctionInfo]] = []
+        enclosing = fi.nested_in
+        while enclosing is not None:
+            parent = self.functions.get(enclosing)
+            if parent is None:
+                break
+            scopes.append(parent.nested)
+            enclosing = parent.nested_in
+
+        def resolve_call(call: ast.Call) -> tuple[FunctionInfo, str] | None:
+            func = call.func
+            if isinstance(func, ast.Name):
+                name = func.id
+                if name in fi.nested:
+                    return fi.nested[name], "local"
+                for scope in scopes:
+                    if name in scope:
+                        return scope[name], "local"
+                if name in mod.functions:
+                    return mod.functions[name], "local"
+                sym = mod.from_syms.get(name)
+                if sym is not None:
+                    src = self.modules.get(sym[0])
+                    if src is not None and sym[1] in src.functions:
+                        return src.functions[sym[1]], "import"
+                ci = self._class_of(mod, name)
+                if ci is not None:
+                    init = self._method_in_class(mod, ci, "__init__")
+                    if init is not None:
+                        return init, "class"
+                return None
+            if not isinstance(func, ast.Attribute):
+                return None
+            d = _dotted(func)
+            if d is None:
+                # chained receiver (self.x.y.method()): unique-name tier
+                hit = self._unique_by_name(func.attr)
+                return (hit, "unique") if hit is not None else None
+            parts = d.split(".")
+            if parts[0] == "self" and cls is not None and len(parts) == 2:
+                m = self._method_in_class(mod, cls, parts[1])
+                if m is not None:
+                    return m, "self"
+                hit = self._unique_by_name(parts[1])
+                return (hit, "unique") if hit is not None else None
+            if len(parts) == 2 and parts[0] in mod.imports:
+                src = self.modules.get(mod.imports[parts[0]])
+                if src is not None and parts[1] in src.functions:
+                    return src.functions[parts[1]], "module"
+                return None
+            hit = self._unique_by_name(parts[-1])
+            return (hit, "unique") if hit is not None else None
+
+        held: list[str] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child = fi.nested.get(node.name)
+                if child is not None and child.node is node:
+                    fi.calls.append(CallSite(
+                        node.lineno, node.col_offset, child.qualname,
+                        "nested", node.name, frozenset(held)))
+                return  # nested bodies are their own FunctionInfo pass
+            if isinstance(node, ast.With):
+                entered: list[str] = []
+                for item in node.items:
+                    visit(item.context_expr)
+                    lock = self._lock_id(mod, cls, item.context_expr)
+                    if lock is not None:
+                        fi.acquires.append(
+                            Acquire(item.context_expr.lineno, lock,
+                                    frozenset(held)))
+                        held.append(lock)
+                        entered.append(lock)
+                for stmt in node.body:
+                    visit(stmt)
+                for _ in entered:
+                    held.pop()
+                return
+            if isinstance(node, ast.Call):
+                resolved = resolve_call(node)
+                if resolved is not None:
+                    callee, kind = resolved
+                    if callee.qualname != fi.qualname:
+                        fi.calls.append(CallSite(
+                            node.lineno, node.col_offset, callee.qualname,
+                            kind, _dotted(node.func) or callee.name,
+                            frozenset(held)))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fi.node.body:  # type: ignore[attr-defined]
+            visit(stmt)
+
+    # -- queries --------------------------------------------------------
+    def lookup(self, needle: str) -> list[FunctionInfo]:
+        """Functions whose qualname ends with `needle` (for --graph)."""
+        hits = [fi for q, fi in self.functions.items()
+                if q == needle or q.endswith(f"::{needle}")
+                or q.endswith(f".{needle}") or fi.name == needle]
+        return sorted(hits, key=lambda fi: fi.qualname)
+
+    def callers_of(self, qualname: str) -> Iterable[tuple[FunctionInfo, CallSite]]:
+        for fi in self.functions.values():
+            for c in fi.calls:
+                if c.callee == qualname:
+                    yield fi, c
+
+
+def build_index(root: str | Path) -> ProjectIndex:
+    return ProjectIndex(Path(root))

@@ -1,5 +1,11 @@
 """Carry-coherence rule (SIG02) for the cross-wave signature cache.
 
+This file catches DIRECT writes; the whole-program pass
+(whole_program.py) adds SIG02's transitive mode — a function in a third
+module calling into a mutating helper is flagged at the call site, so
+the mutation can't be laundered through an intermediate module. A write
+suppressed here generates no transitive taint.
+
 The device-resident score rows (`TPUBackend.sig_cache`) are scores AGAINST
 the carried node planes: any mutation of the carry state — the device plane
 buffers, the `_carry*` bookkeeping, the dirty-row set — that does not pass

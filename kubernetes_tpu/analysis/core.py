@@ -29,9 +29,12 @@ SUPPRESS_RE = re.compile(r"#\s*kubesched-lint:\s*disable=([A-Za-z0-9_,\s-]+)")
 # Rule owned by the framework itself: a suppression naming an unknown rule.
 LINT00 = "LINT00"
 LINT01 = "LINT01"
+LINT02 = "LINT02"
 FRAMEWORK_RULES = {
     LINT00: "suppression names a rule no checker owns (typo'd disable)",
     LINT01: "file could not be parsed (syntax error or unreadable)",
+    LINT02: "dead suppression: the named rule no longer fires on that line "
+            "(--audit-suppressions only; remove the stale disable comment)",
 }
 
 
@@ -120,6 +123,7 @@ def default_checkers() -> list[Checker]:
     from .signature_sync import SignatureSyncChecker
     from .snapshot_immutability import SnapshotImmutabilityChecker
     from .transfer_seam import TransferSeamChecker
+    from .whole_program import WholeProgramChecker
 
     return [
         JitPurityChecker(),
@@ -137,6 +141,7 @@ def default_checkers() -> list[Checker]:
         ShardSeamChecker(),
         GangSeamChecker(),
         CrashStateChecker(),
+        WholeProgramChecker(),
     ]
 
 
@@ -205,24 +210,122 @@ def run_paths(
     paths: Iterable[str | Path],
     checkers: list[Checker] | None = None,
     project_root: str | Path | None = None,
+    use_cache: bool = False,
 ) -> list[Finding]:
     """Lint every .py under `paths` plus project-scoped cross-file checks.
 
     `project_root` anchors the registry-sync checker; when None it is
     inferred as the `kubernetes_tpu` package directory containing (or
-    contained by) the first path.
+    contained by) the first path. With `use_cache`, the final finding list
+    is memoized on a content digest of every involved file (plus the
+    analysis package's own sources) under `.kubesched_lint_cache/` — only
+    when `checkers` is the default set, since a custom list isn't part of
+    the key.
     """
+    default_set = checkers is None
     if checkers is None:
         checkers = default_checkers()
+    root = _infer_package_root(paths, project_root)
+    key = None
+    if use_cache and default_set:
+        from . import cache
+
+        key = cache.tree_digest(paths, root)
+        cached = cache.load(key, root)
+        if cached is not None:
+            return cached
     findings: list[Finding] = []
     for f in iter_python_files(paths):
         findings.extend(check_file(f, checkers))
-    root = _infer_package_root(paths, project_root)
     if root is not None:
         for ch in checkers:
             if isinstance(ch, ProjectChecker):
                 findings.extend(ch.check_project(root))
-    return sorted(set(findings))
+    result = sorted(set(findings))
+    if key is not None:
+        from . import cache
+
+        cache.store(key, result, root)
+    return result
+
+
+def audit_suppressions(
+    paths: Iterable[str | Path],
+    checkers: list[Checker] | None = None,
+    project_root: str | Path | None = None,
+) -> list[Finding]:
+    """LINT02 findings for dead `# kubesched-lint: disable=` comments.
+
+    A suppression is dead when the rule it names (a known rule — unknown
+    names are LINT00's job) produces no raw finding on that exact line.
+    Raw means pre-suppression: module checkers run unfiltered, and the
+    whole-program checker runs with its own suppression filtering off.
+    Project-scoped checkers that never honored suppressions are included
+    too, so a stale SHARD01/GANG01 disable is still reported as dead.
+    """
+    if checkers is None:
+        checkers = default_checkers()
+    from .whole_program import WholeProgramChecker
+
+    audit_checkers: list[Checker] = [
+        WholeProgramChecker(honor_suppressions=False)
+        if isinstance(ch, WholeProgramChecker) else ch
+        for ch in checkers
+    ]
+    rules = known_rules(audit_checkers)
+
+    # raw findings keyed on (resolved path, line, rule); module checkers
+    # only need to run on files that actually carry suppressions — a
+    # finding elsewhere can't prove any disable comment live
+    fired: set[tuple[str, int, str]] = set()
+    suppressed: list[tuple[Path, ModuleContext]] = []
+    for f in iter_python_files(paths):
+        try:
+            ctx = ModuleContext(Path(f).as_posix(), Path(f).read_text())
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue  # LINT01 reports unparseable files
+        if not ctx.suppressions:
+            continue
+        for ch in audit_checkers:
+            for finding in ch.check_module(ctx):
+                fired.add((Path(finding.path).resolve().as_posix(),
+                           finding.line, finding.rule))
+        suppressed.append((Path(f), ctx))
+    # project checkers re-parse the whole tree, so only run the ones
+    # whose rules some suppression actually names; the whole-program
+    # checker can also emit the ownership-family ids it transits
+    needed: set[str] = set()
+    for _, ctx in suppressed:
+        for names in ctx.suppressions.values():
+            needed.update(names)
+    root = _infer_package_root(paths, project_root)
+    if root is not None:
+        for ch in audit_checkers:
+            if not isinstance(ch, ProjectChecker):
+                continue
+            emits = set(ch.rules)
+            if isinstance(ch, WholeProgramChecker):
+                emits |= {"SIG02", "PIPE01", "GANG01", "CRASH01", "SHARD01"}
+            if not emits & needed:
+                continue
+            for finding in ch.check_project(root):
+                fired.add((Path(finding.path).resolve().as_posix(),
+                           finding.line, finding.rule))
+    out: list[Finding] = []
+    for path, ctx in suppressed:
+        resolved = path.resolve().as_posix()
+        for line, names in sorted(ctx.suppressions.items()):
+            for name in sorted(names):
+                if name not in rules or name in FRAMEWORK_RULES:
+                    continue  # unknown names are LINT00; LINT01/02 unreal
+                if (resolved, line, name) not in fired:
+                    out.append(Finding(
+                        ctx.posix_path, line, 0, LINT02,
+                        f"dead suppression: {name} no longer fires on "
+                        "this line — remove the disable comment so the "
+                        "justification trail stays honest",
+                    ))
+    return sorted(set(out))
 
 
 def _infer_package_root(

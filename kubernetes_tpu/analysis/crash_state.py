@@ -1,5 +1,9 @@
 """Reconcile-restored state rule (CRASH01).
 
+Direct writes only; CRASH01's transitive mode (calling a mutating helper
+cross-module) lives in whole_program.py, which re-parses the same
+RECONCILE_RESTORED_STATE declaration via this module's _parse_state.
+
 `scheduler/scheduler.py` declares, in one `RECONCILE_RESTORED_STATE`
 literal, every attribute a fresh scheduler's `reconcile()` re-derives from
 store truth after a crash — the assumed-pod set, the gang quorum table,

@@ -1,0 +1,371 @@
+"""Whole-program effect inference over the project call graph.
+
+For every function in the `callgraph.ProjectIndex` this pass computes a
+direct effect set — host syncs / blocking calls, telemetry, seeded-rng
+consumption, lock acquisition, guarded-state writes (the SIG02 / PIPE01 /
+GANG01 / CRASH01 / SHARD01 ownership families), device transfers, and
+fault-point visits — then propagates the sets over the call graph to a
+fixpoint, so `TPUBackend.collect`'s effect set includes everything every
+transitively reached helper does, across module boundaries.
+
+Each propagated effect carries provenance: the origin function and line
+where the primitive effect happens, plus the first callee it arrived
+through, so rules can render a `root -> helper -> leaf` chain in the
+finding message instead of a bare "something somewhere blocks".
+
+Sanction semantics (what makes the rules precise rather than noisy):
+
+- ownership-family writes (`SIG02:..`, `PIPE01:..`, ...) are recorded only
+  OUTSIDE the family's owning modules, and do not propagate out of a
+  function defined in an owning module — calling a sanctioned hook like
+  `backend.invalidate_carry()` is the fix, not a violation;
+- rng consumption (`rng.randrange()` and friends on a receiver named
+  `rng` / `*.rng`) is recorded only outside the sanctioned scheduling-core
+  modules and stops propagating at them — entering the core through its
+  public API (`collect(fl, rng=...)`) is legal; what RNG01 flags is the
+  stream being consumed or advanced out in the open;
+- host-sync / telemetry / lock effects propagate unconditionally; their
+  rules (EFF01/EFF02, LOCK05) decide relevance from context (traced
+  region, held locks), not from where the effect lives.
+
+A write on a line carrying `# kubesched-lint: disable=<family rule>` does
+not generate the effect at all: a reviewed, justified suppression kills
+the taint at the source instead of re-flagging every transitive caller.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Iterator
+
+from .callgraph import FunctionInfo, ProjectIndex, _dotted
+from .carry_coherence import _GUARDED as _SIG02_ATTRS
+from .crash_state import SCHEDULER as _CRASH_DECL, _parse_state as _parse_crash_state
+from .obs_purity import TELEMETRY_SEGMENTS
+from .pipeline_state import _GUARDED as _PIPE01_ATTRS
+
+# effect kinds
+HOST_SYNC = "host_sync"
+TELEMETRY = "telemetry"
+RNG = "rng"
+LOCK = "lock"
+WRITE = "write"          # detail = "<RULE>:<attr>"
+TRANSFER = "transfer"
+FAULT = "fault"
+
+# method names that consume or advance a seeded random.Random tie-break
+# stream (setstate transplants the position; getstate alone is a read)
+RNG_CONSUME = {
+    "random", "randrange", "randint", "getrandbits", "shuffle", "sample",
+    "choice", "choices", "setstate",
+}
+
+# the scheduling-core modules sanctioned to touch the tie-break stream:
+# the host algorithm draw, the device backend's clone/advance transplant,
+# the gang planner handing the stream to run_gang, and the scheduler
+# profile wiring that seeds it
+RNG_SANCTIONED = (
+    "scheduler/schedule_one.py",
+    "scheduler/tpu/backend.py",
+    "scheduler/tpu/gangplanner.py",
+    "scheduler/scheduler.py",
+)
+
+# in-place mutators (union of the ownership checkers' sets)
+_MUTATORS = {
+    "clear", "update", "add", "discard", "pop", "remove", "append",
+    "extend", "insert", "setdefault", "store", "appendleft", "popleft",
+}
+
+_GANG01_ATTRS = {
+    "gang_placements", "gang_n_constrained", "gang_has_fallback",
+    "gang_required", "gang_groups", "gang_pods", "gang_fallback_pods",
+    "gang_outcome",
+}
+
+_TRANSFER_CALLS = {
+    "device_put", "accounted_put", "accounted_fetch", "account_upload",
+    "account_fetch",
+}
+
+_BACKEND = "scheduler/tpu/backend.py"
+_GANGPLANNER = "scheduler/tpu/gangplanner.py"
+_SHARD_SEAM_FUNC = "_cold_start_upload"
+
+
+@dataclasses.dataclass(frozen=True)
+class Effect:
+    kind: str
+    detail: str
+
+    def render(self) -> str:
+        return f"{self.kind}:{self.detail}" if self.detail else self.kind
+
+
+@dataclasses.dataclass
+class Provenance:
+    origin: str          # qualname whose body performs the effect
+    origin_line: int
+    via: str | None      # first callee the effect arrived through
+    via_line: int        # call-site line (in the carrying function)
+
+
+class OwnershipFamily:
+    """One guarded-state family: rule id, owning modules, guarded attrs."""
+
+    def __init__(self, rule: str, owners: tuple[str, ...],
+                 attrs: set[str] | None = None, prefix: str | None = None,
+                 exempt: tuple[str, ...] = ()):
+        self.rule = rule
+        self.owners = owners
+        self.attrs = attrs or set()
+        self.prefix = prefix
+        self.exempt = exempt  # modules neither owning nor checked (decl site)
+
+    def guards(self, attr: str) -> bool:
+        return attr in self.attrs or (
+            self.prefix is not None and attr.startswith(self.prefix))
+
+    def is_owner(self, path: str) -> bool:
+        return any(path.endswith(o) for o in self.owners + self.exempt)
+
+
+def ownership_families(index: ProjectIndex) -> list[OwnershipFamily]:
+    fams = [
+        OwnershipFamily("SIG02", (_BACKEND,), set(_SIG02_ATTRS),
+                        prefix="_carry"),
+        OwnershipFamily("PIPE01", (_BACKEND,), set(_PIPE01_ATTRS)),
+        OwnershipFamily("GANG01", (_GANGPLANNER, _BACKEND), _GANG01_ATTRS),
+    ]
+    decl = index.root / _CRASH_DECL
+    if decl.is_file():
+        state = _parse_crash_state(decl)
+        if state:
+            # one family per attribute: owners differ per attr
+            for attr, owners in sorted(state.items()):
+                fams.append(OwnershipFamily(
+                    "CRASH01", tuple(sorted(owners)), {attr},
+                    exempt=(_CRASH_DECL,)))
+    return fams
+
+
+class EffectEngine:
+    """Direct effect extraction + fixpoint propagation over the graph."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.families = ownership_families(index)
+        # qualname -> {Effect: Provenance}; direct kept separately so
+        # rules can distinguish "does it here" from "reaches it"
+        self.direct: dict[str, dict[Effect, Provenance]] = {}
+        self.effects: dict[str, dict[Effect, Provenance]] = {}
+        for fi in index.functions.values():
+            self.direct[fi.qualname] = dict(self._direct_effects(fi))
+        self._propagate()
+
+    # -- direct effects -------------------------------------------------
+    def _suppressed(self, fi: FunctionInfo, line: int, rule: str) -> bool:
+        mod = self.index.modules.get(fi.path)
+        return mod is not None and rule in mod.suppressions.get(line, ())
+
+    def _direct_effects(
+        self, fi: FunctionInfo
+    ) -> Iterator[tuple[Effect, Provenance]]:
+        q = fi.qualname
+
+        def prov(line: int) -> Provenance:
+            return Provenance(q, line, None, line)
+
+        for acq in fi.acquires:
+            yield Effect(LOCK, acq.lock), prov(acq.line)
+
+        def visit(node: ast.AST) -> Iterator[tuple[Effect, Provenance]]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue  # nested defs carry their own effects
+                yield from visit(child)
+                if isinstance(child, ast.Call):
+                    yield from check_call(child)
+                elif isinstance(child, (ast.Assign, ast.AugAssign,
+                                        ast.AnnAssign, ast.Delete)):
+                    yield from check_write(child)
+
+        def check_call(call: ast.Call) -> Iterator[tuple[Effect, Provenance]]:
+            func = call.func
+            d = _dotted(func)
+            line = call.lineno
+            if d == "time.sleep" or (isinstance(func, ast.Name)
+                                     and func.id == "sleep"):
+                yield Effect(HOST_SYNC, "time.sleep"), prov(line)
+            if isinstance(func, ast.Attribute):
+                attr = func.attr
+                if attr == "item":
+                    yield Effect(HOST_SYNC, ".item()"), prov(line)
+                elif attr in ("result", "join") and not call.args:
+                    yield Effect(HOST_SYNC, f".{attr}()"), prov(line)
+                elif attr in ("wait", "wait_for"):
+                    yield Effect(HOST_SYNC, f".{attr}()"), prov(line)
+                if attr in _TRANSFER_CALLS:
+                    yield Effect(TRANSFER, attr), prov(line)
+                    yield from check_shard_seam(call, attr, line)
+                # seeded tie-break stream: receiver named rng / *.rng
+                if attr in RNG_CONSUME:
+                    recv = _dotted(func.value)
+                    if recv is not None and recv.split(".")[-1] == "rng":
+                        if not any(fi.path.endswith(m)
+                                   for m in RNG_SANCTIONED):
+                            yield (Effect(RNG, f"{recv}.{attr}()"),
+                                   prov(line))
+                if attr == "fire" or (isinstance(func, ast.Name)
+                                      and func.id == "fire"):
+                    yield Effect(FAULT, "fire()"), prov(line)
+            elif isinstance(func, ast.Name):
+                if func.id == "fire":
+                    yield Effect(FAULT, "fire()"), prov(line)
+                if func.id in _TRANSFER_CALLS:
+                    yield Effect(TRANSFER, func.id), prov(line)
+                    yield from check_shard_seam(call, func.id, line)
+            if d is not None:
+                segments = {seg.lower() for seg in d.split(".")}
+                if segments & TELEMETRY_SEGMENTS:
+                    yield Effect(TELEMETRY, f"{d}()"), prov(line)
+            # mutator calls on guarded attrs are writes too
+            if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+                for attr_node in ast.walk(func.value):
+                    if isinstance(attr_node, ast.Attribute):
+                        yield from family_writes(
+                            attr_node.attr, line, f".{func.attr}()")
+
+        def check_shard_seam(
+            call: ast.Call, name: str, line: int
+        ) -> Iterator[tuple[Effect, Provenance]]:
+            if name not in ("accounted_put", "account_upload"):
+                return
+            plane = None
+            if call.args and isinstance(call.args[0], ast.Constant):
+                plane = call.args[0].value
+            else:
+                for kw in call.keywords:
+                    if kw.arg == "plane" and isinstance(kw.value,
+                                                        ast.Constant):
+                        plane = kw.value.value
+            if plane != "node_planes":
+                return
+            if (fi.path.endswith(_BACKEND)
+                    and _SHARD_SEAM_FUNC in fi.qualname):
+                return  # the one sanctioned cold-start seam
+            if self._suppressed(fi, line, "SHARD01"):
+                return
+            yield (Effect(WRITE, "SHARD01:node_planes"), prov(line))
+
+        def family_writes(
+            attr: str, line: int, how: str
+        ) -> Iterator[tuple[Effect, Provenance]]:
+            for fam in self.families:
+                if fam.guards(attr) and not fam.is_owner(fi.path):
+                    if self._suppressed(fi, line, fam.rule):
+                        continue
+                    yield (Effect(WRITE, f"{fam.rule}:{attr}"), prov(line))
+
+        def check_write(
+            stmt: ast.stmt,
+        ) -> Iterator[tuple[Effect, Provenance]]:
+            if isinstance(stmt, ast.Assign):
+                targets = list(stmt.targets)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            else:  # Delete
+                targets = list(stmt.targets)
+            for tgt in targets:
+                for node in ast.walk(tgt):
+                    if isinstance(node, ast.Attribute):
+                        yield from family_writes(node.attr, node.lineno,
+                                                 "assignment")
+
+        yield from visit(fi.node)
+
+    # -- propagation ----------------------------------------------------
+    def _carries(self, effect: Effect, callee_path: str) -> bool:
+        """May this effect flow OUT of a function in `callee_path`?"""
+        if effect.kind == WRITE:
+            rule = effect.detail.split(":", 1)[0]
+            if rule == "SHARD01":
+                return not callee_path.endswith(_BACKEND)
+            for fam in self.families:
+                if fam.rule == rule and fam.guards(
+                        effect.detail.split(":", 1)[1]):
+                    if fam.is_owner(callee_path):
+                        return False
+            return True
+        if effect.kind == RNG:
+            return not any(callee_path.endswith(m) for m in RNG_SANCTIONED)
+        return True
+
+    def _propagate(self) -> None:
+        for q, eff in self.direct.items():
+            self.effects[q] = dict(eff)
+        callers: dict[str, list[str]] = {}
+        for fi in self.index.functions.values():
+            for c in fi.calls:
+                callers.setdefault(c.callee, []).append(fi.qualname)
+        work = list(self.index.functions)
+        pending = set(work)
+        while work:
+            q = work.pop()
+            pending.discard(q)
+            fi = self.index.functions[q]
+            mine = self.effects.setdefault(q, {})
+            grew = False
+            for c in fi.calls:
+                sub = self.effects.get(c.callee)
+                if not sub:
+                    continue
+                callee_path = self.index.functions[c.callee].path
+                for eff, p in sub.items():
+                    if eff in mine:
+                        continue
+                    if not self._carries(eff, callee_path):
+                        continue
+                    mine[eff] = Provenance(p.origin, p.origin_line,
+                                           c.callee, c.line)
+                    grew = True
+            if grew:
+                for caller in callers.get(q, ()):
+                    if caller not in pending:
+                        pending.add(caller)
+                        work.append(caller)
+
+    # -- provenance rendering -------------------------------------------
+    def chain(self, qualname: str, effect: Effect) -> list[tuple[str, int]]:
+        """[(carrier qualname, call-site line), ...] ending at the origin."""
+        out: list[tuple[str, int]] = []
+        cur = qualname
+        seen = {cur}
+        while True:
+            p = self.effects.get(cur, {}).get(effect)
+            if p is None:
+                break
+            if p.via is None or p.via in seen:
+                out.append((p.origin, p.origin_line))
+                break
+            out.append((cur, p.via_line))
+            seen.add(p.via)
+            cur = p.via
+        return out
+
+    def render_chain(self, qualname: str, effect: Effect) -> str:
+        hops = self.chain(qualname, effect)
+        if not hops:
+            return qualname
+        names = [q.split("::")[-1] for q, _ in hops]
+        origin_q, origin_line = hops[-1]
+        path = self.index.functions[origin_q].path
+        return (" -> ".join(names)
+                + f" ({path}:{origin_line})")
+
+    def reaches(self, qualname: str, kind: str) -> list[Effect]:
+        return sorted(
+            (e for e in self.effects.get(qualname, {}) if e.kind == kind),
+            key=lambda e: e.detail)

@@ -1,5 +1,8 @@
 """Gang admission/placement seam rule (GANG01).
 
+Direct writes only; GANG01's transitive mode (calling a mutating helper
+cross-module) lives in whole_program.py.
+
 The gang-wave fast path stays bit-compatible with the host pod-group cycle
 only because every piece of group admission/placement state — the GangPlan
 fields and the WaveRecord gang_* outcome fields — is produced in exactly

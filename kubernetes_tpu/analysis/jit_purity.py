@@ -1,5 +1,11 @@
 """JIT purity / host-sync / bit-compat dtype rules (JIT01-JIT04).
 
+These rules are per-file: the traced-closure walk below stops at the
+module boundary. The cross-module closure — a host sync reached from a
+traced root *through a helper in another module* — is EFF01 in
+whole_program.py, which propagates effect sets over the project call
+graph; keep the two in sync when adding host-sync patterns.
+
 The bit-compat contract (SURVEY.md §7, ops/kernels.py module docstring) says
 the dense kernels' score math is int32/float32 with a fixed op order, traced
 once and replayed. Four things quietly break that:

@@ -1,5 +1,10 @@
 """Lock discipline rules (LOCK01-LOCK04) for the threaded modules.
 
+These rules see one class in one file at a time; the deadlock half —
+two call paths acquiring the same locks in opposite orders across
+modules — is LOCK05 in whole_program.py, built from the per-call-site
+held-lock sets the project call graph records.
+
 The threaded scheduler components (api_dispatcher, cache, scheduling_queue,
 pod_workers, controllers) follow client-go's convention: every shared
 attribute is guarded by one `threading.Lock`/`RLock`/`Condition` held via

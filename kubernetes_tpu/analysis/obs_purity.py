@@ -1,5 +1,9 @@
 """OBS01: telemetry stays host-side — never inside a jit/vmap/pmap graph.
 
+Per-file, like JIT01-03; the cross-module closure (a telemetry call
+reached from a traced root through a helper in another module) is EFF02
+in whole_program.py, which reuses this module's TELEMETRY_SEGMENTS set.
+
 The wave flight recorder's contract (scheduler/tpu/flightrecorder.py) is
 that recording happens post-`collect`, on the host: a recorder/tracer/
 metrics call inside a traced function would either fail at trace time

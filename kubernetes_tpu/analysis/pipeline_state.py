@@ -1,5 +1,9 @@
 """Pipeline-state rule (PIPE01) for the streaming-waves double buffer.
 
+Direct writes only; PIPE01's transitive mode (calling a mutating helper
+cross-module) lives in whole_program.py and reuses this module's
+guarded-attribute set.
+
 The streaming wave pipeline keeps TWO device buffer sets live at once: the
 base plane mirror (`_device_planes` + its `_mirror_dirty` repair debt) and
 the in-flight wave's carry overlay, with the `InflightWave` handle

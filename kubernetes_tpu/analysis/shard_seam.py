@@ -1,5 +1,9 @@
 """Cold-start plane-upload seam rule (SHARD01).
 
+Direct seam calls only; SHARD01's transitive mode (a caller in a third
+module reaching a full-plane upload through a helper) lives in
+whole_program.py.
+
 The delta-maintained device planes only deliver their flat upload curve if
 the full-plane re-put of the node planes stays demoted to the one
 sanctioned cold-start seam: `TPUBackend._cold_start_upload` in

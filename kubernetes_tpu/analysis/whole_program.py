@@ -1,0 +1,337 @@
+"""Whole-program rules: EFF01/EFF02, LOCK05, RNG01, transitive ownership.
+
+These are the call-graph-transitive closures of the per-file rules:
+
+- **EFF01** — a `jit`/`vmap`/`pmap`/`shard_map` root transitively reaches
+  a host sync / blocking call (`time.sleep`, `.item()`, `.result()`,
+  `.wait()`, lock acquisition) through a helper in ANOTHER module. The
+  per-file JIT01-03 closure stops at the module boundary; this rule
+  doesn't. In-module chains are deliberately left to JIT01-03 so one
+  defect never produces two findings.
+- **EFF02** — same closure for telemetry/recorder calls (OBS01's
+  transitive half).
+- **LOCK05** — lock-ordering cycle detection. Every `with <lock>:`
+  acquisition records the locks already held; every call site records the
+  locks lexically held around it, and the callee's transitively inferred
+  lock set contributes order edges `held -> acquired`. A cycle in that
+  graph is a potential deadlock no single-file rule can see; the finding
+  dumps the full acquisition-order graph with a witness per edge.
+- **RNG01** — the seeded tie-break stream (a receiver named `rng` /
+  `*.rng`) is consumed or advanced (`random`/`randrange`/`shuffle`/...)
+  outside the sanctioned scheduling-core modules
+  (`schedule_one.py` / `backend.py` / `gangplanner.py` / `scheduler.py`
+  and their `advance_rng` transplant path). Any other draw skews the
+  host/device bit-identity goldens one position per call.
+- **transitive ownership** — SIG02 / PIPE01 / GANG01 / CRASH01 / SHARD01
+  gain a transitive mode: a function outside the owning module that CALLS
+  a helper (in yet another module) which mutates the guarded state is
+  flagged at the call site, reusing the family's rule id with a
+  "(transitive)" message. A write on a line suppressed for the family
+  rule generates no taint — a reviewed suppression ends the chain.
+
+Unlike the older project-scoped checkers, findings from this checker DO
+honor per-line `# kubesched-lint: disable=` suppressions (the audit mode
+needs the raw stream, so filtering can be switched off).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from .core import Finding, ProjectChecker
+from .callgraph import ProjectIndex
+from .effects import (
+    HOST_SYNC, LOCK, RNG, TELEMETRY, WRITE,
+    Effect, EffectEngine, RNG_SANCTIONED,
+)
+
+EFF01 = "EFF01"
+EFF02 = "EFF02"
+LOCK05 = "LOCK05"
+RNG01 = "RNG01"
+
+# in-process memo: building the index re-parses the whole tree (~2s on
+# the real repo), and one test/CLI session hits the same unchanged tree
+# many times (lint run + audit + --graph). Keyed on every file's
+# (path, mtime_ns, size) so any edit invalidates.
+_MEMO: dict[Path, tuple[tuple, ProjectIndex, EffectEngine]] = {}
+_MEMO_MAX = 8
+
+
+def _tree_signature(root: Path) -> tuple:
+    sig = []
+    for p in sorted(root.rglob("*.py")):
+        try:
+            st = p.stat()
+        except OSError:
+            continue
+        sig.append((p.relative_to(root).as_posix(), st.st_mtime_ns,
+                    st.st_size))
+    return tuple(sig)
+
+
+def indexed(root: str | Path) -> tuple[ProjectIndex, EffectEngine]:
+    """Memoized (ProjectIndex, EffectEngine) for an unchanged tree."""
+    root = Path(root).resolve()
+    sig = _tree_signature(root)
+    hit = _MEMO.get(root)
+    if hit is not None and hit[0] == sig:
+        return hit[1], hit[2]
+    index = ProjectIndex(root)
+    engine = EffectEngine(index)
+    _MEMO[root] = (sig, index, engine)
+    while len(_MEMO) > _MEMO_MAX:
+        _MEMO.pop(next(iter(_MEMO)))
+    return index, engine
+
+
+def _strongly_connected(graph: dict[str, set[str]]) -> list[list[str]]:
+    """Tarjan SCCs (iterative); only components of size >= 2 returned."""
+    index_of: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work: list[tuple[str, Iterator[str]]] = [
+            (root, iter(sorted(graph.get(root, ()))))]
+        index_of[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index_of:
+                    index_of[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index_of[v]:
+                comp: list[str] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) >= 2:
+                    sccs.append(sorted(comp))
+
+    for node in sorted(graph):
+        if node not in index_of:
+            strongconnect(node)
+    return sccs
+
+
+class WholeProgramChecker(ProjectChecker):
+    """Call-graph-transitive rules over the whole project tree."""
+
+    rules = {
+        EFF01: "traced (jit/vmap/pmap/shard_map) function transitively "
+               "reaches a host-sync/blocking call through another module "
+               "(cross-module closure of JIT01-JIT03)",
+        EFF02: "traced function transitively reaches a telemetry/recorder "
+               "call through another module (cross-module closure of "
+               "OBS01)",
+        LOCK05: "lock-ordering cycle across modules: two call paths "
+                "acquire the same locks in opposite orders (potential "
+                "deadlock); the acquisition-order graph is dumped in the "
+                "finding",
+        RNG01: "seeded tie-break rng stream consumed or advanced outside "
+               "the sanctioned scheduling-core paths "
+               "(schedule_one/backend.advance_rng/gangplanner/scheduler) "
+               "— every stray draw shifts host/device bit-identity by one "
+               "position",
+    }
+
+    def __init__(self, honor_suppressions: bool = True):
+        self.honor_suppressions = honor_suppressions
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        index, engine = indexed(root)
+        raw: list[Finding] = []
+        raw.extend(self._traced_closure(root, index, engine))
+        raw.extend(self._rng_flow(root, index, engine))
+        raw.extend(self._lock_order(root, index, engine))
+        raw.extend(self._transitive_ownership(root, index, engine))
+        if not self.honor_suppressions:
+            return sorted(set(raw))
+        kept = []
+        for f in raw:
+            rel = Path(f.path)
+            try:
+                rel_posix = rel.relative_to(root).as_posix()
+            except ValueError:
+                rel_posix = rel.as_posix()
+            mod = index.modules.get(rel_posix)
+            if mod is not None and f.rule in mod.suppressions.get(f.line, ()):
+                continue
+            kept.append(f)
+        return sorted(set(kept))
+
+    # -- EFF01 / EFF02 ---------------------------------------------------
+    def _traced_closure(
+        self, root: Path, index: ProjectIndex, engine: EffectEngine
+    ) -> Iterator[Finding]:
+        for q, fi in index.functions.items():
+            if not fi.traced_root:
+                continue
+            for kind, rule, what in ((HOST_SYNC, EFF01, "host-sync"),
+                                     (TELEMETRY, EFF02, "telemetry")):
+                for eff in engine.reaches(q, kind):
+                    anchor = self._module_exit(index, engine, q, eff,
+                                               fi.path)
+                    if anchor is None:
+                        continue  # in-module: JIT01-03/OBS01 territory
+                    a_path, a_line = anchor
+                    yield Finding(
+                        (root / a_path).as_posix(), a_line, 0, rule,
+                        f"traced function {fi.name!r} transitively "
+                        f"reaches {what} {eff.detail} across a module "
+                        f"boundary: {engine.render_chain(q, eff)} — "
+                        "device-path code must stay pure; hoist the "
+                        "effect out of the traced region",
+                    )
+
+    @staticmethod
+    def _module_exit(
+        index: ProjectIndex, engine: EffectEngine, q: str, eff: Effect,
+        home: str,
+    ) -> tuple[str, int] | None:
+        """(path, line) of the first hop leaving `home`, else None."""
+        hops = engine.chain(q, eff)
+        for i in range(len(hops) - 1):
+            nxt = index.functions.get(hops[i + 1][0])
+            if nxt is not None and nxt.path != home:
+                carrier = index.functions[hops[i][0]]
+                return carrier.path, hops[i][1]
+        return None
+
+    # -- RNG01 -----------------------------------------------------------
+    def _rng_flow(
+        self, root: Path, index: ProjectIndex, engine: EffectEngine
+    ) -> Iterator[Finding]:
+        seen: set[tuple[str, int, str]] = set()
+        for q, fi in index.functions.items():
+            for eff, prov in engine.direct.get(q, {}).items():
+                if eff.kind != RNG:
+                    continue
+                key = (fi.path, prov.origin_line, eff.detail)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    (root / fi.path).as_posix(), prov.origin_line, 0,
+                    RNG01,
+                    f"seeded tie-break stream consumed via {eff.detail} "
+                    f"in {fi.name!r} outside the sanctioned scheduling "
+                    "core ("
+                    + ", ".join(m.rsplit('/', 1)[-1] for m in RNG_SANCTIONED)
+                    + ") — route draws through the core API or "
+                    "backend.advance_rng so host/device streams stay "
+                    "bit-identical",
+                )
+
+    # -- LOCK05 ----------------------------------------------------------
+    def _lock_order(
+        self, root: Path, index: ProjectIndex, engine: EffectEngine
+    ) -> Iterator[Finding]:
+        # order edge held -> acquired, with one witness per edge
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        for fi in index.functions.values():
+            for acq in fi.acquires:
+                for held in acq.held:
+                    if held != acq.lock:
+                        edges.setdefault(
+                            (held, acq.lock),
+                            (fi.path, acq.line,
+                             f"{fi.qualname} acquires {acq.lock} while "
+                             f"holding {held}"))
+            for c in fi.calls:
+                if not c.held:
+                    continue
+                for eff in engine.reaches(c.callee, LOCK):
+                    for held in c.held:
+                        if held != eff.detail:
+                            edges.setdefault(
+                                (held, eff.detail),
+                                (fi.path, c.line,
+                                 f"{fi.qualname} calls {c.expr}() which "
+                                 f"acquires {eff.detail} "
+                                 f"[{engine.render_chain(c.callee, eff)}] "
+                                 f"while holding {held}"))
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for comp in _strongly_connected(graph):
+            in_cycle = [((a, b), w) for (a, b), w in sorted(edges.items())
+                        if a in comp and b in comp]
+            if not in_cycle:
+                continue
+            witness_path, witness_line, _ = in_cycle[0][1]
+            lines = [
+                f"  {a} -> {b}   [{p}:{ln}: {desc}]"
+                for (a, b), (p, ln, desc) in in_cycle
+            ]
+            yield Finding(
+                (root / witness_path).as_posix(), witness_line, 0, LOCK05,
+                "lock-ordering cycle (potential deadlock) among: "
+                + ", ".join(comp)
+                + "; acquisition-order graph:\n" + "\n".join(lines)
+                + "\n  fix: pick one global order for these locks and "
+                "acquire in that order on every path",
+            )
+
+    # -- transitive ownership (SIG02/PIPE01/GANG01/CRASH01/SHARD01) ------
+    def _transitive_ownership(
+        self, root: Path, index: ProjectIndex, engine: EffectEngine
+    ) -> Iterator[Finding]:
+        fam_by_rule = {}
+        for fam in engine.families:
+            fam_by_rule.setdefault(fam.rule, []).append(fam)
+        seen: set[tuple[str, int, str, str]] = set()
+        for fi in index.functions.values():
+            for c in fi.calls:
+                callee = index.functions.get(c.callee)
+                if callee is None or callee.path == fi.path:
+                    continue
+                for eff in engine.reaches(c.callee, WRITE):
+                    rule, attr = eff.detail.split(":", 1)
+                    if rule == "SHARD01":
+                        owner_ok = fi.path.endswith(
+                            "scheduler/tpu/backend.py")
+                    else:
+                        owner_ok = any(
+                            fam.is_owner(fi.path) and fam.guards(attr)
+                            for fam in fam_by_rule.get(rule, ()))
+                    if owner_ok:
+                        continue  # owners may delegate to helpers
+                    key = (fi.path, c.line, rule, attr)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Finding(
+                        (root / fi.path).as_posix(), c.line, 0, rule,
+                        f"(transitive) {fi.name!r} calls {c.expr}() which "
+                        f"mutates guarded {attr!r} outside its owning "
+                        f"module: {engine.render_chain(c.callee, eff)} — "
+                        "route the mutation through the owner's "
+                        "sanctioned API instead",
+                    )
