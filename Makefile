@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint lint-graph test obs chaos bench-smoke bench-gate multichip-smoke verify
+.PHONY: lint lint-graph test obs chaos bench-smoke bench-gate multichip-smoke stall-smoke verify
 
 # kubesched-lint: AST invariant checker (rule IDs in README "Invariants");
 # runs the whole-program pass (call-graph-transitive EFF01/EFF02, LOCK05,
@@ -45,10 +45,12 @@ chaos:
 # flight-recorder CLI smoke: synthetic multi-wave run (no device, no jax),
 # exercises ring buffer + watchdog + post-mortem formatting, and asserts
 # the device-telemetry block (transfer ledger / compile tracker / memory
-# watermark) is present in the dump with per-plane sums that add up
+# watermark) AND the stall-attribution block (>=95% coverage per wave)
+# are present in the dump; then dumps the stall profiler's own summary
 obs:
 	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --demo
 	$(PY) -m kubernetes_tpu.scheduler.tpu.flightrecorder --schema
+	$(PY) -m kubernetes_tpu.scheduler.tpu.stallprofiler --demo
 
 # trace-bench CI smoke: a tiny 200-pod Poisson trace through the real
 # loop (virtual-time SLI, deterministic), asserting the standing row keys
@@ -69,7 +71,13 @@ bench-gate:
 multichip-smoke:
 	$(PY) bench_multichip.py --nodes-sweep 512,1024 --bursts 3 --wave 8 --churn 16 --smoke
 
+# critical-path analyzer smoke: synthetic waves through the full
+# decompose -> analyze path, asserting the coverage invariant and
+# dominant-edge selection (no device, no jax)
+stall-smoke:
+	$(PY) -m kubernetes_tpu.scheduler.tpu.stallprofiler --smoke
+
 # the full gate: invariants, tier-1 tests, chaos soaks (incl. the
-# arrival-trace runs), observability smoke, trace-bench smoke, and the
-# sharded-mesh upload-flatness smoke
-verify: lint test chaos obs bench-smoke multichip-smoke
+# arrival-trace runs), observability smoke, trace-bench smoke, the
+# stall critical-path smoke, and the sharded-mesh upload-flatness smoke
+verify: lint test chaos obs bench-smoke stall-smoke multichip-smoke

@@ -158,6 +158,13 @@ def run_headline() -> None:
     workload = next(w for w in case["workloads"]
                     if w["name"] == "5000Nodes_10000Pods")
 
+    # host calibration BEFORE the workload: the micro-benchmark must not
+    # share the wall clock with the measured run, and the score stamps the
+    # row so the regression gate can normalize cross-host comparisons
+    from kubernetes_tpu.perf.calibrate import host_calibration_score
+
+    calibration = host_calibration_score()
+
     executor = WorkloadExecutor(case, workload, backend="tpu",
                                 wave_size=WAVE_SIZE)
     result = executor.run()
@@ -274,6 +281,11 @@ def run_headline() -> None:
     # regression gate's lower-is-better device checks
     line.update(recorder.device_telemetry.bench_columns(
         recorder.phase_snapshot().get("waves", 0)))
+    # stall attribution (this PR): per-reason decomposition of wave wall
+    # time plus the dominant reason — wall-clock diagnostics, never part of
+    # any determinism contract
+    line.update(recorder.stall_profiler.bench_columns())
+    line["host_calibration_score"] = calibration
     if fallback_reason:
         line["fallback_reason"] = fallback_reason
     _finish(line)

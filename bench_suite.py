@@ -129,6 +129,14 @@ def main() -> None:
 
     from kubernetes_tpu.perf.harness import WorkloadExecutor, load_config
 
+    # host calibration ONCE, before any row runs: every row in the artifact
+    # carries the same score, and the gate normalizes cross-host diffs by
+    # the old/new ratio (perf/calibrate.py). Cached per process, so the
+    # trace rows' own run_trace_bench() calls reuse this measurement.
+    from kubernetes_tpu.perf.calibrate import host_calibration_score
+
+    calibration = host_calibration_score()
+
     cfg_dir = os.path.join(base, "kubernetes_tpu/perf/configs")
     all_pass = True
     summary: dict[str, float] = {}
@@ -172,6 +180,9 @@ def main() -> None:
         recorder = executor.scheduler.flight_recorder
         line.update(recorder.device_telemetry.bench_columns(
             recorder.phase_snapshot().get("waves", 0)))
+        # stall attribution + calibration (wall-clock diagnostics)
+        line.update(recorder.stall_profiler.bench_columns())
+        line["host_calibration_score"] = calibration
         if fallback_reason:
             line["fallback_reason"] = fallback_reason
         print(json.dumps(line), flush=True)
@@ -195,6 +206,7 @@ def main() -> None:
             "git_rev": git_rev,
             "row_wall_s": round(row_wall_s, 2),
         })
+        line.setdefault("host_calibration_score", calibration)
         print(json.dumps(line), flush=True)
 
     # standing WarmRestart row: a restarted scheduler over an occupied
@@ -211,6 +223,7 @@ def main() -> None:
             "device": platform,
             "git_rev": git_rev,
             "row_wall_s": round(time.monotonic() - row_t0, 2),
+            "host_calibration_score": calibration,
         })
         if fallback_reason:
             line["fallback_reason"] = fallback_reason

@@ -76,6 +76,7 @@ from .retry_discipline import RetryDisciplineChecker
 from .shard_seam import ShardSeamChecker
 from .signature_sync import SignatureSyncChecker
 from .snapshot_immutability import SnapshotImmutabilityChecker
+from .stall_seam import StallSeamChecker
 from .transfer_seam import TransferSeamChecker
 from .whole_program import WholeProgramChecker
 
@@ -100,6 +101,7 @@ __all__ = [
     "ShardSeamChecker",
     "SignatureSyncChecker",
     "SnapshotImmutabilityChecker",
+    "StallSeamChecker",
     "TransferSeamChecker",
     "WholeProgramChecker",
     "audit_suppressions",

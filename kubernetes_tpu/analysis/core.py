@@ -122,6 +122,7 @@ def default_checkers() -> list[Checker]:
     from .shard_seam import ShardSeamChecker
     from .signature_sync import SignatureSyncChecker
     from .snapshot_immutability import SnapshotImmutabilityChecker
+    from .stall_seam import StallSeamChecker
     from .transfer_seam import TransferSeamChecker
     from .whole_program import WholeProgramChecker
 
@@ -137,6 +138,7 @@ def default_checkers() -> list[Checker]:
         RetryDisciplineChecker(),
         FaultPointChecker(),
         LedgerSeriesChecker(),
+        StallSeamChecker(),
         TransferSeamChecker(),
         ShardSeamChecker(),
         GangSeamChecker(),

@@ -35,6 +35,10 @@ TELEMETRY_SEGMENTS = {
     "telemetry", "device_telemetry", "devicetelemetry",
     "accounted_put", "accounted_fetch", "account_upload", "account_fetch",
     "compile_span", "note_resident", "stamp_watermark",
+    # stall profiler seam (scheduler/tpu/stallprofiler.py): stamps are
+    # host-side wall-clock arithmetic, never inside traced code
+    "stall_profiler", "stallprofiler", "mark_gap", "note_stall",
+    "note_handoff",
 }
 
 

@@ -1,0 +1,270 @@
+"""Stall-seam rule (OBS04) for the pipeline stall profiler.
+
+The stall profiler (`scheduler/tpu/stallprofiler.py`) promises a CLOSED
+attribution vocabulary: every wave's wall clock decomposes into overlap
+plus reasons from the literal `STALL_REASONS` tuple, and the README stall
+table / zpage / bench columns are all keyed by those exact strings. That
+contract only holds if (a) every seam stamp names a declared literal —
+a typo'd or ad-hoc reason string would either raise at runtime on a cold
+path or silently fork the vocabulary — and (b) the per-record stall state
+is written in exactly one place, so the coverage invariant
+(`overlap + sum(stalls) ~= wall`) can be reasoned about locally.
+
+Nothing imports across these seams at check time (the scheduling loop
+stamps through a recorder attribute, the profiler never imports its
+owner), so — like FI01 for fault points and OBS02 for ledger series —
+enforcement is cross-parsing. OBS04 flags, across the whole tree:
+
+- a `STALL_REASONS` / `STALL_SERIES` declaration in stallprofiler.py that
+  is not a literal tuple/list of string constants (can't be cross-checked);
+- a declared stall series with no matching literal registration in
+  `scheduler/metrics.py` (the OBS02 registration contract), and a
+  `_series(...)` call in stallprofiler.py naming anything else;
+- a `mark_gap(...)` / `note_stall(...)` / `stall_profiler.stall(...)`
+  call site, outside stallprofiler.py, whose reason argument is not a
+  string literal or names an undeclared reason — seams must not launder
+  reasons through variables or helpers;
+- a write (assign / augmented / del / mutating method call) to per-record
+  stall state (`stall_by_reason`, `stall_coverage`, `stall_dominant`,
+  `_stall_acc`, `_stall_mark`, `_stall_done`) outside stallprofiler.py —
+  seams report through `mark_gap`/`note_stall`, never by poking records.
+  (WaveRecord's dataclass field declarations are annotated NAME targets,
+  not attribute writes, so declaring the fields stays legal.)
+
+Findings are project-scoped, so per-line suppressions do not apply — use
+a declared reason (or declare a new one, updating the README table and
+invariant together) instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from .core import Finding, ProjectChecker
+from .ledger_series import METRICS_REGISTRY, _registered_names
+
+OBS04 = "OBS04"
+
+PROFILER = "scheduler/tpu/stallprofiler.py"
+
+_REASON_CALLS = {"mark_gap": 1, "note_stall": 1}
+# `.stall(record, reason)` is a common-enough method name that the rule
+# only binds it when called through a `stall_profiler` attribute chain
+_STALL_CM = "stall"
+
+_GUARDED_ATTRS = {
+    "stall_by_reason",
+    "stall_coverage",
+    "stall_dominant",
+    "_stall_acc",
+    "_stall_mark",
+    "_stall_done",
+}
+
+_MUTATORS = {
+    "clear", "update", "add", "discard", "pop", "remove", "append",
+    "extend", "setdefault",
+}
+
+
+def _parse_literal_tuple(tree: ast.AST, name: str):
+    """(values | None-if-non-literal, lineno) for a module-level `name =
+    (...)` declaration, or None when absent."""
+    for node in getattr(tree, "body", ()):
+        if not (isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        )):
+            continue
+        value = node.value
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            return None, node.lineno
+        out: list[str] = []
+        for el in value.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None, node.lineno
+            out.append(el.value)
+        return out, node.lineno
+    return None
+
+
+def _reason_arg(node: ast.Call, pos: int) -> ast.expr | None:
+    if len(node.args) > pos:
+        return node.args[pos]
+    for kw in node.keywords:
+        if kw.arg == "reason":
+            return kw.value
+    return None
+
+
+def _via_stall_profiler(func: ast.Attribute) -> bool:
+    """True when the call receiver is a `...stall_profiler` chain (or a
+    bare name that obviously holds one, e.g. `prof`/`profiler`)."""
+    recv = func.value
+    if isinstance(recv, ast.Attribute):
+        return recv.attr == "stall_profiler"
+    if isinstance(recv, ast.Name):
+        return "prof" in recv.id
+    return False
+
+
+class StallSeamChecker(ProjectChecker):
+    rules = {
+        OBS04: "stall seam out of contract: non-literal/undeclared stall "
+               "reason at a mark_gap/note_stall/stall call site, stall "
+               "record state written outside stallprofiler.py, or "
+               "STALL_REASONS/STALL_SERIES out of sync with their "
+               "consumers",
+    }
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        prof_path = root / PROFILER
+        if not prof_path.is_file():
+            return  # partial tree (fixture dirs) — nothing to cross-check
+        try:
+            prof_tree = ast.parse(prof_path.read_text(),
+                                  filename=str(prof_path))
+        except (OSError, SyntaxError):
+            return  # LINT01 reports unparseable files
+        reasons = self._declared(prof_path, prof_tree, "STALL_REASONS")
+        series = self._declared(prof_path, prof_tree, "STALL_SERIES")
+        yield from self._decl_findings(prof_path, reasons, "STALL_REASONS")
+        yield from self._decl_findings(prof_path, series, "STALL_SERIES")
+        if series and series[0] is not None:
+            registry = root / METRICS_REGISTRY
+            registered = (_registered_names(registry)
+                          if registry.is_file() else None)
+            if registered is not None:
+                for name in series[0]:
+                    if name not in registered:
+                        yield Finding(
+                            prof_path.as_posix(), series[1], 0, OBS04,
+                            f"STALL_SERIES entry {name!r} is not registered "
+                            "in scheduler/metrics.py — every stall "
+                            "observation on it would be silently dropped",
+                        )
+            yield from self._check_series_calls(prof_path, prof_tree,
+                                                set(series[0]))
+        if reasons is None or reasons[0] is None:
+            return  # vocabulary unknowable; the decl finding covers it
+        declared = set(reasons[0])
+        for path in sorted(root.rglob("*.py")):
+            posix = path.as_posix()
+            if posix.endswith(PROFILER):
+                continue  # the owner: internal indirection is its business
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except (OSError, SyntaxError):
+                continue
+            yield from self._check_tree(posix, tree, declared)
+
+    def _declared(self, path: Path, tree: ast.AST, name: str):
+        return _parse_literal_tuple(tree, name)
+
+    def _decl_findings(self, path: Path, decl, name: str
+                       ) -> Iterator[Finding]:
+        if decl is None:
+            yield Finding(
+                path.as_posix(), 1, 0, OBS04,
+                f"stallprofiler.py must declare {name} so OBS04 can "
+                "cross-check its consumers",
+            )
+        elif decl[0] is None:
+            yield Finding(
+                path.as_posix(), decl[1], 0, OBS04,
+                f"{name} must be a literal tuple of string constants so "
+                "OBS04 can cross-check it",
+            )
+
+    def _check_series_calls(self, path: Path, tree: ast.AST,
+                            declared: set[str]) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_series"
+                    and (node.args or node.keywords)):
+                continue
+            arg = node.args[0] if node.args else node.keywords[0].value
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                yield Finding(
+                    path.as_posix(), node.lineno, node.col_offset, OBS04,
+                    "_series() name must be a string literal so OBS04 can "
+                    "cross-check it against STALL_SERIES",
+                )
+            elif arg.value not in declared:
+                yield Finding(
+                    path.as_posix(), node.lineno, node.col_offset, OBS04,
+                    f"_series({arg.value!r}) emits a series not declared "
+                    "in STALL_SERIES",
+                )
+
+    def _check_tree(self, path: str, tree: ast.AST,
+                    declared: set[str]) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(path, node, declared)
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in _MUTATORS
+                        and isinstance(func.value, ast.Attribute)
+                        and func.value.attr in _GUARDED_ATTRS):
+                    yield self._write_finding(path, func.value.lineno,
+                                              func.value.attr,
+                                              f"mutating call .{func.attr}()")
+                continue
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = list(node.targets)
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    if (isinstance(sub, ast.Attribute)
+                            and sub.attr in _GUARDED_ATTRS):
+                        yield self._write_finding(path, sub.lineno, sub.attr,
+                                                  "write")
+
+    def _check_call(self, path: str, node: ast.Call,
+                    declared: set[str]) -> Iterator[Finding]:
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        if func.attr in _REASON_CALLS:
+            pos = _REASON_CALLS[func.attr]
+        elif func.attr == _STALL_CM and _via_stall_profiler(func):
+            pos = 1
+        else:
+            return
+        arg = _reason_arg(node, pos)
+        if arg is None:
+            return
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            yield Finding(
+                path, node.lineno, node.col_offset, OBS04,
+                f"{func.attr}() stall reason must be a string literal at "
+                "the seam — a variable or helper-forwarded reason can't be "
+                "cross-checked against STALL_REASONS",
+            )
+        elif arg.value not in declared:
+            yield Finding(
+                path, node.lineno, node.col_offset, OBS04,
+                f"{func.attr}({arg.value!r}) names a stall reason not "
+                "declared in STALL_REASONS — the attribution vocabulary "
+                "is closed; declare the reason (and update the README "
+                "stall table) instead",
+            )
+
+    def _write_finding(self, path: str, line: int, attr: str,
+                       what: str) -> Finding:
+        return Finding(
+            path, line, 0, OBS04,
+            f"{what} on stall record state {attr!r} outside "
+            "stallprofiler.py — per-record stall attribution has exactly "
+            "one writer (StallProfiler.finalize); seams report through "
+            "mark_gap/note_stall instead",
+        )

@@ -216,6 +216,25 @@ class SchedulerServer:
                     self._send(200, json.dumps(
                         telemetry.snapshot(), indent=2
                     ), "application/json")
+                elif self.path.startswith("/debug/stalls"):
+                    # stall profiler zpage: per-wave wall-clock attribution
+                    # (overlap + named stall reasons), the dominant reason,
+                    # and the slowest wave's critical path; ?last=N bounds
+                    # the per-wave rows
+                    from urllib.parse import parse_qs, urlparse
+
+                    profiler = (
+                        server.scheduler.flight_recorder.stall_profiler
+                    )
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        last = int(q.get("last", ["10"])[0])
+                    except ValueError:
+                        self._send(400, "last must be an integer")
+                        return
+                    self._send(200, json.dumps(
+                        profiler.snapshot(last=last), indent=2
+                    ), "application/json")
                 elif self.path.startswith("/debug/traces"):
                     # OTLP-shaped span export (the /debug/traces zpage);
                     # ?last=N bounds to the most recent N root spans

@@ -1,0 +1,134 @@
+"""Host calibration: a fixed, seeded micro-benchmark scoring the box.
+
+ROADMAP 6(a): the same bench workload reads 993-1185 pods/s at identical
+device columns across runs — host box drift, not a scheduler regression —
+and every such delta costs a human judgment call at gate time. Following
+the MLPerf TPU-pod methodology (normalize measurements across hosts
+before comparing them), every bench artifact row is stamped with a
+`host_calibration_score` measured at bench start, and
+`perf/regression_gate.py` normalizes throughput/latency comparisons by
+the score ratio, flagging (not failing) rows whose calibration drifted
+more than CALIBRATION_DRIFT_FLAG.
+
+The workload is deliberately boring and dependency-light: a seeded
+pure-Python pass (sort / dict churn / arithmetic — the interpreter-bound
+half of the scheduler's host path) plus a seeded numpy pass (matmul /
+argsort — the vectorized half). No jax, no device, no network; a few ms per
+repeat, best-of-N so scheduler noise on the box reads as the slow
+outliers it is. Scores are relative: 1.0 is the reference box that
+anchored _REFERENCE_SECONDS, >1 is faster, <1 is slower.
+
+`wall_budget()` is the test-suite hook (tier-1 `test_scale_churn`): a
+wall-clock bound calibrated on a fast box scales UP on a slower one
+instead of flaking, and never scales down below the authored bound.
+"""
+
+from __future__ import annotations
+
+import time
+
+# Wall seconds one _microbench_once() pass takes on the reference box
+# (the box that anchored the BENCH_r10 artifact row). score =
+# _REFERENCE_SECONDS / measured, so the reference box scores ~1.0.
+_REFERENCE_SECONDS = 0.0031
+
+# calibration drift beyond this ratio gets FLAGGED (never failed) by the
+# regression gate — past it, normalized comparisons carry real error bars
+CALIBRATION_DRIFT_FLAG = 0.25
+
+_SEED = 20260807
+_PY_N = 12_000
+_NP_DIM = 128
+
+_cached_score: float | None = None
+
+
+def _microbench_once(seed: int = _SEED) -> float:
+    """One seeded pass; returns its wall seconds (perf_counter).
+
+    Input data comes from a Knuth multiplicative hash, not the random
+    module — scrambled enough that the sort does real work, with no rng
+    stream anywhere near the scheduler's seeded tie-break (RNG01)."""
+    data = [((i + seed) * 2654435761) & ((1 << 30) - 1)
+            for i in range(_PY_N)]
+    t0 = time.perf_counter()
+    # interpreter-bound half: sort, dict churn, arithmetic
+    data.sort()
+    table: dict[int, int] = {}
+    acc = 0
+    for i, v in enumerate(data):
+        table[v & 0x3FF] = i
+        acc += v % 97
+    acc += sum(table.values())
+    # vectorized half: seeded matmul + argsort (numpy ships in the image;
+    # no jax — calibration must run before any device touch)
+    import numpy as np
+
+    arr = np.random.default_rng(seed).random((_NP_DIM, _NP_DIM))
+    for _ in range(4):
+        arr = arr @ arr
+        arr /= np.max(arr)
+    order = np.argsort(arr, axis=None)
+    acc += int(order[0]) + int(arr[0, 0] * 0)
+    dt = time.perf_counter() - t0
+    assert acc != 0  # keep the work observable
+    return dt
+
+
+def host_calibration_score(repeats: int = 3, refresh: bool = False) -> float:
+    """Best-of-`repeats` calibration score for this host (cached per
+    process — bench drivers stamp many rows from one measurement)."""
+    global _cached_score
+    if _cached_score is not None and not refresh:
+        return _cached_score
+    best = min(_microbench_once() for _ in range(max(1, repeats)))
+    _cached_score = round(_REFERENCE_SECONDS / best, 4) if best > 0 else 1.0
+    return _cached_score
+
+
+def stamp(row: dict, score: float | None = None) -> dict:
+    """Stamp `host_calibration_score` into a bench artifact row (in
+    place, returned for chaining)."""
+    row["host_calibration_score"] = (
+        score if score is not None else host_calibration_score()
+    )
+    return row
+
+
+def wall_budget(budget_s: float, score: float | None = None) -> float:
+    """Scale an authored wall-clock budget by measured host speed: a
+    slower box (score < 1) gets proportionally more time; a faster box
+    keeps the authored bound (budgets never tighten below what a human
+    signed off on)."""
+    s = host_calibration_score() if score is None else score
+    return budget_s / min(max(s, 1e-6), 1.0)
+
+
+def drift_ratio(old_score: float, new_score: float) -> float:
+    """Relative calibration drift between two artifact rows' scores."""
+    if not old_score or not new_score:
+        return 0.0
+    return abs(new_score - old_score) / old_score
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.perf.calibrate",
+        description="Host calibration micro-benchmark",
+    )
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args(argv)
+    score = host_calibration_score(repeats=args.repeats, refresh=True)
+    print(json.dumps({
+        "host_calibration_score": score,
+        "reference_seconds": _REFERENCE_SECONDS,
+        "budget_example_5s": round(wall_budget(5.0, score), 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,10 +15,21 @@ row regressed by more than the tolerance (default 10%):
 - SLI pass flags (sli_p50_ok, sli_p99_ok): true -> false is a regression
   outright — a blown target never hides inside the tolerance band
 
+When both rows carry a host_calibration_score (perf/calibrate.py stamps
+one into every artifact row), wall-clock comparisons are NORMALIZED by
+the score ratio before the tolerance check: throughput is scaled to the
+old host's speed (new * old_score/new_score), latency the other way
+(new * new_score/old_score). Device keys (bytes, compile counts) are
+host-independent and never normalized. A score drift beyond 25% between
+the artifacts is FLAGGED in the output — flagged, never failed: drift
+means the hosts differ, not that the code regressed.
+
 When a row regresses and both artifacts carry the pod latency ledger's
 "segments" breakdown, the gate names the segment whose p50 delta explains
 the regression — the first question of any perf triage, answered
-mechanically.
+mechanically. If the rows carry the stall profiler's per-reason columns
+(stall_*_s), the gate also names the stall reason whose attributed
+seconds grew the most.
 
 Artifacts come in three shapes, all accepted:
 - a raw JSON line (bench.py stdout saved to a file)
@@ -132,45 +143,107 @@ def _explain(old: dict, new: dict) -> str | None:
             f"(+{worst_delta:.4f}s)")
 
 
+def _explain_stalls(old: dict, new: dict) -> str | None:
+    """Name the stall reason whose attributed seconds grew the most.
+
+    Both rows must carry the stall profiler's per-reason stall_<reason>_s
+    columns (stallprofiler.bench_columns); falls back to the new row's
+    stall_dominant when no per-reason delta stands out.
+    """
+    worst, worst_delta = None, 0.0
+    for key, nv in new.items():
+        if not (key.startswith("stall_") and key.endswith("_s")
+                and key != "stall_total_s"):
+            continue
+        ov = _num(old, key)
+        if ov is None or not isinstance(nv, (int, float)):
+            continue
+        delta = nv - ov
+        if delta > worst_delta:
+            worst, worst_delta = key[len("stall_"):-len("_s")], delta
+    if worst is not None:
+        return (f"stall '{worst}' grew the most "
+                f"(+{worst_delta:.4f}s attributed)")
+    dom = new.get("stall_dominant")
+    if isinstance(dom, str) and dom:
+        return f"dominant stall in the new run: '{dom}'"
+    return None
+
+
+def _cal_scores(old: dict, new: dict) -> tuple[float, float] | None:
+    """(old_score, new_score) when BOTH rows are calibration-stamped."""
+    ov, nv = _num(old, "host_calibration_score"), _num(new, "host_calibration_score")
+    if ov is not None and nv is not None and ov > 0 and nv > 0:
+        return ov, nv
+    return None
+
+
 def compare(old_rows: dict[str, dict], new_rows: dict[str, dict],
-            tolerance: float = TOLERANCE) -> list[str]:
-    """Regression messages (empty = gate passes)."""
+            tolerance: float = TOLERANCE,
+            notes: list[str] | None = None) -> list[str]:
+    """Regression messages (empty = gate passes).
+
+    `notes`, when given, collects non-failing observations: calibration
+    drift flags and which rows were compared under normalization.
+    """
+    from .calibrate import CALIBRATION_DRIFT_FLAG, drift_ratio
+
     failures: list[str] = []
+    drift_noted = False
     for metric in sorted(set(old_rows) & set(new_rows)):
         old, new = old_rows[metric], new_rows[metric]
-        checks: list[tuple[str, float, float, bool, str]] = []
+        cal = _cal_scores(old, new)
+        if (cal is not None and notes is not None and not drift_noted
+                and drift_ratio(cal[0], cal[1]) > CALIBRATION_DRIFT_FLAG):
+            notes.append(
+                f"CALIBRATION DRIFT host_calibration_score "
+                f"{cal[0]:g} -> {cal[1]:g} "
+                f"({(cal[1] / cal[0] - 1) * 100:+.1f}%, flag threshold "
+                f"{CALIBRATION_DRIFT_FLAG:.0%}): the hosts differ; "
+                f"wall-clock rows compared calibration-normalized")
+            drift_noted = True
+        # (key, old, new, normalized new, higher_better, unit suffix)
+        checks: list[tuple[str, float, float, float, bool, str]] = []
         unit = str(old.get("unit", ""))
         if unit.startswith("pods/s"):
             ov, nv = _num(old, "value"), _num(new, "value")
             if ov is not None and nv is not None:
-                checks.append(("value", ov, nv, True, ""))  # higher is better
+                # throughput scales WITH host speed: express the new number
+                # at the old host's speed before judging it
+                adj = nv * cal[0] / cal[1] if cal else nv
+                checks.append(("value", ov, nv, adj, True, ""))
         for key in LATENCY_KEYS:
             ov, nv = _num(old, key), _num(new, key)
             if ov is not None and nv is not None:
-                checks.append((key, ov, nv, False, "s"))  # lower is better
+                # latency scales AGAINST host speed
+                adj = nv * cal[1] / cal[0] if cal else nv
+                checks.append((key, ov, nv, adj, False, "s"))
         for key in DEVICE_KEYS:
             ov, nv = _num(old, key), _num(new, key)
             if ov is not None and nv is not None:
-                checks.append((key, ov, nv, False, ""))  # lower is better
-        for key, ov, nv, higher_better, suf in checks:
+                # bytes / compile counts are host-independent: never adjust
+                checks.append((key, ov, nv, nv, False, ""))
+        for key, ov, nv, adj, higher_better, suf in checks:
             if higher_better:
-                bad = nv < ov * (1.0 - tolerance)
+                bad = adj < ov * (1.0 - tolerance)
             else:
-                bad = nv > ov * (1.0 + tolerance) and nv - ov > 1e-9
+                bad = adj > ov * (1.0 + tolerance) and adj - ov > 1e-9
             arrow = f"{ov:g}{suf} -> {nv:g}{suf}" + (
                 f" ({(nv / ov - 1) * 100:+.1f}%)" if ov else "")
+            if adj != nv:
+                arrow += f" [normalized {adj:g}{suf}]"
             if bad:
                 msg = f"{metric}.{key}: {arrow} exceeds {tolerance:.0%} tolerance"
-                why = _explain(old, new)
-                if why:
-                    msg += f"; {why}"
+                for why in (_explain(old, new), _explain_stalls(old, new)):
+                    if why:
+                        msg += f"; {why}"
                 failures.append(msg)
         for key in OK_KEYS:
             if old.get(key) is True and new.get(key) is False:
                 msg = f"{metric}.{key}: SLI target newly blown (true -> false)"
-                why = _explain(old, new)
-                if why:
-                    msg += f"; {why}"
+                for why in (_explain(old, new), _explain_stalls(old, new)):
+                    if why:
+                        msg += f"; {why}"
                 failures.append(msg)
     return failures
 
@@ -183,7 +256,10 @@ def run_gate(old_path: str, new_path: str,
         print(f"bench-gate: no common metrics between {old_path} and "
               f"{new_path}; nothing to compare (pass)")
         return 0
-    failures = compare(old_rows, new_rows, tolerance)
+    notes: list[str] = []
+    failures = compare(old_rows, new_rows, tolerance, notes=notes)
+    for note in notes:
+        print(f"bench-gate: FLAG {note}")
     if failures:
         print(f"bench-gate: FAIL ({new_path} vs {old_path}, "
               f"{len(common)} common rows)")

@@ -75,6 +75,11 @@ def run_trace_bench(shape: str = "poisson", seed: int = 7,
     from ..store.store import Store
     from ..testing.chaos import ArrivalTrace
     from ..testing.wrappers import make_node, make_pod
+    from .calibrate import host_calibration_score
+
+    # calibrate BEFORE the workload touches the box (and before any jax
+    # work heats it) — the score rides into the row at the end
+    calibration = host_calibration_score()
 
     store = Store()
     for i in range(nodes):
@@ -179,6 +184,13 @@ def run_trace_bench(shape: str = "poisson", seed: int = 7,
     # changing any scheduling decision — the gate bounds them at ±10%)
     row.update(sched.flight_recorder.device_telemetry.bench_columns(
         sched.flight_recorder.phase_snapshot().get("waves", 0)))
+    # stall attribution columns (wall-clock diagnostics — NEVER added to
+    # DETERMINISTIC_KEYS): when the overlap ratio collapses, stall_dominant
+    # names the guilty reason right in the bench row
+    row.update(sched.flight_recorder.stall_profiler.bench_columns())
+    # host calibration score (measured at bench start): the gate
+    # normalizes cross-box comparisons by this (perf/calibrate.py)
+    row["host_calibration_score"] = calibration
     return row
 
 
@@ -202,10 +214,18 @@ def _smoke() -> int:
     row = run_trace_bench(shape="poisson", seed=7, pods=200)
     device_keys = ("upload_bytes_per_wave", "compile_count",
                    "mem_watermark_bytes")
+    stall_keys = ("stall_dominant", "stall_coverage_p50", "stall_total_s",
+                  "host_calibration_score")
     missing = [k for k in DETERMINISTIC_KEYS + ("segments",) + device_keys
-               if k not in row]
+               + stall_keys if k not in row]
     if missing:
         print(json.dumps({"smoke": "FAIL", "missing_keys": missing}))
+        return 1
+    if (row["stall_coverage_p50"] or 0.0) < 0.95:
+        print(json.dumps({"smoke": "FAIL",
+                          "error": "stall attribution covers "
+                                   f"{row['stall_coverage_p50']!r} < 0.95 "
+                                   "of per-wave wall time"}))
         return 1
     if not (row["upload_bytes_per_wave"] > 0 and row["compile_count"] > 0
             and row["mem_watermark_bytes"] > 0):

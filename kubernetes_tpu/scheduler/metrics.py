@@ -261,6 +261,22 @@ class SchedulerMetrics:
             "accounting, source=jax from memory_stats when available)",
             labels=("source",),
         )
+        # pipeline stall profiler (per-wave wall-clock decomposition into
+        # overlap + named stall reasons; emitted by
+        # scheduler/tpu/stallprofiler.py — OBS04 keeps STALL_SERIES and
+        # the STALL_REASONS literal set in sync)
+        self.pipeline_stall_seconds = r.histogram(
+            "scheduler_tpu_pipeline_stall_seconds",
+            "Per-wave streaming-pipeline stall seconds, by reason "
+            "(queue_empty|capacity_gate|prep_serialized|device_busy|"
+            "flush|bind_backpressure)",
+            labels=("reason",),
+        )
+        self.pipeline_stall_total = r.gauge(
+            "scheduler_tpu_pipeline_stall_total_seconds",
+            "Cumulative streaming-pipeline stall seconds, by reason",
+            labels=("reason",),
+        )
         # event recorder (satellite: spill/aggregation visibility)
         self.events_total = r.counter(
             "scheduler_events_total",

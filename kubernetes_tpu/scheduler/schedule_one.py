@@ -494,6 +494,7 @@ class ScheduleOneLoop:
         # a dumped backlog still fills max_pods
         active, _, _ = self.queue.pending_pods()
         target = self.wave_controller.next_size(active, cap=max_pods)
+        clipped = self.wave_controller.last_clipped
         with self.recorder.phase("pop"):
             while len(wave) < target:
                 qpi = self.queue.pop(
@@ -535,6 +536,14 @@ class ScheduleOneLoop:
                     break
 
         if not wave:
+            # nothing to prep a successor from: whatever is in flight sat
+            # (and drains now) because the queue ran dry — the stall
+            # profiler attributes its open gap to queue_empty
+            infl = self._inflight_wave
+            if infl is not None or trailer is not None:
+                self.recorder.stall_profiler.mark_gap(
+                    infl[1].record if infl is not None else None,
+                    "queue_empty")
             processed = self._flush_wave_pipeline()
             if trailer is not None:
                 self.schedule_pod_info(trailer)
@@ -550,9 +559,22 @@ class ScheduleOneLoop:
         while pad_to < len(wave):
             pad_to <<= 1
         processed = self._pipeline_wave(wave_algo, wave, min(pad_to, max_pods))
+        if clipped:
+            # the controller wanted more slots than the per-call cap
+            # allowed (the ticked trace regime's one-wave-per-tick gate):
+            # the launched wave will sit in flight while the clipped
+            # backlog waits for the next tick — attribute its gap
+            infl = self._inflight_wave
+            if infl is not None:
+                self.recorder.stall_profiler.mark_gap(
+                    infl[1].record, "capacity_gate")
         if trailer is not None:
             # the trailer (gang/claim/nominated pod) must run strictly after
             # the wave that preceded it in queue order
+            infl = self._inflight_wave
+            if infl is not None:
+                self.recorder.stall_profiler.mark_gap(
+                    infl[1].record, "flush")
             processed += self._flush_wave_pipeline()
             self.schedule_pod_info(trailer)
             processed += 1
@@ -573,6 +595,7 @@ class ScheduleOneLoop:
             # incompatible in-flight wave (different profile, different
             # program shape — the tie-word frame sizing assumes equal pads —
             # or a poisoned carry): drain before launching
+            self.recorder.stall_profiler.mark_gap(infl[1].record, "flush")
             processed += self._flush_wave_pipeline()
 
         breaker = getattr(algo, "breaker", None)
@@ -582,6 +605,9 @@ class ScheduleOneLoop:
             # and run the wave per-pod; while the breaker is cooling,
             # schedule_pod's device_blocked() check routes each pod to the
             # host tier
+            infl = self._inflight_wave
+            self.recorder.stall_profiler.mark_gap(
+                infl[1].record if infl is not None else None, "flush")
             processed += self._flush_wave_pipeline()
             with self.recorder.phase("finish"), self.recorder.\
                     fallback_attribution(self.framework_for_pod(wave[0].pod)):
@@ -605,6 +631,9 @@ class ScheduleOneLoop:
             except NeedResync:
                 # drain the pipeline (its phases self-account), re-upload
                 # from host truth, retry once
+                infl = self._inflight_wave
+                self.recorder.stall_profiler.mark_gap(
+                    infl[1].record if infl is not None else None, "flush")
                 processed += self._flush_wave_pipeline()
                 algo.backend.invalidate_carry()
                 with self.recorder.phase("snapshot"):
@@ -623,6 +652,9 @@ class ScheduleOneLoop:
                     # no device verdict either way (resync exhaustion,
                     # benign fallback): release a half-open probe slot
                     breaker.record_benign()
+            infl = self._inflight_wave
+            self.recorder.stall_profiler.mark_gap(
+                infl[1].record if infl is not None else None, "flush")
             processed += self._flush_wave_pipeline()
             algo.fallback_count += len(wave)
             with self.recorder.phase("finish"), self.recorder.\
@@ -693,6 +725,10 @@ class ScheduleOneLoop:
                     # successor now rather than holding it in flight through
                     # the cooldown — its pods reroute to the host tier in
                     # queue order right behind this wave's
+                    infl = self._inflight_wave
+                    rec.stall_profiler.mark_gap(
+                        infl[1].record if infl is not None else None,
+                        "flush")
                     return len(wave) + self._flush_wave_pipeline()
                 return len(wave)
             if breaker is not None:
@@ -1368,19 +1404,24 @@ class ScheduleOneLoop:
             # budget (KUBE_TPU_BIND_WAIT_S) is burned in short slices so a
             # stalled dispatcher is logged while it stalls, not 30s later
             deadline = _time.monotonic() + BIND_WAIT_S
-            while not call.done.wait(
-                timeout=min(_BIND_WAIT_SLICE_S,
-                            max(0.0, deadline - _time.monotonic()))
-            ):
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    return Status.as_error(TimeoutError(
-                        f"async bind of {pod.meta.key} timed out after "
-                        f"{BIND_WAIT_S}s (KUBE_TPU_BIND_WAIT_S)"
-                    ))
-                _log.error("async bind still pending; waiting",
-                           pod=pod.meta.key, node=host,
-                           remaining_s=round(remaining, 1))
+            # the dispatcher in-flight wait is pipeline backpressure: the
+            # loop can't prep a successor while it sits here, so the time
+            # lands on the stall profiler's cumulative bind_backpressure
+            with self.recorder.stall_profiler.stall(None,
+                                                    "bind_backpressure"):
+                while not call.done.wait(
+                    timeout=min(_BIND_WAIT_SLICE_S,
+                                max(0.0, deadline - _time.monotonic()))
+                ):
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return Status.as_error(TimeoutError(
+                            f"async bind of {pod.meta.key} timed out after "
+                            f"{BIND_WAIT_S}s (KUBE_TPU_BIND_WAIT_S)"
+                        ))
+                    _log.error("async bind still pending; waiting",
+                               pod=pod.meta.key, node=host,
+                               remaining_s=round(remaining, 1))
             if call.error is not None:
                 return Status.as_error(call.error)
             return Status()
@@ -1480,6 +1521,9 @@ class ScheduleOneLoop:
     def wait_for_bindings(self) -> None:
         # a launched-but-uncollected wave holds popped pods — never leave it
         # behind (its pods would be lost to the queue's accounting)
+        infl = self._inflight_wave
+        if infl is not None:
+            self.recorder.stall_profiler.mark_gap(infl[1].record, "flush")
         self._flush_wave_pipeline()
         for t in self._binding_threads:
             t.join(timeout=5)

@@ -1002,6 +1002,10 @@ class TPUBackend:
         # flight, every host prep phase above (sync/features/upload/dedup/
         # tie/dispatch) ran while the device executed it — hidden time
         self.recorder.note_pipeline(rec, overlapped=prev is not None)
+        # stall profiler: the double-buffer handoff bit (chained launch
+        # vs cold launch into an idle device) — host-side bookkeeping
+        self.recorder.stall_profiler.note_handoff(rec,
+                                                  chained=prev is not None)
         return fl
 
     def collect(self, fl: InflightWave, rng=None):

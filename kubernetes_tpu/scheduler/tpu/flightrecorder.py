@@ -38,6 +38,7 @@ from ...utils.envknob import float_env, int_env
 from ...utils.tracing import Tracer
 from .devicetelemetry import DeviceTelemetry
 from .podlatency import PodLatencyLedger
+from .stallprofiler import StallProfiler
 
 # loop-level pipeline phases (the phase_profile bench.py reports)
 LOOP_PHASES = ("snapshot", "kernel", "finish", "bind", "pump", "events",
@@ -103,12 +104,23 @@ class WaveRecord:
     mem_watermark_bytes: int = 0
     phases: dict = field(default_factory=dict)  # phase -> seconds
     duration_s: float = 0.0
+    # stall attribution (stallprofiler.py — the ONLY writer of these
+    # fields, enforced by kubesched-lint OBS04): wall-clock decomposition
+    # into named stall reasons, its coverage of duration_s, and the
+    # largest contributor
+    stall_by_reason: dict = field(default_factory=dict)
+    stall_coverage: float = 0.0
+    stall_dominant: str | None = None
     profile: str | None = None  # watchdog pprof capture, when triggered
     # internal bookkeeping (not serialized)
     _t0: float = 0.0
     _inv_base: int = 0
     _fault_base: int = 0
     _retry_base: int = 0
+    # stall-profiler scratch (written only in stallprofiler.py: OBS04)
+    _stall_acc: dict = field(default_factory=dict)
+    _stall_mark: str | None = None
+    _stall_done: bool = False
 
     def to_dict(self) -> dict:
         d = {
@@ -142,6 +154,10 @@ class WaveRecord:
             "fetch_by_plane": dict(self.fetch_by_plane),
             "mem_watermark_bytes": self.mem_watermark_bytes,
             "phases": {k: round(v, 6) for k, v in self.phases.items()},
+            "stall_by_reason": {k: round(v, 6)
+                                for k, v in self.stall_by_reason.items()},
+            "stall_coverage": round(self.stall_coverage, 4),
+            "stall_dominant": self.stall_dominant,
         }
         if self.profile is not None:
             d["profile"] = self.profile
@@ -167,6 +183,9 @@ class FlightRecorder:
         # device-side accounting: transfer ledger, compile tracker,
         # memory watermark (README "Device telemetry")
         self.device_telemetry = DeviceTelemetry(metrics=metrics)
+        # streaming-wave stall attribution: per-wave wall-clock decomposed
+        # into overlap + named stall reasons (README "Streaming waves")
+        self.stall_profiler = StallProfiler(metrics=metrics)
         self.slow_wave_deadline_s = slow_wave_deadline_s or None
         self.profile_seconds = profile_seconds
         # cumulative phase stopwatches (the dicts bench.py diffs)
@@ -401,6 +420,10 @@ class FlightRecorder:
             rec.retries = self.retries_total - rec._retry_base
             self.wave_sizes[rec.pad] = self.wave_sizes.get(rec.pad, 0) + 1
             self._records.append(rec)
+        # stall attribution closes with the record: duration/phases are
+        # final here, and the decomposition must land before the metrics
+        # pass reads stall_by_reason
+        self.stall_profiler.finalize(rec)
         m = self.metrics
         if m is not None:
             if hasattr(m, "wave_completed"):
@@ -478,6 +501,7 @@ class FlightRecorder:
             "wave_max_s": round(durations[-1], 4) if durations else None,
             "pipeline_overlap_ratio": self.pipeline_overlap_ratio(),
             "wave_size_hist": self.wave_size_histogram(),
+            "stalls": self.stall_profiler.summary(),
         }
 
     # -- dump hook (cache/debugger.py pattern) --------------------------------
@@ -494,6 +518,7 @@ class FlightRecorder:
                             for k, v in self.wave_snapshot().items()},
             "pod_latency": self.pod_ledger.snapshot(slowest=8),
             "device_telemetry": self.device_telemetry.snapshot(),
+            "stalls": self.stall_profiler.snapshot(last=8),
             "records": [r.to_dict() for r in self.records(last)],
         }, indent=2)
 
@@ -573,6 +598,14 @@ def _demo() -> FlightRecorder:
         # wave 0 launches into an idle device; every later wave's prep
         # overlaps the (synthetic) in-flight predecessor
         rec.note_pipeline(wr, overlapped=bool(i))
+        # stall attribution, driven exactly as the loop drives it: gap
+        # marks at the seams (queue ran dry, per-tick cap, forced drain)
+        if i == 2:
+            rec.stall_profiler.mark_gap(wr, "queue_empty")
+        elif i == 5:
+            rec.stall_profiler.mark_gap(wr, "capacity_gate")
+        elif i == 7:
+            rec.stall_profiler.mark_gap(wr, "flush")
         with rec.phase("kernel", wr):
             if i == 4:
                 time.sleep(0.12)  # trip the watchdog once
@@ -633,6 +666,19 @@ def main(argv: list[str] | None = None) -> int:
                 or telemetry["memory"]["watermark_bytes"] <= 0:
             print("FAIL: device telemetry totals: "
                   + json.dumps(telemetry, indent=2))
+            return 1
+        # stall-attribution block: every wave decomposed, coverage holds
+        stalls = payload.get("stalls", {}).get("summary")
+        if not isinstance(stalls, dict):
+            print("FAIL: dump payload is missing 'stalls'")
+            return 1
+        uncovered = [r["wave_id"] for r in records
+                     if "stall_by_reason" not in r
+                     or r.get("stall_coverage", 0.0) < 0.95]
+        if uncovered or stalls.get("waves_profiled", 0) <= 0 \
+                or (stalls.get("coverage_min") or 0.0) < 0.95:
+            print(f"FAIL: stall attribution: uncovered={uncovered} "
+                  f"summary={json.dumps(stalls)}")
             return 1
     elif args.dump:
         import sys

@@ -70,6 +70,12 @@ class WaveSizeController:
         self._soft_max: int | None = None
         # decision trail for bench/debug dumps (bounded)
         self.sized_waves = 0
+        # capacity-gate signal for the stall profiler: True when the last
+        # next_size() wanted more slots than the caller's cap allowed —
+        # the ticked trace regime's per-tick gate. Deterministic (queue
+        # depth in, bool out); the profiler only reads it.
+        self.last_clipped = False
+        self.capped_waves = 0
 
     def next_size(self, backlog: int, cap: int) -> int:
         """Target pod count for the next wave.
@@ -86,6 +92,9 @@ class WaveSizeController:
         # +1: the pod about to be popped may not be counted as active yet
         target = _next_pow2(backlog + 1, self.min_pods)
         self.sized_waves += 1
+        self.last_clipped = target > ceiling
+        if self.last_clipped:
+            self.capped_waves += 1
         return max(1, min(target, ceiling))
 
     def observe(self, wave_duration_s: float) -> None:
@@ -110,4 +119,5 @@ class WaveSizeController:
             "latency_budget_s": self.latency_budget_s,
             "soft_max": self._soft_max,
             "sized_waves": self.sized_waves,
+            "capped_waves": self.capped_waves,
         }

@@ -289,6 +289,11 @@ class TraceSoakReport(SoakReport):
     evicted: int = 0
     wall_clock_s: float = 0.0
     budget_s: float = 0.0
+    # stall attribution (stallprofiler.py): every completed wave must
+    # decompose into overlap + named stalls covering >=95% of its wall
+    stall_waves: int = 0
+    stall_coverage_min: float = 0.0
+    stall_flush_events: int = 0
 
     @property
     def ok(self) -> bool:  # type: ignore[override]
@@ -303,6 +308,13 @@ class TraceSoakReport(SoakReport):
             and self.evicted >= 1
             and self.bound >= 1
             and self.wall_clock_s <= self.budget_s
+            # the profiler must have attributed EVERY wave's wall time
+            # (coverage invariant holds under chaos, not just clean runs),
+            # and a breaker trip must leave a 'flush' stall footprint —
+            # the trip drains the inflight wave, and that drain is a stall
+            and self.stall_waves >= 1
+            and self.stall_coverage_min >= 0.95
+            and (self.breaker_trips < 1 or self.stall_flush_events >= 1)
         )
 
     def render(self) -> str:
@@ -322,6 +334,9 @@ class TraceSoakReport(SoakReport):
             f"kubelet_outage_drops={self.kubelet_outage_drops} "
             f"nodes_unreachable_seen={self.nodes_unreachable_seen} "
             f"faults_fired={self.faults_fired} retries={self.retries} "
+            f"stall_waves={self.stall_waves} "
+            f"stall_coverage_min={self.stall_coverage_min:.4f} "
+            f"stall_flush_events={self.stall_flush_events} "
             f"wall_clock_s={self.wall_clock_s:.2f} (budget {self.budget_s})"
         )
 
@@ -453,6 +468,15 @@ def run_trace_soak(seed: int = 7, pods: int = 96, nodes: int = 12,
         (ev[2] for ev in partition_events), default=0.0
     )
     report.resync_repairs = report.partition_repairs
+    # stall attribution under chaos: every retained wave record must carry
+    # a >=95%-coverage decomposition, and the guaranteed breaker trip must
+    # have stamped at least one 'flush' stall (the trip's pipeline drain)
+    profiler = sched.flight_recorder.stall_profiler
+    wave_records = sched.flight_recorder.records()
+    report.stall_waves = profiler.waves_profiled
+    report.stall_coverage_min = min(
+        (r.stall_coverage for r in wave_records), default=0.0)
+    report.stall_flush_events = profiler.stall_events.get("flush", 0)
     sched.api_dispatcher.close()
     registry.reset()
     report.wall_clock_s = time.monotonic() - t_start

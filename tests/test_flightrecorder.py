@@ -72,7 +72,8 @@ class TestRingBuffer:
         assert set(rec.phases) == {"kernel", "dispatch"}
         payload = json.loads(fr.dump())
         assert set(payload) == {"summary", "phase_totals", "wave_totals",
-                                "pod_latency", "device_telemetry", "records"}
+                                "pod_latency", "device_telemetry", "stalls",
+                                "records"}
         (d,) = payload["records"]
         assert d["fallback_reason"] == "resync: planes changed"
         # internal bookkeeping must not leak into the serialized record

@@ -279,7 +279,8 @@ class TestFlightRecorderZpage:
             payload = json.loads(body)
             assert set(payload) == {"summary", "phase_totals",
                                     "wave_totals", "pod_latency",
-                                    "device_telemetry", "records"}
+                                    "device_telemetry", "stalls",
+                                    "records"}
             assert payload["records"], "scheduled waves must show up"
             assert len(payload["records"]) <= 2
 

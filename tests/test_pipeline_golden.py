@@ -247,6 +247,47 @@ class TestShardedDeltaGolden:
         assert sum(1 for v in local[0].values() if v) > 0
 
 
+class TestStallProfilerGolden:
+    def test_profiler_on_off_bit_identical(self, monkeypatch):
+        """The stall profiler is an observer: running the same streamed
+        scenario with KUBE_TPU_STALL_PROFILER=0 must produce bit-identical
+        placements, diagnoses, and tie-break rng position — attribution
+        may cost wall time, never a decision."""
+        kw = dict(depth=2, dedup=True, spread=True)
+        monkeypatch.delenv("KUBE_TPU_STALL_PROFILER", raising=False)
+        on = _run_stream(monkeypatch, **kw)
+        monkeypatch.setenv("KUBE_TPU_STALL_PROFILER", "0")
+        off = _run_stream(monkeypatch, **kw)
+        monkeypatch.delenv("KUBE_TPU_STALL_PROFILER")
+        assert on[0] == off[0]
+        assert on[1] == off[1]
+        assert on[2] == off[2]
+        # the on arm genuinely profiled; the off arm attributed nothing
+        prof_on = on[3].flight_recorder.stall_profiler
+        prof_off = off[3].flight_recorder.stall_profiler
+        assert prof_on.enabled and prof_on.waves_profiled > 0
+        assert not prof_off.enabled and prof_off.waves_profiled == 0
+        assert all(r.stall_coverage == 0.0
+                   for r in off[3].flight_recorder.records())
+
+    def test_every_wave_covered_in_streamed_run(self, monkeypatch):
+        """Coverage invariant over a real pipelined run (not synthetic
+        clocks): every retained wave record decomposes into overlap +
+        named stalls explaining >=95% of its wall, stamped with a
+        dominant reason from the declared set."""
+        from kubernetes_tpu.scheduler.tpu.stallprofiler import STALL_REASONS
+
+        piped = _run_stream(monkeypatch, depth=2, dedup=True)
+        records = piped[3].flight_recorder.records()
+        assert records
+        for r in records:
+            assert 0.95 <= r.stall_coverage <= 1.05, (
+                r.wave_id, r.stall_coverage, r.stall_by_reason)
+            assert set(r.stall_by_reason) <= set(STALL_REASONS)
+            if r.duration_s > 0:
+                assert r.stall_dominant in (None, *STALL_REASONS)
+
+
 class TestBreakerTripMidFlight:
     def test_trip_drains_poisoned_successor(self, monkeypatch):
         """Three consecutive injected collect flakes trip the breaker

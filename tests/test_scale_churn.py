@@ -10,6 +10,7 @@ pods and bounded queue/watch-log memory.
 
 import os
 
+from kubernetes_tpu.perf.calibrate import wall_budget
 from kubernetes_tpu.perf.harness import WorkloadExecutor
 from kubernetes_tpu.scheduler import Profile, Scheduler
 from kubernetes_tpu.store.store import Store
@@ -20,7 +21,11 @@ _BASE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # CPU-mesh floors: the same workload sustains ~1700 pods/s and p99 ~1.5s on
 # one core (real-chip numbers are higher); a regression that halves
-# throughput or doubles tail latency fails CI, noise does not
+# throughput or doubles tail latency fails CI, noise does not. The p99
+# bound is authored for a reference-speed host and scaled at runtime by
+# the host calibration score (perf/calibrate.py): a slower CI box gets a
+# proportionally looser bound instead of a flake, a faster one never gets
+# a tighter bound than the authored one.
 SCALE_THRESHOLD_PODS_PER_S = 500.0
 SCALE_P99_BOUND_S = 5.0
 
@@ -53,8 +58,10 @@ def test_scale_2500_nodes_threshold_and_sli():
         f"throughput {result.throughput} below {SCALE_THRESHOLD_PODS_PER_S}"
     )
     sli = next(d for d in result.data_items if d.unit == "seconds")
-    assert sli.data["Perc99"] <= SCALE_P99_BOUND_S, (
-        f"SLI p99 {sli.data['Perc99']}s exceeds {SCALE_P99_BOUND_S}s"
+    p99_bound_s = wall_budget(SCALE_P99_BOUND_S)
+    assert sli.data["Perc99"] <= p99_bound_s, (
+        f"SLI p99 {sli.data['Perc99']}s exceeds {p99_bound_s}s "
+        f"(authored {SCALE_P99_BOUND_S}s, calibration-scaled)"
     )
     algo = ex.scheduler.algorithms["default-scheduler"]
     assert algo.fallback_count == 0, "scale workload must stay on the kernel"

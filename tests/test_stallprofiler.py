@@ -1,0 +1,344 @@
+"""Unit suite for the pipeline stall profiler + host calibration (PR 18).
+
+Synthetic-clock tests: WaveRecord-shaped stand-ins with authored wall
+clocks and phase stopwatches go through the full decompose path, so the
+coverage invariant (overlap + sum(stalls) ~= wall, two-sided: gaps AND
+double counting both fail) and the attribution rules (residual default,
+last-mark-wins, explicit interval folding) are pinned without sleeping.
+Plus the calibration scorer (perf/calibrate.py) and the regression gate's
+calibration-normalized comparisons.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.perf import calibrate
+from kubernetes_tpu.perf.calibrate import (
+    CALIBRATION_DRIFT_FLAG,
+    drift_ratio,
+    host_calibration_score,
+    stamp,
+    wall_budget,
+)
+from kubernetes_tpu.perf.regression_gate import compare
+from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
+from kubernetes_tpu.scheduler.tpu.stallprofiler import (
+    COVERAGE_FLOOR,
+    STALL_REASONS,
+    STALL_SERIES,
+    StallProfiler,
+    _synthetic_record,
+    critical_path,
+    critical_path_of_row,
+    critical_path_of_span,
+)
+
+
+def _profiler(**kw) -> StallProfiler:
+    p = StallProfiler(**kw)
+    p.enabled = True  # independent of the ambient env
+    return p
+
+
+def _attributed(rec) -> float:
+    return rec.overlap_s_attr + sum(rec.stall_by_reason.values())
+
+
+def _finalize(prof, rec):
+    prof.finalize(rec)
+    # finalize caps overlap at prep; recompute the capped value the same
+    # way for the invariant checks
+    prep = sum(rec.phases.get(p, 0.0)
+               for p in ("sync", "features", "upload", "dedup", "tie",
+                         "dispatch"))
+    rec.overlap_s_attr = min(rec.overlap_s, prep)
+    return rec
+
+
+class TestCoverageInvariant:
+    @pytest.mark.parametrize("wall,phases,overlap,mark", [
+        # healthy pipeline: prep hidden, device-bound
+        (1.0, {"sync": 0.05, "features": 0.15, "dispatch": 0.1,
+               "wait": 0.55, "finish": 0.05, "bind": 0.1}, 0.30, None),
+        # serial regime: no overlap at all
+        (1.0, {"sync": 0.2, "features": 0.3, "wait": 0.4, "bind": 0.1},
+         0.0, None),
+        # big unmarked gap
+        (2.0, {"wait": 0.1}, 0.0, None),
+        # big marked gap
+        (2.0, {"wait": 0.1}, 0.0, "capacity_gate"),
+        # zero-wall edge
+        (0.0, {}, 0.0, None),
+    ])
+    def test_overlap_plus_stalls_covers_wall(self, wall, phases, overlap,
+                                             mark):
+        prof = _profiler()
+        rec = _finalize(prof, _synthetic_record(
+            1, wall=wall, phases=phases, overlap_s=overlap, mark=mark))
+        total = _attributed(rec)
+        assert wall * COVERAGE_FLOOR <= total <= wall * (2 - COVERAGE_FLOOR) \
+            or wall == 0.0
+        assert rec.stall_coverage >= COVERAGE_FLOOR
+        assert set(rec.stall_by_reason) <= set(STALL_REASONS)
+
+    def test_double_counting_shows_as_excess_coverage(self):
+        """Coverage is honest both ways: an explicit interval that exceeds
+        the wall clock pushes coverage ABOVE 1 rather than being clamped —
+        the soak/golden two-sided assertions catch it."""
+        prof = _profiler()
+        rec = _synthetic_record(1, wall=1.0, phases={"wait": 0.5})
+        prof.note_stall(rec, "bind_backpressure", 2.0)
+        prof.finalize(rec)
+        assert rec.stall_coverage > 1.05
+
+    def test_zero_wall_coverage_is_one(self):
+        prof = _profiler()
+        rec = _finalize(prof, _synthetic_record(1, wall=0.0, phases={}))
+        assert rec.stall_coverage == 1.0
+
+
+class TestAttributionRules:
+    def test_unmarked_residual_defaults_to_device_busy(self):
+        prof = _profiler()
+        rec = _finalize(prof, _synthetic_record(
+            1, wall=1.0, phases={"sync": 0.1}, overlap_s=0.0))
+        # 0.1 prep_serialized + 0.9 residual -> device_busy
+        assert rec.stall_by_reason["device_busy"] == pytest.approx(0.9)
+        assert rec.stall_dominant == "device_busy"
+
+    def test_marked_residual_lands_on_mark(self):
+        prof = _profiler()
+        rec = _synthetic_record(1, wall=1.0, phases={"sync": 0.1})
+        prof.mark_gap(rec, "queue_empty")
+        prof.finalize(rec)
+        assert rec.stall_by_reason["queue_empty"] == pytest.approx(0.9)
+        assert rec.stall_dominant == "queue_empty"
+
+    def test_last_mark_wins(self):
+        prof = _profiler()
+        rec = _synthetic_record(1, wall=1.0, phases={})
+        prof.mark_gap(rec, "queue_empty")
+        prof.mark_gap(rec, "flush")
+        prof.finalize(rec)
+        assert rec.stall_by_reason["flush"] == pytest.approx(1.0)
+        # both seam events counted even though only one got the residual
+        assert prof.stall_events["queue_empty"] == 1
+        assert prof.stall_events["flush"] == 1
+
+    def test_overlap_capped_at_prep(self):
+        """overlap_s beyond measured prep can't mint negative
+        prep_serialized or over-attribute."""
+        prof = _profiler()
+        rec = _finalize(prof, _synthetic_record(
+            1, wall=1.0, phases={"sync": 0.2, "wait": 0.8}, overlap_s=5.0))
+        assert "prep_serialized" not in rec.stall_by_reason
+        assert rec.overlap_s_attr == pytest.approx(0.2)
+        assert rec.stall_coverage == pytest.approx(1.0)
+
+    def test_explicit_interval_folds_into_record(self):
+        prof = _profiler()
+        rec = _synthetic_record(1, wall=1.0, phases={"wait": 0.4})
+        prof.note_stall(rec, "bind_backpressure", 0.6)
+        prof.finalize(rec)
+        assert rec.stall_by_reason["bind_backpressure"] == pytest.approx(0.6)
+        assert rec.stall_dominant == "bind_backpressure"
+
+    def test_recordless_interval_lands_on_totals(self):
+        prof = _profiler()
+        prof.note_stall(None, "bind_backpressure", 0.25)
+        assert prof.stall_totals["bind_backpressure"] == pytest.approx(0.25)
+        assert prof.stall_events["bind_backpressure"] == 1
+        assert prof.waves_profiled == 0
+
+    def test_stall_contextmanager_times_block(self):
+        prof = _profiler()
+        rec = _synthetic_record(1, wall=1.0, phases={})
+        with prof.stall(rec, "bind_backpressure"):
+            pass
+        assert rec._stall_acc["bind_backpressure"] >= 0.0
+        assert prof.stall_events["bind_backpressure"] == 1
+
+    def test_undeclared_reason_rejected(self):
+        prof = _profiler()
+        rec = _synthetic_record(1, wall=1.0, phases={})
+        with pytest.raises(ValueError):
+            prof.mark_gap(rec, "coffee_break")
+        with pytest.raises(ValueError):
+            prof.note_stall(rec, "coffee_break", 0.1)
+
+    def test_finalize_idempotent(self):
+        prof = _profiler()
+        rec = _synthetic_record(1, wall=1.0, phases={"wait": 1.0})
+        prof.finalize(rec)
+        prof.finalize(rec)
+        assert prof.waves_profiled == 1
+        assert prof.wall_s_total == pytest.approx(1.0)
+
+    def test_disabled_profiler_is_inert(self, monkeypatch):
+        monkeypatch.setenv("KUBE_TPU_STALL_PROFILER", "0")
+        prof = StallProfiler()
+        assert not prof.enabled
+        rec = _synthetic_record(1, wall=1.0, phases={"wait": 1.0})
+        prof.mark_gap(rec, "flush")
+        prof.note_stall(rec, "flush", 0.5)
+        with prof.stall(rec, "flush"):
+            pass
+        prof.finalize(rec)
+        assert prof.waves_profiled == 0
+        assert rec.stall_by_reason == {}
+        assert rec.stall_coverage == 0.0
+        assert all(v == 0 for v in prof.stall_events.values())
+
+
+class TestCriticalPath:
+    def _rows(self):
+        prof = _profiler()
+        r1 = _synthetic_record(1, wall=1.0, phases={"wait": 0.9,
+                                                    "sync": 0.1})
+        r2 = _synthetic_record(2, wall=3.0, phases={"sync": 0.2},
+                               mark="capacity_gate")
+        r3 = _synthetic_record(3, wall=0.5, phases={}, mark="flush")
+        for r in (r1, r2, r3):
+            prof.finalize(r)
+        return prof, [{
+            "wave_id": r.wave_id, "duration_s": r.duration_s,
+            "overlap_s": r.overlap_s, "stall_by_reason": r.stall_by_reason,
+            "stall_dominant": r.stall_dominant,
+        } for r in (r1, r2, r3)]
+
+    def test_guilty_is_largest_summed_reason(self):
+        _, rows = self._rows()
+        cp = critical_path(rows)
+        assert cp["guilty"] == "capacity_gate"
+        assert cp["waves"] == 3
+        assert cp["critical_wave"]["wave_id"] == 2
+        assert cp["chain"][0]["edge"] == "capacity_gate"
+
+    def test_empty_records(self):
+        cp = critical_path([])
+        assert cp == {"waves": 0, "guilty": None, "chain": []}
+        assert critical_path([{"wave_id": 9}])["waves"] == 0
+
+    def test_row_chain_ordered_by_seconds(self):
+        path = critical_path_of_row({
+            "wave_id": 7, "wall_s": 1.0, "overlap_s": 0.2,
+            "stall_by_reason": {"flush": 0.1, "device_busy": 0.7},
+            "dominant": "device_busy",
+        })
+        edges = [e["edge"] for e in path["chain"]]
+        assert edges == ["overlap", "device_busy", "flush"]
+        assert path["dominant"] == "device_busy"
+
+    def test_span_chain_descends_longest_child(self):
+        class N:
+            def __init__(self, name, duration_s, children=()):
+                self.name = name
+                self.duration_s = duration_s
+                self.children = list(children)
+
+        root = N("wave/1", 1.0, [
+            N("phase/kernel", 0.8, [N("wave_phase/wait", 0.7)]),
+            N("phase/bind", 0.1),
+        ])
+        chain = critical_path_of_span(root)
+        assert [e["edge"] for e in chain] == ["phase/kernel",
+                                              "wave_phase/wait"]
+
+    def test_snapshot_and_bench_columns_schema(self):
+        prof, _ = self._rows()
+        snap = prof.snapshot(last=2)
+        assert snap["summary"]["waves_profiled"] == 3
+        assert len(snap["last"]) == 2
+        assert snap["critical_path"]["wave_id"] == 2
+        cols = prof.bench_columns()
+        assert cols["stall_dominant"] == "capacity_gate"
+        for reason in STALL_REASONS:
+            assert f"stall_{reason}_s" in cols
+        assert cols["stall_total_s"] > 0
+
+    def test_metrics_emission_uses_declared_series(self):
+        metrics = SchedulerMetrics()
+        prof = _profiler(metrics=metrics)
+        rec = _synthetic_record(1, wall=1.0, phases={"wait": 1.0})
+        prof.finalize(rec)
+        hist = metrics.registry.get(STALL_SERIES[0])
+        gauge = metrics.registry.get(STALL_SERIES[1])
+        assert hist.count("device_busy") == 1
+        assert gauge.get("device_busy") == pytest.approx(1.0)
+
+
+class TestCalibration:
+    def test_score_positive_and_cached(self):
+        s1 = host_calibration_score()
+        s2 = host_calibration_score()
+        assert s1 > 0
+        assert s1 == s2 == calibrate._cached_score
+
+    def test_stamp(self):
+        row = stamp({}, score=1.25)
+        assert row["host_calibration_score"] == 1.25
+
+    def test_wall_budget_never_tightens(self):
+        assert wall_budget(5.0, score=2.0) == 5.0  # fast box: authored
+        assert wall_budget(5.0, score=1.0) == 5.0
+        assert wall_budget(5.0, score=0.5) == 10.0  # 2x slower: 2x budget
+        assert wall_budget(5.0) >= 5.0  # live score, whatever it is
+
+    def test_drift_ratio(self):
+        assert drift_ratio(1.0, 1.0) == 0.0
+        assert drift_ratio(1.0, 0.7) == pytest.approx(0.3)
+        assert drift_ratio(0.0, 1.0) == 0.0  # unstamped old: no drift
+
+
+class TestGateNormalization:
+    OLD = {"m": {"metric": "m", "unit": "pods/s", "value": 100.0,
+                 "trace_p99_s": 2.0, "host_calibration_score": 1.0,
+                 "stall_prep_serialized_s": 1.0}}
+
+    def test_host_slowdown_normalized_to_pass(self):
+        """2x slower host: raw throughput halves and latency doubles, but
+        normalization sees no code regression — only a drift flag."""
+        new = {"m": {"metric": "m", "unit": "pods/s", "value": 50.0,
+                     "trace_p99_s": 4.0, "host_calibration_score": 0.5,
+                     "stall_prep_serialized_s": 1.0}}
+        notes: list[str] = []
+        assert compare(self.OLD, new, notes=notes) == []
+        assert notes and "CALIBRATION DRIFT" in notes[0]
+
+    def test_real_regression_survives_normalization(self):
+        new = {"m": {"metric": "m", "unit": "pods/s", "value": 30.0,
+                     "trace_p99_s": 4.0, "host_calibration_score": 0.5,
+                     "stall_prep_serialized_s": 3.0}}
+        failures = compare(self.OLD, new, notes=[])
+        assert len(failures) == 1
+        assert "normalized" in failures[0]
+        # the gate names the stall reason whose seconds grew
+        assert "stall 'prep_serialized'" in failures[0]
+
+    def test_small_drift_not_flagged(self):
+        s = 1.0 + CALIBRATION_DRIFT_FLAG - 0.05  # within the flag band
+        new = {"m": dict(self.OLD["m"], host_calibration_score=s,
+                         value=100.0 * s, trace_p99_s=2.0 / s)}
+        notes: list[str] = []
+        assert compare(self.OLD, new, notes=notes) == []
+        assert notes == []
+
+    def test_unstamped_rows_compare_raw(self):
+        old = {"m": {"metric": "m", "unit": "pods/s", "value": 100.0}}
+        assert compare(old, {"m": dict(old["m"], value=95.0)}) == []
+        bad = compare(old, {"m": dict(old["m"], value=80.0)})
+        assert len(bad) == 1 and "normalized" not in bad[0]
+
+    def test_device_keys_never_normalized(self):
+        """Bytes/compile counts are host-independent: a slower host must
+        not excuse real upload growth."""
+        old = {"m": {"metric": "m", "unit": "pods/s", "value": 100.0,
+                     "upload_bytes_per_wave": 1000.0,
+                     "host_calibration_score": 1.0}}
+        new = {"m": {"metric": "m", "unit": "pods/s", "value": 50.0,
+                     "upload_bytes_per_wave": 2000.0,
+                     "host_calibration_score": 0.5}}
+        failures = compare(old, new, notes=[])
+        assert len(failures) == 1
+        assert "upload_bytes_per_wave" in failures[0]

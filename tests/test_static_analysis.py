@@ -17,6 +17,7 @@ from kubernetes_tpu.analysis import (
     JitPurityChecker,
     LedgerSeriesChecker,
     LockDisciplineChecker,
+    StallSeamChecker,
     RegistrySyncChecker,
     GangSeamChecker,
     RetryDisciplineChecker,
@@ -823,6 +824,123 @@ class TestLedgerSeriesSync:
     def test_repo_ledger_series_in_sync(self):
         """The shipped ledger's LEDGER_SERIES matches scheduler/metrics.py."""
         assert list(LedgerSeriesChecker().check_project(PKG)) == []
+
+
+# ------------------------------------------------------------------ OBS04
+
+
+STALL_METRICS_SRC = """\
+class SchedulerMetrics:
+    def __init__(self):
+        r = self.registry
+        self.stall = r.histogram(
+            "scheduler_tpu_pipeline_stall_seconds", "help",
+            labels=("reason",))
+        self.stall_total = r.gauge(
+            "scheduler_tpu_pipeline_stall_total_seconds", "help",
+            labels=("reason",))
+"""
+
+STALL_PROFILER_SRC = """\
+STALL_REASONS = ("queue_empty", "flush")
+STALL_SERIES = (
+    "scheduler_tpu_pipeline_stall_seconds",
+    "scheduler_tpu_pipeline_stall_total_seconds",
+)
+
+class StallProfiler:
+    def note_stall(self, record, reason, seconds):
+        self._series("scheduler_tpu_pipeline_stall_seconds")
+"""
+
+
+def write_stall_tree(root, seam_src, profiler=STALL_PROFILER_SRC,
+                     registry=STALL_METRICS_SRC):
+    p = root / "scheduler/tpu/stallprofiler.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(profiler))
+    m = root / "scheduler/metrics.py"
+    m.parent.mkdir(parents=True, exist_ok=True)
+    m.write_text(registry)
+    s = root / "scheduler/schedule_one.py"
+    s.write_text(textwrap.dedent(seam_src))
+    return root
+
+
+class TestStallSeam:
+    def test_literal_declared_reasons_clean(self, tmp_path):
+        write_stall_tree(tmp_path, """
+            class Loop:
+                def run(self):
+                    self.recorder.stall_profiler.mark_gap(None, "flush")
+                    self.recorder.stall_profiler.note_stall(
+                        None, "queue_empty", 0.1)
+                    with self.recorder.stall_profiler.stall(None, "flush"):
+                        pass
+        """)
+        assert list(StallSeamChecker().check_project(tmp_path)) == []
+
+    def test_undeclared_reason_flagged(self, tmp_path):
+        write_stall_tree(tmp_path, """
+            class Loop:
+                def run(self):
+                    self.recorder.stall_profiler.mark_gap(None, "coffee")
+        """)
+        fs = list(StallSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS04"]
+        assert "coffee" in fs[0].message
+
+    def test_non_literal_reason_flagged(self, tmp_path):
+        write_stall_tree(tmp_path, """
+            class Loop:
+                def _mark(self, why):
+                    self.recorder.stall_profiler.mark_gap(None, why)
+        """)
+        fs = list(StallSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS04"]
+        assert "string literal" in fs[0].message
+
+    def test_record_state_write_outside_profiler_flagged(self, tmp_path):
+        write_stall_tree(tmp_path, """
+            class Loop:
+                def run(self, rec):
+                    rec.stall_by_reason = {"flush": 1.0}
+                    rec._stall_acc.update(flush=1.0)
+        """)
+        fs = list(StallSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS04", "OBS04"]
+        assert "one writer" in fs[0].message
+
+    def test_unregistered_series_flagged(self, tmp_path):
+        write_stall_tree(tmp_path, "x = 1\n", registry="class M: pass\n")
+        fs = list(StallSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS04", "OBS04"]
+        assert "not registered" in fs[0].message
+
+    def test_non_literal_declaration_flagged(self, tmp_path):
+        write_stall_tree(tmp_path, "x = 1\n",
+                         profiler="STALL_REASONS = tuple(make())\n"
+                                  "STALL_SERIES = ()\n")
+        fs = list(StallSeamChecker().check_project(tmp_path))
+        assert rules(fs) == ["OBS04"]
+        assert "literal tuple" in fs[0].message
+
+    def test_unrelated_stall_method_not_bound(self, tmp_path):
+        # `.stall(...)` on a non-profiler receiver is someone else's API
+        write_stall_tree(tmp_path, """
+            class Engine:
+                def run(self, car, gear):
+                    car.stall(None, gear)
+        """)
+        assert list(StallSeamChecker().check_project(tmp_path)) == []
+
+    def test_partial_tree_is_silent(self, tmp_path):
+        assert list(StallSeamChecker().check_project(tmp_path)) == []
+
+    def test_repo_stall_seams_in_contract(self):
+        """Every shipped seam stamp names a declared literal, stall record
+        state has one writer, and STALL_SERIES is registered."""
+        assert list(StallSeamChecker().check_project(PKG)) == []
 
 
 # ------------------------------------------------------------------ OBS03
