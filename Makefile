@@ -33,7 +33,12 @@ test:
 # — then (4) the restart storm: seeded scheduler crashes mid-wave /
 # mid-bind-commit / mid-gang-permit with ungraceful teardown and warm
 # restarts over the same store (zero double binds, zero leaked assumes,
-# per-gang all-or-nothing, compile-free warm restart asserted).
+# per-gang all-or-nothing, compile-free warm restart asserted) — then
+# (5) the fleet soak: 3 lease-sharded active-active schedulers over ONE
+# store under the full ladder plus seeded lease loss, one peer killed
+# mid-wave; survivors must adopt the orphaned shard inside the bounded
+# window (counted on restart_recoveries{kind="shard_adopt*"}) with zero
+# double binds and disjoint ownership after convergence.
 # Exits non-zero on divergence — same seed replays the same schedule
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --seed 7
@@ -41,6 +46,7 @@ chaos:
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --trace --seed 1234 --budget-s 60
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --gang --seed 7
 	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --restart --seed 7
+	env JAX_PLATFORMS=cpu $(PY) -m kubernetes_tpu.testing.chaos --fleet --seed 7
 
 # flight-recorder CLI smoke: synthetic multi-wave run (no device, no jax),
 # exercises ring buffer + watchdog + post-mortem formatting, and asserts

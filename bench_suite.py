@@ -229,6 +229,26 @@ def main() -> None:
             line["fallback_reason"] = fallback_reason
         print(json.dumps(line), flush=True)
 
+    # standing fleet scale-out row: the same workload through one member
+    # vs a statically sharded fleet of 2; aggregate throughput is the
+    # busy-seconds projection (README "Scheduler fleet") and the row
+    # fails under the 1.7x speedup floor or on ANY double bind
+    from kubernetes_tpu.perf.fleet_bench import run_fleet_bench
+
+    if not only or only in "fleet_scaleout_2x":
+        row_t0 = time.monotonic()
+        line = run_fleet_bench(seed=SUITE_SEED)
+        all_pass = all_pass and line["pass"]
+        line.update({
+            "device": platform,
+            "git_rev": git_rev,
+            "row_wall_s": round(time.monotonic() - row_t0, 2),
+            "host_calibration_score": calibration,
+        })
+        if fallback_reason:
+            line["fallback_reason"] = fallback_reason
+        print(json.dumps(line), flush=True)
+
     print(json.dumps({
         "metric": "bench_suite_summary",
         "value": float(sum(summary.values())),

@@ -27,7 +27,12 @@ ad-hoc random flakes outside the shared helpers (RET01),
 and reconcile-restored state ownership — the attributes a restart's
 reconcile() re-derives from store truth (RECONCILE_RESTORED_STATE in
 scheduler/scheduler.py) are writable only in their sanctioned owning
-modules, so crash recovery never races a stray writer (CRASH01).
+modules, so crash recovery never races a stray writer (CRASH01),
+and fleet shard-ownership state ownership — the member-held shard set and
+the installed ownership predicate (FLEET_SHARD_STATE in
+scheduler/fleet.py) are writable only in scheduler/fleet.py, so the
+fleet's admission/pop gates can never disagree with the lease record
+about who owns a pod (FLEET01).
 
 On top of the per-file rules sits a whole-program pass (callgraph.py +
 effects.py + whole_program.py): a project-wide symbol table and
@@ -39,7 +44,7 @@ reached from inside a traced region ACROSS a module boundary — the
 closure of JIT01-03/OBS01), LOCK05 (lock-ordering cycles, the deadlock
 half LOCK01-04 can't see), RNG01 (the seeded tie-break stream consumed
 outside the sanctioned scheduling core), and a transitive mode for the
-ownership rules (SIG02/PIPE01/GANG01/CRASH01/SHARD01: calling a
+ownership rules (SIG02/PIPE01/GANG01/CRASH01/SHARD01/FLEET01: calling a
 mutating helper cross-module is flagged, not just the direct write).
 
 CLI: `python -m kubernetes_tpu.analysis [paths]` (exit 1 on findings);
@@ -65,6 +70,7 @@ from .effects import EffectEngine
 from .carry_coherence import CarryCoherenceChecker
 from .crash_state import CrashStateChecker
 from .fault_points import FaultPointChecker
+from .fleet_state import FleetStateChecker
 from .gang_seam import GangSeamChecker
 from .jit_purity import JitPurityChecker
 from .ledger_series import LedgerSeriesChecker
@@ -87,6 +93,7 @@ __all__ = [
     "EffectEngine",
     "FaultPointChecker",
     "Finding",
+    "FleetStateChecker",
     "GangSeamChecker",
     "JitPurityChecker",
     "LedgerSeriesChecker",

@@ -111,6 +111,7 @@ def default_checkers() -> list[Checker]:
     from .carry_coherence import CarryCoherenceChecker
     from .crash_state import CrashStateChecker
     from .fault_points import FaultPointChecker
+    from .fleet_state import FleetStateChecker
     from .gang_seam import GangSeamChecker
     from .jit_purity import JitPurityChecker
     from .ledger_series import LedgerSeriesChecker
@@ -143,6 +144,7 @@ def default_checkers() -> list[Checker]:
         ShardSeamChecker(),
         GangSeamChecker(),
         CrashStateChecker(),
+        FleetStateChecker(),
         WholeProgramChecker(),
     ]
 

@@ -42,6 +42,7 @@ from typing import Iterable, Iterator
 from .callgraph import FunctionInfo, ProjectIndex, _dotted
 from .carry_coherence import _GUARDED as _SIG02_ATTRS
 from .crash_state import SCHEDULER as _CRASH_DECL, _parse_state as _parse_crash_state
+from .fleet_state import FLEET as _FLEET_DECL, _parse_state as _parse_fleet_state
 from .obs_purity import TELEMETRY_SEGMENTS
 from .pipeline_state import _GUARDED as _PIPE01_ATTRS
 
@@ -147,6 +148,14 @@ def ownership_families(index: ProjectIndex) -> list[OwnershipFamily]:
                 fams.append(OwnershipFamily(
                     "CRASH01", tuple(sorted(owners)), {attr},
                     exempt=(_CRASH_DECL,)))
+    fleet_decl = index.root / _FLEET_DECL
+    if fleet_decl.is_file():
+        state = _parse_fleet_state(fleet_decl)
+        if state:
+            for attr, owners in sorted(state.items()):
+                fams.append(OwnershipFamily(
+                    "FLEET01", tuple(sorted(owners)), {attr},
+                    exempt=(_FLEET_DECL,)))
     return fams
 
 

@@ -1,0 +1,148 @@
+"""Fleet shard-ownership state rule (FLEET01).
+
+Direct writes only; FLEET01's transitive mode (calling a mutating helper
+cross-module) lives in whole_program.py, which re-parses the same
+FLEET_SHARD_STATE declaration via this module's _parse_state.
+
+`scheduler/fleet.py` declares, in one `FLEET_SHARD_STATE` literal, the
+state the active-active fleet's correctness hangs on — the shard set a
+member currently holds (`_owned_shards`) and the ownership predicate
+installed into the scheduler, loop, and queue gates (`shard_filter`) —
+together with the ONE module sanctioned to write each. The zero-
+double-bind contract (README "Scheduler fleet") is only sound if that
+state has exactly one writer: a stray mutation from, say, a plugin or a
+test helper would let a member's admission gates disagree with the lease
+record about who owns a pod, and two members would pop — and race to
+bind — the same pod.
+
+FLEET01 therefore flags, across the whole tree:
+
+- assignment (plain, augmented, annotated, tuple-unpacked) to a declared
+  attribute outside its sanctioned module;
+- `del` of such an attribute;
+- mutating method calls on one (`.add()`, `.discard()`, `.clear()`, ...).
+
+The declaring module itself (`scheduler/fleet.py`) is exempt — it owns
+the contract: ownership changes only through the per-shard electors'
+acquire/release callbacks, and the filter is installed only through
+`install_shard_filter`. Reads stay free everywhere (every gate is a
+read). Like CRASH01, nothing imports the constant at the write sites, so
+cross-parsing is the only enforcement possible; findings are
+project-scoped and per-line suppressions do not apply — route the write
+through scheduler/fleet.py instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from .core import Finding, ProjectChecker
+from .crash_state import _MUTATORS, _guarded_attrs
+
+FLEET01 = "FLEET01"
+
+FLEET = "scheduler/fleet.py"
+
+
+def _parse_state(path: Path) -> dict[str, set[str]] | None:
+    """The FLEET_SHARD_STATE literal as {attr: sanctioned files}, or None
+    if it is not a literal tuple of (str, str) pairs."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "FLEET_SHARD_STATE"
+            for t in node.targets
+        ):
+            value = node.value
+            if not isinstance(value, (ast.Tuple, ast.List)):
+                return None
+            out: dict[str, set[str]] = {}
+            for el in value.elts:
+                if not (isinstance(el, (ast.Tuple, ast.List))
+                        and len(el.elts) == 2
+                        and all(isinstance(c, ast.Constant)
+                                and isinstance(c.value, str)
+                                for c in el.elts)):
+                    return None
+                attr, owner = (c.value for c in el.elts)
+                out.setdefault(attr, set()).add(owner)
+            return out
+    return None
+
+
+class FleetStateChecker(ProjectChecker):
+    rules = {
+        FLEET01: "fleet shard-ownership state written outside its "
+                 "sanctioned owner (see scheduler/fleet.py "
+                 "FLEET_SHARD_STATE) — the zero-double-bind contract "
+                 "needs the ownership gates and the lease record to have "
+                 "one writer",
+    }
+
+    def check_project(self, root: Path) -> Iterable[Finding]:
+        decl = root / FLEET
+        if not decl.is_file():
+            return  # partial tree (fixture dirs) — nothing to cross-check
+        state = _parse_state(decl)
+        if state is None:
+            yield Finding(
+                decl.as_posix(), 1, 0, FLEET01,
+                "could not parse FLEET_SHARD_STATE for cross-checking — "
+                "keep it a literal tuple of (attribute, sanctioned "
+                "module) string pairs",
+            )
+            return
+        for path in sorted(root.rglob("*.py")):
+            posix = path.as_posix()
+            if posix.endswith(FLEET):
+                continue  # the contract's declaration site
+            guarded = {
+                attr for attr, owners in state.items()
+                if not any(posix.endswith(owner) for owner in owners)
+            }
+            if not guarded:
+                continue
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except (OSError, SyntaxError):
+                continue  # LINT01 reports unparseable files
+            yield from self._check_tree(posix, tree, guarded)
+
+    def _check_tree(self, path, tree, guarded):
+        for node in ast.walk(tree):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = list(node.targets)
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in _MUTATORS):
+                    for line, attr in _guarded_attrs(func.value, guarded):
+                        yield Finding(
+                            path, line, 0, FLEET01,
+                            f"mutating call .{func.attr}() on fleet "
+                            f"shard-ownership state {attr!r} outside its "
+                            "sanctioned owner — route the write through "
+                            "scheduler/fleet.py so ownership gates and "
+                            "the lease record cannot disagree",
+                        )
+                continue
+            for tgt in targets:
+                for line, attr in _guarded_attrs(tgt, guarded):
+                    yield Finding(
+                        path, line, 0, FLEET01,
+                        f"write to fleet shard-ownership state {attr!r} "
+                        "outside its sanctioned owner (see "
+                        "FLEET_SHARD_STATE) — a stray writer here lets "
+                        "two members both believe they own a pod, which "
+                        "is a double-bind waiting for a watch gap",
+                    )

@@ -19,6 +19,22 @@ class LeaseSpec:
     renew_time: float = 0.0
     lease_transitions: int = 0
 
+    def deadline(self) -> float:
+        """The instant the current term expires: the holder must land a
+        renew before this or any candidate may take the lease over."""
+        return self.renew_time + self.lease_duration_seconds
+
+    def expired(self, now: float) -> bool:
+        """Past the holder's renewal deadline — takeover is legal."""
+        return now > self.deadline()
+
+
+def shard_lease_name(base: str, shard: int) -> str:
+    """Per-shard coordination Lease name for the active-active scheduler
+    fleet (scheduler/fleet.py): shard ownership is one Lease per shard,
+    named off the configured resource name."""
+    return f"{base}-shard-{shard}"
+
 
 @dataclass
 class Lease:

@@ -19,6 +19,7 @@ from typing import Callable
 from ..api.coordination import Lease, LeaseSpec
 from ..api.meta import ObjectMeta
 from ..store.store import ConflictError, NotFoundError
+from ..utils import faultinject
 
 
 @dataclass
@@ -72,7 +73,22 @@ class LeaderElector:
             return None
 
     def try_acquire_or_renew(self) -> bool:
-        """leaderelection.go tryAcquireOrRenew — one CAS round."""
+        """leaderelection.go tryAcquireOrRenew — one CAS round.
+
+        The round is a seeded fault point (`lease.renew`): ERROR models a
+        flaky coordination write (the round fails, retried next tick),
+        LATENCY a renew that lands late, PARTITION a window where every
+        renewal is lost — so lease loss and renew storms replay from the
+        chaos seed like every other fault."""
+        try:
+            if faultinject.fire("lease.renew"):
+                return False  # renewal lost in a partition window
+        except faultinject.SchedulerCrashed:
+            raise  # CRASH mode must rip through to the soak driver
+        except faultinject.FaultInjected:
+            return False  # flaky coordination write: retry next round
+        # clock read AFTER the fault point: injected LATENCY makes this the
+        # renew that lands late, exercising the stale-lease step-down below
         now = self.clock.now()
         lease = self._get_lease()
         if lease is None:
@@ -94,12 +110,23 @@ class LeaderElector:
 
         spec = lease.spec
         if spec.holder_identity != self.identity:
-            expired = now > spec.renew_time + spec.lease_duration_seconds
-            if spec.holder_identity and not expired:
+            if spec.holder_identity and not spec.expired(now):
                 self._observe(spec.holder_identity)
                 return False
             # lease expired (or released): try to take it over
             spec.holder_identity = self.identity
+            spec.acquire_time = now
+            spec.renew_time = now
+            spec.lease_transitions += 1
+        elif spec.expired(now):
+            # renewal edge: this renew landed AFTER our own lease's
+            # deadline (slow write, renew storm, partition). The term is
+            # dead — a peer may already have observed the expiry and begun
+            # takeover, so silently re-stamping renew_time would keep a
+            # stale leader scheduling. Step down FIRST (on_stopped_leading
+            # halts the owned work before its next pop), then contend for
+            # a FRESH term through the same CAS as any other candidate.
+            self._lost_leadership()
             spec.acquire_time = now
             spec.renew_time = now
             spec.lease_transitions += 1

@@ -24,10 +24,13 @@ class SchedulerServer:
     """One running scheduler instance + its serving mux."""
 
     def __init__(self, store: Store, config: SchedulerConfiguration,
-                 identity: str = "scheduler-0"):
+                 identity: str = "scheduler-0", fleet_size: int = 1,
+                 shard_id: int | None = None):
         self.config = config
         self.store = store
         self.identity = identity
+        self.fleet_size = max(1, int(fleet_size))
+        self.shard_id = shard_id
         self.metrics = SchedulerMetrics()
         gates = FeatureGate()
         gates.set_from_map(config.feature_gates)
@@ -93,7 +96,32 @@ class SchedulerServer:
         except ValueError:
             pass  # not the main thread (tests): on-demand calls still work
         self.elector = None
-        if config.leader_election.leader_elect:
+        self.fleet = None
+        if self.fleet_size > 1:
+            # active-active fleet (scheduler/fleet.py): shard ownership
+            # replaces the single global lease — per-shard leases when
+            # leader election is on, a pinned --shard-id otherwise
+            from ..scheduler.fleet import FleetMember
+
+            le = config.leader_election
+            static = (
+                {shard_id}
+                if (shard_id is not None and not le.leader_elect)
+                else None
+            )
+            self.fleet = FleetMember(
+                self.scheduler,
+                self.fleet_size,
+                identity,
+                preferred_shard=shard_id,
+                static_shards=static,
+                lease_name=le.resource_name,
+                namespace=le.resource_namespace,
+                lease_duration=le.lease_duration,
+                renew_deadline=le.renew_deadline,
+                retry_period=le.retry_period,
+            )
+        elif config.leader_election.leader_elect:
             from ..client.leaderelection import LeaderElector
 
             le = config.leader_election
@@ -303,11 +331,26 @@ class SchedulerServer:
         if self.started:
             return
         self._sched_stop = threading.Event()
-        self.scheduler.start()
+        if self.fleet is not None:
+            # fleet start: informer sync + per-shard lease contention;
+            # shard_adopt/acquire reconciles run inside the acquire
+            # callbacks, scoped to each shard as it is won
+            self.fleet.start()
+        else:
+            self.scheduler.start()
         self.started = True
 
         def run_term(stop=self._sched_stop):
+            retry = self.config.leader_election.retry_period
+            last_elect = self.scheduler.clock.now()
             while not stop.is_set() and not self._stop.is_set():
+                if self.fleet is not None:
+                    now = self.scheduler.clock.now()
+                    if now - last_elect >= retry:
+                        last_elect = now
+                        # renew held shard leases / adopt orphans between
+                        # scheduling rounds (single-threaded with the pops)
+                        self.fleet.elect_once()
                 self.scheduler.pump()
                 self.scheduler.loop.schedule_one(timeout=0.05)
 
@@ -323,6 +366,8 @@ class SchedulerServer:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.fleet is not None:
+            self.fleet.stop()  # release shard leases for instant adoption
         if self.elector is not None:
             self.elector.stop()
         if self._http is not None:
@@ -345,6 +390,15 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--port", type=int, default=10259,
                         help="health/metrics port")
     parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--fleet-size", type=int, default=None,
+                        help="active-active fleet: total shard count "
+                             "(env KUBE_TPU_FLEET_SIZE; 1 = single "
+                             "scheduler, the default)")
+    parser.add_argument("--shard-id", type=int, default=None,
+                        help="this member's preferred shard (env "
+                             "KUBE_TPU_SHARD_ID; with --leader-elect it "
+                             "seeds lease contention, without it the "
+                             "shard is pinned statically)")
     parser.add_argument("--v", type=int, default=0,
                         help="log verbosity (klog levels)")
     parser.add_argument("--log-format", choices=["text", "json"],
@@ -373,7 +427,18 @@ def main(argv: list[str] | None = None) -> int:
         from ..utils.jaxcache import enable_persistent_cache
 
         enable_persistent_cache()
-    server = SchedulerServer(Store(), config)
+    from ..utils.envknob import int_env
+
+    fleet_size = (args.fleet_size if args.fleet_size is not None
+                  else int_env("KUBE_TPU_FLEET_SIZE", 1))
+    shard_id = (args.shard_id if args.shard_id is not None
+                else int_env("KUBE_TPU_SHARD_ID", -1))
+    if shard_id is not None and shard_id < 0:
+        shard_id = None
+    identity = (f"scheduler-{shard_id}" if shard_id is not None
+                else "scheduler-0")
+    server = SchedulerServer(Store(), config, identity=identity,
+                             fleet_size=fleet_size, shard_id=shard_id)
     server.flags = {k: v for k, v in vars(args).items()}
     server.run(block=True)
     return 0

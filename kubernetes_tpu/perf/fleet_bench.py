@@ -1,0 +1,160 @@
+"""Standing fleet scale-out bench row: single scheduler vs fleet-of-2.
+
+The active-active fleet (README "Scheduler fleet") is a capacity claim,
+so it gets a standing bench row: the SAME 128-pod workload is scheduled
+once by a single member and once by a 2-member fleet with statically
+pinned shards (no lease churn — this row measures scheduling capacity,
+not election overhead; the chaos fleet soak owns the churn story).
+
+Both phases run in ONE process, so the two fleet members are driven
+interleaved on one thread and the GIL would hide any wall-clock win.
+The row therefore reports the scale-OUT projection the deployment
+actually sees (one member per process/host): per-member BUSY seconds —
+time spent inside `schedule_pending`, the only work a real member's
+process would do — are accumulated separately, and the fleet's
+aggregate throughput is total_pods / max(member busy seconds): the
+critical-path member bounds the fleet's wall time. The single phase is
+measured with the identical busy-seconds stopwatch, so the drive loop's
+bookkeeping cancels out of the speedup.
+
+The workload's pod names are chosen so the content hash splits them
+64/64 across the two shards (the split is stable: blake2b, not
+builtin hash()); `shard_balance` in the row keeps the split honest.
+The speedup floor is 1.7x — below that, per-wave fixed costs or an
+ownership-gate bug are eating the second member. The store's bind path
+doubles as the double-bind oracle, same as the chaos soaks: any key
+bound twice fails the row outright regardless of throughput.
+"""
+
+from __future__ import annotations
+
+import time
+
+SPEEDUP_FLOOR = 1.7
+
+
+def _drain(schedulers, store, total: int, budget_s: float = 300.0):
+    """Round-robin schedule_pending until every pod is bound; returns
+    per-scheduler busy seconds (time inside schedule_pending only)."""
+    busy = [0.0] * len(schedulers)
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        for i, s in enumerate(schedulers):
+            t0 = time.monotonic()
+            s.schedule_pending()
+            busy[i] += time.monotonic() - t0
+        if sum(1 for p in store.pods() if p.spec.node_name) >= total:
+            break
+    return busy
+
+
+def run_fleet_bench(nodes: int = 16, pods: int = 128, wave_size: int = 8,
+                    seed: int = 0) -> dict:
+    """One single-member phase, one static fleet-of-2 phase over fresh
+    stores; returns the bench row dict (never raises on a perf miss —
+    `pass` carries the verdict)."""
+    from ..scheduler import Profile, Scheduler
+    from ..scheduler.fleet import FleetMember, shard_of
+    from ..store.store import Store
+    from ..testing import make_node, make_pod
+
+    def build_store():
+        store = Store()
+        for i in range(nodes):
+            store.create(make_node(f"fbn{i}", cpu="16", mem="32Gi",
+                                   zone=f"z{i % 4}"))
+        return store
+
+    def build_scheduler(store):
+        s = Scheduler(store,
+                      profiles=[Profile(backend="tpu",
+                                        wave_size=wave_size)],
+                      seed=seed, warm_start=True)
+        return s
+
+    def traffic(store):
+        # "sb-<i>" hashes 64/64 across 2 shards (see module docstring)
+        for i in range(pods):
+            store.create(make_pod(f"sb-{i}", cpu="100m", mem="64Mi"))
+
+    # -- phase 1: single member --------------------------------------------
+    store_a = build_store()
+    single = build_scheduler(store_a)
+    single.start()
+    traffic(store_a)
+    busy_single = _drain([single], store_a, pods)[0]
+    bound_single = sum(1 for p in store_a.pods() if p.spec.node_name)
+    single.informers.stop_all()
+
+    # -- phase 2: fleet of 2, statically pinned shards ---------------------
+    store_b = build_store()
+    bind_ledger: dict[str, int] = {}
+    orig_bind_pods, orig_bind_pod = store_b.bind_pods, store_b.bind_pod
+
+    def ledgered_bind_pods(bindings):
+        out = orig_bind_pods(bindings)
+        for (key, _node), status in zip(bindings, out):
+            if status == "bound":
+                bind_ledger[key] = bind_ledger.get(key, 0) + 1
+        return out
+
+    def ledgered_bind_pod(key, node_name):
+        obj = orig_bind_pod(key, node_name)
+        bind_ledger[key] = bind_ledger.get(key, 0) + 1
+        return obj
+
+    store_b.bind_pods = ledgered_bind_pods
+    store_b.bind_pod = ledgered_bind_pod
+
+    members = []
+    for i in range(2):
+        m = FleetMember(build_scheduler(store_b), 2, f"bench-{i}",
+                        static_shards={i})
+        m.start()
+        members.append(m)
+    traffic(store_b)
+    busy_fleet = _drain([m.scheduler for m in members], store_b, pods)
+    bound_fleet = sum(1 for p in store_b.pods() if p.spec.node_name)
+    double_binds = sum(1 for n in bind_ledger.values() if n > 1)
+    for m in members:
+        m.scheduler.informers.stop_all()
+
+    balance = [0, 0]
+    for i in range(pods):
+        balance[shard_of("default", f"sb-{i}", 2)] += 1
+
+    single_pods_s = pods / busy_single if busy_single > 0 else 0.0
+    critical_path_s = max(busy_fleet)
+    fleet_pods_s = pods / critical_path_s if critical_path_s > 0 else 0.0
+    speedup = fleet_pods_s / single_pods_s if single_pods_s > 0 else 0.0
+    ok = (speedup >= SPEEDUP_FLOOR
+          and bound_single == pods and bound_fleet == pods
+          and double_binds == 0)
+    return {
+        "metric": "fleet_scaleout_2x",
+        "value": round(fleet_pods_s, 1),
+        "unit": "pods/s (fleet-of-2 aggregate, busy-seconds projection)",
+        "pass": ok,
+        "speedup": round(speedup, 2),
+        "speedup_floor": SPEEDUP_FLOOR,
+        "vs_floor": round(speedup / SPEEDUP_FLOOR, 2),
+        "single_pods_s": round(single_pods_s, 1),
+        "member_busy_s": [round(b, 4) for b in busy_fleet],
+        "single_busy_s": round(busy_single, 4),
+        "shard_balance": balance,
+        "double_binds": double_binds,
+        "scheduled": bound_fleet,
+        "nodes": nodes,
+        "pods": pods,
+        "wave_size": wave_size,
+        "seed": seed,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    from ..utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
+    print(json.dumps(run_fleet_bench()), flush=True)

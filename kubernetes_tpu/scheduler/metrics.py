@@ -166,6 +166,26 @@ class SchedulerMetrics:
             "gang_adopt/gang_release/permit_cleared)",
             labels=("kind",),
         )
+        # active-active scheduler fleet (scheduler/fleet.py): shard
+        # ownership and lease failover
+        self.fleet_shards_owned = r.gauge(
+            "scheduler_fleet_shards_owned",
+            "Shards this fleet member currently holds the lease for",
+        )
+        self.fleet_size = r.gauge(
+            "scheduler_fleet_size",
+            "Configured fleet size (total shard count)",
+        )
+        self.fleet_shard_failovers = r.counter(
+            "scheduler_fleet_shard_failovers_total",
+            "Orphaned shard leases this member took over from a dead peer",
+            labels=("shard",),
+        )
+        self.fleet_failover_latency = r.histogram(
+            "scheduler_fleet_failover_latency_seconds",
+            "Lease expiry to shard adoption by a survivor",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+        )
         # TPU backend (new: kernel-vs-host path split)
         self.kernel_dispatches = r.counter(
             "scheduler_tpu_kernel_dispatches_total",
@@ -426,6 +446,18 @@ class SchedulerMetrics:
         the given kind (flightrecorder fan-out from Scheduler.reconcile)."""
         if n:
             self.restart_recoveries.inc(kind, by=float(n))
+
+    def fleet_ownership(self, owned: int, fleet_size: int) -> None:
+        """This member's current shard count (flightrecorder fan-out from
+        the fleet's acquire/release callbacks)."""
+        self.fleet_shards_owned.set(float(owned))
+        self.fleet_size.set(float(fleet_size))
+
+    def fleet_failover(self, shard: int, latency_s: float) -> None:
+        """An orphaned shard adopted from a dead peer, with lease-expiry
+        to adoption latency."""
+        self.fleet_shard_failovers.inc(str(shard))
+        self.fleet_failover_latency.observe(latency_s)
 
     def update_sli_quantiles(self) -> None:
         """Record exact p50/p99 over the recent-sample window (the SLO the

@@ -84,6 +84,11 @@ class _InFlightPod:
 
 
 class SchedulingQueue:
+    # fleet ownership predicate at queue admission (installed by
+    # scheduler/fleet.py, the sole writer — kubesched-lint FLEET01):
+    # None = admit everything. A non-owned pod never enters any tier.
+    shard_filter = None
+
     def __init__(
         self,
         less_fn: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
@@ -200,6 +205,9 @@ class SchedulingQueue:
     def add(self, pod: Pod, pod_info: PodInfo | None = None) -> None:
         from ...api.resource import ResourceNames
 
+        sf = self.shard_filter
+        if sf is not None and not sf(pod):
+            return  # a peer's shard: its owner queues it
         with self._mu:
             pi = pod_info or PodInfo(pod, ResourceNames())
             qpi = QueuedPodInfo(pi, self._clock.now())
@@ -473,6 +481,28 @@ class SchedulingQueue:
                 qpi.timestamp = self._clock.now()
                 self._active.add(qpi)
             self._mu.notify_all()
+
+    def prune(self, keep: Callable[[Pod], bool]) -> int:
+        """Drop every QUEUED pod failing `keep` from all three tiers (a
+        fleet member losing a shard lease calls this before its next pop —
+        the new owner requeues the pods from store truth). In-flight pods
+        are left alone: their cycle resolves through the pop-side shard
+        gate and the store's CAS, never by yanking state mid-cycle."""
+        removed = 0
+        with self._mu:
+            for heap in (self._active, self._backoff, self._error_backoff):
+                for key in list(heap.keys()):
+                    qpi = heap.get(key)
+                    if qpi is not None and not keep(qpi.pod):
+                        heap.delete(key)
+                        self._nominated.pop(key, None)
+                        removed += 1
+            for key in [k for k, q in self._unschedulable.items()
+                        if not keep(q.pod)]:
+                del self._unschedulable[key]
+                self._nominated.pop(key, None)
+                removed += 1
+        return removed
 
     def _flush_backoff_locked(self) -> None:
         now = self._clock.now()

@@ -306,6 +306,11 @@ class ScheduleOneLoop:
     (pipeline parallelism pod N+1 scheduling overlaps pod N binding — §2.9.2).
     """
 
+    # fleet ownership predicate on the pop side (installed by
+    # scheduler/fleet.py, the sole writer — kubesched-lint FLEET01):
+    # catches pods whose shard lease moved after queue admission
+    shard_filter = None
+
     def __init__(
         self,
         cache,
@@ -389,7 +394,12 @@ class ScheduleOneLoop:
         return self.profiles.get(pod.spec.scheduler_name)
 
     def _skip_pod_schedule(self, fw: Framework, pod: Pod) -> bool:
-        """skipPodSchedule:546 — deleted or already-assumed pods."""
+        """skipPodSchedule:546 — deleted or already-assumed pods; in a
+        fleet, also pods whose shard this member no longer holds (the
+        lease moved between queue admission and this pop)."""
+        sf = self.shard_filter
+        if sf is not None and not sf(pod):
+            return True
         if pod.is_terminating:
             return True
         if not self.store.contains("Pod", pod.meta.key):

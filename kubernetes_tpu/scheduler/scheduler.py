@@ -84,6 +84,13 @@ def _apply_plugin_set(plugins: list, prof: "Profile") -> list:
 
 
 class Scheduler:
+    # fleet ownership predicate (installed by scheduler/fleet.py, the sole
+    # sanctioned writer — kubesched-lint rule FLEET01). None = own every
+    # pod, the single-scheduler default. When set, _on_pod_event ignores
+    # non-owned unbound pods at admission; the queue and loop carry the
+    # same predicate on their own gates.
+    shard_filter = None
+
     def __init__(
         self,
         store: Store,
@@ -303,6 +310,12 @@ class Scheduler:
                     ClusterEvent(ev.ASSIGNED_POD, ev.ADD), None, new
                 )
             else:
+                # fleet gate: a peer's pod never enters this member's queue
+                # (its owner admits it; bound-pod branches above stay
+                # ungated so every member's cache mirrors ALL occupancy)
+                sf = self.shard_filter
+                if sf is not None and not sf(new):
+                    return
                 # ledger edges: informer delivered the pod, then it entered
                 # the scheduling queue (the informer segment spans PodInfo
                 # construction + queue admission)
@@ -437,7 +450,7 @@ class Scheduler:
         pre-lowering the TPU wave kernels (AOT warm restart) so the first
         real wave pays zero compiles."""
         self.informers.start_all()
-        self.reconcile()
+        self.reconcile(shard_pred=self.shard_filter)
         if self.warm_start:
             self._run_warmup()
 
@@ -453,7 +466,7 @@ class Scheduler:
             if backend is not None:
                 warm_backend(backend, self.snapshot, self.wave_size)
 
-    def reconcile(self) -> dict:
+    def reconcile(self, shard_pred=None, kind_prefix="") -> dict:
         """Startup crash recovery: resolve every piece of mid-flight state a
         previous incarnation may have left behind against store truth (the
         README "Restart & recovery" contract). Three sweeps:
@@ -480,9 +493,18 @@ class Scheduler:
 
         Every outcome lands on the flight recorder's restart_events and the
         scheduler_restart_recoveries_total{kind} series. Gang/permit kinds
-        appear in the returned stats only when non-zero."""
+        appear in the returned stats only when non-zero.
+
+        `shard_pred` scopes every sweep to one fleet member's ownership
+        (None = own everything, the single-scheduler default): a member's
+        reconcile must never forget/requeue a PEER's in-flight pod — the
+        peer's assume is valid mid-flight state, not a crash leftover.
+        `kind_prefix` namespaces the recorded recovery kinds (the fleet's
+        shard adoption reuses these sweeps under "shard_adopt_*")."""
         stats = {"adopted": 0, "forgotten": 0, "requeued": 0}
         for pod in self.cache.assumed_pods():
+            if shard_pred is not None and not shard_pred(pod):
+                continue  # a peer's in-flight assume: not ours to resolve
             key = pod.meta.key
             cur = self.store.try_get("Pod", key)
             if cur is None:
@@ -522,6 +544,10 @@ class Scheduler:
         for g in _list("PodGroup"):
             gk = g.meta.key
             mem = members.get(gk, [])
+            # gangs shard by group key, so one member decides the whole
+            # gang's fate — a peer's half-bound gang is the peer's problem
+            if shard_pred is not None and mem and not shard_pred(mem[0]):
+                continue
             bound = [p for p in mem if p.spec.node_name]
             if not bound or len(bound) >= g.spec.policy.min_count:
                 continue  # whole gang landed, or nothing did
@@ -545,6 +571,9 @@ class Scheduler:
         permit_cleared = 0
         live_assumes = {p.meta.key for p in self.cache.assumed_pods()}
         for gk, gstate in self.cache.pod_group_states.snapshot().items():
+            mem = members.get(gk, [])
+            if shard_pred is not None and mem and not shard_pred(mem[0]):
+                continue  # a peer's gang quorum state
             for key in gstate.assumed:
                 if key in live_assumes:
                     continue  # a real assume: sweep 1 owns its fate
@@ -565,10 +594,47 @@ class Scheduler:
         if permit_cleared:
             stats["permit_cleared"] = permit_cleared
         for kind, n in stats.items():
-            self.flight_recorder.restart_recovery(kind, n)
+            self.flight_recorder.restart_recovery(kind_prefix + kind, n)
         if stats["adopted"] or stats["forgotten"] or gang_release:
             # node occupancy changed under any live device carry
             self._mark_external()
+        return stats
+
+    def adopt_shard(self, shard_pred, kind_prefix: str = "shard_adopt_") -> dict:
+        """Fleet shard adoption (scheduler/fleet.py calls this when a
+        member acquires a shard — at boot, or after a dead peer's lease
+        expired): the reconcile() sweeps scoped to the shard, plus a
+        requeue pass for the shard's pending pods this member's admission
+        gate had been filtering out while a peer owned them. Outcomes
+        count on restart_recoveries{kind="<kind_prefix>*"}."""
+        stats = self.reconcile(shard_pred=shard_pred, kind_prefix=kind_prefix)
+        if hasattr(self.store, "list_refs"):
+            _list = self.store.list_refs
+        else:
+            _list = lambda kind: self.store.list(kind)[0]  # noqa: E731
+        pending = 0
+        for pod in _list("Pod"):
+            if pod.is_scheduled or not shard_pred(pod):
+                continue
+            key = pod.meta.key
+            if self.queue.has_pod(key) or self.cache.is_assumed_pod(pod):
+                continue
+            # register gang membership first: the admission gate skipped
+            # pod_added while a peer owned this shard, and the gang cycle
+            # pops siblings from gstate.unscheduled — without this the
+            # adopted gang can never reach quorum
+            gk = self._group_key(pod)
+            if gk is not None:
+                self.cache.pod_group_states.pod_added(gk, key)
+            # clear any stale in-flight record, then admit through the
+            # queue's own gate (the shard is owned now, so it passes)
+            self.queue.done(key)
+            self.queue.add(pod, PodInfo(pod, self.names))
+            pending += 1
+        if pending:
+            stats["pending"] = pending
+            self.flight_recorder.restart_recovery(kind_prefix + "pending",
+                                                  pending)
         return stats
 
     def pump(self) -> int:

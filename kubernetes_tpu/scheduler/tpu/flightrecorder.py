@@ -222,6 +222,12 @@ class FlightRecorder:
         self.restart_events: "collections.deque[tuple]" = collections.deque(
             maxlen=64
         )
+        # fleet shard ownership/failover transitions: ("ownership", owned,
+        # fleet_size) on acquire/release, ("failover", shard, latency_s)
+        # on a dead peer's shard adoption — bounded
+        self.fleet_events: "collections.deque[tuple]" = collections.deque(
+            maxlen=64
+        )
 
     # -- phase stopwatches (span-backed) --------------------------------------
 
@@ -400,6 +406,24 @@ class FlightRecorder:
         m = self.metrics
         if m is not None and hasattr(m, "restart_recovery"):
             m.restart_recovery(kind, n)
+
+    def shard_ownership(self, owned: int, fleet_size: int) -> None:
+        """This fleet member's shard count changed (lease acquired or
+        lost); lands the ownership gauges on the metrics registry."""
+        with self._lock:
+            self.fleet_events.append(("ownership", owned, fleet_size))
+        m = self.metrics
+        if m is not None and hasattr(m, "fleet_ownership"):
+            m.fleet_ownership(owned, fleet_size)
+
+    def shard_failover(self, shard: int, latency_s: float) -> None:
+        """A dead peer's shard adopted (lease expiry -> takeover latency);
+        lands the failover counter + latency histogram."""
+        with self._lock:
+            self.fleet_events.append(("failover", shard, latency_s))
+        m = self.metrics
+        if m is not None and hasattr(m, "fleet_failover"):
+            m.fleet_failover(shard, latency_s)
 
     def end_wave(self, rec: WaveRecord,
                  fallback_reason: str | None = None) -> WaveRecord:

@@ -958,6 +958,314 @@ def run_restart_soak(seed: int = 7, cycles: int = 3, pods_per_cycle: int = 24,
     return report
 
 
+# -- fleet soak: active-active schedulers, kill one, zero double-binds ---------
+
+
+def fleet_schedule(registry: faultinject.FaultRegistry, nodes: int,
+                   outage_start_tick: int, outage_ticks: int) -> None:
+    """The fleet soak's fault ladder: everything the trace soak throws at
+    one scheduler — watch partition, fleet-wide kubelet outage, bind
+    latency + flakes, conflicts, lossy watch — PLUS seeded lease loss on
+    the new `lease.renew` point (a guaranteed renewal-outage burst and one
+    coordination-partition window), all against 2-3 concurrent members.
+    The CRASH-mode peer kill is registered separately mid-soak (aimed by
+    visit count, like the restart storm)."""
+    registry.register(FaultSpec(
+        "watch.partition", mode=PARTITION, start_after=150, window=250,
+        times=1))
+    registry.register(FaultSpec(
+        "kubelet.sync", mode=DROP, start_after=outage_start_tick * nodes,
+        times=outage_ticks * nodes))
+    registry.register(FaultSpec(
+        "store.bind_pod", mode=LATENCY, probability=0.15, times=10,
+        latency_s=0.02))
+    registry.register(FaultSpec(
+        "store.bind_pod", mode=ERROR, transient=True,
+        probability=0.1, times=10, message="bind flake"))
+    registry.register(FaultSpec(
+        "dispatcher.execute", mode=ERROR, transient=True,
+        probability=0.1, times=20, message="dispatcher flake"))
+    registry.register(FaultSpec(
+        "store.update", mode=ERROR, probability=0.05, times=15,
+        exc=ConflictError, message="injected conflict"))
+    registry.register(FaultSpec(
+        "watch.deliver", mode=DROP, probability=0.03, times=30))
+    # seeded lease loss (satellite: lease.renew is FI01-declared): a
+    # guaranteed 4-round renewal outage — whoever's renew lands on those
+    # visits steps down and must reclaim — then one coordination-partition
+    # window where every CAS round inside it is silently lost. Aim low:
+    # the point is visited roughly once per held shard per drive tick
+    # (~3/tick), so high start_after values would never arm.
+    registry.register(FaultSpec(
+        "lease.renew", mode=ERROR, transient=True, start_after=6, times=4,
+        message="coordination flake"))
+    registry.register(FaultSpec(
+        "lease.renew", mode=PARTITION, start_after=18, window=5, times=1))
+
+
+@dataclasses.dataclass
+class FleetSoakReport:
+    seed: int
+    members: int
+    created: int = 0
+    bound: int = 0
+    unbound: int = 0
+    evicted: int = 0
+    double_binds: int = 0
+    leaked_assumes: int = 0
+    queue_pending: int = 0
+    crashes: int = 0
+    failovers: int = 0
+    failover_latency_s: float = 0.0
+    failover_budget_s: float = 30.0
+    shard_adoptions: int = 0
+    ownership_overlap: int = 0
+    lease_renew_faults: int = 0
+    faults_fired: int = 0
+    wall_clock_s: float = 0.0
+    budget_s: float = 120.0
+
+    @property
+    def ok(self) -> bool:
+        return (
+            self.unbound == 0
+            and self.double_binds == 0
+            and self.leaked_assumes == 0
+            and self.queue_pending == 0
+            and self.ownership_overlap == 0
+            # the kill must bite AND a survivor must adopt the orphaned
+            # shard inside the bounded window, counted on the
+            # restart_recoveries{kind="shard_adopt*"} kinds
+            and self.crashes >= 1
+            and self.failovers >= 1
+            and self.failover_latency_s <= self.failover_budget_s
+            and self.shard_adoptions >= 1
+            and self.lease_renew_faults >= 1
+            and self.faults_fired > 0
+            and self.wall_clock_s <= self.budget_s
+        )
+
+    def render(self) -> str:
+        verdict = "PASS" if self.ok else "FAIL"
+        return (
+            f"fleet soak [{verdict}] seed={self.seed} "
+            f"members={self.members}: created={self.created} "
+            f"bound={self.bound} unbound={self.unbound} "
+            f"evicted={self.evicted} double_binds={self.double_binds} "
+            f"leaked_assumes={self.leaked_assumes} "
+            f"queue_pending={self.queue_pending} crashes={self.crashes} "
+            f"failovers={self.failovers} "
+            f"failover_latency_s={self.failover_latency_s:.2f} "
+            f"shard_adoptions={self.shard_adoptions} "
+            f"ownership_overlap={self.ownership_overlap} "
+            f"lease_renew_faults={self.lease_renew_faults} "
+            f"faults_fired={self.faults_fired} "
+            f"wall_clock_s={self.wall_clock_s:.2f} (budget {self.budget_s})"
+        )
+
+
+def run_fleet_soak(seed: int = 7, members: int = 3, rounds: int = 3,
+                   pods_per_round: int = 12, min_count: int = 3,
+                   nodes: int = 12, wave_size: int = 8,
+                   tick_s: float = 0.05, ticks_per_round: int = 5,
+                   grace_period_s: float = 6.0,
+                   outage_start_tick: int = 6, outage_ticks: int = 3,
+                   lease_duration: float = 4.0, kill_round: int = 1,
+                   budget_s: float = 120.0) -> FleetSoakReport:
+    """Active-active fleet under the full chaos ladder (ISSUE 19): 2-3
+    lease-sharded schedulers over ONE store take kubelet death, a watch
+    partition, bind latency/flakes, seeded lease loss, and a CRASH-mode
+    peer kill mid-traffic. The drive loop is single-threaded and
+    fixed-order (arrivals -> kubelets -> lifecycle -> each alive member:
+    elect_once + schedule_pending), so the fault schedule replays
+    deterministically from the seed. Asserted after fault-free
+    convergence: every surviving pod bound EXACTLY once (the store bind
+    path is the double-bind oracle), zero leaked assumes across
+    survivors, disjoint shard ownership, and the kill-one failover
+    adopted the orphaned shard inside the bounded window with recoveries
+    counted on restart_recoveries{kind="shard_adopt*"}. Leaves the
+    registry disarmed + reset."""
+    from ..api.meta import ObjectMeta
+    from ..api.types import GangPolicy, PodGroup, PodGroupSpec
+    from ..controllers.lifecycle import NodeLifecycleController
+    from ..kubelet.hollow import HollowKubelet
+    from ..scheduler import Profile, Scheduler
+    from ..scheduler.fleet import FleetMember
+    from ..scheduler.metrics import SchedulerMetrics
+    from ..utils.faultinject import CRASH, SchedulerCrashed
+    from .wrappers import with_gang
+
+    report = FleetSoakReport(seed=seed, members=members, budget_s=budget_s)
+    # lease expiry + a couple of full drive rounds is the legal adoption
+    # window; anything slower means survivors are not contending
+    report.failover_budget_s = lease_duration + 30.0
+    t_start = time.monotonic()
+    registry = faultinject.registry()
+    registry.reset(seed=seed)
+    fleet_schedule(registry, nodes=nodes,
+                   outage_start_tick=outage_start_tick,
+                   outage_ticks=outage_ticks)
+
+    store = Store()
+
+    # double-bind oracle (same as the restart storm): every SUCCESSFUL
+    # bind lands here; lifecycle evictions DELETE pods (never recreate a
+    # key), so any key with two landed binds is two members both placing
+    # a pod only one of them owned
+    bind_ledger: dict[str, int] = {}
+    orig_bind_pods, orig_bind_pod = store.bind_pods, store.bind_pod
+
+    def ledgered_bind_pods(bindings):
+        out = orig_bind_pods(bindings)
+        for (key, _node), status in zip(bindings, out):
+            if status == "bound":
+                bind_ledger[key] = bind_ledger.get(key, 0) + 1
+        return out
+
+    def ledgered_bind_pod(key, node_name):
+        obj = orig_bind_pod(key, node_name)
+        bind_ledger[key] = bind_ledger.get(key, 0) + 1
+        return obj
+
+    store.bind_pods = ledgered_bind_pods
+    store.bind_pod = ledgered_bind_pod
+
+    kubelets = []
+    for i in range(nodes):
+        node = make_node(f"fn{i}", cpu="16", mem="32Gi", zone=f"z{i % 4}")
+        k = HollowKubelet(store, node)
+        k.register()
+        kubelets.append(k)
+    lifecycle = NodeLifecycleController(store)
+    lifecycle.grace_period = grace_period_s
+    lifecycle.start()
+    lifecycle.sweep()
+
+    fleet: list[FleetMember] = []
+    for i in range(members):
+        sched = Scheduler(
+            store,
+            profiles=[Profile(backend="tpu", wave_size=wave_size)],
+            feature_gates={"GenericWorkload": True,
+                           "SchedulerAsyncAPICalls": True},
+            async_api_calls=True,
+            metrics=SchedulerMetrics(),
+            seed=seed,
+        )
+        sched.queue._initial_backoff = 0.02
+        sched.queue._max_backoff = 0.1
+        member = FleetMember(
+            sched, members, f"scheduler-{i}", preferred_shard=i,
+            lease_duration=lease_duration,
+            renew_deadline=lease_duration * 0.66,
+            retry_period=tick_s,
+        )
+        member.start()
+        fleet.append(member)
+    alive = list(fleet)
+
+    def drive(ticks: int) -> None:
+        for _ in range(ticks):
+            for k in kubelets:
+                k.sync_once()
+            lifecycle.sync_once()
+            for member in list(alive):
+                member.elect_once()
+                try:
+                    member.scheduler.schedule_pending()
+                except SchedulerCrashed:
+                    # the peer kill: ungraceful death — no lease release,
+                    # no drain. Its shard leases now age toward expiry;
+                    # survivors adopt through elect_once.
+                    report.crashes += 1
+                    member.crash()
+                    alive.remove(member)
+            time.sleep(tick_s)
+
+    registry.arm()
+    seq = 0
+    try:
+        for rnd in range(rounds):
+            if rnd == kill_round:
+                # aim a one-shot CRASH just past the visits the fleet has
+                # already spent mid-wave, so the kill lands on live
+                # traffic — whichever member launches that wave dies
+                visits = registry.snapshot()["visits"].get("loop.wave", 0)
+                registry.register(FaultSpec(
+                    "loop.wave", mode=CRASH, times=1,
+                    start_after=visits + 1, message="fleet peer kill"))
+            gang = f"fgang-{rnd}"
+            store.create(PodGroup(
+                meta=ObjectMeta(name=gang),
+                spec=PodGroupSpec(policy=GangPolicy(min_count=min_count)),
+            ))
+            for i in range(min_count):
+                store.create(with_gang(
+                    make_pod(f"{gang}-m{i}", cpu="200m", mem="128Mi"),
+                    gang))
+            for _ in range(pods_per_round):
+                store.create(make_pod(f"fleet-{seq}", cpu="100m",
+                                      mem="64Mi"))
+                seq += 1
+            report.created += min_count + pods_per_round
+            drive(ticks=ticks_per_round)
+    finally:
+        registry.disarm()
+    report.faults_fired = registry.fired_total
+    report.lease_renew_faults = registry.fired_by_point["lease.renew"]
+
+    # fault-free convergence: survivors keep electing (the orphaned
+    # shard's lease expires INSIDE this loop when the kill came late),
+    # kubelets heartbeat again, stranded/backoff/adopted pods bind
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        for k in kubelets:
+            k.sync_once()
+        lifecycle.sync_once()
+        done = True
+        for member in alive:
+            member.elect_once()
+            member.scheduler.schedule_pending()
+            active, backoff, unsched = member.scheduler.queue.pending_pods()
+            if (member.scheduler.cache.assumed_pod_count()
+                    or active + backoff + unsched):
+                done = False
+        owned = set().union(*(m.owned_shards() for m in alive)) if alive else set()
+        pending = [p for p in store.pods() if not p.spec.node_name]
+        if done and not pending and len(owned) == members:
+            break
+        time.sleep(tick_s)
+
+    pods_now = store.pods()
+    report.bound = sum(1 for p in pods_now if p.spec.node_name)
+    report.unbound = len(pods_now) - report.bound
+    report.evicted = report.created - len(pods_now)
+    report.double_binds = sum(1 for n in bind_ledger.values() if n > 1)
+    for member in alive:
+        report.leaked_assumes += member.scheduler.cache.assumed_pod_count()
+        active, backoff, unsched = member.scheduler.queue.pending_pods()
+        report.queue_pending += active + backoff + unsched
+        for kind, n in list(member.scheduler.flight_recorder.restart_events):
+            if kind.startswith("shard_adopt"):
+                report.shard_adoptions += n
+        for ev_ in list(member.scheduler.flight_recorder.fleet_events):
+            if ev_[0] == "failover":
+                report.failovers += 1
+                report.failover_latency_s = max(
+                    report.failover_latency_s, ev_[2])
+    # disjoint ownership: no shard held by two live members
+    seen: dict[int, int] = {}
+    for member in alive:
+        for s in member.owned_shards():
+            seen[s] = seen.get(s, 0) + 1
+    report.ownership_overlap = sum(1 for n in seen.values() if n > 1)
+    for member in alive:
+        member.scheduler.api_dispatcher.close()
+    registry.reset()
+    report.wall_clock_s = time.monotonic() - t_start
+    return report
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -996,6 +1304,17 @@ def main(argv: list[str] | None = None) -> int:
                              "zero) instead of the scale-churn soak")
     parser.add_argument("--cycles", type=int, default=3,
                         help="crash/restart cycles for --restart")
+    parser.add_argument("--fleet", action="store_true",
+                        help="run the fleet soak (2-3 active-active "
+                             "lease-sharded schedulers over one store "
+                             "under kubelet death + watch partition + "
+                             "bind latency + seeded lease loss + a "
+                             "CRASH-mode peer kill; zero double-binds, "
+                             "zero leaked assumes, and kill-one shard "
+                             "adoption asserted) instead of the "
+                             "scale-churn soak")
+    parser.add_argument("--members", type=int, default=3,
+                        help="fleet size for --fleet")
     args = parser.parse_args(argv)
 
     # every soak benefits from the persistent jax compilation cache: the
@@ -1004,7 +1323,12 @@ def main(argv: list[str] | None = None) -> int:
     from ..utils.jaxcache import enable_persistent_cache
     enable_persistent_cache()
 
-    if args.restart:
+    if args.fleet:
+        report = run_fleet_soak(seed=args.seed,
+                                members=max(2, min(args.members, 3)),
+                                nodes=min(args.nodes, 12),
+                                wave_size=min(args.wave_size, 8))
+    elif args.restart:
         report = run_restart_soak(seed=args.seed, cycles=args.cycles,
                                   nodes=min(args.nodes, 16),
                                   wave_size=min(args.wave_size, 8))

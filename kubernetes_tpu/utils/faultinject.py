@@ -92,6 +92,10 @@ FAULT_POINTS = (
     "controller.reconcile",
     "controller.lifecycle",
     "controller.workloads",
+    # one leader-election CAS round (acquire or renew): ERROR/LATENCY model
+    # a flaky or slow coordination write, PARTITION a window where every
+    # renewal is lost — seeded lease loss and renew storms for the fleet
+    "lease.renew",
     # crash points on the main scheduling thread: unlike tpu.* (whose
     # FaultInjected raises are caught locally and wrapped as device
     # flakes) these sit where SchedulerCrashed can propagate cleanly up

@@ -1,0 +1,450 @@
+"""Tests: active-active scheduler fleet (scheduler/fleet.py).
+
+Covers the shard map (stable hashing, gang grouping), the three
+ownership gates (disjoint admission under a barrier-synced concurrent
+drain), kill-one failover inside a bounded window with recoveries
+counted on restart_recoveries{kind="shard_adopt*"}, shard-scoped
+reconcile/adoption, the leader-election renewal-edge regression
+(step down THEN recontend, never silently re-stamp a dead term), and
+the seeded `lease.renew` fault point.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.scheduler import Profile, Scheduler
+from kubernetes_tpu.scheduler.fleet import (
+    FleetMember,
+    install_shard_filter,
+    pod_shard,
+    shard_of,
+)
+from kubernetes_tpu.store import Store
+from kubernetes_tpu.testing import make_node, make_pod, with_gang
+from kubernetes_tpu.utils import faultinject
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def build_store(nodes=8, prefix="ftn"):
+    store = Store()
+    for i in range(nodes):
+        store.create(make_node(f"{prefix}{i}", cpu="16", mem="32Gi",
+                               zone=f"z{i % 2}"))
+    return store
+
+
+def create_pod(store, name, **kw):
+    """Create a pod with uid == name so its shard is computable from the
+    name (the store mints an opaque uid otherwise)."""
+    pod = make_pod(name, **kw)
+    pod.meta.uid = name
+    return store.create(pod)
+
+
+def ledgered(store):
+    """Wrap the store's bind path with the double-bind oracle."""
+    ledger: dict[str, int] = {}
+    orig_bind_pods, orig_bind_pod = store.bind_pods, store.bind_pod
+
+    def bind_pods(bindings):
+        out = orig_bind_pods(bindings)
+        for (key, _node), status in zip(bindings, out):
+            if status == "bound":
+                ledger[key] = ledger.get(key, 0) + 1
+        return out
+
+    def bind_pod(key, node_name):
+        obj = orig_bind_pod(key, node_name)
+        ledger[key] = ledger.get(key, 0) + 1
+        return obj
+
+    store.bind_pods = bind_pods
+    store.bind_pod = bind_pod
+    return ledger
+
+
+class TestShardMap:
+    def test_stable_across_calls_and_instances(self):
+        # blake2b, not builtin hash(): the exact integers below must hold
+        # on every process, host, and PYTHONHASHSEED
+        assert shard_of("default", "a", 3) == shard_of("default", "a", 3)
+        one = [shard_of("default", f"p{i}", 4) for i in range(50)]
+        two = [shard_of("default", f"p{i}", 4) for i in range(50)]
+        assert one == two
+
+    def test_namespace_is_part_of_the_key(self):
+        shards = {shard_of(f"ns{i}", "same-name", 16) for i in range(64)}
+        assert len(shards) > 1
+
+    def test_every_shard_reachable(self):
+        for n in (2, 3, 4):
+            hit = {shard_of("default", f"u{i}", n) for i in range(200)}
+            assert hit == set(range(n))
+
+    def test_fleet_of_one_is_shard_zero(self):
+        assert shard_of("default", "anything", 1) == 0
+        assert shard_of("default", "anything", 0) == 0
+
+    def test_gang_members_share_their_groups_shard(self):
+        a = with_gang(make_pod("ga-0"), "grp")
+        b = with_gang(make_pod("totally-different-name"), "grp")
+        for n in (2, 3, 4):
+            assert pod_shard(a, n) == pod_shard(b, n)
+            assert pod_shard(a, n) == shard_of("default", "group:grp", n)
+
+    def test_solo_pods_hash_their_own_identity(self):
+        p = make_pod("solo")
+        assert pod_shard(p, 4) == shard_of(
+            "default", p.meta.uid or p.meta.name, 4)
+
+
+class TestOwnershipGates:
+    def test_disjoint_ownership_concurrent_drain(self):
+        """Two members drain one store CONCURRENTLY (barrier-synced):
+        every pod binds exactly once, ownership stays disjoint, no
+        member leaks an assume."""
+        store = build_store()
+        ledger = ledgered(store)
+        members = []
+        for i in range(2):
+            s = Scheduler(store, profiles=[Profile()], seed=0)
+            m = FleetMember(s, 2, f"scheduler-{i}", preferred_shard=i,
+                            lease_duration=60.0, retry_period=0.01)
+            m.start()
+            members.append(m)
+        for m in members:
+            m.elect_once()
+        assert members[0].owned_shards() == {0}
+        assert members[1].owned_shards() == {1}
+
+        total = 40
+        for i in range(total):
+            create_pod(store, f"fp-{i}", cpu="100m", mem="64Mi")
+        split = [0, 0]
+        for i in range(total):
+            split[shard_of("default", f"fp-{i}", 2)] += 1
+        assert split[0] > 0 and split[1] > 0
+
+        barrier = threading.Barrier(2)
+        errors = []
+
+        def drain(m):
+            try:
+                barrier.wait(timeout=10)
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    m.scheduler.schedule_pending()
+                    if sum(1 for p in store.pods()
+                           if p.spec.node_name) >= total:
+                        return
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=drain, args=(m,))
+                   for m in members]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+
+        assert sum(1 for p in store.pods() if p.spec.node_name) == total
+        assert len(ledger) == total
+        assert all(n == 1 for n in ledger.values()), "double bind"
+        for m in members:
+            assert m.scheduler.cache.assumed_pod_count() == 0
+        assert members[0].owned_shards() & members[1].owned_shards() == set()
+
+    def test_gates_filter_non_owned_unbound_pods(self):
+        store = build_store()
+        s = Scheduler(store, profiles=[Profile()], seed=0)
+        m = FleetMember(s, 2, "scheduler-0", static_shards={0})
+        m.start()
+        total = 20
+        for i in range(total):
+            create_pod(store, f"fp-{i}", cpu="100m", mem="64Mi")
+        s.schedule_pending()
+        mine = sum(1 for i in range(total)
+                   if shard_of("default", f"fp-{i}", 2) == 0)
+        assert sum(1 for p in store.pods() if p.spec.node_name) == mine
+        # the queue never admitted the other shard's pods
+        active, backoff, unsched = s.queue.pending_pods()
+        assert active + backoff + unsched == 0
+
+    def test_cache_still_mirrors_peer_binds(self):
+        """Bound pods always enter the cache — a peer's bind changes node
+        occupancy this member must score against."""
+        store = build_store(nodes=1)
+        s0 = Scheduler(store, profiles=[Profile()], seed=0)
+        m0 = FleetMember(s0, 2, "scheduler-0", static_shards={0})
+        m0.start()
+        s1 = Scheduler(store, profiles=[Profile()], seed=0)
+        m1 = FleetMember(s1, 2, "scheduler-1", static_shards={1})
+        m1.start()
+        i = 0
+        while shard_of("default", f"peer-{i}", 2) != 1:
+            i += 1
+        create_pod(store, f"peer-{i}", cpu="100m", mem="64Mi")
+        s1.schedule_pending()
+        pod = store.get("Pod", f"default/peer-{i}")
+        assert pod.spec.node_name
+        # member 0 does not own the pod but must see its resources once
+        # its (pull-based) informers drain the bind event
+        s0.informers.pump_all()
+        ninfo = s0.cache.get_node_info(pod.spec.node_name)
+        assert ninfo is not None
+        assert f"default/peer-{i}" in ninfo.pods
+
+
+class TestFailover:
+    def test_kill_one_survivor_adopts_inside_bounded_window(self):
+        clock = FakeClock()
+        store = build_store()
+        ledger = ledgered(store)
+        members = []
+        for i in range(2):
+            s = Scheduler(store, profiles=[Profile()], seed=0)
+            m = FleetMember(s, 2, f"scheduler-{i}", preferred_shard=i,
+                            lease_duration=15.0, renew_deadline=10.0,
+                            retry_period=0.01, clock=clock)
+            m.start()
+            members.append(m)
+        m0, m1 = members
+        assert m0.owned_shards() == {0} and m1.owned_shards() == {1}
+
+        # peer dies ungracefully: no release, lease left on record
+        m0.crash()
+
+        # orphan traffic lands on the dead peer's shard
+        orphans = [i for i in range(40)
+                   if shard_of("default", f"orph-{i}", 2) == 0][:5]
+        for i in orphans:
+            create_pod(store, f"orph-{i}", cpu="100m", mem="64Mi")
+        m1.elect_once()
+        m1.scheduler.schedule_pending()
+        # lease still live: ownership is sticky, orphans stay pending
+        assert m1.owned_shards() == {1}
+        assert all(not store.get("Pod", f"default/orph-{i}").spec.node_name
+                   for i in orphans)
+
+        # lease expires; ONE election round later the survivor owns it
+        clock.step(20.0)
+        m1.elect_once()
+        assert m1.owned_shards() == {0, 1}
+        m1.scheduler.schedule_pending()
+        assert all(store.get("Pod", f"default/orph-{i}").spec.node_name
+                   for i in orphans)
+        assert all(n == 1 for n in ledger.values())
+
+        # counted on restart_recoveries{kind="shard_adopt*"} with the
+        # adoption latency stamped from the dead lease's deadline
+        recorder = m1.scheduler.flight_recorder
+        kinds = [k for k, _ in recorder.restart_events]
+        assert any(k.startswith("shard_adopt") for k in kinds)
+        failovers = [ev for ev in recorder.fleet_events
+                     if ev[0] == "failover"]
+        assert len(failovers) == 1
+        shard, latency = failovers[0][1], failovers[0][2]
+        assert shard == 0
+        # bounded window: expiry was at most the 20s step ago
+        assert 0.0 <= latency <= 20.0
+
+    def test_clean_stop_releases_immediately(self):
+        clock = FakeClock()
+        store = build_store()
+        members = []
+        for i in range(2):
+            s = Scheduler(store, profiles=[Profile()], seed=0)
+            m = FleetMember(s, 2, f"scheduler-{i}", preferred_shard=i,
+                            lease_duration=60.0, retry_period=0.01,
+                            clock=clock)
+            m.start()
+            members.append(m)
+        members[0].stop()
+        # a released lease reads as unclaimed; the survivor is not the
+        # preferred member for shard 0, so it scavenges only after the
+        # grace window (2x lease_duration by default) — never before
+        members[1].elect_once()
+        assert members[1].owned_shards() == {1}
+        clock.step(120.0)
+        members[1].elect_once()
+        assert members[1].owned_shards() == {0, 1}
+
+
+class TestAdoptShard:
+    def test_scoped_reconcile_and_pending_requeue(self):
+        """A second member arriving over an occupied store adopts ONLY
+        its shard: the requeue pass picks up the pending pods the gate
+        had filtered, scoped by the shard predicate."""
+        store = build_store()
+        s0 = Scheduler(store, profiles=[Profile()], seed=0)
+        m0 = FleetMember(s0, 2, "scheduler-0", static_shards={0})
+        m0.start()
+        total = 24
+        for i in range(total):
+            create_pod(store, f"fp-{i}", cpu="100m", mem="64Mi")
+        s0.schedule_pending()
+        shard1 = [i for i in range(total)
+                  if shard_of("default", f"fp-{i}", 2) == 1]
+        assert all(not store.get("Pod", f"default/fp-{i}").spec.node_name
+                   for i in shard1)
+
+        s1 = Scheduler(store, profiles=[Profile()], seed=0)
+        m1 = FleetMember(s1, 2, "scheduler-1", static_shards={1})
+        m1.start()  # static acquisition runs adopt_shard
+        kinds = dict(m1.scheduler.flight_recorder.restart_events)
+        assert kinds.get("shard_acquire_pending") == len(shard1)
+        s1.schedule_pending()
+        assert all(store.get("Pod", f"default/fp-{i}").spec.node_name
+                   for i in shard1)
+        # and member 0's pods were never touched by member 1's queue
+        assert m1.scheduler.cache.assumed_pod_count() == 0
+
+    def test_adopted_gang_reaches_quorum(self):
+        """Regression: adopt_shard must register gang membership in
+        pod_group_states — the admission gate skipped pod_added while a
+        peer owned the shard, and the gang cycle pops siblings from
+        gstate.unscheduled, so an adopted gang could never reach quorum
+        and its attempts failed forever."""
+        from kubernetes_tpu.api.meta import ObjectMeta
+        from kubernetes_tpu.api.types import GangPolicy, PodGroup, PodGroupSpec
+
+        store = build_store()
+        s = Scheduler(store, profiles=[Profile()], seed=0,
+                      feature_gates={"GenericWorkload": True})
+        gname = next(c for c in ("ga", "gb", "gc", "gd", "ge")
+                     if shard_of("default", f"group:{c}", 2) == 1)
+        m = FleetMember(s, 2, "scheduler-0", static_shards={0})
+        m.start()
+        store.create(PodGroup(
+            meta=ObjectMeta(name=gname),
+            spec=PodGroupSpec(policy=GangPolicy(min_count=3))))
+        for i in range(3):
+            store.create(with_gang(
+                make_pod(f"{gname}-m{i}", cpu="200m", mem="128Mi"), gname))
+        s.schedule_pending()  # not the owner: nothing binds
+        assert sum(1 for p in store.pods() if p.spec.node_name) == 0
+
+        m._owned_shards.add(1)  # as _shard_acquired does, before adopting
+        stats = s.adopt_shard(lambda pod: pod_shard(pod, 2) == 1)
+        assert stats["pending"] == 3
+        s.schedule_pending()
+        assert sum(1 for p in store.pods() if p.spec.node_name) == 3
+
+    def test_reconcile_shard_pred_scopes_the_sweeps(self):
+        """reconcile(shard_pred=...) ignores foreign-shard damage: an
+        assumed pod outside the predicate is left for its owner."""
+        store = build_store()
+        s = Scheduler(store, profiles=[Profile()], seed=0)
+        install_shard_filter(s, lambda pod: True)
+        s.start()
+        names = [f"rp-{i}" for i in range(30)]
+        by_shard = {0: [], 1: []}
+        for n in names:
+            by_shard[shard_of("default", n, 2)].append(n)
+        assert by_shard[0] and by_shard[1]
+        for n in (by_shard[0][0], by_shard[1][0]):
+            create_pod(store, n, cpu="100m", mem="64Mi")
+        stats = s.reconcile(
+            shard_pred=lambda pod: pod_shard(pod, 2) == 0,
+            kind_prefix="test_")
+        # only shard-0 state was swept; shard-1's pod untouched
+        assert stats["requeued"] <= 1
+
+
+class TestRenewalEdge:
+    """Satellite regression: a renew that lands after our own deadline
+    must step down FIRST (the owned work halts before the next pop),
+    then contend for a fresh term — never silently re-stamp the dead
+    term's renew_time."""
+
+    def _elector(self, store, clock, events):
+        return LeaderElector(
+            store=store, identity="a", clock=clock,
+            lease_duration=15.0, renew_deadline=10.0, retry_period=2.0,
+            on_started_leading=lambda: events.append("started"),
+            on_stopped_leading=lambda: events.append("stopped"),
+        )
+
+    def test_stale_renew_steps_down_then_recontends(self):
+        store, clock, events = Store(), FakeClock(), []
+        e = self._elector(store, clock, events)
+        assert e.run_once()
+        assert events == ["started"]
+        lease = store.get("Lease", "kube-system/kube-scheduler")
+        transitions_before = lease.spec.lease_transitions
+
+        clock.step(16.0)  # our own lease expired un-renewed
+        assert e.run_once()  # reacquires a FRESH term
+        assert events == ["started", "stopped", "started"]
+        lease = store.get("Lease", "kube-system/kube-scheduler")
+        assert lease.spec.holder_identity == "a"
+        assert lease.spec.lease_transitions == transitions_before + 1
+        assert lease.spec.acquire_time == clock.now()
+
+    def test_live_renew_keeps_the_term(self):
+        store, clock, events = Store(), FakeClock(), []
+        e = self._elector(store, clock, events)
+        assert e.run_once()
+        lease = store.get("Lease", "kube-system/kube-scheduler")
+        acquired = lease.spec.acquire_time
+        clock.step(5.0)  # inside the lease: a plain renew
+        assert e.run_once()
+        assert events == ["started"]
+        lease = store.get("Lease", "kube-system/kube-scheduler")
+        assert lease.spec.acquire_time == acquired
+        assert lease.spec.renew_time == clock.now()
+
+
+class TestLeaseRenewFaultPoint:
+    """Satellite: `lease.renew` is a declared, seeded injection point —
+    one CAS round per visit, so lease loss replays from the seed."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_registry(self):
+        faultinject.registry().reset(seed=0)
+        yield
+        faultinject.registry().reset()
+
+    def test_error_fails_the_round_and_retry_recovers(self):
+        store, clock = Store(), FakeClock()
+        e = LeaderElector(store=store, identity="a", clock=clock,
+                          lease_duration=15.0)
+        r = faultinject.registry()
+        r.register(faultinject.FaultSpec(
+            "lease.renew", mode=faultinject.ERROR, transient=True,
+            times=1, message="coordination flake"))
+        r.arm()
+        assert not e.run_once()  # the flaky round fails closed
+        assert r.fired_by_point["lease.renew"] == 1
+        assert e.run_once()  # next round acquires normally
+        assert store.get(
+            "Lease", "kube-system/kube-scheduler"
+        ).spec.holder_identity == "a"
+
+    def test_partition_window_loses_renewals_until_it_closes(self):
+        store, clock = Store(), FakeClock()
+        e = LeaderElector(store=store, identity="a", clock=clock,
+                          lease_duration=15.0)
+        assert e.run_once()
+        r = faultinject.registry()
+        r.register(faultinject.FaultSpec(
+            "lease.renew", mode=faultinject.PARTITION, window=2, times=1))
+        r.arm()
+        assert not e.run_once()  # renewal lost in the partition
+        assert not e.is_leader()  # a failed round while leading steps down
+        assert not e.run_once()
+        assert e.run_once()  # window closed: reclaim our on-record lease
+
+    def test_crash_mode_rips_through(self):
+        store, clock = Store(), FakeClock()
+        e = LeaderElector(store=store, identity="a", clock=clock)
+        r = faultinject.registry()
+        r.register(faultinject.FaultSpec(
+            "lease.renew", mode=faultinject.CRASH, times=1))
+        r.arm()
+        with pytest.raises(faultinject.SchedulerCrashed):
+            e.run_once()

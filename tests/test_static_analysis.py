@@ -14,6 +14,7 @@ from pathlib import Path
 from kubernetes_tpu.analysis import (
     CrashStateChecker,
     FaultPointChecker,
+    FleetStateChecker,
     JitPurityChecker,
     LedgerSeriesChecker,
     LockDisciplineChecker,
@@ -1741,6 +1742,80 @@ def reconcile(cache):
         assert list(CrashStateChecker().check_project(PKG)) == []
 
 
+# ---------------------------------------------------------------- FLEET01
+
+
+FLEET_DECL_SRC = """\
+FLEET_SHARD_STATE = (
+    ("_owned_shards", "scheduler/fleet.py"),
+    ("shard_filter", "scheduler/fleet.py"),
+)
+"""
+
+
+def write_fleet_tree(root, caller_src, caller="scheduler/plugins/rogue.py",
+                     decl=FLEET_DECL_SRC):
+    p = root / "scheduler/fleet.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(decl)
+    c = root / caller
+    c.parent.mkdir(parents=True, exist_ok=True)
+    c.write_text(textwrap.dedent(caller_src))
+    return root
+
+
+class TestFleetState:
+    def test_outside_assignment_flagged(self, tmp_path):
+        write_fleet_tree(tmp_path, """
+            def hijack(scheduler):
+                scheduler.shard_filter = None
+        """)
+        fs = list(FleetStateChecker().check_project(tmp_path))
+        assert rules(fs) == ["FLEET01"]
+        assert "shard_filter" in fs[0].message
+
+    def test_outside_mutator_call_flagged(self, tmp_path):
+        write_fleet_tree(tmp_path, """
+            def hijack(member):
+                member._owned_shards.add(0)
+        """)
+        fs = list(FleetStateChecker().check_project(tmp_path))
+        assert rules(fs) == ["FLEET01"]
+        assert "_owned_shards" in fs[0].message
+
+    def test_reads_stay_free(self, tmp_path):
+        write_fleet_tree(tmp_path, """
+            def gate(scheduler, pod):
+                sf = scheduler.shard_filter
+                return sf is None or sf(pod)
+        """)
+        assert list(FleetStateChecker().check_project(tmp_path)) == []
+
+    def test_declaring_module_exempt(self, tmp_path):
+        write_fleet_tree(tmp_path, "x = 1\n", decl=FLEET_DECL_SRC + """
+
+def install(scheduler, pred):
+    scheduler.shard_filter = pred
+""")
+        assert list(FleetStateChecker().check_project(tmp_path)) == []
+
+    def test_partial_tree_is_silent(self, tmp_path):
+        # fixture dirs without the declaration file can't be cross-checked
+        assert list(FleetStateChecker().check_project(tmp_path)) == []
+
+    def test_unparseable_declaration_flagged(self, tmp_path):
+        write_fleet_tree(tmp_path, "x = 1\n",
+                         decl="FLEET_SHARD_STATE = tuple(derive())\n")
+        fs = list(FleetStateChecker().check_project(tmp_path))
+        assert rules(fs) == ["FLEET01"]
+        assert "literal" in fs[0].message
+
+    def test_repo_fleet_state_writers_sanctioned(self):
+        """Every write to fleet shard-ownership state in the shipped tree
+        lives in scheduler/fleet.py."""
+        assert list(FleetStateChecker().check_project(PKG)) == []
+
+
 # -------------------------------------------------------------- CLI + repo
 
 
@@ -1762,8 +1837,8 @@ class TestCli:
         out = capsys.readouterr().out
         for rule in ("JIT01", "JIT02", "JIT03", "JIT04", "LOCK01", "LOCK02",
                      "LOCK03", "SNAP01", "REG01", "REG02", "SIG01", "SIG02",
-                     "PIPE01", "OBS01", "RET01", "CRASH01", "LINT00",
-                     "EFF01", "EFF02", "LOCK05", "RNG01", "LINT02"):
+                     "PIPE01", "OBS01", "RET01", "CRASH01", "FLEET01",
+                     "LINT00", "EFF01", "EFF02", "LOCK05", "RNG01", "LINT02"):
             assert rule in out
 
     def test_rule_ids_documented_in_readme(self):
